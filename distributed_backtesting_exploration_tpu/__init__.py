@@ -1,0 +1,27 @@
+"""TPU-native distributed backtesting framework.
+
+A brand-new framework with the capabilities of
+``brendisurfs/Distributed-Backtesting-Exploration`` (the reference), re-designed
+TPU-first:
+
+- The reference's compute slot — a ``sleep(1s)`` stub per job
+  (reference ``src/worker/process.rs:13-29``) — is here a fused ``jit``+``vmap``
+  JAX backtest engine running indicator construction (rolling SMA/std/OLS) and
+  the strategy-signal/PnL state machine over a (ticker x parameter-set) grid.
+- The reference's distribution shell — a gRPC dispatcher handing out OHLC jobs
+  sized by advertised core count with peer-liveness pruning (reference
+  ``src/server/main.rs``) — is here a dispatcher with per-TPU-chip batching,
+  job leases with re-queue, a journaled (crash-durable) queue, and a native C++
+  runtime core (scheduler / bounded queues / journal / OHLC decoder).
+- Multi-chip scaling is expressed with ``jax.sharding.Mesh`` + ``shard_map``
+  and XLA collectives over ICI, not sockets; multi-host job-level data
+  parallelism keeps the gRPC contract over DCN.
+
+Import alias convention used throughout the docs and tests::
+
+    import distributed_backtesting_exploration_tpu as dbx
+"""
+
+__version__ = "0.1.0"
+
+from . import ops, models, parallel, utils  # noqa: F401

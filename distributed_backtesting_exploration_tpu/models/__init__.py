@@ -1,0 +1,9 @@
+"""Strategy families (the framework's "model zoo").
+
+Each strategy maps OHLCV arrays + a parameter set to a position series; the
+sweep engine vmaps it over (ticker x parameter) grids. See ``models.base`` for
+the Strategy API and the registry.
+"""
+
+from .base import Strategy, register, get_strategy, available_strategies  # noqa: F401
+from . import sma_crossover, bollinger, momentum  # noqa: F401

@@ -1,0 +1,66 @@
+"""Strategy API and registry.
+
+A strategy is a pure function from (OHLCV arrays, scalar parameter set) to a
+position series ``(T,)`` in ``[-1, 1]`` — the seam the sweep engine vmaps over
+(ticker x param) grids. The reference has no strategy layer at all
+(reference ``README.md:84`` "No actual backtesting strategies are implemented");
+this registry is the slot its sleep stub reserved.
+
+Stateful strategies (hysteresis/hold-until-exit) run their tiny per-bar state
+machine with ``lax.scan`` *inside* ``positions``; indicator math stays in the
+vectorized rolling ops. Path-free strategies are pure elementwise transforms.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Mapping
+
+import jax
+
+Array = jax.Array
+ParamSet = Mapping[str, Array]  # scalar leaves (possibly traced) keyed by name
+
+
+@dataclasses.dataclass(frozen=True)
+class Strategy:
+    """A named, registrable strategy.
+
+    Attributes:
+        name: registry key (also the wire-level strategy id in JobSpec).
+        param_fields: ordered names of the scalar parameters it consumes.
+        positions_fn: ``(ohlcv, params) -> (T,)`` target-exposure series.
+        stateful: True if positions carry path dependence (uses lax.scan).
+    """
+
+    name: str
+    param_fields: tuple[str, ...]
+    positions_fn: Callable[[object, ParamSet], Array]
+    stateful: bool = False
+
+    def positions(self, ohlcv, params: ParamSet) -> Array:
+        missing = [f for f in self.param_fields if f not in params]
+        if missing:
+            raise KeyError(f"strategy {self.name!r} missing params {missing}")
+        return self.positions_fn(ohlcv, params)
+
+
+_REGISTRY: dict[str, Strategy] = {}
+
+
+def register(strategy: Strategy) -> Strategy:
+    """Register a strategy under its name (last registration wins)."""
+    _REGISTRY[strategy.name] = strategy
+    return strategy
+
+
+def get_strategy(name: str) -> Strategy:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown strategy {name!r}; available: {sorted(_REGISTRY)}") from None
+
+
+def available_strategies() -> list[str]:
+    return sorted(_REGISTRY)

@@ -1,0 +1,71 @@
+"""Bollinger-band mean-reversion (stateful) and band-touch (path-free).
+
+``BASELINE.json`` configs[2]: 500 tickers x 1k (window, sigma) grid.
+
+``bollinger`` is the classic hysteresis machine — enter long when the z-score
+drops below ``-k``, enter short above ``+k``, hold until the price re-crosses
+the rolling mean — so the position depends on its own past: a genuine
+``lax.scan`` over bars with a one-scalar carry per (ticker, param) lane.
+
+``bollinger_touch`` is the path-free variant (exposure = which band you are
+currently outside of), used where prefix-engine throughput matters more than
+the hold-until-exit semantics.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import rolling
+from .base import Strategy, register
+
+
+def _z_and_valid(ohlcv, params):
+    close = ohlcv.close
+    z = rolling.rolling_zscore(close, params["window"], fill=0.0)
+    valid = rolling.valid_mask(close.shape[-1], params["window"])
+    return z, valid
+
+
+def _touch_positions(ohlcv, params):
+    z, valid = _z_and_valid(ohlcv, params)
+    k = params["k"]
+    pos = jnp.where(z < -k, 1.0, jnp.where(z > k, -1.0, 0.0))
+    return jnp.where(valid, pos, 0.0)
+
+
+def _mr_positions(ohlcv, params):
+    z, valid = _z_and_valid(ohlcv, params)
+    k = params["k"]
+
+    def step(pos, inp):
+        z_t, valid_t = inp
+        entered = jnp.where(z_t < -k, 1.0, jnp.where(z_t > k, -1.0, 0.0))
+        # exit when price re-crosses the rolling mean, in the held direction
+        exit_long = (pos > 0) & (z_t >= 0)
+        exit_short = (pos < 0) & (z_t <= 0)
+        held = jnp.where(exit_long | exit_short, 0.0, pos)
+        nxt = jnp.where(pos == 0, entered, held)
+        nxt = jnp.where(valid_t, nxt, 0.0)
+        return nxt, nxt
+
+    xs = (jnp.moveaxis(z, -1, 0), jnp.moveaxis(
+        jnp.broadcast_to(valid, z.shape), -1, 0))
+    _, pos_tmajor = jax.lax.scan(step, jnp.zeros(z.shape[:-1]), xs, unroll=8)
+    return jnp.moveaxis(pos_tmajor, 0, -1)
+
+
+BOLLINGER = register(Strategy(
+    name="bollinger",
+    param_fields=("window", "k"),
+    positions_fn=_mr_positions,
+    stateful=True,
+))
+
+BOLLINGER_TOUCH = register(Strategy(
+    name="bollinger_touch",
+    param_fields=("window", "k"),
+    positions_fn=_touch_positions,
+    stateful=False,
+))

@@ -1,0 +1,26 @@
+"""Time-series momentum (path-free): sign of the trailing ``lookback`` return."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops import rolling
+from .base import Strategy, register
+
+
+def _positions(ohlcv, params):
+    close = ohlcv.close
+    lb = params["lookback"]
+    T = close.shape[-1]
+    idx = jnp.arange(T) - jnp.asarray(lb)
+    past = jnp.take(close, jnp.clip(idx, 0, T - 1).astype(jnp.int32), axis=-1)
+    valid = rolling.valid_mask(T, jnp.asarray(lb) + 1)
+    return jnp.where(valid, jnp.sign(close - past), 0.0)
+
+
+MOMENTUM = register(Strategy(
+    name="momentum",
+    param_fields=("lookback",),
+    positions_fn=_positions,
+    stateful=False,
+))

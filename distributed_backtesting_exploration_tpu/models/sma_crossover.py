@@ -1,0 +1,32 @@
+"""SMA-crossover strategy (path-free).
+
+The canonical sweep workload (``BASELINE.json`` configs[0] and [1], and the
+north-star benchmark: a 500-ticker SMA-crossover sweep over 5y of daily bars).
+Long when the fast SMA is above the slow SMA, short when below, flat during
+warmup. Because the position is a pure function of the two SMAs at bar ``t``,
+this runs entirely on the vectorized prefix engine — no scan.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops import rolling
+from .base import Strategy, register
+
+
+def _positions(ohlcv, params):
+    close = ohlcv.close
+    fast = rolling.rolling_mean(close, params["fast"], fill=0.0)
+    slow = rolling.rolling_mean(close, params["slow"], fill=0.0)
+    valid = rolling.valid_mask(close.shape[-1], params["slow"]) & \
+        rolling.valid_mask(close.shape[-1], params["fast"])
+    return jnp.where(valid, jnp.sign(fast - slow), 0.0)
+
+
+SMA_CROSSOVER = register(Strategy(
+    name="sma_crossover",
+    param_fields=("fast", "slow"),
+    positions_fn=_positions,
+    stateful=False,
+))

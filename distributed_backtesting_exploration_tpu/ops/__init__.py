@@ -1,0 +1,38 @@
+"""Core TPU compute ops: rolling indicators, PnL engines, performance metrics.
+
+These are the building blocks that replace the reference's compute stub
+(reference ``src/worker/process.rs:21-25`` — a serial sleep loop). Everything
+here is pure JAX, static-shaped, and safe under ``jit``/``vmap``/``shard_map``.
+The time axis is always the **last** axis so that it maps onto TPU lanes.
+"""
+
+from .rolling import (  # noqa: F401
+    rolling_sum,
+    rolling_mean,
+    rolling_std,
+    rolling_var,
+    rolling_ols,
+    rolling_zscore,
+    ema,
+    rolling_max,
+    rolling_min,
+    valid_mask,
+)
+from .pnl import (  # noqa: F401
+    simple_returns,
+    log_returns,
+    backtest_prefix,
+    backtest_scan,
+    BacktestResult,
+)
+from .metrics import (  # noqa: F401
+    sharpe,
+    sortino,
+    max_drawdown,
+    total_return,
+    cagr,
+    hit_rate,
+    n_trades,
+    summary_metrics,
+    Metrics,
+)

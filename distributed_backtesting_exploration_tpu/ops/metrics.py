@@ -1,0 +1,132 @@
+"""Performance metrics over backtest return/equity series.
+
+The reference records only a completion bit per job and ignores the result
+payload entirely (reference ``src/server/main.rs:66-78`` — ``CompleteRequest.data``
+is never read). Here completions carry real metrics, computed on-device as
+fused reductions over the ``(ticker, param)`` grid so that only a few scalars
+per backtest ever leave the TPU.
+
+All metrics reduce over the trailing time axis and support an optional
+boolean ``mask`` (e.g. to exclude indicator warmup bars) implemented as
+weighted reductions — no dynamic shapes.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class Metrics(NamedTuple):
+    """Scalar (per-series) performance summary; each field is ``(...)``."""
+
+    sharpe: Array
+    sortino: Array
+    max_drawdown: Array
+    total_return: Array
+    cagr: Array
+    volatility: Array
+    hit_rate: Array
+    n_trades: Array
+    turnover: Array
+
+
+def _masked_moments(x: Array, mask, ddof: int = 0):
+    if mask is None:
+        n = jnp.asarray(x.shape[-1], x.dtype)
+        s1 = jnp.sum(x, axis=-1)
+        s2 = jnp.sum(x * x, axis=-1)
+    else:
+        m = mask.astype(x.dtype)
+        n = jnp.sum(m, axis=-1)
+        s1 = jnp.sum(x * m, axis=-1)
+        s2 = jnp.sum(x * x * m, axis=-1)
+    mean = s1 / jnp.maximum(n, 1.0)
+    var = jnp.maximum(s2 / jnp.maximum(n, 1.0) - mean * mean, 0.0)
+    if ddof:
+        var = var * n / jnp.maximum(n - ddof, 1.0)
+    return mean, jnp.sqrt(var), n
+
+
+def sharpe(returns: Array, *, periods_per_year: int = 252, mask=None,
+           eps: float = 1e-12) -> Array:
+    """Annualized Sharpe ratio of per-bar returns (risk-free = 0)."""
+    mean, std, _ = _masked_moments(returns, mask)
+    return mean / (std + eps) * jnp.sqrt(jnp.asarray(periods_per_year, returns.dtype))
+
+
+def sortino(returns: Array, *, periods_per_year: int = 252, mask=None,
+            eps: float = 1e-12) -> Array:
+    """Annualized Sortino ratio: mean over downside deviation."""
+    m = jnp.ones_like(returns) if mask is None else mask.astype(returns.dtype)
+    n = jnp.maximum(jnp.sum(m, axis=-1), 1.0)
+    mean = jnp.sum(returns * m, axis=-1) / n
+    downside = jnp.minimum(returns, 0.0) * m
+    dstd = jnp.sqrt(jnp.sum(downside * downside, axis=-1) / n)
+    return mean / (dstd + eps) * jnp.sqrt(jnp.asarray(periods_per_year, returns.dtype))
+
+
+def max_drawdown(equity: Array) -> Array:
+    """Max peak-to-trough drawdown fraction of an equity curve (>= 0)."""
+    peak = jax.lax.associative_scan(jnp.maximum, equity, axis=-1)
+    dd = (peak - equity) / jnp.maximum(peak, 1e-12)
+    return jnp.max(dd, axis=-1)
+
+
+def total_return(equity: Array) -> Array:
+    """Final equity over the implicit starting equity of 1.0, minus 1."""
+    return equity[..., -1] - 1.0
+
+
+def cagr(equity: Array, *, periods_per_year: int = 252, mask=None) -> Array:
+    """Compound annual growth rate implied by the final equity value."""
+    T = equity.shape[-1]
+    n = jnp.asarray(T, equity.dtype) if mask is None else jnp.sum(
+        mask.astype(equity.dtype), axis=-1)
+    years = jnp.maximum(n / periods_per_year, 1e-12)
+    final = jnp.maximum(equity[..., -1], 1e-12)
+    return jnp.power(final, 1.0 / years) - 1.0
+
+
+def hit_rate(returns: Array, positions: Array, *, eps: float = 1e-12) -> Array:
+    """Fraction of bars with positive net return, among bars with exposure."""
+    active = (jnp.abs(_lagged_abs(positions)) > 0).astype(returns.dtype)
+    wins = (returns > 0).astype(returns.dtype) * active
+    return jnp.sum(wins, axis=-1) / (jnp.sum(active, axis=-1) + eps)
+
+
+def _lagged_abs(positions: Array) -> Array:
+    return jnp.concatenate(
+        [jnp.zeros_like(positions[..., :1]), positions[..., :-1]], axis=-1)
+
+
+def turnover_total(positions: Array) -> Array:
+    """Total absolute position change (round-trip trade = 2.0 for unit size)."""
+    prev = _lagged_abs(positions)
+    return jnp.sum(jnp.abs(positions - prev), axis=-1)
+
+
+def n_trades(positions: Array) -> Array:
+    """Approximate round-trip trade count: total turnover / 2."""
+    return 0.5 * turnover_total(positions)
+
+
+def summary_metrics(returns: Array, equity: Array, positions: Array, *,
+                    periods_per_year: int = 252, mask=None) -> Metrics:
+    """All metrics in one fused pass; this is the standard job result payload."""
+    return Metrics(
+        sharpe=sharpe(returns, periods_per_year=periods_per_year, mask=mask),
+        sortino=sortino(returns, periods_per_year=periods_per_year, mask=mask),
+        max_drawdown=max_drawdown(equity),
+        total_return=total_return(equity),
+        cagr=cagr(equity, periods_per_year=periods_per_year, mask=mask),
+        volatility=_masked_moments(returns, mask)[1]
+        * jnp.sqrt(jnp.asarray(periods_per_year, returns.dtype)),
+        hit_rate=hit_rate(returns, positions),
+        n_trades=n_trades(positions),
+        turnover=turnover_total(positions),
+    )

@@ -1,0 +1,116 @@
+"""PnL engines: the strategy-signal -> position -> returns state machine.
+
+The reference's compute slot processes a job batch serially with a 1-second
+sleep per job (reference ``src/worker/process.rs:21-25``); its intended
+replacement is "the strategy-signal/PnL state machine as a single jit+vmap
+kernel" (``BASELINE.json`` north_star). Two engines are provided:
+
+- :func:`backtest_prefix` — for **path-free** strategies, where the position at
+  bar ``t`` is a pure function of indicators at ``t`` (SMA crossover,
+  momentum, band-touch). Pure fused elementwise/cumsum work, no sequential
+  dependency: the whole (ticker x param x time) block is one VPU pass. This is
+  the fast path that makes millions of backtests/sec possible.
+- :func:`backtest_scan` — for **stateful** strategies with hysteresis (hold
+  until exit: Bollinger mean-reversion, pairs z-score entry/exit, stops).
+  The per-bar state machine runs under ``jax.lax.scan`` with a tiny carry;
+  all parameter/ticker lanes advance in lockstep per step, so the scan is
+  sequential in T only — exactly the "lax.scan whose carry stays small"
+  design called for in SURVEY.md section 7.
+
+Conventions:
+
+- Time is the last axis, shape ``(..., T)``.
+- ``positions[t]`` is the target exposure *decided at the close of bar t*; it
+  earns ``returns[t+1]``. Transaction cost is charged on ``|delta position|``.
+- Warmup bars must carry position 0 (strategies multiply by
+  :func:`~..ops.rolling.valid_mask`), never NaN.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class BacktestResult(NamedTuple):
+    """Per-bar outputs of a backtest, each shaped ``(..., T)``."""
+
+    returns: Array    # net strategy simple returns per bar
+    equity: Array     # equity curve, starts at 1.0 implicitly before bar 0
+    positions: Array  # target exposure per bar (echo of the input)
+
+
+def simple_returns(close: Array) -> Array:
+    """Per-bar simple returns ``close[t]/close[t-1] - 1``; ``r[0] = 0``."""
+    prev = jnp.concatenate([close[..., :1], close[..., :-1]], axis=-1)
+    return close / prev - 1.0
+
+
+def log_returns(close: Array) -> Array:
+    """Per-bar log returns; ``r[0] = 0``."""
+    prev = jnp.concatenate([close[..., :1], close[..., :-1]], axis=-1)
+    return jnp.log(close) - jnp.log(prev)
+
+
+def _lagged(x: Array) -> Array:
+    """``x[t-1]`` with 0 at ``t=0``."""
+    return jnp.concatenate([jnp.zeros_like(x[..., :1]), x[..., :-1]], axis=-1)
+
+
+def backtest_prefix(
+    close: Array,
+    positions: Array,
+    *,
+    cost: float | Array = 0.0,
+    compound: bool = False,
+) -> BacktestResult:
+    """Vectorized PnL for path-free position series.
+
+    ``net[t] = positions[t-1] * r[t] - cost * |positions[t] - positions[t-1]|``
+
+    with ``r`` the simple returns of ``close``. Broadcasts: ``close`` may be
+    ``(T,)`` or ``(tickers, T)`` while ``positions`` is ``(params, ..., T)``.
+
+    ``compound=False`` (default) gives an additive equity curve ``1 + cumsum``
+    — a pure prefix-sum on the VPU; ``compound=True`` compounds via
+    ``exp(cumsum(log1p))``.
+    """
+    r = simple_returns(close)
+    prev_pos = _lagged(positions)
+    turnover = jnp.abs(positions - prev_pos)
+    net = prev_pos * r - jnp.asarray(cost, r.dtype) * turnover
+    if compound:
+        equity = jnp.exp(jnp.cumsum(jnp.log1p(net), axis=-1))
+    else:
+        equity = 1.0 + jnp.cumsum(net, axis=-1)
+    return BacktestResult(returns=net, equity=equity, positions=positions)
+
+
+def backtest_scan(
+    step: Callable,
+    init_carry,
+    inputs,
+    close: Array,
+    *,
+    cost: float | Array = 0.0,
+    compound: bool = False,
+    unroll: int = 8,
+) -> BacktestResult:
+    """Stateful engine: run ``step`` over bars with ``lax.scan``, then price it.
+
+    ``step(carry, inputs_t) -> (carry, position_t)`` is the per-bar state
+    machine. ``inputs`` is a pytree of precomputed indicator arrays with time
+    on the **last** axis (they are transposed to scan order here and back);
+    indicator math itself stays in the vectorized rolling ops — only the tiny
+    hysteresis state lives in the scan carry.
+
+    ``unroll`` trades compile time for fewer loop iterations on TPU.
+    """
+    xs = jax.tree_util.tree_map(lambda a: jnp.moveaxis(a, -1, 0), inputs)
+    _, pos_tmajor = jax.lax.scan(step, init_carry, xs, unroll=unroll)
+    positions = jnp.moveaxis(pos_tmajor, 0, -1)
+    return backtest_prefix(close, positions, cost=cost, compound=compound)

@@ -1,0 +1,204 @@
+"""Rolling-window indicators as O(T) cumulative-sum kernels.
+
+The reference never implements any indicator math (its compute path is a sleep
+stub, reference ``src/worker/process.rs:21-25``); its north-star replacement is
+"indicator construction (rolling SMA/std, rolling OLS)" run as fused jit+vmap
+kernels (``BASELINE.json`` north_star). This module is that indicator layer.
+
+Design notes (TPU-first):
+
+- **Time is the last axis.** Arrays are ``(..., T)`` so the bar-time axis lands
+  on TPU lanes (128-wide) and every op below is a fused VPU elementwise pass.
+- **O(T) via cumulative sums**, not O(T*W) via explicit windows: a rolling sum
+  over window ``w`` is ``cs[t] - cs[t-w]`` on the inclusive prefix sum. The
+  shifted read uses a clipped ``take`` so that ``w`` may be a *traced* scalar —
+  this is what lets a parameter sweep ``vmap`` over thousands of window lengths
+  without recompilation or dynamic shapes.
+- **Numerical stability in f32**: variance via ``E[x^2] - E[x]^2`` on raw
+  price levels (~1e2) catastrophically cancels in float32. All second-moment
+  ops first subtract the per-series mean (a constant shift changes neither
+  variance nor covariance); means are shifted back where needed.
+- Warmup positions ``t < w-1`` are invalid. Ops return them filled with
+  ``fill`` (default NaN) and :func:`valid_mask` gives the boolean mask; PnL
+  code multiplies positions by the mask instead of branching.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _shifted(cs: Array, w, *, fill=0.0) -> Array:
+    """Return ``cs[..., t - w]`` along the last axis, ``fill`` where ``t < w``.
+
+    ``w`` may be a Python int or a traced scalar. Implemented with a clipped
+    gather so the shape stays static under ``vmap`` over ``w``.
+    """
+    T = cs.shape[-1]
+    idx = jnp.arange(T) - jnp.asarray(w)
+    gather_idx = jnp.clip(idx, 0, T - 1).astype(jnp.int32)
+    taken = jnp.take(cs, gather_idx, axis=-1)
+    return jnp.where(idx >= 0, taken, fill)
+
+
+def valid_mask(T: int, window) -> Array:
+    """Boolean ``(T,)`` mask: True where a ``window``-bar indicator is defined.
+
+    Broadcasts against any ``(..., T)`` indicator array.
+    """
+    return jnp.arange(T) >= window - 1
+
+
+def rolling_sum(x: Array, window, *, fill=jnp.nan) -> Array:
+    """Rolling sum over the trailing ``window`` bars (inclusive), same length.
+
+    ``out[..., t] = sum(x[..., t-window+1 : t+1])``; warmup -> ``fill``.
+    """
+    cs = jnp.cumsum(x, axis=-1)
+    out = cs - _shifted(cs, window)
+    return _mask_warmup(out, window, fill)
+
+
+def _mask_warmup(out: Array, window, fill) -> Array:
+    T = out.shape[-1]
+    return jnp.where(valid_mask(T, window), out, fill)
+
+
+def rolling_mean(x: Array, window, *, fill=jnp.nan) -> Array:
+    """Rolling mean (SMA) over the trailing ``window`` bars."""
+    return rolling_sum(x, window, fill=fill) / jnp.asarray(window, x.dtype)
+
+
+def _centered(x: Array) -> Array:
+    # Constant per-series shift: preserves variances/covariances, kills the
+    # float32 cancellation between E[x^2] and E[x]^2 for price-level inputs.
+    return x - jnp.mean(x, axis=-1, keepdims=True)
+
+
+def rolling_var(x: Array, window, *, ddof: int = 0, fill=jnp.nan) -> Array:
+    """Rolling population (ddof=0) or sample (ddof=1) variance."""
+    xc = _centered(x)
+    w = jnp.asarray(window, x.dtype)
+    s1 = rolling_sum(xc, window, fill=jnp.nan)
+    s2 = rolling_sum(xc * xc, window, fill=jnp.nan)
+    var = (s2 - s1 * s1 / w) / (w - ddof)
+    var = jnp.maximum(var, 0.0)  # clamp tiny negative f32 residue
+    return _mask_warmup(var, window, fill)
+
+
+def rolling_std(x: Array, window, *, ddof: int = 0, fill=jnp.nan) -> Array:
+    """Rolling standard deviation."""
+    return jnp.sqrt(rolling_var(x, window, ddof=ddof, fill=fill))
+
+
+def rolling_zscore(x: Array, window, *, ddof: int = 0, eps=1e-12,
+                   fill=jnp.nan) -> Array:
+    """``(x - rolling_mean) / rolling_std`` — the Bollinger/pairs entry signal."""
+    m = rolling_mean(x, window, fill=jnp.nan)
+    s = rolling_std(x, window, ddof=ddof, fill=jnp.nan)
+    z = (x - m) / (s + eps)
+    return _mask_warmup(z, window, fill)
+
+
+def rolling_ols(y: Array, x: Array, window, *, eps=1e-12, fill=jnp.nan):
+    """Rolling ordinary least squares of ``y`` on ``x`` (with intercept).
+
+    Closed form from windowed moments (all O(T) cumsum differences)::
+
+        beta_t  = cov_w(x, y) / var_w(x)
+        alpha_t = mean_w(y) - beta_t * mean_w(x)
+
+    Returns ``(alpha, beta)``, each shaped like ``y``. This is the
+    linear-regression kernel behind the pairs-trade config
+    (``BASELINE.json`` configs[3]).
+    """
+    w = jnp.asarray(window, y.dtype)
+    mx = jnp.mean(x, axis=-1, keepdims=True)
+    my = jnp.mean(y, axis=-1, keepdims=True)
+    xc, yc = x - mx, y - my
+
+    sx = rolling_sum(xc, window, fill=jnp.nan)
+    sy = rolling_sum(yc, window, fill=jnp.nan)
+    sxx = rolling_sum(xc * xc, window, fill=jnp.nan)
+    sxy = rolling_sum(xc * yc, window, fill=jnp.nan)
+
+    cov = sxy - sx * sy / w
+    var = jnp.maximum(sxx - sx * sx / w, 0.0)
+    beta = cov / (var + eps)
+    # Means of the *uncentered* series: mean_w(x) = sx/w + mx.
+    alpha = (sy / w + my) - beta * (sx / w + mx)
+    return _mask_warmup(alpha, window, fill), _mask_warmup(beta, window, fill)
+
+
+def ema(x: Array, *, span=None, alpha=None, fill=None) -> Array:
+    """Exponential moving average via a parallel (associative) scan.
+
+    ``y[t] = (1-a) * y[t-1] + a * x[t]``, ``y[0] = x[0]``, with
+    ``a = 2/(span+1)`` when ``span`` is given. A first-order linear recurrence
+    is associative under ``(A2,B2) o (A1,B1) = (A1*A2, A2*B1 + B2)``, so XLA
+    evaluates it in O(log T) depth on the VPU instead of a serial loop —
+    the TPU-idiomatic replacement for a per-bar Python loop.
+
+    ``span``/``alpha`` may be traced scalars (vmap over decay grids).
+    """
+    if (span is None) == (alpha is None):
+        raise ValueError("pass exactly one of span= or alpha=")
+    if alpha is None:
+        alpha = 2.0 / (jnp.asarray(span, x.dtype) + 1.0)
+    a = jnp.broadcast_to(jnp.asarray(1.0 - alpha, x.dtype), x.shape)
+    b = x * alpha
+    # y[0] = x[0] exactly: make the first element's recurrence y0 = 0*prev + x0.
+    t0 = jnp.arange(x.shape[-1]) == 0
+    a = jnp.where(t0, 0.0, a)
+    b = jnp.where(t0, x, b)
+
+    def combine(left, right):
+        a1, b1 = left
+        a2, b2 = right
+        return a1 * a2, a2 * b1 + b2
+
+    _, y = jax.lax.associative_scan(combine, (a, b), axis=-1)
+    return y
+
+
+def _static_window(window, name: str) -> int:
+    if not isinstance(window, (int,)):
+        raise TypeError(
+            f"{name} requires a static (Python int) window; got {type(window)}. "
+            "Rolling extrema have no cumsum form — sweep windows with a Python "
+            "loop / jnp.stack over static values instead of vmap."
+        )
+    return int(window)
+
+
+def rolling_max(x: Array, window, *, fill=jnp.nan) -> Array:
+    """Rolling max over trailing ``window`` bars (static window).
+
+    Doubling trick: O(T log W) fused elementwise maxes, no gather loops —
+    the Donchian-channel building block.
+    """
+    w = _static_window(window, "rolling_max")
+    out = x
+    span = 1  # out[t] currently covers x[t-span+1 .. t]
+    while span < w:
+        step = min(span, w - span)
+        out = jnp.maximum(out, _shifted(out, step, fill=-jnp.inf))
+        span += step
+    return _mask_warmup(out, w, fill)
+
+
+def rolling_min(x: Array, window, *, fill=jnp.nan) -> Array:
+    """Rolling min over trailing ``window`` bars (static window)."""
+    w = _static_window(window, "rolling_min")
+    out = x
+    span = 1
+    while span < w:
+        step = min(span, w - span)
+        out = jnp.minimum(out, _shifted(out, step, fill=jnp.inf))
+        span += step
+    return _mask_warmup(out, w, fill)

@@ -1,0 +1,1 @@
+"""Multi-chip / multi-host parallelism: meshes, shard_map sweeps, time sharding."""

@@ -1,0 +1,107 @@
+"""The sweep engine: one fused jit+vmap kernel over a (ticker x param) grid.
+
+This is the unit of compute a worker runs per job — the TPU replacement for
+the reference's serial sleep loop over a job batch (reference
+``src/worker/process.rs:21-25``, 1 job/sec/worker). One call evaluates every
+(ticker, parameter-set) combination in the job as a single XLA program:
+indicators, positions, PnL, and the metric reductions all fuse, and only the
+``(n_tickers, n_params)`` scalar metrics come back to the host.
+
+Axis order: tickers outer, params inner — so sharding the leading ticker axis
+across chips (``parallel.sharding``) leaves the param axis dense per-chip.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+
+from ..models.base import Strategy
+from ..ops import metrics as metrics_mod
+from ..ops import pnl as pnl_mod
+
+Array = jax.Array
+
+
+def grid_size(grid: Mapping[str, Array]) -> int:
+    (leaf,) = set(int(v.shape[0]) for v in grid.values())
+    return leaf
+
+
+def product_grid(**axes) -> dict:
+    """Cartesian product of named 1-D parameter axes -> dict of flat (P,) arrays.
+
+    ``product_grid(fast=[5,10], slow=[50,100])`` yields 4 combos. Axes are
+    materialized with ``meshgrid`` so the flat order is row-major in the
+    argument order.
+    """
+    names = list(axes)
+    arrs = [jnp.asarray(axes[n]) for n in names]
+    mesh = jnp.meshgrid(*arrs, indexing="ij")
+    return {n: m.reshape(-1) for n, m in zip(names, mesh)}
+
+
+def run_sweep(
+    ohlcv,
+    strategy: Strategy,
+    grid: Mapping[str, Array],
+    *,
+    cost: float = 0.0,
+    bar_mask: Array | None = None,
+    periods_per_year: int = 252,
+) -> metrics_mod.Metrics:
+    """Evaluate ``strategy`` on every (ticker, param) combo.
+
+    Args:
+        ohlcv: OHLCV pytree with fields shaped ``(n_tickers, T)``.
+        strategy: a registered :class:`~..models.base.Strategy`.
+        grid: dict of ``(P,)`` parameter arrays (see :func:`product_grid`).
+        cost: proportional transaction cost per unit turnover.
+        bar_mask: optional ``(n_tickers, T)`` validity mask for ragged
+            histories (padded bars carry zero position and are excluded from
+            metric moments).
+
+    Returns:
+        :class:`~..ops.metrics.Metrics` with every field ``(n_tickers, P)``.
+    """
+
+    def per_param(ohlcv_1, mask_1, params):
+        pos = strategy.positions(ohlcv_1, params)
+        if mask_1 is not None:
+            pos = pos * mask_1.astype(pos.dtype)
+        res = pnl_mod.backtest_prefix(ohlcv_1.close, pos, cost=cost)
+        return metrics_mod.summary_metrics(
+            res.returns, res.equity, res.positions,
+            periods_per_year=periods_per_year, mask=mask_1)
+
+    def per_ticker(ohlcv_1, mask_1):
+        return jax.vmap(lambda p: per_param(ohlcv_1, mask_1, p))(dict(grid))
+
+    if bar_mask is None:
+        return jax.vmap(lambda o: per_ticker(o, None))(ohlcv)
+    return jax.vmap(per_ticker)(ohlcv, bar_mask)
+
+
+@functools.partial(jax.jit, static_argnames=("strategy", "periods_per_year"))
+def jit_sweep(ohlcv, strategy, grid, *, cost=0.0, bar_mask=None,
+              periods_per_year=252):
+    """``run_sweep`` under ``jit`` (strategy is a static argument)."""
+    return run_sweep(ohlcv, strategy, grid, cost=cost, bar_mask=bar_mask,
+                     periods_per_year=periods_per_year)
+
+
+def best_params(metric_values: Array, grid: Mapping[str, Array], *, axis=-1):
+    """Argmax a ``(..., P)`` metric over the param axis; gather the winners.
+
+    Returns ``(best_value, {name: best_param})`` with the leading shape of
+    ``metric_values`` minus the param axis. Used by walk-forward refits and by
+    dispatcher-side result aggregation.
+    """
+    idx = jnp.argmax(metric_values, axis=axis)
+    best = jnp.take_along_axis(
+        metric_values, jnp.expand_dims(idx, axis), axis=axis).squeeze(axis)
+    chosen = {n: jnp.take(v, idx) for n, v in grid.items()}
+    return best, chosen

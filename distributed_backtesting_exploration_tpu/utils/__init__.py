@@ -1,0 +1,11 @@
+"""Utilities: market-data codecs, config, logging, counters, native bindings."""
+
+from .data import (  # noqa: F401
+    OHLCV,
+    synthetic_ohlcv,
+    to_csv_bytes,
+    from_csv_bytes,
+    to_wire_bytes,
+    from_wire_bytes,
+    pad_and_stack,
+)

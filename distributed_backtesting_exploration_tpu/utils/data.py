@@ -1,0 +1,165 @@
+"""Market-data representation and codecs.
+
+The reference ships raw CSV file bytes inside ``Job.File``
+(reference ``proto/backtesting.proto:15``) and gzips the server->worker
+direction to shrink them (reference ``README.md:18``). Here the wire format is
+a compact binary OHLCV block (:func:`to_wire_bytes`) — smaller than gzipped
+CSV and decodable straight into device-ready float32 arrays with zero text
+parsing on the hot path — while CSV remains supported for ingest parity.
+
+Layout rules (TPU-first):
+
+- every field is a separate ``(..., T)`` array (struct-of-arrays). A packed
+  ``(..., T, 5)`` channels-last layout would waste a 128-lane tile on a
+  5-wide minor axis; struct-of-arrays keeps the long time axis on lanes.
+- ragged ticker histories are padded at the *end* to a lane-friendly multiple
+  (default 128) with the last close repeated — so padded bars have zero
+  return — plus an explicit validity mask (:func:`pad_and_stack`).
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+from typing import NamedTuple, Sequence
+
+import numpy as np
+
+_WIRE_MAGIC = b"DBX1"
+_FIELDS = ("open", "high", "low", "close", "volume")
+
+
+class OHLCV(NamedTuple):
+    """Struct-of-arrays OHLCV batch; each field shaped ``(..., T)``."""
+
+    open: np.ndarray
+    high: np.ndarray
+    low: np.ndarray
+    close: np.ndarray
+    volume: np.ndarray
+
+    @property
+    def n_bars(self) -> int:
+        return self.close.shape[-1]
+
+
+def synthetic_ohlcv(
+    n_tickers: int,
+    n_bars: int,
+    *,
+    seed: int = 0,
+    s0: float = 100.0,
+    mu: float = 0.08,
+    sigma: float = 0.25,
+    periods_per_year: int = 252,
+    dtype=np.float32,
+) -> OHLCV:
+    """Geometric-Brownian-motion OHLCV panel, shape ``(n_tickers, n_bars)``.
+
+    Deterministic in ``seed``; used for fixtures and benchmarks in place of
+    the reference's eight hardcoded stock CSVs (reference
+    ``src/server/main.rs:198-209``).
+    """
+    rng = np.random.default_rng(seed)
+    dt = 1.0 / periods_per_year
+    z = rng.standard_normal((n_tickers, n_bars))
+    log_ret = (mu - 0.5 * sigma**2) * dt + sigma * np.sqrt(dt) * z
+    close = s0 * np.exp(np.cumsum(log_ret, axis=-1))
+    open_ = np.concatenate([np.full((n_tickers, 1), s0), close[:, :-1]], axis=-1)
+    wick = np.abs(rng.standard_normal((2, n_tickers, n_bars))) * sigma * np.sqrt(dt)
+    high = np.maximum(open_, close) * (1.0 + wick[0])
+    low = np.minimum(open_, close) * (1.0 - wick[1])
+    volume = np.exp(rng.normal(12.0, 1.0, (n_tickers, n_bars)))
+    return OHLCV(*(a.astype(dtype) for a in (open_, high, low, close, volume)))
+
+
+# ---------------------------------------------------------------------------
+# CSV codec (ingest parity with the reference's CSV job payloads)
+# ---------------------------------------------------------------------------
+
+def to_csv_bytes(series: OHLCV) -> bytes:
+    """Encode a single ticker (fields shaped ``(T,)``) as OHLCV CSV bytes."""
+    if series.close.ndim != 1:
+        raise ValueError("to_csv_bytes takes a single ticker, fields shaped (T,)")
+    buf = io.StringIO()
+    buf.write("open,high,low,close,volume\n")
+    for row in zip(*(np.asarray(getattr(series, f), np.float64) for f in _FIELDS)):
+        buf.write(",".join(repr(float(v)) for v in row) + "\n")
+    return buf.getvalue().encode()
+
+
+def from_csv_bytes(data: bytes, *, dtype=np.float32) -> OHLCV:
+    """Decode OHLCV CSV bytes (header with open/high/low/close/volume columns).
+
+    Tolerates extra columns (e.g. a leading date column) by name-matching the
+    header, like typical adjusted-split stock CSVs.
+    """
+    text = data.decode()
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    header = [h.strip().lower() for h in lines[0].split(",")]
+    cols = {name: header.index(name) for name in _FIELDS if name in header}
+    missing = [f for f in _FIELDS if f not in cols]
+    if missing:
+        raise ValueError(f"CSV missing columns: {missing}; header={header}")
+    rows = [ln.split(",") for ln in lines[1:]]
+    out = {}
+    for name, j in cols.items():
+        out[name] = np.asarray([float(r[j]) for r in rows], dtype=dtype)
+    return OHLCV(**out)
+
+
+# ---------------------------------------------------------------------------
+# Binary wire codec (replaces CSV-text-over-gzip on the job data plane)
+# ---------------------------------------------------------------------------
+
+def to_wire_bytes(series: OHLCV) -> bytes:
+    """Pack one ticker into the compact binary block: magic, T, 5 x f32[T]."""
+    if series.close.ndim != 1:
+        raise ValueError("to_wire_bytes takes a single ticker, fields shaped (T,)")
+    T = series.n_bars
+    parts = [_WIRE_MAGIC, struct.pack("<I", T)]
+    for f in _FIELDS:
+        parts.append(np.ascontiguousarray(
+            getattr(series, f), dtype="<f4").tobytes())
+    return b"".join(parts)
+
+
+def from_wire_bytes(data: bytes) -> OHLCV:
+    """Decode the binary block produced by :func:`to_wire_bytes`."""
+    if data[:4] != _WIRE_MAGIC:
+        raise ValueError("bad magic; not a DBX1 OHLCV block")
+    (T,) = struct.unpack_from("<I", data, 4)
+    need = 8 + 4 * 5 * T
+    if len(data) < need:
+        raise ValueError(f"truncated OHLCV block: {len(data)} < {need}")
+    fields = []
+    off = 8
+    for _ in _FIELDS:
+        fields.append(np.frombuffer(data, dtype="<f4", count=T, offset=off).copy())
+        off += 4 * T
+    return OHLCV(*fields)
+
+
+def pad_and_stack(
+    series: Sequence[OHLCV], *, lane_multiple: int = 128
+) -> tuple[OHLCV, np.ndarray, np.ndarray]:
+    """Stack ragged per-ticker series into one padded device-ready batch.
+
+    Returns ``(batch, lengths, mask)`` where ``batch`` fields are
+    ``(n_tickers, T_pad)`` with ``T_pad`` the max length rounded up to
+    ``lane_multiple``; padding repeats each ticker's final bar (so padded
+    returns are exactly 0 and cannot create phantom PnL) and ``mask`` is the
+    ``(n_tickers, T_pad)`` validity mask.
+    """
+    lengths = np.asarray([s.n_bars for s in series], np.int32)
+    t_max = int(lengths.max())
+    t_pad = -(-t_max // lane_multiple) * lane_multiple
+    n = len(series)
+    cols = {f: np.zeros((n, t_pad), np.float32) for f in _FIELDS}
+    for i, s in enumerate(series):
+        for f in _FIELDS:
+            a = np.asarray(getattr(s, f), np.float32)
+            cols[f][i, : a.shape[0]] = a
+            cols[f][i, a.shape[0]:] = a[-1]
+    mask = np.arange(t_pad)[None, :] < lengths[:, None]
+    return OHLCV(**cols), lengths, mask

@@ -1,0 +1,25 @@
+"""Test harness setup: run JAX on CPU with 8 virtual devices.
+
+Multi-chip code paths (mesh/shard_map/ppermute) are validated without TPU
+hardware by forcing the host platform to expose 8 devices — the strategy
+SURVEY.md section 4 prescribes. Must run before the first ``import jax``.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (env must be set first)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {devs}"
+    return devs

@@ -1,0 +1,62 @@
+"""Codec round-trips and padding semantics for the market-data layer."""
+
+import numpy as np
+import pytest
+
+from distributed_backtesting_exploration_tpu.utils import data as data_mod
+
+
+def one_ticker(n=100, seed=1):
+    batch = data_mod.synthetic_ohlcv(1, n, seed=seed)
+    return data_mod.OHLCV(*(f[0] for f in batch))
+
+
+def test_synthetic_shapes_and_determinism():
+    a = data_mod.synthetic_ohlcv(3, 50, seed=9)
+    b = data_mod.synthetic_ohlcv(3, 50, seed=9)
+    assert a.close.shape == (3, 50)
+    np.testing.assert_array_equal(a.close, b.close)
+    assert (a.high >= a.close).all() and (a.low <= a.close).all()
+    assert (a.high >= a.open).all() and (a.low <= a.open).all()
+
+
+def test_csv_roundtrip():
+    s = one_ticker(64)
+    back = data_mod.from_csv_bytes(data_mod.to_csv_bytes(s))
+    for f in ("open", "high", "low", "close", "volume"):
+        np.testing.assert_allclose(getattr(back, f), getattr(s, f), rtol=1e-6)
+
+
+def test_csv_with_date_column_and_reordered_header():
+    body = "date,close,open,low,high,volume\n"
+    body += "2024-01-01,10,9,8,11,100\n2024-01-02,11,10,9,12,110\n"
+    s = data_mod.from_csv_bytes(body.encode())
+    np.testing.assert_allclose(s.close, [10, 11])
+    np.testing.assert_allclose(s.high, [11, 12])
+
+
+def test_wire_roundtrip_and_size():
+    s = one_ticker(500)
+    blob = data_mod.to_wire_bytes(s)
+    assert len(blob) == 8 + 5 * 4 * 500
+    back = data_mod.from_wire_bytes(blob)
+    for f in ("open", "high", "low", "close", "volume"):
+        np.testing.assert_array_equal(getattr(back, f), getattr(s, f))
+
+
+def test_wire_rejects_garbage():
+    with pytest.raises(ValueError):
+        data_mod.from_wire_bytes(b"nope")
+    s = one_ticker(10)
+    with pytest.raises(ValueError):
+        data_mod.from_wire_bytes(data_mod.to_wire_bytes(s)[:-4])
+
+
+def test_pad_and_stack():
+    series = [one_ticker(100, seed=1), one_ticker(260, seed=2)]
+    batch, lengths, mask = data_mod.pad_and_stack(series, lane_multiple=128)
+    assert batch.close.shape == (2, 384)
+    np.testing.assert_array_equal(lengths, [100, 260])
+    assert mask[0, :100].all() and not mask[0, 100:].any()
+    # padding repeats the final bar -> zero returns in the padded tail
+    np.testing.assert_array_equal(batch.close[0, 100:], batch.close[0, 99])

@@ -1,0 +1,101 @@
+"""Golden tests: rolling ops vs trivially-correct float64 NumPy loops."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_backtesting_exploration_tpu.ops import rolling
+
+
+RNG = np.random.default_rng(42)
+T = 400
+# Price-like levels: the numerically nasty case for f32 second moments.
+X = (100.0 * np.exp(np.cumsum(RNG.normal(0, 0.02, T)))).astype(np.float64)
+Y = (80.0 * np.exp(np.cumsum(RNG.normal(0, 0.02, T)))).astype(np.float64)
+
+
+def np_rolling(x, w, fn):
+    out = np.full_like(x, np.nan)
+    for t in range(w - 1, len(x)):
+        out[t] = fn(x[t - w + 1: t + 1])
+    return out
+
+
+@pytest.mark.parametrize("w", [2, 5, 20, 128])
+def test_rolling_mean(w):
+    got = np.asarray(rolling.rolling_mean(jnp.asarray(X, jnp.float32), w))
+    want = np_rolling(X, w, np.mean)
+    np.testing.assert_allclose(got[w - 1:], want[w - 1:], rtol=1e-4)
+    assert np.isnan(got[: w - 1]).all()
+
+
+@pytest.mark.parametrize("w,ddof", [(5, 0), (20, 0), (20, 1), (64, 1)])
+def test_rolling_std(w, ddof):
+    got = np.asarray(rolling.rolling_std(jnp.asarray(X, jnp.float32), w, ddof=ddof))
+    want = np_rolling(X, w, lambda s: np.std(s, ddof=ddof))
+    np.testing.assert_allclose(got[w - 1:], want[w - 1:], rtol=5e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("w", [5, 30])
+def test_rolling_zscore(w):
+    got = np.asarray(rolling.rolling_zscore(jnp.asarray(X, jnp.float32), w))
+    m = np_rolling(X, w, np.mean)
+    s = np_rolling(X, w, np.std)
+    want = (X - m) / s
+    np.testing.assert_allclose(got[w - 1:], want[w - 1:], rtol=5e-3, atol=5e-3)
+
+
+@pytest.mark.parametrize("w", [10, 60])
+def test_rolling_ols(w):
+    alpha, beta = rolling.rolling_ols(
+        jnp.asarray(Y, jnp.float32), jnp.asarray(X, jnp.float32), w)
+    alpha, beta = np.asarray(alpha), np.asarray(beta)
+    want_a = np.full(T, np.nan)
+    want_b = np.full(T, np.nan)
+    for t in range(w - 1, T):
+        xs, ys = X[t - w + 1: t + 1], Y[t - w + 1: t + 1]
+        b, a = np.polyfit(xs, ys, 1)
+        want_a[t], want_b[t] = a, b
+    np.testing.assert_allclose(beta[w - 1:], want_b[w - 1:], rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(alpha[w - 1:], want_a[w - 1:], rtol=2e-2, atol=2.0)
+
+
+@pytest.mark.parametrize("span", [3, 21])
+def test_ema(span):
+    got = np.asarray(rolling.ema(jnp.asarray(X, jnp.float32), span=span))
+    a = 2.0 / (span + 1)
+    want = np.empty_like(X)
+    want[0] = X[0]
+    for t in range(1, T):
+        want[t] = (1 - a) * want[t - 1] + a * X[t]
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+@pytest.mark.parametrize("w", [1, 2, 7, 33])
+def test_rolling_max_min(w):
+    gmax = np.asarray(rolling.rolling_max(jnp.asarray(X, jnp.float32), w))
+    gmin = np.asarray(rolling.rolling_min(jnp.asarray(X, jnp.float32), w))
+    np.testing.assert_allclose(gmax[w - 1:], np_rolling(X, w, np.max)[w - 1:],
+                               rtol=1e-6)
+    np.testing.assert_allclose(gmin[w - 1:], np_rolling(X, w, np.min)[w - 1:],
+                               rtol=1e-6)
+
+
+def test_traced_window_vmap_matches_static():
+    """vmap over a window grid must equal per-window static calls."""
+    x = jnp.asarray(X, jnp.float32)
+    windows = jnp.asarray([3, 10, 50], jnp.int32)
+    batched = jax.vmap(lambda w: rolling.rolling_mean(x, w, fill=0.0))(windows)
+    for i, w in enumerate([3, 10, 50]):
+        single = rolling.rolling_mean(x, w, fill=0.0)
+        np.testing.assert_allclose(np.asarray(batched[i]), np.asarray(single),
+                                   rtol=1e-6)
+
+
+def test_rolling_sum_under_jit():
+    x = jnp.asarray(X, jnp.float32)
+    f = jax.jit(lambda x, w: rolling.rolling_sum(x, w, fill=0.0))
+    np.testing.assert_allclose(
+        np.asarray(f(x, 7)),
+        np.asarray(rolling.rolling_sum(x, 7, fill=0.0)), rtol=1e-6)
