@@ -14,10 +14,9 @@ the hold-until-exit semantics.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
-from ..ops import rolling
+from ..ops import rolling, signals
 from .base import Strategy, register
 
 
@@ -36,24 +35,9 @@ def _touch_positions(ohlcv, params):
 
 
 def _mr_positions(ohlcv, params):
+    # Exit at the rolling mean = the shared band machine with z_exit=0.
     z, valid = _z_and_valid(ohlcv, params)
-    k = params["k"]
-
-    def step(pos, inp):
-        z_t, valid_t = inp
-        entered = jnp.where(z_t < -k, 1.0, jnp.where(z_t > k, -1.0, 0.0))
-        # exit when price re-crosses the rolling mean, in the held direction
-        exit_long = (pos > 0) & (z_t >= 0)
-        exit_short = (pos < 0) & (z_t <= 0)
-        held = jnp.where(exit_long | exit_short, 0.0, pos)
-        nxt = jnp.where(pos == 0, entered, held)
-        nxt = jnp.where(valid_t, nxt, 0.0)
-        return nxt, nxt
-
-    xs = (jnp.moveaxis(z, -1, 0), jnp.moveaxis(
-        jnp.broadcast_to(valid, z.shape), -1, 0))
-    _, pos_tmajor = jax.lax.scan(step, jnp.zeros(z.shape[:-1]), xs, unroll=8)
-    return jnp.moveaxis(pos_tmajor, 0, -1)
+    return signals.band_hysteresis(z, valid, params["k"], 0.0)
 
 
 BOLLINGER = register(Strategy(

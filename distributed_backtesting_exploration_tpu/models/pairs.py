@@ -1,0 +1,93 @@
+"""Rolling-OLS pairs trade (``BASELINE.json`` configs[3]).
+
+A pair is (y, x) close series. Per bar: a rolling OLS of y on x gives the
+hedge ratio ``beta``; the spread ``y - (alpha + beta x)`` is z-scored over the
+same lookback; the machine enters a unit spread position when ``|z|`` exceeds
+``z_entry`` and exits when z re-crosses ``z_exit`` (hysteresis -> ``lax.scan``).
+Spread return per bar is ``pos[t-1] * (r_y[t] - beta[t-1] * r_x[t]) / (1 + |beta|)``
+(gross exposure normalized), with cost charged on both legs' turnover.
+
+Pairs don't fit the single-asset :class:`~.base.Strategy` seam (two inputs),
+so this module owns its sweep entry point :func:`run_pairs_sweep`, vmapped
+over (pair x param) exactly like the single-asset engine — one fused XLA
+program per job.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import metrics as metrics_mod
+from ..ops import pnl as pnl_mod
+from ..ops import rolling, signals
+
+Array = jax.Array
+
+
+def pair_signals(y: Array, x: Array, lookback):
+    """Rolling hedge ratio and spread z-score for one pair; ``(T,)`` each.
+
+    ``lookback`` may be traced (vmap over lookback grids).
+    """
+    alpha, beta = rolling.rolling_ols(y, x, lookback, fill=0.0)
+    spread = y - (alpha + beta * x)
+    z = rolling.rolling_zscore(spread, lookback, fill=0.0)
+    # The spread itself needs `lookback` bars of OLS warmup, and its z-score
+    # another `lookback`; mask both.
+    valid = rolling.valid_mask(y.shape[-1], 2 * jnp.asarray(lookback) - 1)
+    return beta, jnp.where(valid, z, 0.0), valid
+
+
+def pairs_positions(y: Array, x: Array, params) -> tuple[Array, Array]:
+    """Stateful entry/exit machine; returns ``(pos, beta)`` each ``(T,)``.
+
+    pos = +1: long spread (long y, short beta*x); -1: short spread; 0 flat.
+    Shares the band-hysteresis scan with Bollinger mean-reversion.
+    """
+    beta, z, valid = pair_signals(y, x, params["lookback"])
+    pos = signals.band_hysteresis(
+        z, valid, params["z_entry"], params.get("z_exit", 0.0))
+    return pos, beta
+
+
+def pair_backtest(y: Array, x: Array, params, *, cost=0.0,
+                  periods_per_year: int = 252) -> metrics_mod.Metrics:
+    """Full backtest of one pair under one param set (vmap target)."""
+    pos, beta = pairs_positions(y, x, params)
+    ry = pnl_mod.simple_returns(y)
+    rx = pnl_mod.simple_returns(x)
+    prev_pos = jnp.concatenate(
+        [jnp.zeros_like(pos[..., :1]), pos[..., :-1]], axis=-1)
+    prev_beta = jnp.concatenate(
+        [jnp.zeros_like(beta[..., :1]), beta[..., :-1]], axis=-1)
+    gross = 1.0 + jnp.abs(prev_beta)
+    spread_ret = prev_pos * (ry - prev_beta * rx) / jnp.maximum(gross, 1.0)
+    # Returns are per unit of gross book, so cost must be too: leg notional
+    # |dpos|*(1+|beta|) over the same gross normalizer reduces to |dpos|.
+    turnover = jnp.abs(pos - prev_pos)
+    net = spread_ret - jnp.asarray(cost, y.dtype) * turnover
+    equity = 1.0 + jnp.cumsum(net, axis=-1)
+    return metrics_mod.summary_metrics(
+        net, equity, pos, periods_per_year=periods_per_year)
+
+
+@functools.partial(jax.jit, static_argnames=("periods_per_year",))
+def run_pairs_sweep(y_close: Array, x_close: Array, grid, *, cost=0.0,
+                    periods_per_year: int = 252) -> metrics_mod.Metrics:
+    """Evaluate every (pair, param) combo; fields come back ``(n_pairs, P)``.
+
+    ``y_close``/``x_close`` are ``(n_pairs, T)``; ``grid`` maps param name ->
+    ``(P,)`` (see :func:`~..parallel.sweep.product_grid`).
+    """
+
+    def per_param(y1, x1, p):
+        return pair_backtest(y1, x1, p, cost=cost,
+                             periods_per_year=periods_per_year)
+
+    def per_pair(y1, x1):
+        return jax.vmap(lambda p: per_param(y1, x1, p))(dict(grid))
+
+    return jax.vmap(per_pair)(y_close, x_close)

@@ -34,5 +34,8 @@ from .metrics import (  # noqa: F401
     hit_rate,
     n_trades,
     summary_metrics,
+    metric_sign,
+    LOWER_IS_BETTER,
     Metrics,
 )
+from .signals import band_hysteresis  # noqa: F401

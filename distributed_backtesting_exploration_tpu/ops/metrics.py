@@ -21,6 +21,23 @@ import jax.numpy as jnp
 Array = jax.Array
 
 
+# Metrics where a *smaller* value is better; argmax-style selection must
+# negate these (see metric_sign). Everything else is higher-is-better.
+LOWER_IS_BETTER = frozenset({"max_drawdown", "volatility", "turnover"})
+
+
+def metric_sign(name: str) -> float:
+    """+1.0 for higher-is-better metrics, -1.0 for lower-is-better ones.
+
+    Multiply a metric by its sign before any argmax so that selection code
+    (walk-forward refits, cross-chip best_over_grid) optimizes the right
+    direction for every :class:`Metrics` field.
+    """
+    if name not in Metrics._fields:
+        raise KeyError(f"unknown metric {name!r}; one of {Metrics._fields}")
+    return -1.0 if name in LOWER_IS_BETTER else 1.0
+
+
 class Metrics(NamedTuple):
     """Scalar (per-series) performance summary; each field is ``(...)``."""
 

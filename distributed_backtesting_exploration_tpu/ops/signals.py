@@ -1,0 +1,43 @@
+"""Shared signal state machines (scan bodies used by multiple strategies).
+
+The band entry/exit hysteresis machine — enter when a z-score breaches an
+entry band, hold until it re-crosses an exit band — is the core stateful
+pattern of both Bollinger mean-reversion and the pairs trade. One
+implementation lives here so the scan semantics (warmup zeroing, no
+flip-through-zero, unroll) cannot drift between strategies.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def band_hysteresis(z: Array, valid: Array, z_entry, z_exit=0.0, *,
+                    unroll: int = 8) -> Array:
+    """Positions from a z-score band machine; shapes ``(..., T)`` -> same.
+
+    Enter long (+1) when ``z < -z_entry``, short (-1) when ``z > z_entry``;
+    exit to flat when z re-crosses ``-z_exit`` (long) / ``z_exit`` (short).
+    Position never flips sign without passing through flat. Bars with
+    ``valid`` False force flat. ``z_entry``/``z_exit`` may be traced scalars
+    (vmap over parameter grids).
+    """
+    valid = jnp.broadcast_to(valid, z.shape)
+
+    def step(pos, inp):
+        z_t, valid_t = inp
+        entered = jnp.where(z_t < -z_entry, 1.0,
+                            jnp.where(z_t > z_entry, -1.0, 0.0))
+        exit_long = (pos > 0) & (z_t >= -z_exit)
+        exit_short = (pos < 0) & (z_t <= z_exit)
+        held = jnp.where(exit_long | exit_short, 0.0, pos)
+        nxt = jnp.where(pos == 0, entered, held)
+        nxt = jnp.where(valid_t, nxt, 0.0)
+        return nxt, nxt
+
+    xs = (jnp.moveaxis(z, -1, 0), jnp.moveaxis(valid, -1, 0))
+    _, pos_t = jax.lax.scan(step, jnp.zeros(z.shape[:-1]), xs, unroll=unroll)
+    return jnp.moveaxis(pos_t, 0, -1)

@@ -1,1 +1,13 @@
-"""Multi-chip / multi-host parallelism: meshes, shard_map sweeps, time sharding."""
+"""Multi-chip / multi-host parallelism: meshes, shard_map sweeps, time sharding.
+
+- :mod:`.sweep` — the fused jit+vmap (ticker x param) kernel, the per-job unit
+  of compute.
+- :mod:`.sharding` — 1-D device mesh over a worker's chips; ticker-sharded
+  SPMD sweeps via ``shard_map`` (no collectives in the hot loop).
+- :mod:`.timeshard` — bar-time-axis sharding: distributed cumsum and linear
+  scans (the sequence-parallelism analogue for backtests).
+- :mod:`.walkforward` — walk-forward optimization: ``lax.scan`` over refit
+  windows with the sweep kernel nested inside.
+"""
+
+from . import sweep, sharding, timeshard, walkforward  # noqa: F401

@@ -1,0 +1,133 @@
+"""Time-axis (sequence) parallelism: blockwise scan with ICI carry handoff.
+
+The long-context axis of a backtest is bar time. Indicators are prefix-sum
+algebra and the PnL/hysteresis machines are first-order recurrences — the
+domain analogue of sequence parallelism is therefore not ring *attention*
+(there is no all-pairs interaction) but a **blockwise scan**: shard the time
+axis across chips, run the local recurrence per block, then fix up each
+block with the carry from the chips to its left. Two primitives cover every
+kernel in this framework:
+
+- :func:`sharded_cumsum` — distributed inclusive prefix sum. Local cumsum,
+  then one ``psum``-style exclusive scan of per-block totals over ICI
+  (implemented with ``all_gather`` of one scalar-per-chip + a masked sum;
+  O(T/n) compute, O(n) tiny collective). Rolling sum/mean/var/OLS are all
+  cumsum differences, so this makes every indicator time-shardable.
+- :func:`sharded_linear_scan` — distributed first-order linear recurrence
+  ``y[t] = a[t] * y[t-1] + b[t]`` (EMA, decayed state). Local associative
+  scan per block, then a log(n)-step ``ppermute`` ladder combines block
+  summaries across chips, and a final local fixup applies each block's
+  incoming carry. Exact same math as the single-device
+  ``lax.associative_scan`` — verified bit-for-bit in tests.
+
+The general hysteresis machine (``backtest_scan``) is *not* associative, so
+it cannot be time-sharded exactly; long histories there use
+:func:`chunked_scan` (sequential over chunks, carry threaded on one chip)
+which bounds peak memory instead. This mirrors SURVEY.md §5's call: blockwise
+scan with carried state, not attention-style ring exchange.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+TIME_AXIS = "time"
+
+
+def _exclusive_block_offset(block_total, axis: str):
+    """Sum of ``block_total`` over all chips strictly left of this one.
+
+    ``all_gather`` of one value per chip + masked sum — O(n_chips) scalars
+    over ICI, no host round-trip.
+    """
+    idx = jax.lax.axis_index(axis)
+    totals = jax.lax.all_gather(block_total, axis)          # (n, ...)
+    n = totals.shape[0]
+    mask = (jnp.arange(n) < idx).astype(totals.dtype)
+    mask = mask.reshape((n,) + (1,) * (totals.ndim - 1))
+    return jnp.sum(totals * mask, axis=0)
+
+
+def sharded_cumsum(mesh: Mesh, x, *, axis_name: str = TIME_AXIS):
+    """Inclusive cumsum along a time axis sharded over ``mesh``.
+
+    ``x`` is ``(..., T)`` with T sharded; result has the same sharding.
+    """
+    spec = P(*((None,) * (x.ndim - 1) + (axis_name,)))
+
+    def local(x_blk):
+        cs = jnp.cumsum(x_blk, axis=-1)
+        offset = _exclusive_block_offset(cs[..., -1], axis_name)
+        return cs + offset[..., None]
+
+    return jax.shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec,
+                         check_vma=False)(x)
+
+
+def sharded_linear_scan(mesh: Mesh, a, b, *, axis_name: str = TIME_AXIS):
+    """Distributed ``y[t] = a[t]*y[t-1] + b[t]`` (y[-1] = 0), T sharded.
+
+    Per block, the composition of all steps is itself a first-order map
+    ``y_out = A*y_in + B`` with ``A = prod(a)``, ``B`` = the local scan's
+    last element. The cross-chip combine gathers one (A, B) pair per chip and
+    left-folds the pairs for blocks to this chip's left; each block then
+    applies its incoming carry locally: ``y = scan_local + prefix_a * carry_in``
+    where ``prefix_a[t] = prod(a[block_start..t])``. At backtest scale the
+    n-chip fold of scalars is cheaper than a log-depth ``ppermute`` ladder
+    and exact for any mesh size.
+    """
+    spec = P(*((None,) * (a.ndim - 1) + (axis_name,)))
+
+    def combine(left, right):
+        a1, b1 = left
+        a2, b2 = right
+        return a1 * a2, a2 * b1 + b2
+
+    def local_simple(a_blk, b_blk):
+        prefix_a, y_local = jax.lax.associative_scan(
+            combine, (a_blk, b_blk), axis=-1)
+        A = prefix_a[..., -1]
+        B = y_local[..., -1]
+        n = jax.lax.axis_size(axis_name)
+        idx = jax.lax.axis_index(axis_name)
+        all_A = jax.lax.all_gather(A, axis_name)   # (n, ...)
+        all_B = jax.lax.all_gather(B, axis_name)
+        # Exclusive left-fold of (A, B) maps for blocks < idx, in order.
+        carry = jnp.zeros_like(B)
+        for j in range(n):
+            take = jnp.asarray(j < idx)
+            carry = jnp.where(take, all_A[j] * carry + all_B[j], carry)
+        return y_local + prefix_a * carry[..., None]
+
+    return jax.shard_map(local_simple, mesh=mesh, in_specs=(spec, spec),
+                         out_specs=spec, check_vma=False)(a, b)
+
+
+def chunked_scan(step, init_carry, inputs, *, chunk: int, unroll: int = 8):
+    """Memory-bounded sequential scan for non-associative state machines.
+
+    Splits the time axis (leading axis of each leaf of ``inputs``) into
+    ``chunk``-sized pieces and runs ``lax.scan`` over chunks of ``lax.scan``
+    over bars. Semantically identical to one big scan; peak live activation
+    memory drops from O(T) to O(chunk) under ``jax.checkpoint`` of the inner
+    scan — the long-history escape hatch for hysteresis strategies.
+    """
+    leaves = jax.tree_util.tree_leaves(inputs)
+    T = leaves[0].shape[0]
+    if T % chunk:
+        raise ValueError(f"T={T} not divisible by chunk={chunk}")
+    n_chunks = T // chunk
+    chunked = jax.tree_util.tree_map(
+        lambda x: x.reshape((n_chunks, chunk) + x.shape[1:]), inputs)
+
+    @jax.checkpoint
+    def run_chunk(carry, xs):
+        return jax.lax.scan(step, carry, xs, unroll=unroll)
+
+    carry, ys = jax.lax.scan(run_chunk, init_carry, chunked)
+    return carry, jax.tree_util.tree_map(
+        lambda y: y.reshape((T,) + y.shape[2:]), ys)

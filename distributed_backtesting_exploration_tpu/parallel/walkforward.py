@@ -1,0 +1,132 @@
+"""Walk-forward optimization (``BASELINE.json`` configs[4]).
+
+Classic out-of-sample protocol: slide a (train, test) window over the bar
+history; per window, evaluate the full parameter grid on the train span, pick
+the best parameter per ticker, then realize that parameter's returns on the
+held-out test span. The TPU shape of this is ``lax.scan`` over refit windows
+(sequential by construction — window w+1's start depends only on the
+schedule, but scanning keeps one compiled program) with the full
+(ticker x param) ``vmap`` sweep *nested inside* each step — SURVEY.md §7's
+"lax.scan over refit windows + nested vmap".
+
+All shapes are static: every window is ``train + test`` bars long, sliced
+with ``lax.dynamic_slice`` at traced offsets; train/test membership is a
+mask, not a shape.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Mapping, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.base import Strategy
+from ..ops import metrics as metrics_mod
+from ..ops import pnl as pnl_mod
+
+Array = jax.Array
+
+
+class WalkForwardResult(NamedTuple):
+    """Outputs of a walk-forward run.
+
+    Attributes:
+        oos_returns: ``(n_tickers, n_windows * test)`` stitched out-of-sample
+            net returns under the per-window chosen params.
+        oos_metrics: :class:`~..ops.metrics.Metrics` over the stitched series,
+            each field ``(n_tickers,)`` — the honest performance estimate.
+        chosen: dict param name -> ``(n_tickers, n_windows)`` selected values.
+        train_metric: ``(n_tickers, n_windows)`` best in-sample metric value.
+    """
+
+    oos_returns: Array
+    oos_metrics: metrics_mod.Metrics
+    chosen: Mapping[str, Array]
+    train_metric: Array
+
+
+def window_starts(T: int, train: int, test: int) -> jnp.ndarray:
+    """Anchored-walk schedule: windows advance by ``test`` bars.
+
+    Number of windows is ``(T - train) // test`` — every test bar is covered
+    at most once, and only bars with a full train span behind them are used.
+    """
+    n = (T - train) // test
+    if n <= 0:
+        raise ValueError(f"history T={T} too short for train={train} test={test}")
+    return jnp.arange(n) * test
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("strategy", "train", "test", "metric", "periods_per_year"))
+def walk_forward(
+    ohlcv,
+    strategy: Strategy,
+    grid: Mapping[str, Array],
+    *,
+    train: int,
+    test: int,
+    metric: str = "sharpe",
+    cost: float = 0.0,
+    periods_per_year: int = 252,
+) -> WalkForwardResult:
+    """Run walk-forward optimization over a ``(n_tickers, T)`` OHLCV panel.
+
+    Per window (scanned): slice ``train + test`` bars, sweep the grid with
+    metrics masked to the train span, argmax per ticker, re-price the winning
+    param with returns masked to the test span. The per-window sweep reuses
+    the same fused (ticker x param) kernel as :func:`~.sweep.run_sweep`.
+    """
+    T = ohlcv.close.shape[-1]
+    starts = window_starts(T, train, test)
+    n_tickers = ohlcv.close.shape[0]
+    span = train + test
+    sign = metrics_mod.metric_sign(metric)
+
+    def slice_win(a, s0):
+        return jax.lax.dynamic_slice_in_dim(a, s0, span, axis=-1)
+
+    def one_window(carry, s0):
+        win = type(ohlcv)(*(slice_win(f, s0) for f in ohlcv))
+
+        def per_param(ohlcv_1, params):
+            pos = strategy.positions(ohlcv_1, params)
+            res = pnl_mod.backtest_prefix(ohlcv_1.close, pos, cost=cost)
+            # Positions at bar t use only bars <= t, so the full-window series
+            # sliced to [:train] is identical to a train-only run — the train
+            # metric sees *statically* train-span returns/equity/positions
+            # (no test-span leakage for equity-based metrics either).
+            train_m = getattr(metrics_mod.summary_metrics(
+                res.returns[..., :train], res.equity[..., :train],
+                res.positions[..., :train],
+                periods_per_year=periods_per_year), metric)
+            return train_m, res.returns[..., train:], res.positions[..., train:]
+
+        def per_ticker(ohlcv_1):
+            train_m, rets, poss = jax.vmap(
+                lambda p: per_param(ohlcv_1, p))(dict(grid))  # (P,),(P,test)x2
+            best = jnp.argmax(sign * train_m)
+            return train_m[best], best, rets[best], poss[best]
+
+        best_val, best_idx, oos_r, oos_p = jax.vmap(per_ticker)(win)
+        return carry, (best_val, best_idx, oos_r, oos_p)
+
+    _, (train_best, best_idx, oos_r, oos_p) = jax.lax.scan(one_window, 0, starts)
+    # scan outputs are window-major: (n_windows, n_tickers, ...)
+    oos_returns = jnp.moveaxis(oos_r, 0, 1).reshape(n_tickers, -1)
+    oos_positions = jnp.moveaxis(oos_p, 0, 1).reshape(n_tickers, -1)
+    chosen = {k: jnp.moveaxis(jnp.take(v, best_idx), 0, 1)
+              for k, v in grid.items()}
+    equity = 1.0 + jnp.cumsum(oos_returns, axis=-1)
+    oos_metrics = metrics_mod.summary_metrics(
+        oos_returns, equity, oos_positions,
+        periods_per_year=periods_per_year)
+    return WalkForwardResult(
+        oos_returns=oos_returns,
+        oos_metrics=oos_metrics,
+        chosen=chosen,
+        train_metric=jnp.moveaxis(train_best, 0, 1),
+    )

@@ -2,18 +2,24 @@
 
 Multi-chip code paths (mesh/shard_map/ppermute) are validated without TPU
 hardware by forcing the host platform to expose 8 devices — the strategy
-SURVEY.md section 4 prescribes. Must run before the first ``import jax``.
+SURVEY.md section 4 prescribes. The environment's ``sitecustomize`` registers
+the real-TPU "axon" backend and pins ``jax_platforms="axon,cpu"`` via
+``jax.config`` *before any user code runs*, so an env-var override is
+ineffective — the config must be updated through ``jax.config`` after import
+and before the first backend initialization. ``XLA_FLAGS`` must still be set
+before the CPU client spins up.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
-import jax  # noqa: E402  (env must be set first)
+import jax  # noqa: E402  (flags must be set first)
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,75 @@
+"""Time-axis sharding: distributed scans must equal their local versions."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_backtesting_exploration_tpu.ops import rolling
+from distributed_backtesting_exploration_tpu.parallel import timeshard
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@pytest.fixture(scope="module")
+def tmesh(devices):
+    return Mesh(np.asarray(devices), (timeshard.TIME_AXIS,))
+
+
+def _time_sharded(mesh, x):
+    spec = P(*((None,) * (x.ndim - 1) + (timeshard.TIME_AXIS,)))
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def test_sharded_cumsum_matches_local(tmesh):
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((3, 512)),
+                    jnp.float32)
+    got = timeshard.sharded_cumsum(tmesh, _time_sharded(tmesh, x))
+    np.testing.assert_allclose(np.asarray(got), np.cumsum(np.asarray(x), -1),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_linear_scan_matches_ema(tmesh):
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((2, 512)), jnp.float32)
+    span = 20
+    ref = rolling.ema(x, span=span)
+    alpha = 2.0 / (span + 1.0)
+    a = jnp.full_like(x, 1.0 - alpha)
+    b = x * alpha
+    t0 = jnp.arange(x.shape[-1]) == 0
+    a = jnp.where(t0, 0.0, a)
+    b = jnp.where(t0, x, b)
+    got = timeshard.sharded_linear_scan(
+        tmesh, _time_sharded(tmesh, a), _time_sharded(tmesh, b))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_linear_scan_random_coeffs(tmesh):
+    rng = np.random.default_rng(2)
+    a = jnp.asarray(rng.uniform(0.1, 0.99, (512,)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((512,)), jnp.float32)
+    want = np.zeros(512, np.float64)
+    y = 0.0
+    an, bn = np.asarray(a, np.float64), np.asarray(b, np.float64)
+    for t in range(512):
+        y = an[t] * y + bn[t]
+        want[t] = y
+    got = timeshard.sharded_linear_scan(
+        tmesh, _time_sharded(tmesh, a), _time_sharded(tmesh, b))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-4)
+
+
+def test_chunked_scan_equals_flat_scan():
+    rng = np.random.default_rng(3)
+    xs = jnp.asarray(rng.standard_normal((256, 4)), jnp.float32)
+
+    def step(carry, x):
+        nxt = 0.9 * carry + jnp.sum(x)
+        return nxt, nxt
+
+    want_carry, want_ys = jax.lax.scan(step, 0.0, xs)
+    got_carry, got_ys = timeshard.chunked_scan(step, 0.0, xs, chunk=32)
+    np.testing.assert_allclose(float(got_carry), float(want_carry), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_ys), np.asarray(want_ys),
+                               rtol=1e-5, atol=1e-5)
