@@ -1,0 +1,96 @@
+"""Headline benchmark: (ticker x param) backtests/sec on one chip.
+
+Workload = the BASELINE.json north star: a 500-ticker SMA-crossover sweep
+over 5 years of daily bars with a 2,000-point (fast, slow) grid — 1,000,000
+full backtests (indicators, positions, PnL, 9 summary metrics) per sweep
+call, executed as a single fused jit kernel chunked over the param axis to
+bound HBM.
+
+Baseline: the reference's worker processes jobs serially at 1 job/sec (its
+compute slot sleeps 1 s per job — reference ``src/worker/process.rs:23``), so
+``vs_baseline`` is the raw speedup over 1 backtest/sec.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "backtests/sec", "vs_baseline": N}
+
+Env overrides (for local smoke runs): DBX_BENCH_TICKERS, DBX_BENCH_BARS,
+DBX_BENCH_PARAMS (grid points, must stay divisible by the chunk),
+DBX_BENCH_CHUNK, DBX_BENCH_ITERS, DBX_BENCH_CPU=1 to force the CPU platform.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    if os.environ.get("DBX_BENCH_CPU") == "1":
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_backtesting_exploration_tpu.models import base
+    from distributed_backtesting_exploration_tpu.parallel import sweep
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    n_tickers = int(os.environ.get("DBX_BENCH_TICKERS", 500))
+    n_bars = int(os.environ.get("DBX_BENCH_BARS", 1260))      # 5y daily
+    n_params = int(os.environ.get("DBX_BENCH_PARAMS", 2000))
+    chunk = int(os.environ.get("DBX_BENCH_CHUNK", 100))
+    iters = int(os.environ.get("DBX_BENCH_ITERS", 3))
+
+    dev = jax.devices()[0]
+    print(f"bench: device={dev.device_kind} tickers={n_tickers} "
+          f"bars={n_bars} params={n_params} chunk={chunk}", file=sys.stderr)
+
+    # Param grid: n_fast x n_slow = n_params (default 20 x 100).
+    n_fast = 20
+    n_slow = n_params // n_fast
+    grid = sweep.product_grid(
+        fast=jnp.arange(5, 5 + n_fast, dtype=jnp.float32),
+        slow=jnp.linspace(30, 250, n_slow).astype(jnp.float32))
+
+    ohlcv = data.synthetic_ohlcv(n_tickers, n_bars, seed=0)
+    panel = type(ohlcv)(*(jax.device_put(jnp.asarray(f), dev) for f in ohlcv))
+    strategy = base.get_strategy("sma_crossover")
+
+    def run():
+        return sweep.chunked_sweep(panel, strategy, grid, param_chunk=chunk,
+                                   cost=1e-3)
+
+    t0 = time.perf_counter()
+    out = run()
+    first_sharpe = np.asarray(out.sharpe)
+    compile_s = time.perf_counter() - t0
+    print(f"bench: first call (incl. compile) {compile_s:.1f}s", file=sys.stderr)
+
+    # Force a device-side reduction + scalar fetch every iteration: with the
+    # remote-proxy TPU backend, block_until_ready alone can report dispatch
+    # time rather than execution time.
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = run()
+        float(jnp.sum(out.sharpe))
+    elapsed = time.perf_counter() - t0
+
+    n_backtests = n_tickers * sweep.grid_size(grid)
+    rate = n_backtests * iters / elapsed
+    assert np.isfinite(first_sharpe).all()
+    print(f"bench: {iters}x {n_backtests} backtests in {elapsed:.3f}s",
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": "backtests/sec/chip (ticker x param combos), "
+                  "SMA-crossover sweep, 5y daily bars",
+        "value": round(rate, 1),
+        "unit": "backtests/sec",
+        "vs_baseline": round(rate, 1),  # reference worker: 1 backtest/sec
+    }))
+
+
+if __name__ == "__main__":
+    main()

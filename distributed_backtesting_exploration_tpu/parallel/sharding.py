@@ -27,7 +27,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import metrics as metrics_mod
 from ..parallel import sweep as sweep_mod
-from ..utils import data as data_mod
 
 TICKER_AXIS = "tickers"
 

@@ -93,14 +93,48 @@ def jit_sweep(ohlcv, strategy, grid, *, cost=0.0, bar_mask=None,
                      periods_per_year=periods_per_year)
 
 
-def best_params(metric_values: Array, grid: Mapping[str, Array], *, axis=-1):
-    """Argmax a ``(..., P)`` metric over the param axis; gather the winners.
+@functools.partial(
+    jax.jit, static_argnames=("strategy", "param_chunk", "periods_per_year"))
+def chunked_sweep(ohlcv, strategy, grid, *, param_chunk: int, cost=0.0,
+                  bar_mask=None, periods_per_year=252):
+    """Memory-bounded sweep: ``lax.map`` over param chunks of a vmapped kernel.
+
+    A fully-vmapped sweep materializes ``(tickers, P, T)`` intermediates —
+    ~``tickers*P*T*4`` bytes per live tensor, which blows past HBM once
+    ``tickers*P`` reaches the millions the north star calls for. Chunking the
+    param axis bounds live memory to the chunk's working set while the
+    sequential ``lax.map`` keeps one compiled program; per-chunk compute stays
+    a fused (ticker x chunk) kernel big enough to saturate the VPU.
+
+    ``P`` must be divisible by ``param_chunk``.
+    """
+    P = grid_size(grid)
+    if P % param_chunk:
+        raise ValueError(f"grid size {P} not divisible by chunk {param_chunk}")
+    chunked = {k: jnp.reshape(v, (P // param_chunk, param_chunk))
+               for k, v in grid.items()}
+
+    def one_chunk(g):
+        return run_sweep(ohlcv, strategy, g, cost=cost, bar_mask=bar_mask,
+                         periods_per_year=periods_per_year)
+
+    out = jax.lax.map(one_chunk, chunked)   # fields: (n_chunks, tickers, chunk)
+    return metrics_mod.Metrics(*(
+        jnp.reshape(jnp.moveaxis(f, 0, 1), (f.shape[1], P)) for f in out))
+
+
+def best_params(metric_values: Array, grid: Mapping[str, Array], *, axis=-1,
+                metric: str | None = None):
+    """Select the best point of a ``(..., P)`` metric over the param axis.
 
     Returns ``(best_value, {name: best_param})`` with the leading shape of
     ``metric_values`` minus the param axis. Used by walk-forward refits and by
-    dispatcher-side result aggregation.
+    dispatcher-side result aggregation. Pass ``metric`` (the
+    :class:`~..ops.metrics.Metrics` field name) so lower-is-better metrics
+    (max_drawdown, volatility, turnover) select the minimum.
     """
-    idx = jnp.argmax(metric_values, axis=axis)
+    sign = metrics_mod.metric_sign(metric) if metric is not None else 1.0
+    idx = jnp.argmax(sign * metric_values, axis=axis)
     best = jnp.take_along_axis(
         metric_values, jnp.expand_dims(idx, axis), axis=axis).squeeze(axis)
     chosen = {n: jnp.take(v, idx) for n, v in grid.items()}

@@ -34,7 +34,9 @@ class WalkForwardResult(NamedTuple):
 
     Attributes:
         oos_returns: ``(n_tickers, n_windows * test)`` stitched out-of-sample
-            net returns under the per-window chosen params.
+            net returns under the per-window chosen params, including the
+            rebalance cost at window boundaries.
+        oos_positions: ``(n_tickers, n_windows * test)`` stitched positions.
         oos_metrics: :class:`~..ops.metrics.Metrics` over the stitched series,
             each field ``(n_tickers,)`` — the honest performance estimate.
         chosen: dict param name -> ``(n_tickers, n_windows)`` selected values.
@@ -42,6 +44,7 @@ class WalkForwardResult(NamedTuple):
     """
 
     oos_returns: Array
+    oos_positions: Array
     oos_metrics: metrics_mod.Metrics
     chosen: Mapping[str, Array]
     train_metric: Array
@@ -103,19 +106,39 @@ def walk_forward(
                 res.returns[..., :train], res.equity[..., :train],
                 res.positions[..., :train],
                 periods_per_year=periods_per_year), metric)
-            return train_m, res.returns[..., train:], res.positions[..., train:]
+            return (train_m, res.returns[..., train:],
+                    res.positions[..., train:], res.positions[..., train - 1])
 
         def per_ticker(ohlcv_1):
-            train_m, rets, poss = jax.vmap(
-                lambda p: per_param(ohlcv_1, p))(dict(grid))  # (P,),(P,test)x2
+            train_m, rets, poss, prevs = jax.vmap(
+                lambda p: per_param(ohlcv_1, p))(dict(grid))  # (P,),(P,test)..
             best = jnp.argmax(sign * train_m)
-            return train_m[best], best, rets[best], poss[best]
+            return train_m[best], best, rets[best], poss[best], prevs[best]
 
-        best_val, best_idx, oos_r, oos_p = jax.vmap(per_ticker)(win)
-        return carry, (best_val, best_idx, oos_r, oos_p)
+        best_val, best_idx, oos_r, oos_p, prev_in = jax.vmap(per_ticker)(win)
+        rf = win.close[:, train] / win.close[:, train - 1] - 1.0
+        return carry, (best_val, best_idx, oos_r, oos_p, prev_in, rf)
 
-    _, (train_best, best_idx, oos_r, oos_p) = jax.lax.scan(one_window, 0, starts)
+    _, (train_best, best_idx, oos_r, oos_p, prev_in, rf) = jax.lax.scan(
+        one_window, 0, starts)
     # scan outputs are window-major: (n_windows, n_tickers, ...)
+
+    # Boundary fix-up. Each window's first OOS bar was priced by
+    # backtest_prefix against that window's own train-span position at
+    # ``train-1`` (``prev_in``): it earned ``prev_in * r`` and paid turnover
+    # ``|pos - prev_in|``. A sequential deployment instead carries the
+    # *previous window's* final OOS position into that bar (window w's last
+    # test bar is the bar before window w+1's first one) — and starts flat at
+    # window 0. Swap both the earnings and the cost terms so the stitched
+    # series prices exactly the positions it reports.
+    first_pos = oos_p[:, :, 0]                                # (W, n_tickers)
+    prev_deployed = jnp.concatenate(
+        [jnp.zeros_like(first_pos[:1]), oos_p[:-1, :, -1]], axis=0)
+    c = jnp.asarray(cost, oos_r.dtype)
+    adj = (prev_deployed - prev_in) * rf - c * (
+        jnp.abs(first_pos - prev_deployed) - jnp.abs(first_pos - prev_in))
+    oos_r = oos_r.at[:, :, 0].add(adj)
+
     oos_returns = jnp.moveaxis(oos_r, 0, 1).reshape(n_tickers, -1)
     oos_positions = jnp.moveaxis(oos_p, 0, 1).reshape(n_tickers, -1)
     chosen = {k: jnp.moveaxis(jnp.take(v, best_idx), 0, 1)
@@ -126,6 +149,7 @@ def walk_forward(
         periods_per_year=periods_per_year)
     return WalkForwardResult(
         oos_returns=oos_returns,
+        oos_positions=oos_positions,
         oos_metrics=oos_metrics,
         chosen=chosen,
         train_metric=jnp.moveaxis(train_best, 0, 1),

@@ -1,0 +1,82 @@
+"""gRPC service/client stubs for the Dispatcher contract.
+
+Hand-written equivalent of what ``grpc_tools.protoc``'s python-grpc plugin
+would generate from ``backtesting.proto`` (the plugin is not available in
+this environment; only message codegen is). The ``.proto`` file remains the
+single source of truth for the wire contract — this module only binds the
+four unary RPCs to the generated message classes, once, in one place.
+
+The channel is gzip-compressed in both directions (the reference compressed
+only the server->worker leg, reference ``src/server/main.rs:212`` /
+``src/worker/main.rs:49``; with binary OHLCV blocks both directions carry
+bulk payloads — jobs down, metric matrices up — so symmetric compression is
+the right default).
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from . import backtesting_pb2 as pb
+
+SERVICE_NAME = "dbx.rpc.Dispatcher"
+
+# (method, request class, reply class) — mirrors the service block in
+# backtesting.proto.
+_METHODS = (
+    ("RequestJobs", pb.JobsRequest, pb.JobsReply),
+    ("SendStatus", pb.StatusRequest, pb.Ack),
+    ("CompleteJob", pb.CompleteRequest, pb.Ack),
+    ("GetStats", pb.StatsRequest, pb.StatsReply),
+)
+
+
+class DispatcherServicer:
+    """Interface for the server side; subclass and override each RPC."""
+
+    def RequestJobs(self, request: pb.JobsRequest, context) -> pb.JobsReply:
+        raise NotImplementedError
+
+    def SendStatus(self, request: pb.StatusRequest, context) -> pb.Ack:
+        raise NotImplementedError
+
+    def CompleteJob(self, request: pb.CompleteRequest, context) -> pb.Ack:
+        raise NotImplementedError
+
+    def GetStats(self, request: pb.StatsRequest, context) -> pb.StatsReply:
+        raise NotImplementedError
+
+
+def add_dispatcher_to_server(servicer: DispatcherServicer, server) -> None:
+    """Register the servicer's unary handlers under the service name."""
+    handlers = {
+        name: grpc.unary_unary_rpc_method_handler(
+            getattr(servicer, name),
+            request_deserializer=req.FromString,
+            response_serializer=rep.SerializeToString,
+        )
+        for name, req, rep in _METHODS
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),))
+
+
+class DispatcherStub:
+    """Client stub; one callable per RPC, bound to ``channel``."""
+
+    def __init__(self, channel: grpc.Channel):
+        for name, req, rep in _METHODS:
+            setattr(self, name, channel.unary_unary(
+                f"/{SERVICE_NAME}/{name}",
+                request_serializer=req.SerializeToString,
+                response_deserializer=rep.FromString,
+            ))
+
+
+def default_channel_options() -> list[tuple[str, object]]:
+    """Channel/server options: gzip + generous message sizes for OHLCV blocks."""
+    return [
+        ("grpc.default_compression_algorithm", grpc.Compression.Gzip),
+        ("grpc.max_send_message_length", 256 * 1024 * 1024),
+        ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+    ]

@@ -2,7 +2,6 @@
 
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from distributed_backtesting_exploration_tpu.models import pairs
 from distributed_backtesting_exploration_tpu.models.base import get_strategy
@@ -69,7 +68,6 @@ def test_walkforward_matches_manual_loop():
     assert res.oos_returns.shape == (4, n_windows * test)
 
     # Manual reference for ticker 0, window 0.
-    from distributed_backtesting_exploration_tpu.ops import metrics as M
     win = type(panel)(*(f[0:1, starts[0]:starts[0] + train + test]
                         for f in panel))
     per_param = sweep.run_sweep(
@@ -80,7 +78,10 @@ def test_walkforward_matches_manual_loop():
     params = {k: v[best] for k, v in grid.items()}
     pos = strat.positions(type(panel)(*(f[0] for f in win)), params)
     ref = pnl.backtest_prefix(win.close[0], pos)
-    want_oos = np.asarray(ref.returns)[train:]
+    want_oos = np.asarray(ref.returns)[train:].copy()
+    # Window 0 starts flat in deployment: its first OOS bar earns nothing
+    # (the in-window backtest carried the train-span position into it).
+    want_oos[0] = 0.0
     np.testing.assert_allclose(
         np.asarray(res.oos_returns)[0, :test], want_oos, rtol=1e-5, atol=1e-6)
     for k in grid:
@@ -119,3 +120,36 @@ def test_walkforward_lower_is_better_metric():
         dds.append(float(M.max_drawdown(r.equity)))
     np.testing.assert_allclose(float(res.train_metric[0, 0]), min(dds),
                                rtol=1e-5, atol=1e-7)
+
+
+def test_walkforward_boundary_rebalance_cost():
+    """The stitched series prices exactly the positions it reports.
+
+    Reprice the stitched position series from scratch: bar-over-bar returns
+    of the underlying closes times the lagged stitched position, minus cost
+    on the stitched turnover (starting flat). That must equal oos_returns —
+    including at window boundaries, where the in-window charge from
+    backtest_prefix has to have been swapped for the deployed-transition
+    charge.
+    """
+    cost = 1e-2
+    ohlcv = data.synthetic_ohlcv(2, 512, seed=21)
+    panel = type(ohlcv)(*(jnp.asarray(f) for f in ohlcv))
+    grid = sweep.product_grid(fast=jnp.array([3., 6.]),
+                              slow=jnp.array([12., 24.]))
+    train, test = 128, 64
+    res = walkforward.walk_forward(panel, get_strategy("sma_crossover"), grid,
+                                   train=train, test=test, cost=cost)
+    pos = np.asarray(res.oos_positions, np.float64)   # (tickers, W*test)
+    close = np.asarray(panel.close, np.float64)
+    W = (512 - train) // test
+    # Global bar index of each stitched OOS bar: window w spans
+    # [w*test + train, w*test + train + test).
+    idx = np.concatenate(
+        [np.arange(w * test + train, w * test + train + test)
+         for w in range(W)])
+    r = close[:, idx] / close[:, idx - 1] - 1.0       # per-bar simple returns
+    prev = np.concatenate([np.zeros((2, 1)), pos[:, :-1]], axis=1)
+    want = prev * r - cost * np.abs(pos - prev)
+    np.testing.assert_allclose(np.asarray(res.oos_returns), want,
+                               rtol=1e-4, atol=1e-6)

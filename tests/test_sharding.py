@@ -5,12 +5,10 @@ sweeps are embarrassingly parallel over tickers, so any divergence is a
 sharding bug, not math.
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-import distributed_backtesting_exploration_tpu as dbx
 from distributed_backtesting_exploration_tpu.models import sma_crossover  # noqa: F401
 from distributed_backtesting_exploration_tpu.models.base import get_strategy
 from distributed_backtesting_exploration_tpu.parallel import sharding, sweep

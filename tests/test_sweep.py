@@ -86,3 +86,23 @@ def test_padding_invariance():
                                np.asarray(m_unpadded.sharpe), rtol=1e-3)
     np.testing.assert_allclose(np.asarray(m_padded.max_drawdown),
                                np.asarray(m_unpadded.max_drawdown), atol=1e-5)
+
+
+def test_chunked_sweep_matches_jit_sweep():
+    """Param-chunked lax.map sweep must equal the fully-vmapped sweep."""
+    import jax.numpy as jnp
+    from distributed_backtesting_exploration_tpu.models.base import get_strategy
+    from distributed_backtesting_exploration_tpu.parallel import sweep as sw
+    from distributed_backtesting_exploration_tpu.utils import data as d
+
+    ohlcv = d.synthetic_ohlcv(5, 256, seed=13)
+    panel = type(ohlcv)(*(jnp.asarray(f) for f in ohlcv))
+    grid = sw.product_grid(fast=jnp.array([3., 5., 8.]),
+                           slow=jnp.array([13., 21., 34., 55.]))
+    strat = get_strategy("sma_crossover")
+    ref = sw.jit_sweep(panel, strat, dict(grid), cost=1e-3)
+    got = sw.chunked_sweep(panel, strat, dict(grid), param_chunk=4, cost=1e-3)
+    for name in ref._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(got, name)), np.asarray(getattr(ref, name)),
+            rtol=1e-6, atol=1e-7, err_msg=name)
