@@ -28,11 +28,12 @@ def main():
     if os.environ.get("DBX_BENCH_CPU") == "1":
         os.environ.setdefault(
             "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-        import jax
-        jax.config.update("jax_platforms", "cpu")
     import jax
     import jax.numpy as jnp
     import numpy as np
+
+    if os.environ.get("DBX_BENCH_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
 
     from distributed_backtesting_exploration_tpu.models import base
     from distributed_backtesting_exploration_tpu.parallel import sweep

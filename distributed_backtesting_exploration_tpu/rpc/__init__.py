@@ -1,0 +1,19 @@
+"""Distributed control plane: the dispatcher <-> worker gRPC contract.
+
+``backtesting.proto`` is the single source of truth for the wire contract
+(same discipline as the reference, reference ``README.md:17``); generated
+messages live in ``backtesting_pb2``, the hand-written stubs in
+:mod:`.service`. :mod:`.dispatcher` is the server (leased durable queue,
+peer liveness, stats); :mod:`.worker` the polling client; :mod:`.compute`
+the backend seam where the JAX engine plugs in; :mod:`.journal` the
+crash-recovery log; :mod:`.wire` the binary result codec.
+
+Run them:
+
+    python -m distributed_backtesting_exploration_tpu.rpc.dispatcher \
+        --synthetic 64 --grid "fast=5:25,slow=30:130:5" --journal q.jsonl
+    python -m distributed_backtesting_exploration_tpu.rpc.worker \
+        --connect localhost:50051 --backend jax
+"""
+
+from . import backtesting_pb2, compute, dispatcher, journal, service, wire, worker  # noqa: F401

@@ -1,0 +1,143 @@
+"""Worker compute backends: the slot the reference filled with a sleep.
+
+The reference's worker pushes each job batch to an OS thread that sleeps one
+second per job (reference ``src/worker/process.rs:13-29``, acknowledged as a
+stub in reference ``README.md:84``). Here the same seam — a backend consuming
+job batches and yielding completions — is filled by the fused JAX sweep
+kernel; fake backends preserve the seam for control-plane tests exactly as
+the stub's isolation suggested (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterable, Protocol
+
+import numpy as np
+
+from . import backtesting_pb2 as pb
+from . import wire
+from ..utils import data as data_mod
+
+
+class Completion:
+    """One finished job: id + packed DBXM metrics + compute seconds."""
+
+    __slots__ = ("job_id", "metrics", "elapsed_s")
+
+    def __init__(self, job_id: str, metrics: bytes, elapsed_s: float):
+        self.job_id = job_id
+        self.metrics = metrics
+        self.elapsed_s = elapsed_s
+
+
+class ComputeBackend(Protocol):
+    def process(self, jobs: Iterable[pb.JobSpec]) -> list[Completion]:
+        """Run a job batch to completion (synchronous, CPU/TPU-bound)."""
+        ...
+
+    @property
+    def chips(self) -> int:
+        """Device count to advertise to the dispatcher."""
+        ...
+
+
+class JaxSweepBackend:
+    """The real engine: decode OHLCV bytes, run the fused sweep, pack metrics.
+
+    Jobs in a batch that share (strategy, grid, n_bars) are stacked into one
+    (tickers x params) device call — the per-chip batching the north star
+    prescribes — instead of being looped one by one.
+    """
+
+    def __init__(self, *, param_chunk: int | None = None):
+        import jax  # deferred: workers decide platform via env/config
+
+        self._jax = jax
+        self.param_chunk = param_chunk
+        self._devices = jax.devices()
+
+    @property
+    def chips(self) -> int:
+        return len(self._devices)
+
+    def process(self, jobs) -> list[Completion]:
+        import jax.numpy as jnp
+
+        from ..models import base as models_base
+        from ..parallel import sweep as sweep_mod
+
+        jobs = list(jobs)
+        out: list[Completion] = []
+        # Group stackable jobs: same strategy, same grid, same history length.
+        groups: dict[tuple, list[pb.JobSpec]] = {}
+        for job in jobs:
+            grid = wire.grid_from_proto(job.grid)
+            key = (job.strategy,
+                   tuple(sorted((k, v.tobytes()) for k, v in grid.items())),
+                   len(job.ohlcv), job.cost, job.periods_per_year)
+            groups.setdefault(key, []).append(job)
+
+        for group in groups.values():
+            t0 = time.perf_counter()
+            series = [data_mod.from_wire_bytes(j.ohlcv) for j in group]
+            batch, _, mask = data_mod.pad_and_stack(series)
+            panel = type(batch)(*(jnp.asarray(f) for f in batch))
+            # JobSpec.grid carries per-parameter AXES; the cartesian product
+            # is materialized worker-side (backtesting.proto JobSpec.grid).
+            grid = sweep_mod.product_grid(
+                **wire.grid_from_proto(group[0].grid))
+            strategy = models_base.get_strategy(group[0].strategy)
+            ppy = group[0].periods_per_year or 252
+            kwargs = dict(cost=group[0].cost, bar_mask=jnp.asarray(mask),
+                          periods_per_year=ppy)
+            P = sweep_mod.grid_size(grid) if grid else 1
+            if self.param_chunk and P % self.param_chunk == 0:
+                m = sweep_mod.chunked_sweep(
+                    panel, strategy, grid, param_chunk=self.param_chunk,
+                    **kwargs)
+            else:
+                m = sweep_mod.jit_sweep(panel, strategy, grid, **kwargs)
+            host = type(m)(*(np.asarray(f) for f in m))   # (n, P) each
+            elapsed = time.perf_counter() - t0
+            per_job = elapsed / len(group)
+            for i, job in enumerate(group):
+                row = type(host)(*(f[i] for f in host))
+                out.append(Completion(
+                    job.id, wire.metrics_to_bytes(row), per_job))
+        return out
+
+
+class InstantBackend:
+    """Completes every job immediately with an empty metric block (tests)."""
+
+    chips = 1
+
+    def __init__(self):
+        self.seen: list[str] = []
+
+    def process(self, jobs) -> list[Completion]:
+        out = []
+        from ..ops.metrics import Metrics
+        empty = wire.metrics_to_bytes(
+            Metrics(*(np.zeros(1, np.float32) for _ in Metrics._fields)))
+        for job in jobs:
+            self.seen.append(job.id)
+            out.append(Completion(job.id, empty, 0.0))
+        return out
+
+
+class SleepBackend:
+    """Fixed per-job delay — the reference stub's behavior, for liveness tests."""
+
+    chips = 1
+
+    def __init__(self, delay_s: float = 0.05):
+        self.delay_s = delay_s
+
+    def process(self, jobs) -> list[Completion]:
+        out = []
+        for job in jobs:
+            time.sleep(self.delay_s)
+            out.append(Completion(job.id, b"", self.delay_s))
+        return out

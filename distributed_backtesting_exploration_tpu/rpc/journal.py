@@ -1,0 +1,82 @@
+"""Crash-durable job journal: append-only JSONL with replay.
+
+The reference's queue is a bare in-memory Vec — a server crash loses every
+job and every completion record (its own Limitations list names this,
+reference ``README.md:80``). Here every queue transition is appended to a
+JSONL journal and fsync'd, and a restarting dispatcher replays the file:
+``pending = enqueued - completed - failed``. Leases are deliberately NOT
+journaled — a lease lost to a crash simply leaves the job pending again,
+and completion is idempotent, so replay needs no lease bookkeeping.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ReplayState:
+    """Result of replaying a journal file."""
+
+    jobs: dict = field(default_factory=dict)        # id -> job record (dict)
+    completed: set = field(default_factory=set)     # job ids
+    failed: set = field(default_factory=set)        # job ids
+
+    @property
+    def pending(self) -> list[str]:
+        done = self.completed | self.failed
+        return [j for j in self.jobs if j not in done]
+
+
+class Journal:
+    """Append-only JSONL journal; thread-safe; no-op when ``path`` is None."""
+
+    def __init__(self, path: str | None):
+        self._path = path
+        self._lock = threading.Lock()
+        self._fh = open(path, "a", encoding="utf-8") if path else None
+
+    def append(self, event: str, **payload) -> None:
+        if self._fh is None:
+            return
+        rec = {"ev": event, **payload}
+        line = json.dumps(rec, separators=(",", ":"))
+        with self._lock:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    @staticmethod
+    def replay(path: str) -> ReplayState:
+        """Reconstruct queue state from a journal file (missing file = empty).
+
+        Tolerates a torn final line (crash mid-append).
+        """
+        state = ReplayState()
+        if not path or not os.path.exists(path):
+            return state
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail write from a crash
+                ev = rec.get("ev")
+                if ev == "enqueue":
+                    state.jobs[rec["id"]] = rec
+                elif ev == "complete":
+                    state.completed.add(rec["id"])
+                elif ev == "fail":
+                    state.failed.add(rec["id"])
+        return state

@@ -1,0 +1,69 @@
+"""Binary result codec + job spec <-> proto conversion helpers.
+
+Completions carry the full per-param metric matrix as a compact float32
+block ("DBXM"). The reference's completion payload was a free-text string the
+server never read (reference ``src/server/main.rs:66-78``); here the payload
+is the actual product of the backtest and the dispatcher records it.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Mapping
+
+import numpy as np
+
+from ..ops.metrics import Metrics
+from . import backtesting_pb2 as pb
+
+_METRICS_MAGIC = b"DBXM"
+
+
+def metrics_to_bytes(m: Metrics) -> bytes:
+    """Pack a ``(P,)``-per-field Metrics tuple into one DBXM block."""
+    fields = [np.asarray(f, dtype="<f4").reshape(-1) for f in m]
+    P = fields[0].shape[0]
+    if any(f.shape[0] != P for f in fields):
+        raise ValueError("all metric fields must have equal length")
+    head = _METRICS_MAGIC + struct.pack("<II", P, len(fields))
+    return head + b"".join(f.tobytes() for f in fields)
+
+
+def metrics_from_bytes(data: bytes) -> Metrics:
+    """Decode a DBXM block back into a Metrics tuple of ``(P,)`` arrays."""
+    if data[:4] != _METRICS_MAGIC:
+        raise ValueError("bad magic; not a DBXM metrics block")
+    P, n_fields = struct.unpack_from("<II", data, 4)
+    if n_fields != len(Metrics._fields):
+        raise ValueError(
+            f"metrics block has {n_fields} fields, expected "
+            f"{len(Metrics._fields)}")
+    need = 12 + 4 * n_fields * P
+    if len(data) < need:
+        raise ValueError(f"truncated metrics block: {len(data)} < {need}")
+    out = []
+    off = 12
+    for _ in range(n_fields):
+        out.append(np.frombuffer(data, dtype="<f4", count=P, offset=off).copy())
+        off += 4 * P
+    return Metrics(*out)
+
+
+def grid_to_proto(grid: Mapping[str, "np.ndarray"]) -> dict:
+    """Param axes dict -> proto map field value dict."""
+    return {k: pb.GridAxis(values=[float(v) for v in np.asarray(vs).reshape(-1)])
+            for k, vs in grid.items()}
+
+
+def grid_from_proto(proto_grid) -> dict[str, np.ndarray]:
+    """Proto map field -> dict of float32 axis arrays."""
+    return {k: np.asarray(ax.values, np.float32)
+            for k, ax in proto_grid.items()}
+
+
+def grid_n_combos(proto_grid) -> int:
+    """Cartesian-product size of a job's parameter grid (1 if empty)."""
+    n = 1
+    for ax in proto_grid.values():
+        n *= max(len(ax.values), 1)
+    return n

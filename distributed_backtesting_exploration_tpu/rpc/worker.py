@@ -1,0 +1,244 @@
+"""The worker: poll loop + compute thread, bridged by bounded queues.
+
+Same shape as the reference's worker — an I/O loop polling the dispatcher on
+a tick, a dedicated compute thread so device-bound work never starves the
+control plane, and bounded channels between them (reference
+``src/worker/main.rs:24-85``) — with its sharp edges removed:
+
+- the worker stops *requesting* jobs while its compute queue is full (the
+  reference kept polling every 250 ms regardless, hoarding up to 1024
+  batches in its channel; reference ``src/worker/handlers.rs:54-58``);
+- a failed completion RPC is retried with backoff, not ``.unwrap()``-panicked
+  (reference ``src/worker/main.rs:82``);
+- startup connect failures retry instead of exiting (reference
+  ``src/worker/main.rs:50-55``);
+- shutdown is graceful: in-flight work drains before exit (a reference
+  Limitations item, reference ``README.md:85``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import queue as queue_mod
+import threading
+import time
+import uuid
+
+import grpc
+
+from . import backtesting_pb2 as pb
+from . import compute, service
+
+log = logging.getLogger("dbx.worker")
+
+
+class Worker:
+    """Polls a dispatcher, runs a compute backend, reports completions."""
+
+    def __init__(self, target: str, backend: compute.ComputeBackend, *,
+                 worker_id: str | None = None,
+                 poll_interval_s: float = 0.25,
+                 status_interval_s: float = 1.0,
+                 jobs_per_chip: int = 1,
+                 max_inflight_batches: int = 2):
+        self.target = target
+        self.backend = backend
+        self.worker_id = worker_id or f"worker-{uuid.uuid4().hex[:8]}"
+        self.poll_interval_s = poll_interval_s
+        self.status_interval_s = status_interval_s
+        self.jobs_per_chip = jobs_per_chip
+        self._in: queue_mod.Queue = queue_mod.Queue(max_inflight_batches)
+        self._out: queue_mod.Queue = queue_mod.Queue()
+        self._stop = threading.Event()
+        self._busy = threading.Event()
+        self._connected = True  # edge-triggered logging, reference CONNECTED
+        self.jobs_completed = 0
+        self._compute_thread: threading.Thread | None = None
+
+    # -- compute side ------------------------------------------------------
+
+    def _compute_loop(self) -> None:
+        while True:
+            batch = self._in.get()
+            if batch is None:
+                return
+            self._busy.set()
+            try:
+                for completion in self.backend.process(batch):
+                    self._out.put(completion)
+            except Exception:
+                log.exception("backend failed on a %d-job batch; jobs will "
+                              "be re-queued by lease expiry", len(batch))
+            finally:
+                self._busy.clear()
+
+    # -- control side ------------------------------------------------------
+
+    def run(self, *, max_idle_polls: int | None = None) -> None:
+        """Run until stopped (or until ``max_idle_polls`` empty polls).
+
+        ``max_idle_polls`` gives batch-style runs a natural exit: stop after
+        that many consecutive empty replies once at least one job was seen.
+        """
+        channel = grpc.insecure_channel(
+            self.target, options=service.default_channel_options(),
+            compression=grpc.Compression.Gzip)
+        stub = service.DispatcherStub(channel)
+        self._compute_thread = threading.Thread(
+            target=self._compute_loop, name="dbx-compute", daemon=True)
+        self._compute_thread.start()
+
+        idle_polls = 0
+        saw_work = False
+        next_poll = 0.0
+        next_status = 0.0
+        try:
+            while not self._stop.is_set():
+                now = time.monotonic()
+                if now >= next_status:
+                    next_status = now + self.status_interval_s
+                    self._send_status(stub)
+                if now >= next_poll:
+                    next_poll = now + self.poll_interval_s
+                    got = self._poll_jobs(stub)
+                    if got is not None:
+                        if got:
+                            saw_work = True
+                            idle_polls = 0
+                        elif not self._busy.is_set() and self._out.empty():
+                            idle_polls += 1
+                self._drain_completions(stub)
+                if (max_idle_polls is not None and saw_work
+                        and idle_polls >= max_idle_polls):
+                    log.info("idle for %d polls; draining and exiting",
+                             idle_polls)
+                    break
+                time.sleep(min(self.poll_interval_s, 0.05))
+            self._shutdown(stub)
+        finally:
+            channel.close()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _shutdown(self, stub) -> None:
+        """Graceful drain: finish queued batches, flush completions.
+
+        The compute thread is joined first, so nothing produces into the
+        completion queue anymore and a non-blocking drain is exhaustive.
+        """
+        self._in.put(None)
+        if self._compute_thread is not None:
+            self._compute_thread.join(timeout=60.0)
+        self._drain_completions(stub)
+
+    def _send_status(self, stub) -> None:
+        status = (pb.WORKER_STATUS_RUNNING if self._busy.is_set()
+                  else pb.WORKER_STATUS_IDLE)
+        try:
+            stub.SendStatus(pb.StatusRequest(
+                worker_id=self.worker_id, status=status), timeout=5.0)
+            self._log_reconnected()
+        except grpc.RpcError as e:
+            self._log_disconnected(e)
+
+    def _poll_jobs(self, stub):
+        """Request a batch if the compute queue has room; None on RPC error."""
+        if self._in.full():
+            return None
+        try:
+            reply = stub.RequestJobs(pb.JobsRequest(
+                worker_id=self.worker_id, chips=self.backend.chips,
+                jobs_per_chip=self.jobs_per_chip), timeout=30.0)
+            self._log_reconnected()
+        except grpc.RpcError as e:
+            self._log_disconnected(e)
+            return None
+        jobs = list(reply.jobs)
+        if jobs:
+            log.info("received %d jobs", len(jobs))
+            self._in.put(jobs)
+        return jobs
+
+    def _drain_completions(self, stub) -> None:
+        while True:
+            try:
+                comp = self._out.get_nowait()
+            except queue_mod.Empty:
+                return
+            self._report_completion(stub, comp)
+
+    def _report_completion(self, stub, comp) -> None:
+        req = pb.CompleteRequest(
+            id=comp.job_id, worker_id=self.worker_id,
+            metrics=comp.metrics, elapsed_s=comp.elapsed_s)
+        for backoff in (0.2, 1.0, 5.0, None):
+            try:
+                ack = stub.CompleteJob(req, timeout=10.0)
+                if ack.ok:
+                    self.jobs_completed += 1
+                else:
+                    log.warning("completion %s rejected: %s",
+                                comp.job_id, ack.detail)
+                return
+            except grpc.RpcError as e:
+                self._log_disconnected(e)
+                if backoff is None:
+                    log.error("dropping completion %s after retries "
+                              "(lease will re-queue it)", comp.job_id)
+                    return
+                time.sleep(backoff)
+
+    def _log_disconnected(self, err) -> None:
+        if self._connected:
+            self._connected = False
+            log.error("dispatcher unreachable: %s", getattr(err, "code", err))
+
+    def _log_reconnected(self) -> None:
+        if not self._connected:
+            self._connected = True
+            log.info("dispatcher reachable again")
+
+
+def make_backend(name: str, **kwargs) -> compute.ComputeBackend:
+    if name == "jax":
+        return compute.JaxSweepBackend(
+            param_chunk=kwargs.get("param_chunk"))
+    if name == "instant":
+        return compute.InstantBackend()
+    if name == "sleep":
+        return compute.SleepBackend(kwargs.get("delay_s", 0.05))
+    raise ValueError(f"unknown backend {name!r}")
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        description="dbx worker: poll a dispatcher and run backtest jobs")
+    ap.add_argument("--connect", default="localhost:50051")
+    ap.add_argument("--id", default=None, help="stable worker id")
+    ap.add_argument("--backend", default="jax",
+                    choices=("jax", "instant", "sleep"))
+    ap.add_argument("--param-chunk", type=int, default=None)
+    ap.add_argument("--poll-s", type=float, default=0.25)
+    ap.add_argument("--status-s", type=float, default=1.0)
+    ap.add_argument("--jobs-per-chip", type=int, default=1)
+    ap.add_argument("--exit-after-idle", type=int, default=None,
+                    help="exit after N consecutive empty polls (batch mode)")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    backend = make_backend(args.backend, param_chunk=args.param_chunk)
+    worker = Worker(args.connect, backend, worker_id=args.id,
+                    poll_interval_s=args.poll_s,
+                    status_interval_s=args.status_s,
+                    jobs_per_chip=args.jobs_per_chip)
+    log.info("worker %s -> %s (backend=%s, chips=%d)",
+             worker.worker_id, args.connect, args.backend, backend.chips)
+    worker.run(max_idle_polls=args.exit_after_idle)
+
+
+if __name__ == "__main__":
+    main()

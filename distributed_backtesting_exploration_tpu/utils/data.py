@@ -96,6 +96,8 @@ def from_csv_bytes(data: bytes, *, dtype=np.float32) -> OHLCV:
     """
     text = data.decode()
     lines = [ln for ln in text.splitlines() if ln.strip()]
+    if not lines:
+        raise ValueError("empty CSV payload")
     header = [h.strip().lower() for h in lines[0].split(",")]
     cols = {name: header.index(name) for name in _FIELDS if name in header}
     missing = [f for f in _FIELDS if f not in cols]

@@ -1,0 +1,189 @@
+"""Unit tests for the control plane's pure pieces.
+
+SURVEY.md §4 calls out exactly these as the spots the reference left untested
+and buggy: batch-split math (its split_off was inverted), liveness windowing,
+and job materialization including unreadable files.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from distributed_backtesting_exploration_tpu.ops.metrics import Metrics
+from distributed_backtesting_exploration_tpu.rpc import wire
+from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+    JobQueue, JobRecord, PeerRegistry, parse_grid, synthetic_jobs)
+from distributed_backtesting_exploration_tpu.rpc.journal import Journal
+
+
+def _mk_jobs(n, **kw):
+    return [JobRecord(id=f"j{i}", strategy="sma_crossover",
+                      grid={"fast": np.asarray([5.0, 10.0], np.float32)},
+                      ohlcv=b"payload", **kw) for i in range(n)]
+
+
+def test_take_n_semantics():
+    """Ask for n, get exactly min(n, len) — the reference handed out len-n."""
+    q = JobQueue()
+    for r in _mk_jobs(5):
+        q.enqueue(r)
+    got = q.take(3, "w1")
+    assert [r.id for r, _ in got] == ["j0", "j1", "j2"]
+    got = q.take(10, "w1")
+    assert [r.id for r, _ in got] == ["j3", "j4"]
+    assert q.take(1, "w1") == []          # empty -> empty, not an error
+
+
+def test_lease_expiry_requeues_front():
+    q = JobQueue(lease_s=0.0)             # leases expire immediately
+    for r in _mk_jobs(2):
+        q.enqueue(r)
+    q.take(1, "w1")
+    assert q.requeue_expired() == ["j0"]
+    got = q.take(2, "w2")
+    assert [r.id for r, _ in got] == ["j0", "j1"]   # requeued at the front
+
+
+def test_requeue_worker_on_prune():
+    q = JobQueue(lease_s=60.0)
+    for r in _mk_jobs(3):
+        q.enqueue(r)
+    q.take(2, "w1")
+    q.take(1, "w2")
+    assert sorted(q.requeue_worker("w1")) == ["j0", "j1"]
+    s = q.stats()
+    assert s["jobs_pending"] == 2 and s["jobs_leased"] == 1
+    assert s["jobs_requeued"] == 2
+
+
+def test_complete_idempotent_and_unknown():
+    q = JobQueue()
+    for r in _mk_jobs(1):
+        q.enqueue(r)
+    q.take(1, "w1")
+    assert q.complete("j0", "w1") is True
+    assert q.complete("j0", "w1") is True    # duplicate is fine
+    assert q.complete("nope", "w1") is False
+    assert q.stats()["jobs_completed"] == 1
+    assert q.drained
+
+
+def test_unreadable_file_marked_failed(tmp_path):
+    jpath = str(tmp_path / "journal.jsonl")
+    q = JobQueue(Journal(jpath))
+    q.enqueue(JobRecord(id="bad", strategy="s", grid={},
+                        path=str(tmp_path / "missing.csv")))
+    q.enqueue(_mk_jobs(1)[0])
+    got = q.take(2, "w1")
+    assert [r.id for r, _ in got] == ["j0"]   # bad one skipped, not dispatched
+    assert q.stats()["jobs_failed"] == 1
+    state = Journal.replay(jpath)
+    assert state.failed == {"bad"}
+
+
+def test_journal_replay_roundtrip(tmp_path):
+    from distributed_backtesting_exploration_tpu.utils import data
+    csv_path = tmp_path / "t.csv"
+    series = data.synthetic_ohlcv(1, 16, seed=0)
+    csv_path.write_bytes(
+        data.to_csv_bytes(type(series)(*(f[0] for f in series))))
+    jpath = str(tmp_path / "journal.jsonl")
+    q = JobQueue(Journal(jpath))
+    for r in _mk_jobs(3, path=None):
+        r.ohlcv = None
+        r.path = str(csv_path)
+        q.enqueue(r)
+    q.take(3, "w1")
+    q.complete("j1", "w1")
+
+    q2 = JobQueue()
+    restored = q2.restore(jpath)
+    assert restored == 2                      # j0, j2 pending again
+    ids = {r.id for r, _ in q2.take(5, "w2")}
+    assert ids == {"j0", "j2"}
+
+
+def test_journal_tolerates_torn_tail(tmp_path):
+    jpath = tmp_path / "journal.jsonl"
+    jpath.write_text(
+        '{"ev":"enqueue","id":"a","strategy":"s","grid":{}}\n'
+        '{"ev":"enqueue","id":"b","strategy":"s","grid":{}}\n'
+        '{"ev":"comp')                        # crash mid-append
+    state = Journal.replay(str(jpath))
+    assert set(state.jobs) == {"a", "b"} and state.pending == ["a", "b"]
+
+
+def test_peer_registry_prune(monkeypatch):
+    reg = PeerRegistry(prune_window_s=10.0)
+    t = [100.0]
+    monkeypatch.setattr("time.monotonic", lambda: t[0])
+    assert reg.touch("w1", chips=4) is True
+    assert reg.touch("w1") is False
+    t[0] = 105.0
+    reg.touch("w2", chips=8)
+    t[0] = 111.0                              # w1 silent 11s, w2 6s
+    assert reg.prune() == ["w1"]
+    assert reg.alive() == 1
+
+
+def test_metrics_wire_roundtrip():
+    m = Metrics(*(np.arange(4, dtype=np.float32) + i
+                  for i in range(len(Metrics._fields))))
+    back = wire.metrics_from_bytes(wire.metrics_to_bytes(m))
+    for a, b in zip(m, back):
+        np.testing.assert_array_equal(np.asarray(a), b)
+    with pytest.raises(ValueError):
+        wire.metrics_from_bytes(b"XXXX" + b"\0" * 16)
+
+
+def test_parse_grid():
+    g = parse_grid("fast=5:8,slow=30:50:10,k=1.5;2.0")
+    np.testing.assert_array_equal(g["fast"], [5, 6, 7])
+    np.testing.assert_array_equal(g["slow"], [30, 40])
+    np.testing.assert_array_equal(g["k"], [1.5, 2.0])
+    assert parse_grid("") == {}
+
+
+def test_synthetic_jobs_decode():
+    from distributed_backtesting_exploration_tpu.utils import data
+    jobs = synthetic_jobs(2, 64, "sma_crossover",
+                          parse_grid("fast=3:5,slow=10:12"))
+    assert len(jobs) == 2 and jobs[0].combos == 4
+    series = data.from_wire_bytes(jobs[0].ohlcv)
+    assert series.n_bars == 64
+
+
+def test_late_completion_of_pending_job_removes_it():
+    """A completion racing a requeue (dispatcher restart / expired lease)
+    must remove the job from pending and clear any fresh lease."""
+    q = JobQueue(lease_s=60.0)
+    for r in _mk_jobs(2):
+        q.enqueue(r)
+    # j0 completed while still pending (late RPC after a restart replay):
+    assert q.complete("j0", "w1") is True
+    got = q.take(5, "w2")
+    assert [r.id for r, _ in got] == ["j1"], "completed job must not dispatch"
+    # duplicate completion of a re-leased job clears the lease:
+    q.complete("j1", "w2")
+    q.complete("j1", "w3")
+    assert q.drained
+
+
+def test_inline_job_survives_journal_restart(tmp_path):
+    """Synthetic (inline-payload) jobs must be dispatchable after replay."""
+    jpath = str(tmp_path / "journal.jsonl")
+    q = JobQueue(Journal(jpath))
+    rec = synthetic_jobs(1, 32, "sma_crossover", parse_grid("fast=3:5"))[0]
+    q.enqueue(rec)
+    q2 = JobQueue()
+    assert q2.restore(jpath) == 1
+    got = q2.take(1, "w")
+    assert len(got) == 1 and got[0][1] == rec.ohlcv
+
+
+def test_job_with_no_source_fails_cleanly():
+    q = JobQueue()
+    q.enqueue(JobRecord(id="x", strategy="s", grid={}))
+    assert q.take(1, "w") == []
+    assert q.stats()["jobs_failed"] == 1
