@@ -43,26 +43,36 @@ def main():
     n_bars = int(os.environ.get("DBX_BENCH_BARS", 1260))      # 5y daily
     n_params = int(os.environ.get("DBX_BENCH_PARAMS", 2000))
     chunk = int(os.environ.get("DBX_BENCH_CHUNK", 100))
-    iters = int(os.environ.get("DBX_BENCH_ITERS", 3))
+    iters = int(os.environ.get("DBX_BENCH_ITERS", 10))
 
     dev = jax.devices()[0]
     print(f"bench: device={dev.device_kind} tickers={n_tickers} "
           f"bars={n_bars} params={n_params} chunk={chunk}", file=sys.stderr)
 
-    # Param grid: n_fast x n_slow = n_params (default 20 x 100).
+    # Param grid: n_fast x n_slow = n_params (default 20 x 100). Windows are
+    # bar counts — keep them integral.
     n_fast = 20
     n_slow = n_params // n_fast
     grid = sweep.product_grid(
         fast=jnp.arange(5, 5 + n_fast, dtype=jnp.float32),
-        slow=jnp.linspace(30, 250, n_slow).astype(jnp.float32))
+        slow=jnp.arange(30, 30 + 2 * n_slow, 2, dtype=jnp.float32))
 
     ohlcv = data.synthetic_ohlcv(n_tickers, n_bars, seed=0)
     panel = type(ohlcv)(*(jax.device_put(jnp.asarray(f), dev) for f in ohlcv))
     strategy = base.get_strategy("sma_crossover")
 
-    def run():
-        return sweep.chunked_sweep(panel, strategy, grid, param_chunk=chunk,
-                                   cost=1e-3)
+    if os.environ.get("DBX_BENCH_GENERIC") == "1":
+        def run():
+            return sweep.chunked_sweep(panel, strategy, grid,
+                                       param_chunk=chunk, cost=1e-3)
+    else:
+        # Flagship path: the fused Pallas sweep kernel (ops/fused.py).
+        from distributed_backtesting_exploration_tpu.ops import fused
+        fa = np.asarray(grid["fast"])
+        sl = np.asarray(grid["slow"])
+
+        def run():
+            return fused.fused_sma_sweep(panel.close, fa, sl, cost=1e-3)
 
     t0 = time.perf_counter()
     out = run()
@@ -70,14 +80,18 @@ def main():
     compile_s = time.perf_counter() - t0
     print(f"bench: first call (incl. compile) {compile_s:.1f}s", file=sys.stderr)
 
-    # Force a device-side reduction + scalar fetch every iteration: with the
-    # remote-proxy TPU backend, block_until_ready alone can report dispatch
-    # time rather than execution time.
+    # Chain every iteration into a device-side accumulator and fetch ONE
+    # scalar at the end: the data dependency forces every sweep to execute
+    # (with the remote-proxy TPU backend, block_until_ready alone can report
+    # dispatch time), while paying the proxy round-trip only once.
     t0 = time.perf_counter()
+    acc = jnp.float32(0.0)
     for _ in range(iters):
         out = run()
-        float(jnp.sum(out.sharpe))
+        acc = acc + jnp.sum(out.sharpe)
+    acc_val = float(acc)   # the synchronizing fetch — must not be elided
     elapsed = time.perf_counter() - t0
+    assert np.isfinite(acc_val)
 
     n_backtests = n_tickers * sweep.grid_size(grid)
     rate = n_backtests * iters / elapsed

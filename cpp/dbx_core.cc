@@ -1,0 +1,331 @@
+// Native runtime core implementation. See dbx_core.h for the component map.
+
+#include "dbx_core.h"
+
+#include <cctype>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+void set_err(char* err, size_t errlen, const char* msg) {
+  if (err && errlen) {
+    std::snprintf(err, errlen, "%s", msg);
+  }
+}
+
+// Fast float parse over [p, end); advances p past the number. Falls back to
+// strtod semantics via manual exponent handling — CSV numeric fields only.
+bool parse_float(const char*& p, const char* end, float* out) {
+  const char* start = p;
+  // strtof needs a NUL-terminated buffer; copy the token (fields are short).
+  char buf[64];
+  size_t n = 0;
+  while (p < end && *p != ',' && *p != '\n' && *p != '\r' &&
+         n < sizeof(buf) - 1) {
+    buf[n++] = *p++;
+  }
+  buf[n] = '\0';
+  if (n == 0) return false;
+  char* stop = nullptr;
+  *out = std::strtof(buf, &stop);
+  return stop == buf + n && p >= start;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// CSV decode
+// ---------------------------------------------------------------------------
+
+extern "C" int dbx_csv_decode(const char* data, size_t len, DbxOhlcv* out,
+                              char* err, size_t errlen) {
+  std::memset(out, 0, sizeof(*out));
+  const char* p = data;
+  const char* end = data + len;
+  if (p == end) {
+    set_err(err, errlen, "empty CSV payload");
+    return 1;
+  }
+
+  // Header row: map column index -> field slot (0..4), -1 = ignore.
+  std::vector<int> slots;
+  int found = 0;
+  {
+    const char* line_end = p;
+    while (line_end < end && *line_end != '\n') ++line_end;
+    const char* q = p;
+    while (q < line_end) {
+      const char* tok = q;
+      while (q < line_end && *q != ',') ++q;
+      std::string name(tok, q - tok);
+      while (!name.empty() && (name.back() == '\r' || name.back() == ' '))
+        name.pop_back();
+      size_t h = 0;
+      while (h < name.size() && name[h] == ' ') ++h;
+      name = name.substr(h);
+      for (auto& c : name) c = static_cast<char>(std::tolower(c));
+      int slot = -1;
+      if (name == "open") slot = 0;
+      else if (name == "high") slot = 1;
+      else if (name == "low") slot = 2;
+      else if (name == "close") slot = 3;
+      else if (name == "volume") slot = 4;
+      if (slot >= 0) ++found;
+      slots.push_back(slot);
+      if (q < line_end) ++q;  // skip comma
+    }
+    p = line_end < end ? line_end + 1 : end;
+  }
+  if (found < 5) {
+    set_err(err, errlen, "CSV header missing open/high/low/close/volume");
+    return 1;
+  }
+
+  std::vector<float> cols[5];
+  while (p < end) {
+    // Skip blank lines.
+    if (*p == '\n' || *p == '\r') {
+      ++p;
+      continue;
+    }
+    size_t col = 0;
+    float row[5];
+    bool row_ok = true;
+    bool have[5] = {false, false, false, false, false};
+    while (p <= end) {
+      int slot = col < slots.size() ? slots[col] : -1;
+      if (slot >= 0) {
+        float v;
+        if (!parse_float(p, end, &v)) {
+          row_ok = false;
+          break;
+        }
+        row[slot] = v;
+        have[slot] = true;
+      } else {
+        while (p < end && *p != ',' && *p != '\n') ++p;
+      }
+      ++col;
+      if (p >= end || *p == '\n' || *p == '\r') break;
+      if (*p == ',') ++p;
+    }
+    while (p < end && *p != '\n') ++p;
+    if (p < end) ++p;
+    if (!row_ok || !(have[0] && have[1] && have[2] && have[3] && have[4])) {
+      set_err(err, errlen, "malformed CSV data row");
+      return 1;
+    }
+    for (int i = 0; i < 5; ++i) cols[i].push_back(row[i]);
+  }
+  if (cols[0].empty()) {
+    set_err(err, errlen, "CSV has no data rows");
+    return 1;
+  }
+
+  uint32_t n = static_cast<uint32_t>(cols[0].size());
+  float* bufs[5];
+  for (int i = 0; i < 5; ++i) {
+    bufs[i] = static_cast<float*>(std::malloc(sizeof(float) * n));
+    std::memcpy(bufs[i], cols[i].data(), sizeof(float) * n);
+  }
+  out->n_bars = n;
+  out->open = bufs[0];
+  out->high = bufs[1];
+  out->low = bufs[2];
+  out->close = bufs[3];
+  out->volume = bufs[4];
+  return 0;
+}
+
+extern "C" size_t dbx_ohlcv_to_wire(const DbxOhlcv* o, uint8_t** out) {
+  if (!o || !o->n_bars) return 0;
+  const uint32_t n = o->n_bars;
+  const size_t total = 8 + sizeof(float) * 5 * n;
+  uint8_t* buf = static_cast<uint8_t*>(std::malloc(total));
+  if (!buf) return 0;
+  std::memcpy(buf, "DBX1", 4);
+  std::memcpy(buf + 4, &n, 4);  // little-endian hosts only (x86/ARM)
+  const float* fields[5] = {o->open, o->high, o->low, o->close, o->volume};
+  size_t off = 8;
+  for (const float* f : fields) {
+    std::memcpy(buf + off, f, sizeof(float) * n);
+    off += sizeof(float) * n;
+  }
+  *out = buf;
+  return total;
+}
+
+extern "C" int dbx_wire_decode(const uint8_t* data, size_t len, DbxOhlcv* out,
+                               char* err, size_t errlen) {
+  std::memset(out, 0, sizeof(*out));
+  if (len < 8 || std::memcmp(data, "DBX1", 4) != 0) {
+    set_err(err, errlen, "bad magic; not a DBX1 block");
+    return 1;
+  }
+  uint32_t n;
+  std::memcpy(&n, data + 4, 4);
+  const size_t need = 8 + sizeof(float) * 5 * static_cast<size_t>(n);
+  if (len < need) {
+    set_err(err, errlen, "truncated DBX1 block");
+    return 1;
+  }
+  float* bufs[5];
+  size_t off = 8;
+  for (int i = 0; i < 5; ++i) {
+    bufs[i] = static_cast<float*>(std::malloc(sizeof(float) * n));
+    std::memcpy(bufs[i], data + off, sizeof(float) * n);
+    off += sizeof(float) * n;
+  }
+  out->n_bars = n;
+  out->open = bufs[0];
+  out->high = bufs[1];
+  out->low = bufs[2];
+  out->close = bufs[3];
+  out->volume = bufs[4];
+  return 0;
+}
+
+extern "C" void dbx_ohlcv_free(DbxOhlcv* o) {
+  if (!o) return;
+  std::free(o->open);
+  std::free(o->high);
+  std::free(o->low);
+  std::free(o->close);
+  std::free(o->volume);
+  std::memset(o, 0, sizeof(*o));
+}
+
+extern "C" void dbx_bytes_free(uint8_t* p) { std::free(p); }
+
+// ---------------------------------------------------------------------------
+// Bounded MPMC blob queue
+// ---------------------------------------------------------------------------
+
+struct DbxQueue {
+  explicit DbxQueue(size_t cap) : capacity(cap) {}
+  const size_t capacity;
+  std::mutex mu;
+  std::condition_variable not_full;
+  std::condition_variable not_empty;
+  std::deque<std::vector<uint8_t>> items;
+  bool closed = false;
+};
+
+extern "C" DbxQueue* dbx_queue_new(size_t capacity) {
+  return new DbxQueue(capacity ? capacity : 1);
+}
+
+static bool wait_on(std::condition_variable& cv,
+                    std::unique_lock<std::mutex>& lk, int64_t timeout_ms,
+                    const std::function<bool()>& pred) {
+  if (timeout_ms < 0) {
+    cv.wait(lk, pred);
+    return true;
+  }
+  return cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred);
+}
+
+extern "C" int dbx_queue_push(DbxQueue* q, const uint8_t* data, size_t len,
+                              int64_t timeout_ms) {
+  std::unique_lock<std::mutex> lk(q->mu);
+  const bool ok = wait_on(q->not_full, lk, timeout_ms, [q] {
+    return q->closed || q->items.size() < q->capacity;
+  });
+  if (!ok) return 1;
+  if (q->closed) return 2;
+  q->items.emplace_back(data, data + len);
+  q->not_empty.notify_one();
+  return 0;
+}
+
+extern "C" int dbx_queue_pop(DbxQueue* q, uint8_t** data, size_t* len,
+                             int64_t timeout_ms) {
+  std::unique_lock<std::mutex> lk(q->mu);
+  const bool ok = wait_on(q->not_empty, lk, timeout_ms,
+                          [q] { return q->closed || !q->items.empty(); });
+  if (!ok) return 1;
+  if (q->items.empty()) return 2;  // closed and drained
+  std::vector<uint8_t> item = std::move(q->items.front());
+  q->items.pop_front();
+  q->not_full.notify_one();
+  lk.unlock();
+  *len = item.size();
+  *data = static_cast<uint8_t*>(std::malloc(item.size() ? item.size() : 1));
+  std::memcpy(*data, item.data(), item.size());
+  return 0;
+}
+
+extern "C" void dbx_queue_close(DbxQueue* q) {
+  std::lock_guard<std::mutex> lk(q->mu);
+  q->closed = true;
+  q->not_full.notify_all();
+  q->not_empty.notify_all();
+}
+
+extern "C" size_t dbx_queue_size(DbxQueue* q) {
+  std::lock_guard<std::mutex> lk(q->mu);
+  return q->items.size();
+}
+
+extern "C" void dbx_queue_free(DbxQueue* q) { delete q; }
+
+// ---------------------------------------------------------------------------
+// Peer registry
+// ---------------------------------------------------------------------------
+
+struct DbxRegistry {
+  explicit DbxRegistry(int64_t window) : window_ms(window) {}
+  const int64_t window_ms;
+  std::mutex mu;
+  std::unordered_map<std::string, std::chrono::steady_clock::time_point> peers;
+};
+
+extern "C" DbxRegistry* dbx_registry_new(int64_t prune_window_ms) {
+  return new DbxRegistry(prune_window_ms);
+}
+
+extern "C" int dbx_registry_touch(DbxRegistry* r, const char* peer_id) {
+  std::lock_guard<std::mutex> lk(r->mu);
+  auto now = std::chrono::steady_clock::now();
+  auto [it, inserted] = r->peers.insert_or_assign(peer_id, now);
+  (void)it;
+  return inserted ? 1 : 0;
+}
+
+extern "C" int dbx_registry_prune(DbxRegistry* r, DbxPrunedFn fn, void* ctx) {
+  std::vector<std::string> dead;
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    const auto cutoff = std::chrono::steady_clock::now() -
+                        std::chrono::milliseconds(r->window_ms);
+    for (auto it = r->peers.begin(); it != r->peers.end();) {
+      if (it->second < cutoff) {
+        dead.push_back(it->first);
+        it = r->peers.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+  if (fn) {
+    for (const auto& id : dead) fn(id.c_str(), ctx);
+  }
+  return static_cast<int>(dead.size());
+}
+
+extern "C" int dbx_registry_alive(DbxRegistry* r) {
+  std::lock_guard<std::mutex> lk(r->mu);
+  return static_cast<int>(r->peers.size());
+}
+
+extern "C" void dbx_registry_free(DbxRegistry* r) { delete r; }

@@ -1,0 +1,104 @@
+// C ABI of the native runtime core (libdbx_core.so).
+//
+// Native-parity layer: the reference implements its entire runtime natively
+// (Rust: dispatcher state + pruning thread, worker poll loop, flume channel
+// substrate, CSV file handling — reference src/server/main.rs,
+// src/worker/main.rs). This environment has no Rust toolchain, so the native
+// runtime substrate is C++ (SURVEY.md §2.2), exposed through a plain C ABI
+// consumed from Python via ctypes (no pybind11 in the image) and from the
+// native worker shell (worker_native.cc).
+//
+// Components:
+//   - OHLCV CSV decoder: the data-loader hot path. Parses header-mapped CSV
+//     bytes straight into column-major float32 arrays (and to the DBX1 wire
+//     block) with no Python-level parsing.
+//   - Bounded MPMC blob queue: the channel substrate bridging I/O and
+//     compute threads (the role flume bounded channels play in the
+//     reference worker, reference src/worker/main.rs:32-42).
+//   - Peer registry: liveness map with last-seen stamping and windowed
+//     pruning (the reference server's dedicated pruning thread, reference
+//     src/server/main.rs:39-52).
+
+#ifndef DBX_CORE_H_
+#define DBX_CORE_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+// ---------------------------------------------------------------------------
+// OHLCV decode
+// ---------------------------------------------------------------------------
+
+// Column-major single-ticker OHLCV block; arrays are malloc'd, length n_bars.
+typedef struct {
+  uint32_t n_bars;
+  float* open;
+  float* high;
+  float* low;
+  float* close;
+  float* volume;
+} DbxOhlcv;
+
+// Parse CSV bytes (header row naming open/high/low/close/volume in any
+// column order, extra columns ignored). Returns 0 on success; nonzero on
+// error with a message in err (NUL-terminated, truncated to errlen).
+int dbx_csv_decode(const char* data, size_t len, DbxOhlcv* out, char* err,
+                   size_t errlen);
+
+// Encode an OHLCV block into the DBX1 wire format ("DBX1" u32-LE T then five
+// f32[T] fields). *out is malloc'd; returns its byte length, or 0 on error.
+size_t dbx_ohlcv_to_wire(const DbxOhlcv* o, uint8_t** out);
+
+// Parse a DBX1 wire block. Returns 0 on success.
+int dbx_wire_decode(const uint8_t* data, size_t len, DbxOhlcv* out, char* err,
+                    size_t errlen);
+
+void dbx_ohlcv_free(DbxOhlcv* o);
+void dbx_bytes_free(uint8_t* p);
+
+// ---------------------------------------------------------------------------
+// Bounded MPMC blob queue
+// ---------------------------------------------------------------------------
+
+typedef struct DbxQueue DbxQueue;
+
+DbxQueue* dbx_queue_new(size_t capacity);
+// Push a copy of data. Blocks up to timeout_ms when full (-1 = forever).
+// Returns 0 ok, 1 timeout, 2 closed.
+int dbx_queue_push(DbxQueue* q, const uint8_t* data, size_t len,
+                   int64_t timeout_ms);
+// Pop into a malloc'd buffer (*data, *len). Blocks up to timeout_ms when
+// empty. Returns 0 ok, 1 timeout, 2 closed-and-drained.
+int dbx_queue_pop(DbxQueue* q, uint8_t** data, size_t* len,
+                  int64_t timeout_ms);
+// Close: pushes fail immediately; pops drain remaining items then report
+// closed.
+void dbx_queue_close(DbxQueue* q);
+size_t dbx_queue_size(DbxQueue* q);
+void dbx_queue_free(DbxQueue* q);
+
+// ---------------------------------------------------------------------------
+// Peer registry
+// ---------------------------------------------------------------------------
+
+typedef struct DbxRegistry DbxRegistry;
+
+DbxRegistry* dbx_registry_new(int64_t prune_window_ms);
+// Stamp a peer as alive now. Returns 1 if newly registered, 0 if refreshed.
+int dbx_registry_touch(DbxRegistry* r, const char* peer_id);
+// Remove peers silent past the window. For each removed peer the callback is
+// invoked with its id. Returns the number pruned.
+typedef void (*DbxPrunedFn)(const char* peer_id, void* ctx);
+int dbx_registry_prune(DbxRegistry* r, DbxPrunedFn fn, void* ctx);
+int dbx_registry_alive(DbxRegistry* r);
+void dbx_registry_free(DbxRegistry* r);
+
+#ifdef __cplusplus
+}  // extern "C"
+#endif
+
+#endif  // DBX_CORE_H_

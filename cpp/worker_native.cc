@@ -1,0 +1,111 @@
+// Native worker shell: a C++ binary that owns the process and shells into
+// the JAX engine through an embedded CPython interpreter — the C++ analogue
+// of the north-star's "Rust shells into JAX via PyO3" (BASELINE.json), and
+// the counterpart of the reference's native worker binary (reference
+// src/worker/main.rs). The control loop, channels, and compute bridge live
+// in distributed_backtesting_exploration_tpu.rpc.worker; this shell
+// validates the native core (queue/decoder smoke), boots the interpreter,
+// and runs the worker CLI with argv passed through.
+//
+// Build: see cpp/CMakeLists.txt (target dbx_worker_native). Run:
+//   dbx_worker_native --connect localhost:50051 --backend jax
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "dbx_core.h"
+
+namespace {
+
+// Pre-flight: exercise the native queue across threads and the CSV->wire
+// decoder, so a broken core library fails fast and loudly here rather than
+// mid-run inside a ctypes call.
+bool selftest() {
+  DbxQueue* q = dbx_queue_new(4);
+  const char payload[] = "job-bytes";
+  std::thread producer([q, &payload] {
+    for (int i = 0; i < 8; ++i) {
+      dbx_queue_push(q, reinterpret_cast<const uint8_t*>(payload),
+                     sizeof(payload), -1);
+    }
+    dbx_queue_close(q);
+  });
+  int popped = 0;
+  for (;;) {
+    uint8_t* data = nullptr;
+    size_t len = 0;
+    const int rc = dbx_queue_pop(q, &data, &len, 1000);
+    if (rc != 0) break;
+    if (len != sizeof(payload) || std::memcmp(data, payload, len) != 0) {
+      dbx_bytes_free(data);
+      producer.join();
+      dbx_queue_free(q);
+      return false;
+    }
+    dbx_bytes_free(data);
+    ++popped;
+  }
+  producer.join();
+  dbx_queue_free(q);
+  if (popped != 8) return false;
+
+  const char csv[] =
+      "open,high,low,close,volume\n"
+      "1.0,2.0,0.5,1.5,100\n"
+      "1.5,2.5,1.0,2.0,200\n";
+  DbxOhlcv o;
+  char err[128];
+  if (dbx_csv_decode(csv, sizeof(csv) - 1, &o, err, sizeof(err)) != 0) {
+    std::fprintf(stderr, "csv selftest: %s\n", err);
+    return false;
+  }
+  uint8_t* wire = nullptr;
+  const size_t n = dbx_ohlcv_to_wire(&o, &wire);
+  DbxOhlcv o2;
+  const bool ok = n > 0 && dbx_wire_decode(wire, n, &o2, err, sizeof(err)) == 0
+                  && o2.n_bars == 2 && o2.close[1] == 2.0f;
+  dbx_bytes_free(wire);
+  dbx_ohlcv_free(&o);
+  dbx_ohlcv_free(&o2);
+  return ok;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (!selftest()) {
+    std::fprintf(stderr, "dbx_worker_native: core selftest FAILED\n");
+    return 2;
+  }
+  std::fprintf(stderr, "dbx_worker_native: core selftest ok\n");
+
+  PyConfig config;
+  PyConfig_InitPythonConfig(&config);
+  // argv is for the worker CLI, not the interpreter: without parse_argv=0
+  // Python would swallow flags like --help itself.
+  config.parse_argv = 0;
+  PyStatus status = PyConfig_SetBytesArgv(&config, argc, argv);
+  if (PyStatus_Exception(status)) {
+    std::fprintf(stderr, "dbx_worker_native: argv setup failed\n");
+    return 2;
+  }
+  status = Py_InitializeFromConfig(&config);
+  PyConfig_Clear(&config);
+  if (PyStatus_Exception(status)) {
+    std::fprintf(stderr, "dbx_worker_native: interpreter init failed\n");
+    return 2;
+  }
+
+  const char* boot =
+      "import sys\n"
+      "from distributed_backtesting_exploration_tpu.rpc import worker\n"
+      "worker.main(sys.argv[1:])\n";
+  const int rc = PyRun_SimpleString(boot);
+  if (Py_FinalizeEx() < 0) return 120;
+  return rc == 0 ? 0 : 1;
+}

@@ -1,0 +1,10 @@
+"""Native runtime layer: ctypes bindings to the C++ core (cpp/).
+
+The compute path is JAX/XLA; the runtime substrate around it — OHLCV
+decoding, bounded inter-thread queues, peer liveness — has a native C++
+implementation mirroring the reference's all-native runtime (SURVEY.md
+§2.2), loaded here via ctypes with transparent fallback to pure Python when
+no toolchain is available.
+"""
+
+from ._core import available, csv_decode, wire_decode, NativeQueue, load  # noqa: F401

@@ -1,0 +1,216 @@
+"""ctypes bindings to the native runtime core (cpp/libdbx_core.so).
+
+pybind11 is not in this image, so the boundary is a plain C ABI loaded with
+ctypes (see ``cpp/dbx_core.h`` for the contract). The library is built on
+first use if a toolchain is present (cmake+ninja, falling back to a direct
+g++ invocation) and cached under ``cpp/build/``; every consumer must degrade
+gracefully to the pure-Python path when :func:`load` returns None, so the
+framework stays functional on machines without a compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import shutil
+import subprocess
+import threading
+
+import numpy as np
+
+log = logging.getLogger("dbx.runtime")
+
+_REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", ".."))
+_CPP_DIR = os.path.join(_REPO_ROOT, "cpp")
+_BUILD_DIR = os.path.join(_CPP_DIR, "build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libdbx_core.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+class _Ohlcv(ctypes.Structure):
+    _fields_ = [
+        ("n_bars", ctypes.c_uint32),
+        ("open", ctypes.POINTER(ctypes.c_float)),
+        ("high", ctypes.POINTER(ctypes.c_float)),
+        ("low", ctypes.POINTER(ctypes.c_float)),
+        ("close", ctypes.POINTER(ctypes.c_float)),
+        ("volume", ctypes.POINTER(ctypes.c_float)),
+    ]
+
+
+def _build() -> bool:
+    if not os.path.isdir(_CPP_DIR):
+        return False
+    try:
+        if shutil.which("cmake") and shutil.which("ninja"):
+            subprocess.run(
+                ["cmake", "-S", _CPP_DIR, "-B", _BUILD_DIR, "-G", "Ninja"],
+                check=True, capture_output=True, timeout=120)
+            subprocess.run(["cmake", "--build", _BUILD_DIR],
+                           check=True, capture_output=True, timeout=300)
+            return os.path.exists(_LIB_PATH)
+        if shutil.which("g++"):
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                 os.path.join(_CPP_DIR, "dbx_core.cc"), "-o", _LIB_PATH],
+                check=True, capture_output=True, timeout=300)
+            return True
+    except (subprocess.SubprocessError, OSError) as e:
+        log.warning("native core build failed: %s", e)
+    return False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.dbx_csv_decode.restype = ctypes.c_int
+    lib.dbx_csv_decode.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.POINTER(_Ohlcv),
+        ctypes.c_char_p, ctypes.c_size_t]
+    lib.dbx_wire_decode.restype = ctypes.c_int
+    lib.dbx_wire_decode.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.POINTER(_Ohlcv),
+        ctypes.c_char_p, ctypes.c_size_t]
+    lib.dbx_ohlcv_to_wire.restype = ctypes.c_size_t
+    lib.dbx_ohlcv_to_wire.argtypes = [
+        ctypes.POINTER(_Ohlcv), ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
+    lib.dbx_ohlcv_free.argtypes = [ctypes.POINTER(_Ohlcv)]
+    lib.dbx_bytes_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+    lib.dbx_queue_new.restype = ctypes.c_void_p
+    lib.dbx_queue_new.argtypes = [ctypes.c_size_t]
+    lib.dbx_queue_push.restype = ctypes.c_int
+    lib.dbx_queue_push.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int64]
+    lib.dbx_queue_pop.restype = ctypes.c_int
+    lib.dbx_queue_pop.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_size_t), ctypes.c_int64]
+    lib.dbx_queue_close.argtypes = [ctypes.c_void_p]
+    lib.dbx_queue_size.restype = ctypes.c_size_t
+    lib.dbx_queue_size.argtypes = [ctypes.c_void_p]
+    lib.dbx_queue_free.argtypes = [ctypes.c_void_p]
+    lib.dbx_registry_new.restype = ctypes.c_void_p
+    lib.dbx_registry_new.argtypes = [ctypes.c_int64]
+    lib.dbx_registry_touch.restype = ctypes.c_int
+    lib.dbx_registry_touch.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.dbx_registry_prune.restype = ctypes.c_int
+    lib.dbx_registry_prune.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+    lib.dbx_registry_alive.restype = ctypes.c_int
+    lib.dbx_registry_alive.argtypes = [ctypes.c_void_p]
+    lib.dbx_registry_free.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def load() -> ctypes.CDLL | None:
+    """Load (building if needed) the native core; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("DBX_NO_NATIVE") == "1":
+            return None
+        if not os.path.exists(_LIB_PATH) and not _build():
+            log.info("native core unavailable; using pure-Python paths")
+            return None
+        try:
+            _lib = _bind(ctypes.CDLL(_LIB_PATH))
+        except OSError as e:
+            log.warning("failed to load %s: %s", _LIB_PATH, e)
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _take_fields(lib, o: _Ohlcv) -> tuple[np.ndarray, ...]:
+    n = int(o.n_bars)
+    out = tuple(
+        np.ctypeslib.as_array(getattr(o, f), shape=(n,)).copy()
+        for f in ("open", "high", "low", "close", "volume"))
+    lib.dbx_ohlcv_free(ctypes.byref(o))
+    return out
+
+
+def csv_decode(data: bytes) -> tuple[np.ndarray, ...]:
+    """Native CSV -> five float32 ``(T,)`` arrays. Raises ValueError."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native core not available")
+    o = _Ohlcv()
+    err = ctypes.create_string_buffer(256)
+    rc = lib.dbx_csv_decode(data, len(data), ctypes.byref(o), err, len(err))
+    if rc != 0:
+        raise ValueError(err.value.decode() or "native CSV decode failed")
+    return _take_fields(lib, o)
+
+
+def wire_decode(data: bytes) -> tuple[np.ndarray, ...]:
+    """Native DBX1 -> five float32 ``(T,)`` arrays. Raises ValueError."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native core not available")
+    o = _Ohlcv()
+    err = ctypes.create_string_buffer(256)
+    rc = lib.dbx_wire_decode(data, len(data), ctypes.byref(o), err, len(err))
+    if rc != 0:
+        raise ValueError(err.value.decode() or "native wire decode failed")
+    return _take_fields(lib, o)
+
+
+class NativeQueue:
+    """Bounded MPMC byte-blob queue backed by the C++ core.
+
+    Mirrors the semantics of the worker's channel substrate; used by tests to
+    validate the native queue and available as a drop-in for byte payloads.
+    """
+
+    def __init__(self, capacity: int = 1024):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native core not available")
+        self._lib = lib
+        self._h = lib.dbx_queue_new(capacity)
+
+    def push(self, data: bytes, timeout_ms: int = -1) -> bool:
+        rc = self._lib.dbx_queue_push(self._h, data, len(data), timeout_ms)
+        if rc == 2:
+            raise ValueError("queue closed")
+        return rc == 0
+
+    def pop(self, timeout_ms: int = -1) -> bytes | None:
+        """None on timeout; raises ValueError once closed and drained."""
+        buf = ctypes.POINTER(ctypes.c_uint8)()
+        ln = ctypes.c_size_t()
+        rc = self._lib.dbx_queue_pop(
+            self._h, ctypes.byref(buf), ctypes.byref(ln), timeout_ms)
+        if rc == 1:
+            return None
+        if rc == 2:
+            raise ValueError("queue closed")
+        out = ctypes.string_at(buf, ln.value)
+        self._lib.dbx_bytes_free(buf)
+        return out
+
+    def close(self) -> None:
+        self._lib.dbx_queue_close(self._h)
+
+    def __len__(self) -> int:
+        return self._lib.dbx_queue_size(self._h)
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            # Close first so threads blocked in pop/push wake and return
+            # before the underlying mutex/condvars are deleted. Callers are
+            # responsible for joining consumers before dropping the queue.
+            self._lib.dbx_queue_close(h)
+            self._lib.dbx_queue_free(h)
+            self._h = None

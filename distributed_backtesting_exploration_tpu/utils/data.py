@@ -92,8 +92,21 @@ def from_csv_bytes(data: bytes, *, dtype=np.float32) -> OHLCV:
     """Decode OHLCV CSV bytes (header with open/high/low/close/volume columns).
 
     Tolerates extra columns (e.g. a leading date column) by name-matching the
-    header, like typical adjusted-split stock CSVs.
+    header, like typical adjusted-split stock CSVs. Uses the native C++
+    decoder (``cpp/dbx_core.cc``) when built — this is the dispatcher's
+    payload hot path — falling back to the pure-Python parser.
     """
+    if dtype == np.float32:
+        try:
+            from ..runtime import _core
+            if _core.available():
+                return OHLCV(*_core.csv_decode(data))
+        except Exception:
+            # Fall through: the Python parser is the semantic reference and
+            # accepts some inputs the strict native parser rejects (e.g.
+            # padded numeric fields); truly bad CSVs fail below with the
+            # canonical error.
+            pass
     text = data.decode()
     lines = [ln for ln in text.splitlines() if ln.strip()]
     if not lines:

@@ -1,0 +1,142 @@
+"""Native C++ core: decoder golden tests vs the Python codec, queue, registry.
+
+Skipped wholesale when no toolchain/library is available — every consumer of
+the native core degrades to pure Python, and these tests prove equivalence.
+"""
+
+import ctypes
+import subprocess
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_backtesting_exploration_tpu.runtime import _core
+from distributed_backtesting_exploration_tpu.utils import data
+
+pytestmark = pytest.mark.skipif(
+    not _core.available(), reason="native core not built/buildable")
+
+
+def _one_ticker(seed=0, T=64):
+    s = data.synthetic_ohlcv(1, T, seed=seed)
+    return type(s)(*(f[0] for f in s))
+
+
+def test_csv_decode_matches_python():
+    series = _one_ticker()
+    raw = data.to_csv_bytes(series)
+    fields = _core.csv_decode(raw)
+    text = raw.decode()
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    want_close = np.asarray(
+        [float(ln.split(",")[3]) for ln in lines[1:]], np.float32)
+    np.testing.assert_allclose(fields[3], want_close, rtol=1e-6)
+    for f in fields:
+        assert f.dtype == np.float32 and f.shape == (64,)
+
+
+def test_csv_decode_extra_columns_and_order():
+    raw = (b"date,close,volume,open,high,low\n"
+           b"2024-01-01,1.5,100,1.0,2.0,0.5\n"
+           b"2024-01-02,2.0,200,1.5,2.5,1.0\n")
+    o, h, l, c, v = _core.csv_decode(raw)
+    np.testing.assert_allclose(c, [1.5, 2.0])
+    np.testing.assert_allclose(o, [1.0, 1.5])
+    np.testing.assert_allclose(v, [100.0, 200.0])
+
+
+def test_csv_decode_errors():
+    with pytest.raises(ValueError):
+        _core.csv_decode(b"")
+    with pytest.raises(ValueError):
+        _core.csv_decode(b"a,b,c\n1,2,3\n")
+    with pytest.raises(ValueError):
+        _core.csv_decode(b"open,high,low,close,volume\n1,2,x,4,5\n")
+
+
+def test_wire_roundtrip_matches_python_codec():
+    series = _one_ticker(seed=3)
+    wire_py = data.to_wire_bytes(series)
+    fields = _core.wire_decode(wire_py)
+    back = data.from_wire_bytes(wire_py)
+    for a, b in zip(fields, back):
+        np.testing.assert_array_equal(a, np.asarray(b))
+
+
+def test_from_csv_bytes_uses_native_transparently():
+    series = _one_ticker(seed=5)
+    raw = data.to_csv_bytes(series)
+    got = data.from_csv_bytes(raw)
+    np.testing.assert_allclose(
+        np.asarray(got.close), np.asarray(series.close), rtol=1e-6)
+
+
+def test_native_queue_mpmc_and_close():
+    q = _core.NativeQueue(capacity=4)
+    items = [f"item-{i}".encode() for i in range(32)]
+    got = []
+    lock = threading.Lock()
+
+    def consumer():
+        while True:
+            try:
+                b = q.pop(timeout_ms=2000)
+            except ValueError:
+                return          # closed and drained
+            if b is not None:
+                with lock:
+                    got.append(b)
+
+    consumers = [threading.Thread(target=consumer) for _ in range(3)]
+    for t in consumers:
+        t.start()
+    for it in items:
+        assert q.push(it)
+    q.close()
+    for t in consumers:
+        t.join(timeout=5)
+    assert sorted(got) == sorted(items)
+
+
+def test_native_queue_timeout():
+    q = _core.NativeQueue(capacity=1)
+    t0 = time.monotonic()
+    assert q.pop(timeout_ms=100) is None
+    assert time.monotonic() - t0 >= 0.09
+    assert q.push(b"x")
+    assert not q.push(b"y", timeout_ms=50)   # full -> timeout False
+
+
+def test_native_registry_prune():
+    lib = _core.load()
+    reg = lib.dbx_registry_new(100)          # 100 ms window
+    assert lib.dbx_registry_touch(reg, b"w1") == 1
+    assert lib.dbx_registry_touch(reg, b"w1") == 0
+    lib.dbx_registry_touch(reg, b"w2")
+    assert lib.dbx_registry_alive(reg) == 2
+    time.sleep(0.15)
+    lib.dbx_registry_touch(reg, b"w2")       # keep w2 alive
+    pruned = lib.dbx_registry_prune(reg, None, None)
+    assert pruned == 1
+    assert lib.dbx_registry_alive(reg) == 1
+    lib.dbx_registry_free(reg)
+
+
+def test_native_worker_shell_selftest():
+    """The embedded-CPython worker binary boots and runs the worker CLI."""
+    binary = _core._BUILD_DIR + "/dbx_worker_native"
+    import os
+    import sysconfig
+    if not os.path.exists(binary):
+        pytest.skip("dbx_worker_native not built")
+    # The embedded interpreter needs the venv's site-packages (jax, grpc)
+    # plus the repo root on its path.
+    site = sysconfig.get_paths()["purelib"]
+    env = dict(os.environ, PYTHONPATH=f"{_core._REPO_ROOT}:{site}")
+    res = subprocess.run([binary, "--help"], env=env, capture_output=True,
+                         timeout=120, text=True)
+    assert "core selftest ok" in res.stderr
+    assert "dbx worker" in res.stdout
+    assert res.returncode == 0

@@ -29,12 +29,31 @@ def _server(queue, *, lease_s=60.0, prune_window_s=10.0, prune_interval_s=0.1,
     return disp, srv
 
 
+_LIVE_WORKERS: list = []
+
+
 def _run_worker(target, backend, **kw):
     w = Worker(target, backend, poll_interval_s=0.02,
                status_interval_s=0.05, **kw)
     t = threading.Thread(target=lambda: w.run(max_idle_polls=10), daemon=True)
     t.start()
+    _LIVE_WORKERS.append((w, t))
     return w, t
+
+
+@pytest.fixture(autouse=True)
+def _stop_workers():
+    """Stop every worker thread at test end.
+
+    A leaked polling worker from one test can land on a later test's
+    OS-assigned port (reuse) and steal its jobs — observed as a flaky
+    metrics mismatch in the golden end-to-end test.
+    """
+    yield
+    while _LIVE_WORKERS:
+        w, t = _LIVE_WORKERS.pop()
+        w.stop()
+        t.join(timeout=10)
 
 
 GRID = parse_grid("fast=3:5,slow=10:14:2")
