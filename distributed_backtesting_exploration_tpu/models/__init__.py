@@ -6,4 +6,4 @@ the Strategy API and the registry.
 """
 
 from .base import Strategy, register, get_strategy, available_strategies  # noqa: F401
-from . import sma_crossover, bollinger, momentum, pairs  # noqa: F401
+from . import sma_crossover, bollinger, momentum, pairs, donchian  # noqa: F401

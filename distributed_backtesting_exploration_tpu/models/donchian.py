@@ -1,0 +1,57 @@
+"""Donchian-channel breakout (stateful).
+
+Classic trend-following: go long when the close breaks above the trailing
+``window``-bar high, short when it breaks below the trailing low, and hold
+until the opposite channel is touched. The channel at bar ``t`` uses bars
+``t-window .. t-1`` (the breakout bar itself is excluded, else every bar
+"breaks" its own high). Path dependence (hold until reversal) runs as a
+``lax.scan``; the channel extrema use the traced-window masked-view kernel
+so the sweep engine can vmap over ``window`` grids (``max_window`` bounds
+the view and is a static field of the strategy construction).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import rolling
+from .base import Strategy, register
+
+MAX_WINDOW = 256
+
+
+def _positions(ohlcv, params):
+    close = ohlcv.close
+    w = params["window"]
+    hi = rolling.rolling_extrema_traced(
+        close, w, max_window=MAX_WINDOW, mode="max", fill=jnp.inf)
+    lo = rolling.rolling_extrema_traced(
+        close, w, max_window=MAX_WINDOW, mode="min", fill=-jnp.inf)
+    # Channel known at the close of t-1, applied to bar t.
+    hi_prev = jnp.concatenate([jnp.full_like(hi[..., :1], jnp.inf),
+                               hi[..., :-1]], axis=-1)
+    lo_prev = jnp.concatenate([jnp.full_like(lo[..., :1], -jnp.inf),
+                               lo[..., :-1]], axis=-1)
+    up = close >= hi_prev
+    down = close <= lo_prev
+    valid = rolling.valid_mask(close.shape[-1], jnp.asarray(w) + 1)
+
+    def step(pos, inp):
+        up_t, down_t, valid_t = inp
+        nxt = jnp.where(up_t, 1.0, jnp.where(down_t, -1.0, pos))
+        nxt = jnp.where(valid_t, nxt, 0.0)
+        return nxt, nxt
+
+    xs = (jnp.moveaxis(up, -1, 0), jnp.moveaxis(down, -1, 0),
+          jnp.moveaxis(jnp.broadcast_to(valid, up.shape), -1, 0))
+    _, pos_t = jax.lax.scan(step, jnp.zeros(up.shape[:-1]), xs, unroll=8)
+    return jnp.moveaxis(pos_t, 0, -1)
+
+
+DONCHIAN = register(Strategy(
+    name="donchian",
+    param_fields=("window",),
+    positions_fn=_positions,
+    stateful=True,
+))

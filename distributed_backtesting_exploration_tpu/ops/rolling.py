@@ -176,6 +176,32 @@ def _static_window(window, name: str) -> int:
     return int(window)
 
 
+def rolling_extrema_traced(x: Array, window, *, max_window: int,
+                           mode: str = "max", fill=jnp.nan) -> Array:
+    """Rolling max/min with a *traced* window, bounded by ``max_window``.
+
+    Rolling extrema have no cumsum form, so a traced window cannot use the
+    doubling trick (:func:`rolling_max`). Instead each output reduces a
+    masked ``(T, max_window)`` windowed view — O(T * max_window) work, but
+    fully vectorized and vmap-able over window grids. Use the static-window
+    versions when the window is known at trace time.
+    """
+    if mode not in ("max", "min"):
+        raise ValueError(f"mode must be 'max' or 'min', got {mode!r}")
+    T = x.shape[-1]
+    offs = jnp.arange(max_window)                       # 0 .. W-1 lags
+    idx = jnp.arange(T)[:, None] - offs[None, :]        # (T, W)
+    neutral = -jnp.inf if mode == "max" else jnp.inf
+    views = jnp.take(x, jnp.clip(idx, 0, T - 1).astype(jnp.int32), axis=-1)
+    ok = (idx >= 0) & (offs[None, :] < jnp.asarray(window))
+    views = jnp.where(ok, views, neutral)
+    out = jnp.max(views, axis=-1) if mode == "max" else jnp.min(views, axis=-1)
+    # A traced window larger than the static bound cannot raise here — poison
+    # the output instead of silently truncating the lookback.
+    out = jnp.where(jnp.asarray(window) <= max_window, out, jnp.nan)
+    return _mask_warmup(out, window, fill)
+
+
 def rolling_max(x: Array, window, *, fill=jnp.nan) -> Array:
     """Rolling max over trailing ``window`` bars (static window).
 

@@ -50,16 +50,47 @@ class JaxSweepBackend:
     prescribes — instead of being looped one by one.
     """
 
-    def __init__(self, *, param_chunk: int | None = None):
+    def __init__(self, *, param_chunk: int | None = None,
+                 use_fused: bool | None = None):
         import jax  # deferred: workers decide platform via env/config
 
         self._jax = jax
         self.param_chunk = param_chunk
         self._devices = jax.devices()
+        # The fused Pallas kernel is compiled-TPU only; its interpret mode
+        # is far slower than the generic XLA path on CPU.
+        if use_fused is None:
+            use_fused = jax.default_backend() == "tpu"
+        self.use_fused = use_fused
 
     @property
     def chips(self) -> int:
         return len(self._devices)
+
+    # Per-cell VMEM budget of the fused kernel: its (T_pad, W_pad) SMA-table
+    # block plus ~8 (T_pad, 128) working tiles must fit in ~16 MB.
+    _FUSED_MAX_BARS = 8192
+    _FUSED_MAX_WINDOWS = 128
+
+    @classmethod
+    def _fused_eligible(cls, job, grid, lengths) -> bool:
+        """SMA-crossover jobs with a (fast, slow) integral grid, equal
+        history lengths, and a VMEM-sized working set route to the fused
+        kernel (no padding mask needed)."""
+        import numpy as np
+
+        if job.strategy != "sma_crossover":
+            return False
+        if set(grid) != {"fast", "slow"}:
+            return False
+        both = np.concatenate([grid["fast"], grid["slow"]])
+        if not np.allclose(both, np.round(both)):
+            return False
+        if np.unique(np.round(both)).size > cls._FUSED_MAX_WINDOWS:
+            return False
+        if len(set(int(x) for x in lengths)) != 1:
+            return False
+        return int(lengths[0]) <= cls._FUSED_MAX_BARS
 
     def process(self, jobs) -> list[Completion]:
         import jax.numpy as jnp
@@ -81,23 +112,36 @@ class JaxSweepBackend:
         for group in groups.values():
             t0 = time.perf_counter()
             series = [data_mod.from_wire_bytes(j.ohlcv) for j in group]
-            batch, _, mask = data_mod.pad_and_stack(series)
-            panel = type(batch)(*(jnp.asarray(f) for f in batch))
+            lengths = [s.n_bars for s in series]
             # JobSpec.grid carries per-parameter AXES; the cartesian product
             # is materialized worker-side (backtesting.proto JobSpec.grid).
-            grid = sweep_mod.product_grid(
-                **wire.grid_from_proto(group[0].grid))
+            axes = wire.grid_from_proto(group[0].grid)
+            grid = sweep_mod.product_grid(**axes)
             strategy = models_base.get_strategy(group[0].strategy)
             ppy = group[0].periods_per_year or 252
-            kwargs = dict(cost=group[0].cost, bar_mask=jnp.asarray(mask),
-                          periods_per_year=ppy)
-            P = sweep_mod.grid_size(grid) if grid else 1
-            if self.param_chunk and P % self.param_chunk == 0:
-                m = sweep_mod.chunked_sweep(
-                    panel, strategy, grid, param_chunk=self.param_chunk,
-                    **kwargs)
+            if self.use_fused and self._fused_eligible(group[0], axes,
+                                                       lengths):
+                from ..ops import fused
+                # Equal-length group: hand the kernel the unpadded closes
+                # (it does its own sublane-aligned padding internally; no
+                # device transfer of the unused open/high/low/volume).
+                close = np.stack([np.asarray(s.close) for s in series])
+                m = fused.fused_sma_sweep(
+                    close, np.asarray(grid["fast"]),
+                    np.asarray(grid["slow"]), cost=group[0].cost,
+                    periods_per_year=ppy)
             else:
-                m = sweep_mod.jit_sweep(panel, strategy, grid, **kwargs)
+                batch, _, mask = data_mod.pad_and_stack(series)
+                panel = type(batch)(*(jnp.asarray(f) for f in batch))
+                kwargs = dict(cost=group[0].cost, bar_mask=jnp.asarray(mask),
+                              periods_per_year=ppy)
+                P = sweep_mod.grid_size(grid) if grid else 1
+                if self.param_chunk and P % self.param_chunk == 0:
+                    m = sweep_mod.chunked_sweep(
+                        panel, strategy, grid, param_chunk=self.param_chunk,
+                        **kwargs)
+                else:
+                    m = sweep_mod.jit_sweep(panel, strategy, grid, **kwargs)
             host = type(m)(*(np.asarray(f) for f in m))   # (n, P) each
             elapsed = time.perf_counter() - t0
             per_job = elapsed / len(group)

@@ -9,3 +9,4 @@ from .data import (  # noqa: F401
     from_wire_bytes,
     pad_and_stack,
 )
+from . import trace  # noqa: F401

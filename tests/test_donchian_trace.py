@@ -1,0 +1,96 @@
+"""Donchian breakout, traced-window extrema, trace utils, fused routing."""
+
+import logging
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_backtesting_exploration_tpu.models.base import get_strategy
+from distributed_backtesting_exploration_tpu.ops import rolling
+from distributed_backtesting_exploration_tpu.parallel import sweep
+from distributed_backtesting_exploration_tpu.utils import data, trace
+
+
+def test_rolling_extrema_traced_matches_static():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal(200), jnp.float32)
+    for w in (3, 10, 32):
+        got = rolling.rolling_extrema_traced(
+            x, jnp.asarray(w), max_window=64, mode="max", fill=0.0)
+        want = rolling.rolling_max(x, w, fill=0.0)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+        got = rolling.rolling_extrema_traced(
+            x, jnp.asarray(w), max_window=64, mode="min", fill=0.0)
+        want = rolling.rolling_min(x, w, fill=0.0)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+
+def test_donchian_breakout_behaviour():
+    # Monotonic rally then crash: long during the rally, short after the
+    # breakdown.
+    up = np.linspace(100, 150, 60)
+    down = np.linspace(150, 80, 60)
+    close = jnp.asarray(np.concatenate([up, down]), jnp.float32)
+    ohlcv = data.OHLCV(*(close for _ in range(5)))
+    pos = get_strategy("donchian").positions(ohlcv, {"window": jnp.asarray(10)})
+    p = np.asarray(pos)
+    assert (p[15:59] == 1.0).all(), "should be long during the rally"
+    assert (p[80:] == -1.0).all(), "should be short after the breakdown"
+
+
+def test_donchian_sweeps_over_window_grid():
+    ohlcv = data.synthetic_ohlcv(3, 256, seed=2)
+    panel = type(ohlcv)(*(jnp.asarray(f) for f in ohlcv))
+    grid = sweep.product_grid(window=jnp.array([10., 20., 40.]))
+    m = sweep.jit_sweep(panel, get_strategy("donchian"), dict(grid), cost=1e-3)
+    assert m.sharpe.shape == (3, 3)
+    assert np.isfinite(np.asarray(m.sharpe)).all()
+
+
+def test_timed_logs_duration(caplog):
+    with caplog.at_level(logging.INFO, logger="dbx.trace"):
+        with trace.timed("unit-test-phase"):
+            pass
+    assert any("unit-test-phase took" in r.message for r in caplog.records)
+
+
+def test_step_timer_rate():
+    t = trace.StepTimer()
+    t.add(100)
+    assert t.rate > 0
+
+
+def test_fused_routing_eligibility():
+    from distributed_backtesting_exploration_tpu.rpc import backtesting_pb2 as pb
+    from distributed_backtesting_exploration_tpu.rpc.compute import (
+        JaxSweepBackend)
+
+    ok_job = pb.JobSpec(strategy="sma_crossover")
+    grids = {"fast": np.array([5.0, 10.0]), "slow": np.array([20.0, 40.0])}
+    assert JaxSweepBackend._fused_eligible(ok_job, grids, [64, 64])
+    assert not JaxSweepBackend._fused_eligible(ok_job, grids, [64, 128])
+    assert not JaxSweepBackend._fused_eligible(
+        pb.JobSpec(strategy="bollinger"), grids, [64, 64])
+    assert not JaxSweepBackend._fused_eligible(
+        ok_job, {"fast": np.array([5.0])}, [64])
+    assert not JaxSweepBackend._fused_eligible(
+        ok_job, {"fast": np.array([5.5]), "slow": np.array([20.0])}, [64])
+
+
+def test_extrema_traced_poisons_oversized_window():
+    x = jnp.ones(64)
+    out = rolling.rolling_extrema_traced(
+        x, jnp.asarray(40), max_window=32, mode="max", fill=0.0)
+    assert np.isnan(np.asarray(out)[60])
+
+
+def test_fused_eligibility_resource_bounds():
+    from distributed_backtesting_exploration_tpu.rpc import backtesting_pb2 as pb
+    from distributed_backtesting_exploration_tpu.rpc.compute import (
+        JaxSweepBackend)
+    job = pb.JobSpec(strategy="sma_crossover")
+    g = {"fast": np.array([5.0]), "slow": np.array([20.0])}
+    assert not JaxSweepBackend._fused_eligible(job, g, [30000])  # too long
+    wide = {"fast": np.arange(2, 120, dtype=np.float64),
+            "slow": np.arange(120, 240, dtype=np.float64)}
+    assert not JaxSweepBackend._fused_eligible(job, wide, [64])  # >128 windows
