@@ -1,0 +1,77 @@
+"""Multi-host scale-out: jax.distributed bring-up + host-sharded sweeps.
+
+Two independent layers scale this framework beyond one host, mirroring how
+the reference scales only by adding worker machines (reference
+``README.md:6-7``):
+
+1. **Job-level (the default).** Each host runs an independent worker process
+   against the dispatcher (``rpc/``); no JAX-level coordination is needed, no
+   collective ever crosses DCN, and hosts can join/leave freely — this is
+   the reference's elasticity model and remains the recommended deployment.
+2. **Slice-level (one logical JAX program over a multi-host slice).** When a
+   single sweep must span more chips than one host owns, initialize
+   ``jax.distributed`` (this module) and use the same
+   :mod:`~.sharding` mesh helpers — ``jax.devices()`` then spans the slice,
+   the ticker axis shards globally, and XLA routes the (tiny) cross-chip
+   collectives over ICI within the slice. The code path is identical to the
+   single-host mesh; only initialization differs.
+
+No multi-host hardware is present in CI, so :func:`initialize` is exercised
+by its single-process no-op path; the mesh math it feeds is covered by the
+8-virtual-device tests (``tests/test_sharding.py``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger("dbx.multihost")
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> int:
+    """Bring up jax.distributed for a multi-host slice; returns process count.
+
+    With no arguments and no cluster environment this is a safe no-op
+    (single-process). On TPU pods the three parameters are auto-detected from
+    the environment; pass them explicitly for manual bring-up:
+
+        initialize("host0:8476", num_processes=4, process_id=int(os.environ["ID"]))
+
+    Call before any other JAX API. Idempotent per process.
+    """
+    import jax
+
+    single = (coordinator_address is None and num_processes is None
+              and process_id is None
+              and not os.environ.get("COORDINATOR_ADDRESS")
+              and not os.environ.get("TPU_WORKER_HOSTNAMES", "").count(","))
+    if single:
+        log.info("multihost: single-process mode (no coordinator configured)")
+        return 1
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    n = jax.process_count()
+    log.info("multihost: process %d/%d, %d local / %d global devices",
+             jax.process_index(), n,
+             jax.local_device_count(), jax.device_count())
+    return n
+
+
+def host_shard(n_items: int) -> slice:
+    """This host's contiguous shard of a length-``n_items`` work list.
+
+    For dispatcher-less multi-host runs (e.g. a pod job reading a shared
+    ticker universe): every host computes the same deterministic split and
+    takes its slice, the multi-host analogue of the dispatcher's take-n
+    batching.
+    """
+    import jax
+
+    pid, n = jax.process_index(), jax.process_count()
+    per = -(-n_items // n)
+    return slice(pid * per, min((pid + 1) * per, n_items))

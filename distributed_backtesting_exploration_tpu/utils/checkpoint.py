@@ -1,0 +1,72 @@
+"""Checkpoint/restore for long-running sweep state (orbax-backed).
+
+The dispatcher's JSONL journal (``rpc/journal.py``) makes the *queue*
+crash-durable; this module makes long *computations* resumable — the result
+store of a large sweep campaign or the per-window state of a long
+walk-forward — via orbax's atomic array checkpointing (the reference has no
+checkpointing at all; its own README lists the resulting data loss,
+reference ``README.md:80``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Mapping
+
+import numpy as np
+
+from ..ops.metrics import Metrics
+
+
+def save_metrics(path: str, metrics: Metrics, *,
+                 meta: Mapping[str, Any] | None = None) -> None:
+    """Atomically checkpoint a Metrics pytree (plus small metadata)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    payload = {name: np.asarray(f) for name, f in zip(Metrics._fields, metrics)}
+    if meta:
+        payload["_meta"] = dict(meta)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path, payload, force=True)
+
+
+def load_metrics(path: str) -> tuple[Metrics, dict]:
+    """Restore a Metrics checkpoint; returns ``(metrics, meta)``."""
+    import orbax.checkpoint as ocp
+
+    with ocp.PyTreeCheckpointer() as ckptr:
+        payload = ckptr.restore(os.path.abspath(path))
+    meta = payload.pop("_meta", {})
+    return Metrics(*(payload[name] for name in Metrics._fields)), dict(meta)
+
+
+class SweepCheckpointer:
+    """Incremental result store for a chunked sweep campaign.
+
+    Usage: iterate your (ticker-block x param-block) work list; after each
+    block call :meth:`add`; on restart :meth:`done` tells you which block
+    ids to skip. Results live as one checkpoint per block id under ``root``
+    (atomic per block, so a crash mid-save never corrupts earlier blocks).
+    """
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _block_path(self, block_id: str) -> str:
+        return os.path.join(self.root, f"block-{block_id}")
+
+    def done(self) -> set[str]:
+        out = set()
+        for name in os.listdir(self.root):
+            if name.startswith("block-"):
+                out.add(name[len("block-"):])
+        return out
+
+    def add(self, block_id: str, metrics: Metrics,
+            meta: Mapping[str, Any] | None = None) -> None:
+        save_metrics(self._block_path(block_id), metrics, meta=meta)
+
+    def get(self, block_id: str) -> tuple[Metrics, dict]:
+        return load_metrics(self._block_path(block_id))

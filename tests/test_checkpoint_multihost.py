@@ -1,0 +1,46 @@
+"""Checkpoint/restore roundtrip and multihost single-process paths."""
+
+import numpy as np
+import pytest
+
+from distributed_backtesting_exploration_tpu.ops.metrics import Metrics
+from distributed_backtesting_exploration_tpu.parallel import multihost
+from distributed_backtesting_exploration_tpu.utils import checkpoint
+
+
+def _mk_metrics(seed=0, shape=(3, 4)):
+    rng = np.random.default_rng(seed)
+    return Metrics(*(rng.standard_normal(shape).astype(np.float32)
+                     for _ in Metrics._fields))
+
+
+def test_metrics_checkpoint_roundtrip(tmp_path):
+    m = _mk_metrics()
+    checkpoint.save_metrics(str(tmp_path / "ckpt"), m, meta={"cost": 1e-3})
+    back, meta = checkpoint.load_metrics(str(tmp_path / "ckpt"))
+    assert meta == {"cost": 1e-3}
+    for a, b in zip(m, back):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sweep_checkpointer_resume(tmp_path):
+    ck = checkpoint.SweepCheckpointer(str(tmp_path / "campaign"))
+    assert ck.done() == set()
+    ck.add("t0-p0", _mk_metrics(1), meta={"tickers": [0, 8]})
+    ck.add("t0-p1", _mk_metrics(2))
+    # A "restarted" campaign sees both blocks and can skip them.
+    ck2 = checkpoint.SweepCheckpointer(str(tmp_path / "campaign"))
+    assert ck2.done() == {"t0-p0", "t0-p1"}
+    m, meta = ck2.get("t0-p0")
+    np.testing.assert_array_equal(
+        np.asarray(m.sharpe), np.asarray(_mk_metrics(1).sharpe))
+    assert meta["tickers"] == [0, 8]
+
+
+def test_multihost_single_process_noop():
+    assert multihost.initialize() == 1
+
+
+def test_host_shard_covers_work_list():
+    s = multihost.host_shard(10)     # single process: everything
+    assert list(range(10))[s] == list(range(10))
