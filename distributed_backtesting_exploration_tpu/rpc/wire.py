@@ -56,9 +56,16 @@ def grid_to_proto(grid: Mapping[str, "np.ndarray"]) -> dict:
 
 
 def grid_from_proto(proto_grid) -> dict[str, np.ndarray]:
-    """Proto map field -> dict of float32 axis arrays."""
-    return {k: np.asarray(ax.values, np.float32)
-            for k, ax in proto_grid.items()}
+    """Proto map field -> dict of float32 axis arrays, sorted by axis name.
+
+    Proto3 map iteration order is unspecified, so the wire contract pins a
+    canonical axis order: **lexicographic by axis name**. The DBXM metric
+    block a completion carries is laid out row-major over the cartesian
+    product in this canonical order — decoders must materialize the grid the
+    same way (``product_grid(**grid_from_proto(g))``).
+    """
+    return {k: np.asarray(proto_grid[k].values, np.float32)
+            for k in sorted(proto_grid)}
 
 
 def grid_n_combos(proto_grid) -> int:

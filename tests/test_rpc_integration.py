@@ -114,9 +114,13 @@ def test_end_to_end_jax_backend_matches_direct_sweep():
     for rec in jobs:
         series = data.from_wire_bytes(rec.ohlcv)
         panel = type(series)(*(jnp.asarray(f)[None, :] for f in series))
+        # DBXM param order is canonical: row-major over axes sorted by name
+        # (wire.grid_from_proto) — proto map iteration order is unspecified,
+        # so decoders must NOT rely on the submitter's dict order.
+        canonical_axes = dict(sorted(rec.grid.items()))
         want = sweep.jit_sweep(
             panel, base.get_strategy("sma_crossover"),
-            sweep.product_grid(**rec.grid), cost=1e-3)
+            sweep.product_grid(**canonical_axes), cost=1e-3)
         got = wire.metrics_from_bytes(disp.results[rec.id])
         for name in want._fields:
             np.testing.assert_allclose(

@@ -187,3 +187,20 @@ def test_job_with_no_source_fails_cleanly():
     q.enqueue(JobRecord(id="x", strategy="s", grid={}))
     assert q.take(1, "w") == []
     assert q.stats()["jobs_failed"] == 1
+
+
+def test_grid_from_proto_canonical_order():
+    """Proto3 map iteration order is unspecified; the wire contract pins
+    sorted-by-name axis order so DBXM param ordering is deterministic."""
+    import numpy as np
+    from distributed_backtesting_exploration_tpu.rpc import backtesting_pb2 as pb
+    from distributed_backtesting_exploration_tpu.rpc import wire
+
+    spec = pb.JobSpec(id="g")
+    # Insert in reverse-sorted order; decode must come back sorted.
+    spec.grid["slow"].values.extend([50.0, 100.0])
+    spec.grid["fast"].values.extend([5.0, 10.0])
+    spec.grid["alpha"].values.extend([0.1])
+    out = wire.grid_from_proto(spec.grid)
+    assert list(out) == ["alpha", "fast", "slow"]
+    np.testing.assert_array_equal(out["fast"], np.float32([5.0, 10.0]))
