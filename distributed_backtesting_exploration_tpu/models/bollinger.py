@@ -4,8 +4,9 @@
 
 ``bollinger`` is the classic hysteresis machine — enter long when the z-score
 drops below ``-k``, enter short above ``+k``, hold until the price re-crosses
-the rolling mean — so the position depends on its own past: a genuine
-``lax.scan`` over bars with a one-scalar carry per (ticker, param) lane.
+the rolling mean — so the position depends on its own past. The 3-state
+transition maps compose associatively, so the machine evaluates in O(log T)
+depth (``ops.signals.band_hysteresis_assoc``) instead of a serial scan.
 
 ``bollinger_touch`` is the path-free variant (exposure = which band you are
 currently outside of), used where prefix-engine throughput matters more than
@@ -36,8 +37,10 @@ def _touch_positions(ohlcv, params):
 
 def _mr_positions(ohlcv, params):
     # Exit at the rolling mean = the shared band machine with z_exit=0.
+    # The associative form evaluates the hysteresis in O(log T) depth —
+    # identical states, no serial scan (see ops.signals).
     z, valid = _z_and_valid(ohlcv, params)
-    return signals.band_hysteresis(z, valid, params["k"], 0.0)
+    return signals.band_hysteresis_assoc(z, valid, params["k"], 0.0)
 
 
 BOLLINGER = register(Strategy(

@@ -3,7 +3,8 @@
 A pair is (y, x) close series. Per bar: a rolling OLS of y on x gives the
 hedge ratio ``beta``; the spread ``y - (alpha + beta x)`` is z-scored over the
 same lookback; the machine enters a unit spread position when ``|z|`` exceeds
-``z_entry`` and exits when z re-crosses ``z_exit`` (hysteresis -> ``lax.scan``).
+``z_entry`` and exits when z re-crosses ``z_exit`` (hysteresis, evaluated in
+log depth via the associative band machine).
 Spread return per bar is ``pos[t-1] * (r_y[t] - beta[t-1] * r_x[t]) / (1 + |beta|)``
 (gross exposure normalized), with cost charged on both legs' turnover.
 
@@ -48,7 +49,7 @@ def pairs_positions(y: Array, x: Array, params) -> tuple[Array, Array]:
     Shares the band-hysteresis scan with Bollinger mean-reversion.
     """
     beta, z, valid = pair_signals(y, x, params["lookback"])
-    pos = signals.band_hysteresis(
+    pos = signals.band_hysteresis_assoc(
         z, valid, params["z_entry"], params.get("z_exit", 0.0))
     return pos, beta
 

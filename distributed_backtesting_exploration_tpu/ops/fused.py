@@ -70,25 +70,14 @@ def _cummax0(x):
     return x
 
 
-def _kernel(r_ref, sma_ref, of_ref, os_ref, warm_ref, out_ref, *,
-            T_real: int, cost: float, ppy: int):
-    T_pad = r_ref.shape[1]
-    r = r_ref[0]                     # (T_pad, 1) -> broadcasts over lanes
-    sma = sma_ref[0]                 # (T_pad, W_pad)
-    # Per-lane window selection as MXU contractions. HIGHEST precision: the
-    # default bf16 MXU pass truncates price-level SMAs enough to flip
-    # sign(fast - slow) near crossovers.
-    f = jnp.dot(sma, of_ref[:], preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST)
-    s = jnp.dot(sma, os_ref[:], preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST)
+def _metrics_tail(pos, r, t_idx, *, T_real: int, cost: float, ppy: int):
+    """Shared kernel tail: positions -> packed (16, 128) metric rows.
 
-    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, _LANES), 0)
-    warm = warm_ref[0, :][None, :]               # (1, 128) max(fast, slow)
-    valid = t_idx >= (warm.astype(jnp.int32) - 1)
-    pos = jnp.where(valid, jnp.sign(f - s), 0.0)
-    # Bars past the true history hold the final position (zero return, zero
-    # turnover) so sums over T_pad equal sums over T_real.
+    ``pos`` is the per-lane position path over ``(T_pad, 128)`` (any signal
+    kernel produces it); bars at ``t >= T_real`` are overwritten to hold the
+    final real position so every reduction over T_pad equals the unpadded
+    reduction over T_real (zero return, zero turnover in the pad).
+    """
     row_ok = t_idx < T_real
     pos_last = pos[T_real - 1:T_real, :]
     pos = jnp.where(row_ok, pos, pos_last)
@@ -134,8 +123,29 @@ def _kernel(r_ref, sma_ref, of_ref, os_ref, warm_ref, out_ref, *,
         0.5 * turnover,                     # n_trades
         turnover,                           # turnover
     ], axis=0)                              # (9, 128)
-    out_ref[0, 0] = jnp.concatenate(
+    return jnp.concatenate(
         [rows, jnp.zeros((_METRIC_ROWS - 9, _LANES), jnp.float32)], axis=0)
+
+
+def _kernel(r_ref, sma_ref, of_ref, os_ref, warm_ref, out_ref, *,
+            T_real: int, cost: float, ppy: int):
+    T_pad = r_ref.shape[1]
+    r = r_ref[0]                     # (T_pad, 1) -> broadcasts over lanes
+    sma = sma_ref[0]                 # (T_pad, W_pad)
+    # Per-lane window selection as MXU contractions. HIGHEST precision: the
+    # default bf16 MXU pass truncates price-level SMAs enough to flip
+    # sign(fast - slow) near crossovers.
+    f = jnp.dot(sma, of_ref[:], preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)
+    s = jnp.dot(sma, os_ref[:], preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)
+
+    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, _LANES), 0)
+    warm = warm_ref[0, :][None, :]               # (1, 128) max(fast, slow)
+    valid = t_idx >= (warm.astype(jnp.int32) - 1)
+    pos = jnp.where(valid, jnp.sign(f - s), 0.0)
+    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, T_real=T_real, cost=cost,
+                                  ppy=ppy)
 
 
 @functools.partial(
@@ -241,6 +251,189 @@ def fused_sma_sweep(close, fast, slow, *, cost: float = 0.0,
                        P_real=P, T_real=T,
                        cost=float(cost), ppy=int(periods_per_year),
                        interpret=bool(interpret))
+
+
+def _boll_kernel(r_ref, z_ref, ow_ref, k_ref, warm_ref, out_ref, *,
+                 T_real: int, cost: float, ppy: int, z_exit: float):
+    """Bollinger mean-reversion cell: z-selection matmul + hysteresis ladder.
+
+    The band machine's state space is {-1, 0, +1}; each bar is a 3-state
+    transition map and composition of maps is associative, so the position
+    path evaluates as a log2(T_pad)-round doubling ladder over the sublane
+    axis — no serial scan (mirrors ``ops.signals.band_hysteresis_assoc``).
+    """
+    T_pad = r_ref.shape[1]
+    r = r_ref[0]                     # (T_pad, 1)
+    z_tbl = z_ref[0]                 # (T_pad, W_pad) per-window z-scores
+    z = jnp.dot(z_tbl, ow_ref[:], preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)   # (T_pad, 128)
+
+    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, _LANES), 0)
+    warm = warm_ref[0, :][None, :]
+    valid = t_idx >= (warm.astype(jnp.int32) - 1)
+    k = k_ref[0, :][None, :]                           # (1, 128) entry band
+
+    # Per-bar transition maps (next state when previous state is -1/0/+1).
+    entered = jnp.where(z < -k, 1.0, jnp.where(z > k, -1.0, 0.0))
+    pm = jnp.where(valid & (z > z_exit), -1.0, 0.0)
+    p0 = jnp.where(valid, entered, 0.0)
+    pp = jnp.where(valid & (z < -z_exit), 1.0, 0.0)
+
+    # Prefix composition: after the ladder, (pm, p0, pp)[t] is the composite
+    # map of bars (0..t]; identity fill (-1/0/+1) pads the shifted reads.
+    span = 1
+    while span < T_pad:
+        em = _shift_down(pm, span, -1.0)
+        e0 = _shift_down(p0, span, 0.0)
+        ep = _shift_down(pp, span, 1.0)
+        pm, p0, pp = (
+            jnp.where(em < 0, pm, jnp.where(em > 0, pp, p0)),
+            jnp.where(e0 < 0, pm, jnp.where(e0 > 0, pp, p0)),
+            jnp.where(ep < 0, pm, jnp.where(ep > 0, pp, p0)),
+        )
+        span *= 2
+
+    pos = p0   # start state is flat
+    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, T_real=T_real, cost=cost,
+                                  ppy=ppy)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
+                     "ppy", "z_exit", "interpret"))
+def _fused_boll_call(close, onehot_w, k_lanes, warm, *, windows: tuple,
+                     T_pad: int, W_pad: int, P_real: int, T_real: int,
+                     cost: float, ppy: int, z_exit: float, interpret: bool):
+    """Z-score table prep + pallas call in one jit (same dispatch-economy
+    rationale as ``_fused_call``).
+
+    The table replicates ``rolling.rolling_zscore``'s exact float op order so
+    CPU interpret-mode results are bit-identical to the generic path:
+    numerator from the *uncentered* rolling mean, std from series-centered
+    second moments (rolling.py's cancellation guard), eps=1e-12.
+    """
+    N, T = close.shape
+    pad_t = T_pad - T
+    close_p = jnp.concatenate(
+        [close, jnp.repeat(close[:, -1:], pad_t, axis=1)], axis=1) \
+        if pad_t else close
+
+    w_vec = jnp.asarray(np.asarray(windows, np.int32))          # (W,)
+    w_f = w_vec.astype(jnp.float32)[None, None, :]              # (1,1,W)
+    t_idx = jnp.arange(T_pad)[:, None]                          # (T_pad,1)
+    gather_idx = jnp.clip(t_idx - w_vec[None, :], 0, T_pad - 1)
+    in_win = (t_idx >= w_vec[None, :])[None]                    # (1,T_pad,W)
+
+    def windowed_sum(series):                                   # (N,T_pad) ->
+        cs = jnp.cumsum(series, axis=1)                         # (N,T_pad,W)
+        shifted = jnp.where(in_win, jnp.take(cs, gather_idx, axis=1), 0.0)
+        return cs[:, :, None] - shifted
+
+    m = windowed_sum(close_p) / w_f                             # rolling mean
+    # Center with the mean over the REAL bars only (the generic path sees the
+    # unpadded series); the pad region's xc values never reach a real output.
+    xc = close_p - jnp.mean(close_p[:, :T], axis=1, keepdims=True)
+    s1 = windowed_sum(xc)
+    s2 = windowed_sum(xc * xc)
+    var = jnp.maximum((s2 - s1 * s1 / w_f) / w_f, 0.0)
+    z_table = (close_p[:, :, None] - m) / (jnp.sqrt(var) + 1e-12)
+    z_table = jnp.where((t_idx >= w_vec[None, :] - 1)[None], z_table, 0.0)
+    if W_pad > len(windows):
+        z_table = jnp.concatenate(
+            [z_table,
+             jnp.zeros((N, T_pad, W_pad - len(windows)), jnp.float32)],
+            axis=-1)
+
+    prev_close = jnp.concatenate([close_p[:, :1], close_p[:, :-1]], axis=1)
+    returns3 = (close_p / prev_close - 1.0)[..., None]          # (N,T_pad,1)
+    P_pad = k_lanes.shape[1]
+    n_blocks = P_pad // _LANES
+    kernel = functools.partial(_boll_kernel, T_real=T_real, cost=cost,
+                               ppy=ppy, z_exit=z_exit)
+    out = pl.pallas_call(
+        kernel,
+        grid=(N, n_blocks),
+        in_specs=[
+            pl.BlockSpec((1, T_pad, 1), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T_pad, W_pad), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((W_pad, _LANES), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, _METRIC_ROWS, _LANES), lambda i, j: (i, j, 0, 0),
+            memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(
+            (N, n_blocks, _METRIC_ROWS, _LANES), jnp.float32),
+        interpret=interpret,
+    )(returns3, z_table, onehot_w, k_lanes, warm)
+    return Metrics(*(
+        jnp.reshape(out[:, :, k, :], (N, P_pad))[:, :P_real]
+        for k in range(9)))
+
+
+def fused_bollinger_sweep(close, window, k, *, z_exit: float = 0.0,
+                          cost: float = 0.0, periods_per_year: int = 252,
+                          interpret: bool | None = None) -> Metrics:
+    """Fused Bollinger mean-reversion sweep: ``(N, T)`` closes x ``(P,)`` lanes.
+
+    ``window``/``k`` are flat per-combo arrays (:func:`product_grid` order);
+    windows must be integral bar counts. Matches the generic
+    ``run_sweep(..., "bollinger")`` path (``models.bollinger`` +
+    ``signals.band_hysteresis_assoc``): bit-level on CPU interpret mode; on
+    TPU the MXU z-selection matmul shares the SMA kernel's knife-edge caveat
+    for |z - k| ~ 1e-7 relative. BASELINE.json configs[2] is this workload.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    close = jnp.asarray(close, jnp.float32)
+    window = np.asarray(window)
+    k = np.asarray(k, np.float32)
+    T = close.shape[1]
+    P = window.shape[0]
+
+    windows, onehot_w, k_lanes, warm = _boll_grid_setup(
+        window.astype(np.float32).tobytes(), k.tobytes())
+    return _fused_boll_call(close, onehot_w, k_lanes, warm,
+                            windows=windows,
+                            T_pad=_round_up(T, 8), W_pad=onehot_w.shape[0],
+                            P_real=P, T_real=T, cost=float(cost),
+                            ppy=int(periods_per_year),
+                            z_exit=float(z_exit), interpret=bool(interpret))
+
+
+@functools.lru_cache(maxsize=4)
+def _boll_grid_setup(window_bytes: bytes, k_bytes: bytes):
+    """Distinct windows + device-resident one-hot/k/warmup lanes (cached, same
+    rationale as :func:`_grid_setup`)."""
+    window = np.frombuffer(window_bytes, np.float32)
+    k = np.frombuffer(k_bytes, np.float32)
+    P = window.shape[0]
+    if not np.allclose(window, np.round(window)):
+        raise ValueError(
+            "fused_bollinger_sweep windows are bar counts and must be "
+            "integral; got non-integer values")
+    windows = np.unique(np.round(window)).astype(np.float32)
+    W = windows.shape[0]
+    W_pad = _round_up(max(W, 1), _LANES)
+    P_pad = _round_up(max(P, 1), _LANES)
+
+    oh = np.zeros((W_pad, P_pad), np.float32)
+    idx = np.searchsorted(windows, np.round(window).astype(np.float32))
+    oh[idx, np.arange(P)] = 1.0
+
+    k_lanes = np.full((1, P_pad), np.float32(np.inf))
+    k_lanes[0, :P] = k            # padded lanes never enter (k = +inf)
+    warm = np.ones((1, P_pad), np.float32)
+    warm[0, :P] = window
+    return (tuple(int(w) for w in windows), jnp.asarray(oh),
+            jnp.asarray(k_lanes), jnp.asarray(warm))
 
 
 @functools.lru_cache(maxsize=4)

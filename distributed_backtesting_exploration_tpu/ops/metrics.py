@@ -109,9 +109,18 @@ def cagr(equity: Array, *, periods_per_year: int = 252, mask=None) -> Array:
     return jnp.power(final, 1.0 / years) - 1.0
 
 
-def hit_rate(returns: Array, positions: Array, *, eps: float = 1e-12) -> Array:
-    """Fraction of bars with positive net return, among bars with exposure."""
-    active = (jnp.abs(_lagged_abs(positions)) > 0).astype(returns.dtype)
+def hit_rate(returns: Array, positions: Array, *, mask=None,
+             eps: float = 1e-12) -> Array:
+    """Fraction of bars with positive net return, among bars with exposure.
+
+    ``mask`` excludes padded bars from the active set — without it, a padded
+    batch whose final position is held through the pad counts zero-return
+    pad bars in the denominator and dilutes the rate vs the unpadded series.
+    """
+    active = jnp.abs(_lagged_abs(positions)) > 0
+    if mask is not None:
+        active = active & mask
+    active = active.astype(returns.dtype)
     wins = (returns > 0).astype(returns.dtype) * active
     return jnp.sum(wins, axis=-1) / (jnp.sum(active, axis=-1) + eps)
 
@@ -143,7 +152,7 @@ def summary_metrics(returns: Array, equity: Array, positions: Array, *,
         cagr=cagr(equity, periods_per_year=periods_per_year, mask=mask),
         volatility=_masked_moments(returns, mask)[1]
         * jnp.sqrt(jnp.asarray(periods_per_year, returns.dtype)),
-        hit_rate=hit_rate(returns, positions),
+        hit_rate=hit_rate(returns, positions, mask=mask),
         n_trades=n_trades(positions),
         turnover=turnover_total(positions),
     )

@@ -24,6 +24,11 @@ def band_hysteresis(z: Array, valid: Array, z_entry, z_exit=0.0, *,
     Position never flips sign without passing through flat. Bars with
     ``valid`` False force flat. ``z_entry``/``z_exit`` may be traced scalars
     (vmap over parameter grids).
+
+    Serial reference implementation (``lax.scan`` over bars). Production
+    paths use :func:`band_hysteresis_assoc`, which computes the identical
+    state sequence in O(log T) depth; this version is kept as the
+    semantics-defining golden model.
     """
     valid = jnp.broadcast_to(valid, z.shape)
 
@@ -41,3 +46,54 @@ def band_hysteresis(z: Array, valid: Array, z_entry, z_exit=0.0, *,
     xs = (jnp.moveaxis(z, -1, 0), jnp.moveaxis(valid, -1, 0))
     _, pos_t = jax.lax.scan(step, jnp.zeros(z.shape[:-1]), xs, unroll=unroll)
     return jnp.moveaxis(pos_t, 0, -1)
+
+
+def band_transition_maps(z: Array, valid: Array, z_entry, z_exit=0.0):
+    """Per-bar transition maps of the band machine, as three float arrays.
+
+    The machine's state space is {-1, 0, +1}, so each bar's update is a
+    function from 3 states to 3 states. ``(frm_m, frm_0, frm_p)`` give the
+    next state when the previous state is -1 / 0 / +1 respectively. Function
+    composition over these maps is associative — the basis for the log-depth
+    evaluation in :func:`band_hysteresis_assoc` and the fused Pallas kernel.
+    """
+    valid = jnp.broadcast_to(valid, z.shape)
+    entered = jnp.where(z < -z_entry, 1.0, jnp.where(z > z_entry, -1.0, 0.0))
+    frm_m = jnp.where(z <= z_exit, 0.0, -1.0)     # short exits at z<=z_exit
+    frm_p = jnp.where(z >= -z_exit, 0.0, 1.0)     # long exits at z>=-z_exit
+    frm_0 = entered
+    zero = jnp.zeros_like(z)
+    return (jnp.where(valid, frm_m, zero), jnp.where(valid, frm_0, zero),
+            jnp.where(valid, frm_p, zero))
+
+
+def _compose_maps(earlier, later):
+    """``later ∘ earlier`` on 3-state maps: route each component through
+    ``later``'s table with two selects (values are exactly -1/0/+1)."""
+    lm, l0, lp = later
+
+    def apply(v):
+        return jnp.where(v < 0, lm, jnp.where(v > 0, lp, l0))
+
+    em, e0, ep = earlier
+    return apply(em), apply(e0), apply(ep)
+
+
+def band_hysteresis_assoc(z: Array, valid: Array, z_entry, z_exit=0.0) -> Array:
+    """:func:`band_hysteresis` in O(log T) depth via ``associative_scan``.
+
+    Produces the bit-identical position sequence (states are small integers
+    in float32; every comparison sees the same inputs) without a serial
+    ``lax.scan`` — on TPU the whole time axis evaluates as ~log2(T) fused
+    VPU passes instead of T sequential steps. This is the production path
+    for stateful strategies (Bollinger mean-reversion, pairs).
+    """
+    maps = band_transition_maps(z, valid, z_entry, z_exit)
+
+    def combine(a, b):
+        # associative_scan folds left-to-right: ``a`` covers earlier bars.
+        return _compose_maps(a, b)
+
+    pm, p0, pp = jax.lax.associative_scan(combine, maps, axis=-1)
+    del pm, pp  # start state is flat: the 0-component is the position path
+    return p0

@@ -61,8 +61,13 @@ def run_sweep(
         grid: dict of ``(P,)`` parameter arrays (see :func:`product_grid`).
         cost: proportional transaction cost per unit turnover.
         bar_mask: optional ``(n_tickers, T)`` validity mask for ragged
-            histories (padded bars carry zero position and are excluded from
-            metric moments).
+            histories. MUST be a contiguous prefix-of-True / suffix-of-False
+            mask as produced by :func:`~..utils.data.pad_and_stack` (padding
+            repeats each ticker's final bar). Padded bars hold the last
+            valid position — earning zero return and zero turnover — and
+            are excluded from metric moments. It is NOT a general
+            interior-bar exclusion mechanism: a mask with False before True
+            would hold positions over bars with real price moves.
 
     Returns:
         :class:`~..ops.metrics.Metrics` with every field ``(n_tickers, P)``.
@@ -71,7 +76,16 @@ def run_sweep(
     def per_param(ohlcv_1, mask_1, params):
         pos = strategy.positions(ohlcv_1, params)
         if mask_1 is not None:
-            pos = pos * mask_1.astype(pos.dtype)
+            # Padding is a suffix (pad_and_stack): HOLD the last valid
+            # position through padded bars instead of zeroing it. Padded
+            # closes repeat the final bar, so held bars earn exactly zero
+            # return and zero turnover — zeroing instead would charge a
+            # phantom exit trade whenever the final position is open,
+            # skewing total_return/turnover/n_trades vs the unpadded series.
+            last_idx = jnp.maximum(
+                jnp.sum(mask_1.astype(jnp.int32), axis=-1) - 1, 0)
+            pos_last = jnp.take(pos, last_idx, axis=-1)
+            pos = jnp.where(mask_1, pos, pos_last)
         res = pnl_mod.backtest_prefix(ohlcv_1.close, pos, cost=cost)
         return metrics_mod.summary_metrics(
             res.returns, res.equity, res.positions,

@@ -72,21 +72,46 @@ class JaxSweepBackend:
     _FUSED_MAX_BARS = 8192
     _FUSED_MAX_WINDOWS = 128
 
+    # Fused Pallas kernels per strategy: strategy name -> (required grid
+    # axes, window-bearing axes whose values must be integral, runner).
+    # Eligibility and dispatch share this table so they cannot drift.
+    @staticmethod
+    def _run_fused_sma(close, grid, cost, ppy):
+        from ..ops import fused
+        return fused.fused_sma_sweep(
+            close, np.asarray(grid["fast"]), np.asarray(grid["slow"]),
+            cost=cost, periods_per_year=ppy)
+
+    @staticmethod
+    def _run_fused_bollinger(close, grid, cost, ppy):
+        from ..ops import fused
+        return fused.fused_bollinger_sweep(
+            close, np.asarray(grid["window"]), np.asarray(grid["k"]),
+            cost=cost, periods_per_year=ppy)
+
+    _FUSED_STRATEGIES = {
+        "sma_crossover": ({"fast", "slow"}, ("fast", "slow"),
+                          _run_fused_sma),
+        "bollinger": ({"window", "k"}, ("window",), _run_fused_bollinger),
+    }
+
     @classmethod
     def _fused_eligible(cls, job, grid, lengths) -> bool:
-        """SMA-crossover jobs with a (fast, slow) integral grid, equal
-        history lengths, and a VMEM-sized working set route to the fused
-        kernel (no padding mask needed)."""
+        """Jobs with a fused kernel (SMA-crossover, Bollinger), integral
+        window grids, equal history lengths, and a VMEM-sized working set
+        route to Pallas (no padding mask needed)."""
         import numpy as np
 
-        if job.strategy != "sma_crossover":
+        spec = cls._FUSED_STRATEGIES.get(job.strategy)
+        if spec is None:
             return False
-        if set(grid) != {"fast", "slow"}:
+        axes, window_axes, _ = spec
+        if set(grid) != axes:
             return False
-        both = np.concatenate([grid["fast"], grid["slow"]])
-        if not np.allclose(both, np.round(both)):
+        wins = np.concatenate([grid[a] for a in window_axes])
+        if not np.allclose(wins, np.round(wins)):
             return False
-        if np.unique(np.round(both)).size > cls._FUSED_MAX_WINDOWS:
+        if np.unique(np.round(wins)).size > cls._FUSED_MAX_WINDOWS:
             return False
         if len(set(int(x) for x in lengths)) != 1:
             return False
@@ -121,15 +146,12 @@ class JaxSweepBackend:
             ppy = group[0].periods_per_year or 252
             if self.use_fused and self._fused_eligible(group[0], axes,
                                                        lengths):
-                from ..ops import fused
                 # Equal-length group: hand the kernel the unpadded closes
                 # (it does its own sublane-aligned padding internally; no
                 # device transfer of the unused open/high/low/volume).
                 close = np.stack([np.asarray(s.close) for s in series])
-                m = fused.fused_sma_sweep(
-                    close, np.asarray(grid["fast"]),
-                    np.asarray(grid["slow"]), cost=group[0].cost,
-                    periods_per_year=ppy)
+                runner = self._FUSED_STRATEGIES[group[0].strategy][2]
+                m = runner(close, grid, group[0].cost, ppy)
             else:
                 batch, _, mask = data_mod.pad_and_stack(series)
                 panel = type(batch)(*(jnp.asarray(f) for f in batch))

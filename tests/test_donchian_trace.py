@@ -69,8 +69,18 @@ def test_fused_routing_eligibility():
     grids = {"fast": np.array([5.0, 10.0]), "slow": np.array([20.0, 40.0])}
     assert JaxSweepBackend._fused_eligible(ok_job, grids, [64, 64])
     assert not JaxSweepBackend._fused_eligible(ok_job, grids, [64, 128])
+    # bollinger has its own fused kernel keyed on (window, k) axes.
+    boll = pb.JobSpec(strategy="bollinger")
+    bgrid = {"window": np.array([10.0, 20.0]), "k": np.array([1.0, 2.5])}
+    assert JaxSweepBackend._fused_eligible(boll, bgrid, [64, 64])
+    assert not JaxSweepBackend._fused_eligible(boll, grids, [64, 64])
     assert not JaxSweepBackend._fused_eligible(
-        pb.JobSpec(strategy="bollinger"), grids, [64, 64])
+        boll, {"window": np.array([10.5]), "k": np.array([1.0])}, [64])
+    # non-integral k is fine — k is a band width, not a bar count.
+    assert JaxSweepBackend._fused_eligible(
+        boll, {"window": np.array([10.0]), "k": np.array([1.37])}, [64])
+    assert not JaxSweepBackend._fused_eligible(
+        pb.JobSpec(strategy="momentum"), grids, [64, 64])
     assert not JaxSweepBackend._fused_eligible(
         ok_job, {"fast": np.array([5.0])}, [64])
     assert not JaxSweepBackend._fused_eligible(

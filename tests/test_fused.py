@@ -57,3 +57,48 @@ def test_fused_rejects_non_integer_windows():
     with pytest.raises(ValueError, match="integral"):
         fused.fused_sma_sweep(
             jnp.ones((1, 64)), np.asarray([3.5]), np.asarray([10.0]))
+
+
+def _check_boll(n_tickers, T, window_axis, k_axis, cost=1e-3, seed=0,
+                z_exit=0.0):
+    ohlcv = data.synthetic_ohlcv(n_tickers, T, seed=seed)
+    panel = type(ohlcv)(*(jnp.asarray(f) for f in ohlcv))
+    grid = sweep.product_grid(k=jnp.asarray(k_axis, jnp.float32),
+                              window=jnp.asarray(window_axis, jnp.float32))
+    ref = sweep.jit_sweep(panel, get_strategy("bollinger"), dict(grid),
+                          cost=cost)
+    got = fused.fused_bollinger_sweep(
+        panel.close, np.asarray(grid["window"]), np.asarray(grid["k"]),
+        cost=cost, z_exit=z_exit)
+    for name in ref._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(got, name)), np.asarray(getattr(ref, name)),
+            rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_fused_bollinger_matches_generic_small():
+    _check_boll(3, 200, [10, 20, 30], [0.5, 1.0, 2.0])
+
+
+def test_fused_bollinger_unaligned_T():
+    _check_boll(2, 251, [8, 16], [1.0, 1.5], seed=3)
+
+
+def test_fused_bollinger_wide_grid():
+    # More params than one 128-lane block; shared windows across combos.
+    _check_boll(2, 320, list(range(5, 16)), [0.5, 1.0, 1.5, 2.0, 2.5, 3.0],
+                seed=5)
+
+
+def test_fused_bollinger_single_param():
+    _check_boll(1, 137, [12], [1.5], seed=7)
+
+
+def test_fused_bollinger_zero_cost():
+    _check_boll(2, 200, [10, 25], [1.0, 2.0], cost=0.0, seed=9)
+
+
+def test_fused_bollinger_rejects_non_integer_windows():
+    with pytest.raises(ValueError, match="integral"):
+        fused.fused_bollinger_sweep(
+            jnp.ones((1, 64)), np.asarray([10.5]), np.asarray([1.0]))

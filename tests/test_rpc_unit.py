@@ -204,3 +204,32 @@ def test_grid_from_proto_canonical_order():
     out = wire.grid_from_proto(spec.grid)
     assert list(out) == ["alpha", "fast", "slow"]
     np.testing.assert_array_equal(out["fast"], np.float32([5.0, 10.0]))
+
+
+def test_backend_fused_bollinger_matches_generic():
+    """A bollinger job routed through the fused kernel (interpret mode on
+    CPU) must produce the same DBXM payload as the generic sweep path."""
+    import numpy as np
+    from distributed_backtesting_exploration_tpu.rpc import compute, wire
+    from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+        synthetic_jobs)
+    from distributed_backtesting_exploration_tpu.rpc import backtesting_pb2 as pb
+
+    grid = {"window": np.float32([10, 20]), "k": np.float32([1.0, 2.0])}
+    recs = synthetic_jobs(2, 160, "bollinger", grid, cost=1e-3, seed=11)
+    specs = [pb.JobSpec(id=r.id, strategy=r.strategy, ohlcv=r.ohlcv,
+                        grid=wire.grid_to_proto(r.grid), cost=r.cost)
+             for r in recs]
+
+    fused_backend = compute.JaxSweepBackend(use_fused=True)
+    generic_backend = compute.JaxSweepBackend(use_fused=False)
+    got_f = {c.job_id: c.metrics for c in fused_backend.process(specs)}
+    got_g = {c.job_id: c.metrics for c in generic_backend.process(specs)}
+    assert set(got_f) == {r.id for r in recs}
+    for jid in got_f:
+        mf = wire.metrics_from_bytes(got_f[jid])
+        mg = wire.metrics_from_bytes(got_g[jid])
+        for name in mf._fields:
+            np.testing.assert_allclose(
+                np.asarray(getattr(mf, name)), np.asarray(getattr(mg, name)),
+                rtol=2e-4, atol=2e-5, err_msg=name)

@@ -1,0 +1,49 @@
+"""The associative band machine must match the serial scan bit-for-bit."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_backtesting_exploration_tpu.ops import rolling, signals
+
+
+@pytest.mark.parametrize("z_entry,z_exit", [(1.0, 0.0), (1.5, 0.5), (0.2, 0.0)])
+def test_assoc_matches_scan(z_entry, z_exit):
+    rng = np.random.default_rng(3)
+    z = jnp.asarray(rng.standard_normal((4, 257)), jnp.float32)
+    valid = rolling.valid_mask(257, 20)
+    want = signals.band_hysteresis(z, valid, z_entry, z_exit)
+    got = signals.band_hysteresis_assoc(z, valid, z_entry, z_exit)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_assoc_matches_scan_knife_edge():
+    # Values exactly on the bands: ties must resolve identically.
+    z = jnp.asarray(
+        [[-1.0, -1.0000001, 0.0, 1.0, 1.0000001, 0.0, -2.0, -0.0, 2.0, 0.5]],
+        jnp.float32)
+    valid = jnp.ones((10,), bool)
+    want = signals.band_hysteresis(z, valid, 1.0, 0.0)
+    got = signals.band_hysteresis_assoc(z, valid, 1.0, 0.0)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_assoc_traced_params_vmap():
+    import jax
+
+    rng = np.random.default_rng(5)
+    z = jnp.asarray(rng.standard_normal((513,)), jnp.float32)
+    valid = rolling.valid_mask(513, 10)
+    ks = jnp.asarray([0.5, 1.0, 2.0], jnp.float32)
+    got = jax.vmap(lambda k: signals.band_hysteresis_assoc(z, valid, k))(ks)
+    want = jnp.stack([signals.band_hysteresis(z, valid, float(k)) for k in ks])
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_assoc_invalid_bars_force_flat():
+    z = jnp.asarray([[-3.0, -3.0, -3.0, 3.0, 3.0, -3.0]], jnp.float32)
+    valid = jnp.asarray([True, False, True, True, False, True])
+    want = signals.band_hysteresis(z, valid, 1.0, 0.0)
+    got = signals.band_hysteresis_assoc(z, valid, 1.0, 0.0)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert np.asarray(got)[0, 1] == 0.0 and np.asarray(got)[0, 4] == 0.0

@@ -66,7 +66,13 @@ def test_best_params():
 
 
 def test_padding_invariance():
-    """Padding a history to lane multiples must not change the economics."""
+    """Padding a history to lane multiples must not change the economics.
+
+    Nonzero cost is load-bearing: zeroing positions at padded bars (instead
+    of holding the last valid position) charges a phantom exit trade when
+    the final position is open — caught only when cost != 0 and turnover /
+    n_trades / hit_rate are compared too. Regression for exactly that bug.
+    """
     full = data_mod.synthetic_ohlcv(1, 300, seed=11)
     series = data_mod.OHLCV(*(f[0] for f in full))
     padded, lengths, mask = data_mod.pad_and_stack([series], lane_multiple=128)
@@ -75,17 +81,43 @@ def test_padding_invariance():
     grid = sweep_mod.product_grid(fast=[5, 10], slow=[40, 80])
     m_unpadded = sweep_mod.run_sweep(
         jx(data_mod.OHLCV(*(f[None, :] for f in series))),
-        sma_crossover.SMA_CROSSOVER, grid, cost=0.0)
+        sma_crossover.SMA_CROSSOVER, grid, cost=1e-3)
     m_padded = sweep_mod.run_sweep(
-        jx(padded), sma_crossover.SMA_CROSSOVER, grid, cost=0.0,
+        jx(padded), sma_crossover.SMA_CROSSOVER, grid, cost=1e-3,
         bar_mask=jnp.asarray(mask))
+    # SMA crossover is always in the market after warmup, so the final
+    # position is open and the phantom-exit bug would fire on every combo.
+    assert (np.abs(np.asarray(m_unpadded.total_return)) > 0).all()
 
-    np.testing.assert_allclose(np.asarray(m_padded.total_return),
-                               np.asarray(m_unpadded.total_return), atol=1e-5)
-    np.testing.assert_allclose(np.asarray(m_padded.sharpe),
-                               np.asarray(m_unpadded.sharpe), rtol=1e-3)
-    np.testing.assert_allclose(np.asarray(m_padded.max_drawdown),
-                               np.asarray(m_unpadded.max_drawdown), atol=1e-5)
+    for name in m_unpadded._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(m_padded, name)),
+            np.asarray(getattr(m_unpadded, name)),
+            rtol=1e-3, atol=1e-5, err_msg=name)
+
+
+def test_padding_invariance_ragged_stateful():
+    """Two tickers of different lengths, stateful strategy, all metrics."""
+    from distributed_backtesting_exploration_tpu.models.base import get_strategy
+
+    full = data_mod.synthetic_ohlcv(2, 300, seed=21)
+    s0 = data_mod.OHLCV(*(f[0] for f in full))
+    s1 = data_mod.OHLCV(*(np.asarray(f[1])[:211] for f in full))
+    padded, lengths, mask = data_mod.pad_and_stack([s0, s1], lane_multiple=128)
+
+    grid = sweep_mod.product_grid(k=[0.5, 1.5], window=[10., 20.])
+    strat = get_strategy("bollinger")
+    m_padded = sweep_mod.run_sweep(jx(padded), strat, grid, cost=1e-3,
+                                   bar_mask=jnp.asarray(mask))
+    for i, s in enumerate((s0, s1)):
+        m_one = sweep_mod.run_sweep(
+            jx(data_mod.OHLCV(*(np.asarray(f)[None, :] for f in s))),
+            strat, grid, cost=1e-3)
+        for name in m_one._fields:
+            np.testing.assert_allclose(
+                np.asarray(getattr(m_padded, name))[i],
+                np.asarray(getattr(m_one, name))[0],
+                rtol=1e-3, atol=1e-5, err_msg=f"ticker {i} {name}")
 
 
 def test_chunked_sweep_matches_jit_sweep():
