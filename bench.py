@@ -1,21 +1,36 @@
-"""Headline benchmark: (ticker x param) backtests/sec on one chip.
+"""Benchmark suite: (ticker x param) backtests/sec on one chip, per config.
 
-Workload = the BASELINE.json north star: a 500-ticker SMA-crossover sweep
-over 5 years of daily bars with a 2,000-point (fast, slow) grid — 1,000,000
-full backtests (indicators, positions, PnL, 9 summary metrics) per sweep
-call, executed as a single fused jit kernel chunked over the param axis to
-bound HBM.
+Headline workload = the BASELINE.json north star (configs[1]): a 500-ticker
+SMA-crossover sweep over 5 years of daily bars with a 2,000-point
+(fast, slow) grid — 1,000,000 full backtests (indicators, positions, PnL,
+9 summary metrics) per sweep call, via the fused Pallas kernel. The suite
+also measures configs[2]-[4]: fused Bollinger (500 x 1k (window, k)),
+rolling-OLS pairs (1k pairs x 500 (lookback, z_entry)), and walk-forward
+(12 refit windows x param grid), printing a per-config line to stderr.
 
 Baseline: the reference's worker processes jobs serially at 1 job/sec (its
 compute slot sleeps 1 s per job — reference ``src/worker/process.rs:23``), so
 ``vs_baseline`` is the raw speedup over 1 backtest/sec.
 
-Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": "backtests/sec", "vs_baseline": N}
+Methodology: the first call (compile) is excluded; a further untimed warm-up
+round absorbs the remote-proxy dispatch pipeline's cold start (the first ~10
+dispatches pay full round-trip latency before pipelining engages — measured
+4M/s cold vs 16M/s warm for the same program). Timed iterations chain into a
+device-side accumulator so every sweep executes, synchronized once at the end.
+A persistent compilation cache under .jax_cache cuts fresh-process compiles.
 
-Env overrides (for local smoke runs): DBX_BENCH_TICKERS, DBX_BENCH_BARS,
-DBX_BENCH_PARAMS (grid points, must stay divisible by the chunk),
-DBX_BENCH_CHUNK, DBX_BENCH_ITERS, DBX_BENCH_CPU=1 to force the CPU platform.
+Prints ONE JSON line to stdout:
+    {"metric": ..., "value": N, "unit": "backtests/sec", "vs_baseline": N,
+     "configs": {name: rate, ...}}
+
+``--verify`` mode instead runs fused-vs-generic parity for the SMA and
+Bollinger kernels ON THE CHIP and prints one JSON line with max relative
+error and the argmax/entry flip rates (the knife-edge MXU caveat, quantified
+fresh each round).
+
+Env overrides (local smoke runs): DBX_BENCH_TICKERS, DBX_BENCH_BARS,
+DBX_BENCH_PARAMS, DBX_BENCH_ITERS, DBX_BENCH_WARMUP, DBX_BENCH_CPU=1 to
+force the CPU platform, DBX_BENCH_CONFIGS=comma list to subset configs.
 """
 
 import json
@@ -24,88 +39,248 @@ import sys
 import time
 
 
-def main():
+def _setup_jax():
     if os.environ.get("DBX_BENCH_CPU") == "1":
         os.environ.setdefault(
             "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
     import jax
-    import jax.numpy as jnp
-    import numpy as np
 
     if os.environ.get("DBX_BENCH_CPU") == "1":
         jax.config.update("jax_platforms", "cpu")
+    cache_dir = os.environ.get(
+        "DBX_BENCH_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+    if cache_dir:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    return jax
 
-    from distributed_backtesting_exploration_tpu.models import base
-    from distributed_backtesting_exploration_tpu.parallel import sweep
+
+def _measure(run, n_backtests: int, *, iters: int, warmup: int, name: str):
+    """Compile + warm the dispatch pipeline, then time ``iters`` chained runs."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    t0 = time.perf_counter()
+    out = run()
+    first = np.asarray(out.sharpe)
+    assert np.isfinite(first).all(), f"{name}: non-finite metrics"
+    compile_s = time.perf_counter() - t0
+
+    acc = jnp.float32(0.0)
+    for _ in range(warmup):
+        acc = acc + jnp.sum(run().sharpe)
+    float(acc)  # sync
+
+    t0 = time.perf_counter()
+    acc = jnp.float32(0.0)
+    for _ in range(iters):
+        acc = acc + jnp.sum(run().sharpe)
+    acc_val = float(acc)   # the synchronizing fetch — must not be elided
+    elapsed = time.perf_counter() - t0
+    assert np.isfinite(acc_val), f"{name}: non-finite accumulator"
+    rate = n_backtests * iters / elapsed
+    print(f"bench[{name}]: compile {compile_s:.1f}s, {iters}x {n_backtests} "
+          f"backtests in {elapsed:.3f}s -> {rate/1e6:.2f}M/s", file=sys.stderr)
+    return rate
+
+
+def main():
+    jax = _setup_jax()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_backtesting_exploration_tpu.models import base, pairs
+    from distributed_backtesting_exploration_tpu.ops import fused
+    from distributed_backtesting_exploration_tpu.parallel import (
+        sweep, walkforward)
     from distributed_backtesting_exploration_tpu.utils import data
 
     n_tickers = int(os.environ.get("DBX_BENCH_TICKERS", 500))
     n_bars = int(os.environ.get("DBX_BENCH_BARS", 1260))      # 5y daily
     n_params = int(os.environ.get("DBX_BENCH_PARAMS", 2000))
-    chunk = int(os.environ.get("DBX_BENCH_CHUNK", 100))
     iters = int(os.environ.get("DBX_BENCH_ITERS", 10))
+    warmup = int(os.environ.get("DBX_BENCH_WARMUP", 12))
+    only = os.environ.get("DBX_BENCH_CONFIGS")
+    only = set(only.split(",")) if only else None
 
     dev = jax.devices()[0]
     print(f"bench: device={dev.device_kind} tickers={n_tickers} "
-          f"bars={n_bars} params={n_params} chunk={chunk}", file=sys.stderr)
-
-    # Param grid: n_fast x n_slow = n_params (default 20 x 100). Windows are
-    # bar counts — keep them integral.
-    n_fast = 20
-    n_slow = n_params // n_fast
-    grid = sweep.product_grid(
-        fast=jnp.arange(5, 5 + n_fast, dtype=jnp.float32),
-        slow=jnp.arange(30, 30 + 2 * n_slow, 2, dtype=jnp.float32))
+          f"bars={n_bars} params={n_params}", file=sys.stderr)
 
     ohlcv = data.synthetic_ohlcv(n_tickers, n_bars, seed=0)
     panel = type(ohlcv)(*(jax.device_put(jnp.asarray(f), dev) for f in ohlcv))
-    strategy = base.get_strategy("sma_crossover")
+    rates: dict[str, float] = {}
 
-    if os.environ.get("DBX_BENCH_GENERIC") == "1":
-        def run():
-            return sweep.chunked_sweep(panel, strategy, grid,
-                                       param_chunk=chunk, cost=1e-3)
-    else:
-        # Flagship path: the fused Pallas sweep kernel (ops/fused.py).
-        from distributed_backtesting_exploration_tpu.ops import fused
-        fa = np.asarray(grid["fast"])
-        sl = np.asarray(grid["slow"])
+    def enabled(name):
+        return only is None or name in only
 
-        def run():
-            return fused.fused_sma_sweep(panel.close, fa, sl, cost=1e-3)
+    # --- configs[1] headline: fused SMA-crossover sweep -------------------
+    if enabled("sma_fused"):
+        n_fast = 20
+        n_slow = max(n_params // n_fast, 1)
+        grid = sweep.product_grid(
+            fast=jnp.arange(5, 5 + n_fast, dtype=jnp.float32),
+            slow=jnp.arange(30, 30 + 2 * n_slow, 2, dtype=jnp.float32))
+        fa, sl = np.asarray(grid["fast"]), np.asarray(grid["slow"])
+        if os.environ.get("DBX_BENCH_GENERIC") == "1":
+            strat = base.get_strategy("sma_crossover")
+            chunk = int(os.environ.get("DBX_BENCH_CHUNK", 100))
 
-    t0 = time.perf_counter()
-    out = run()
-    first_sharpe = np.asarray(out.sharpe)
-    compile_s = time.perf_counter() - t0
-    print(f"bench: first call (incl. compile) {compile_s:.1f}s", file=sys.stderr)
+            def run_sma():
+                return sweep.chunked_sweep(panel, strat, grid,
+                                           param_chunk=chunk, cost=1e-3)
+        else:
+            def run_sma():
+                return fused.fused_sma_sweep(panel.close, fa, sl, cost=1e-3)
 
-    # Chain every iteration into a device-side accumulator and fetch ONE
-    # scalar at the end: the data dependency forces every sweep to execute
-    # (with the remote-proxy TPU backend, block_until_ready alone can report
-    # dispatch time), while paying the proxy round-trip only once.
-    t0 = time.perf_counter()
-    acc = jnp.float32(0.0)
-    for _ in range(iters):
-        out = run()
-        acc = acc + jnp.sum(out.sharpe)
-    acc_val = float(acc)   # the synchronizing fetch — must not be elided
-    elapsed = time.perf_counter() - t0
-    assert np.isfinite(acc_val)
+        rates["sma_fused"] = _measure(
+            run_sma, n_tickers * sweep.grid_size(grid), iters=iters,
+            warmup=warmup, name="sma_fused")
 
-    n_backtests = n_tickers * sweep.grid_size(grid)
-    rate = n_backtests * iters / elapsed
-    assert np.isfinite(first_sharpe).all()
-    print(f"bench: {iters}x {n_backtests} backtests in {elapsed:.3f}s",
-          file=sys.stderr)
+    # --- configs[2]: fused Bollinger (window, k) --------------------------
+    if enabled("bollinger_fused"):
+        n_win, n_k = 20, max(min(n_params, 1000) // 20, 1)
+        bgrid = sweep.product_grid(
+            k=jnp.linspace(0.5, 3.0, n_k).astype(jnp.float32),
+            window=jnp.arange(10, 10 + 2 * n_win, 2, dtype=jnp.float32))
+        bw = np.asarray(bgrid["window"])
+        bk = np.asarray(bgrid["k"])
+
+        def run_boll():
+            return fused.fused_bollinger_sweep(panel.close, bw, bk, cost=1e-3)
+
+        rates["bollinger_fused"] = _measure(
+            run_boll, n_tickers * sweep.grid_size(bgrid), iters=iters,
+            warmup=warmup, name="bollinger_fused")
+
+    # --- configs[3]: rolling-OLS pairs (lookback, z_entry) ----------------
+    if enabled("pairs"):
+        n_pairs = min(2 * n_tickers, 1000)
+        pair_data = data.synthetic_ohlcv(2 * n_pairs, n_bars, seed=1)
+        closes = jax.device_put(jnp.asarray(pair_data.close), dev)
+        y_close, x_close = closes[:n_pairs], closes[n_pairs:]
+        pgrid = sweep.product_grid(
+            lookback=jnp.arange(20, 70, 5, dtype=jnp.float32),
+            z_entry=jnp.linspace(0.5, 3.0, 50).astype(jnp.float32))
+
+        def run_pairs():
+            return pairs.chunked_pairs_sweep(
+                y_close, x_close, pgrid, param_chunk=50, cost=1e-3)
+
+        rates["pairs"] = _measure(
+            run_pairs, n_pairs * sweep.grid_size(pgrid),
+            iters=max(iters // 2, 3), warmup=max(warmup // 3, 2),
+            name="pairs")
+
+    # --- configs[4]: walk-forward (12 refit windows x grid) ---------------
+    if enabled("walkforward"):
+        train = n_bars // 2 - 30
+        test = max((n_bars - train) // 12, 1)
+        wgrid = sweep.product_grid(
+            fast=jnp.arange(5, 25, dtype=jnp.float32),
+            slow=jnp.arange(30, 130, 5, dtype=jnp.float32))
+        n_windows = int((n_bars - train) // test)
+        strat = base.get_strategy("sma_crossover")
+
+        from types import SimpleNamespace
+
+        def run_wf():
+            r = walkforward.walk_forward(
+                panel, strat, wgrid, train=train, test=test, cost=1e-3)
+            return SimpleNamespace(sharpe=r.oos_metrics.sharpe)
+
+        rates["walkforward"] = _measure(
+            run_wf, n_tickers * sweep.grid_size(wgrid) * n_windows,
+            iters=max(iters // 2, 3), warmup=max(warmup // 3, 2),
+            name="walkforward")
+
+    if not rates:
+        known = "sma_fused, bollinger_fused, pairs, walkforward"
+        sys.exit(f"bench: no configs ran — DBX_BENCH_CONFIGS={only} matched "
+                 f"nothing (known: {known})")
+    headline = rates.get("sma_fused", next(iter(rates.values())))
     print(json.dumps({
         "metric": "backtests/sec/chip (ticker x param combos), "
                   "SMA-crossover sweep, 5y daily bars",
-        "value": round(rate, 1),
+        "value": round(headline, 1),
         "unit": "backtests/sec",
-        "vs_baseline": round(rate, 1),  # reference worker: 1 backtest/sec
+        "vs_baseline": round(headline, 1),  # reference worker: 1 backtest/sec
+        "configs": {k: round(v, 1) for k, v in rates.items()},
     }))
 
 
+def verify():
+    """Fused-vs-generic parity ON THE CHIP: max rel err + flip rates."""
+    jax = _setup_jax()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_backtesting_exploration_tpu.models import base
+    from distributed_backtesting_exploration_tpu.ops import fused
+    from distributed_backtesting_exploration_tpu.parallel import sweep
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    n_tickers = int(os.environ.get("DBX_BENCH_TICKERS", 100))
+    n_bars = int(os.environ.get("DBX_BENCH_BARS", 1260))
+    dev = jax.devices()[0]
+    ohlcv = data.synthetic_ohlcv(n_tickers, n_bars, seed=0)
+    panel = type(ohlcv)(*(jax.device_put(jnp.asarray(f), dev) for f in ohlcv))
+    out = {"device": dev.device_kind}
+
+    cases = {
+        "sma": (
+            "sma_crossover",
+            sweep.product_grid(
+                fast=jnp.arange(5, 25, dtype=jnp.float32),
+                slow=jnp.arange(30, 70, 2, dtype=jnp.float32)),
+            lambda g: fused.fused_sma_sweep(
+                panel.close, np.asarray(g["fast"]), np.asarray(g["slow"]),
+                cost=1e-3),
+        ),
+        "bollinger": (
+            "bollinger",
+            sweep.product_grid(
+                k=jnp.linspace(0.5, 3.0, 20).astype(jnp.float32),
+                window=jnp.arange(10, 50, 2, dtype=jnp.float32)),
+            lambda g: fused.fused_bollinger_sweep(
+                panel.close, np.asarray(g["window"]), np.asarray(g["k"]),
+                cost=1e-3),
+        ),
+    }
+    for name, (strat_name, grid, run_fused) in cases.items():
+        ref = sweep.jit_sweep(panel, base.get_strategy(strat_name),
+                              dict(grid), cost=1e-3)
+        got = run_fused(grid)
+        r = np.asarray(ref.sharpe)
+        g = np.asarray(got.sharpe)
+        rel = np.abs(g - r) / (np.abs(r) + 1e-6)
+        # NaN-on-one-side cells would fail BOTH comparisons below and vanish
+        # from the report — count them explicitly as mismatches.
+        nan_mismatch = int((np.isnan(g) != np.isnan(r)).sum())
+        rel = np.where(np.isnan(g) & np.isnan(r), 0.0, rel)
+        # A "flip" = a materially different cell (a knife-edge crossover
+        # resolved differently), vs float noise.
+        flips = int((rel > 1e-2).sum()) + nan_mismatch
+        argmax_flips = int((np.argmax(g, axis=1) != np.argmax(r, axis=1)).sum())
+        out[name] = {
+            "cells": int(rel.size),
+            "max_rel_err_nonflip": float(rel[rel <= 1e-2].max())
+            if (rel <= 1e-2).any() else None,
+            "entry_flips": flips,
+            "nan_mismatches": nan_mismatch,
+            "flip_rate": flips / rel.size,
+            "best_param_flips": argmax_flips,
+            "n_tickers": int(r.shape[0]),
+        }
+        print(f"verify[{name}]: {flips}/{rel.size} entry flips "
+              f"({nan_mismatch} NaN), {argmax_flips}/{r.shape[0]} "
+              f"best-param flips", file=sys.stderr)
+    print(json.dumps(out))
+
+
 if __name__ == "__main__":
-    main()
+    if "--verify" in sys.argv[1:]:
+        verify()
+    else:
+        main()

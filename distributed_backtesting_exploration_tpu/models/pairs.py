@@ -75,15 +75,8 @@ def pair_backtest(y: Array, x: Array, params, *, cost=0.0,
         net, equity, pos, periods_per_year=periods_per_year)
 
 
-@functools.partial(jax.jit, static_argnames=("periods_per_year",))
-def run_pairs_sweep(y_close: Array, x_close: Array, grid, *, cost=0.0,
-                    periods_per_year: int = 252) -> metrics_mod.Metrics:
-    """Evaluate every (pair, param) combo; fields come back ``(n_pairs, P)``.
-
-    ``y_close``/``x_close`` are ``(n_pairs, T)``; ``grid`` maps param name ->
-    ``(P,)`` (see :func:`~..parallel.sweep.product_grid`).
-    """
-
+def _pairs_sweep(y_close: Array, x_close: Array, grid, *, cost,
+                 periods_per_year: int) -> metrics_mod.Metrics:
     def per_param(y1, x1, p):
         return pair_backtest(y1, x1, p, cost=cost,
                              periods_per_year=periods_per_year)
@@ -92,3 +85,37 @@ def run_pairs_sweep(y_close: Array, x_close: Array, grid, *, cost=0.0,
         return jax.vmap(lambda p: per_param(y1, x1, p))(dict(grid))
 
     return jax.vmap(per_pair)(y_close, x_close)
+
+
+@functools.partial(jax.jit, static_argnames=("periods_per_year",))
+def run_pairs_sweep(y_close: Array, x_close: Array, grid, *, cost=0.0,
+                    periods_per_year: int = 252) -> metrics_mod.Metrics:
+    """Evaluate every (pair, param) combo; fields come back ``(n_pairs, P)``.
+
+    ``y_close``/``x_close`` are ``(n_pairs, T)``; ``grid`` maps param name ->
+    ``(P,)`` (see :func:`~..parallel.sweep.product_grid`).
+    """
+    return _pairs_sweep(y_close, x_close, grid, cost=cost,
+                        periods_per_year=periods_per_year)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("param_chunk", "periods_per_year"))
+def chunked_pairs_sweep(y_close: Array, x_close: Array, grid, *,
+                        param_chunk: int, cost=0.0,
+                        periods_per_year: int = 252) -> metrics_mod.Metrics:
+    """Memory-bounded pairs sweep: ``lax.map`` over param chunks.
+
+    A fully-vmapped pairs sweep materializes ``(pairs, P, T)`` intermediates
+    (several live at once — beta, spread, z, positions), which blows past HBM
+    at the 1k-pairs x 500-param baseline scale. Chunking the param axis
+    bounds live memory exactly like ``sweep.chunked_sweep`` does for the
+    single-asset engine. ``P`` must be divisible by ``param_chunk``.
+    """
+    from ..parallel.sweep import map_param_chunks
+
+    def one_chunk(g):
+        return _pairs_sweep(y_close, x_close, g, cost=cost,
+                            periods_per_year=periods_per_year)
+
+    return map_param_chunks(grid, param_chunk, one_chunk)

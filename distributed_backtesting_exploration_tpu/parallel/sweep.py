@@ -107,6 +107,26 @@ def jit_sweep(ohlcv, strategy, grid, *, cost=0.0, bar_mask=None,
                      periods_per_year=periods_per_year)
 
 
+def map_param_chunks(grid: Mapping[str, Array], param_chunk: int, one_chunk):
+    """Memory-bounding pattern: ``lax.map`` a sweep over param-axis chunks.
+
+    ``one_chunk(sub_grid)`` evaluates a ``(param_chunk,)``-sized grid and
+    returns :class:`~..ops.metrics.Metrics` with ``(..., param_chunk)``
+    fields; the chunk results are reassembled into ``(..., P)`` fields in the
+    original flat-grid order. ``P`` must be divisible by ``param_chunk``.
+    Shared by the single-asset and pairs chunked sweeps so the
+    chunk/map/reassemble machinery cannot diverge.
+    """
+    P = grid_size(grid)
+    if P % param_chunk:
+        raise ValueError(f"grid size {P} not divisible by chunk {param_chunk}")
+    chunked = {k: jnp.reshape(v, (P // param_chunk, param_chunk))
+               for k, v in grid.items()}
+    out = jax.lax.map(one_chunk, chunked)   # fields: (n_chunks, ..., chunk)
+    return metrics_mod.Metrics(*(
+        jnp.reshape(jnp.moveaxis(f, 0, 1), (f.shape[1], P)) for f in out))
+
+
 @functools.partial(
     jax.jit, static_argnames=("strategy", "param_chunk", "periods_per_year"))
 def chunked_sweep(ohlcv, strategy, grid, *, param_chunk: int, cost=0.0,
@@ -122,19 +142,12 @@ def chunked_sweep(ohlcv, strategy, grid, *, param_chunk: int, cost=0.0,
 
     ``P`` must be divisible by ``param_chunk``.
     """
-    P = grid_size(grid)
-    if P % param_chunk:
-        raise ValueError(f"grid size {P} not divisible by chunk {param_chunk}")
-    chunked = {k: jnp.reshape(v, (P // param_chunk, param_chunk))
-               for k, v in grid.items()}
 
     def one_chunk(g):
         return run_sweep(ohlcv, strategy, g, cost=cost, bar_mask=bar_mask,
                          periods_per_year=periods_per_year)
 
-    out = jax.lax.map(one_chunk, chunked)   # fields: (n_chunks, tickers, chunk)
-    return metrics_mod.Metrics(*(
-        jnp.reshape(jnp.moveaxis(f, 0, 1), (f.shape[1], P)) for f in out))
+    return map_param_chunks(grid, param_chunk, one_chunk)
 
 
 def best_params(metric_values: Array, grid: Mapping[str, Array], *, axis=-1,

@@ -2,6 +2,7 @@
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from distributed_backtesting_exploration_tpu.models import pairs
 from distributed_backtesting_exploration_tpu.models.base import get_strategy
@@ -153,3 +154,23 @@ def test_walkforward_boundary_rebalance_cost():
     want = prev * r - cost * np.abs(pos - prev)
     np.testing.assert_allclose(np.asarray(res.oos_returns), want,
                                rtol=1e-4, atol=1e-6)
+
+
+def test_chunked_pairs_sweep_matches_full():
+    from distributed_backtesting_exploration_tpu.models import pairs as pm
+
+    rng = np.random.default_rng(17)
+    T, n_pairs = 200, 3
+    x = np.cumsum(rng.standard_normal((n_pairs, T)) * 0.5, axis=1) + 100
+    y = 1.3 * x + rng.standard_normal((n_pairs, T)) * 2.0
+    yj, xj = jnp.asarray(y, jnp.float32), jnp.asarray(x, jnp.float32)
+    grid = sweep.product_grid(lookback=jnp.array([20., 30.]),
+                              z_entry=jnp.array([1.0, 1.5, 2.0]))
+    ref = pm.run_pairs_sweep(yj, xj, grid, cost=1e-3)
+    got = pm.chunked_pairs_sweep(yj, xj, grid, param_chunk=3, cost=1e-3)
+    for name in ref._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(got, name)), np.asarray(getattr(ref, name)),
+            rtol=1e-6, atol=1e-7, err_msg=name)
+    with pytest.raises(ValueError, match="divisible"):
+        pm.chunked_pairs_sweep(yj, xj, grid, param_chunk=4)
