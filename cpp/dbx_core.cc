@@ -248,6 +248,19 @@ extern "C" int dbx_queue_push(DbxQueue* q, const uint8_t* data, size_t len,
   return 0;
 }
 
+extern "C" int dbx_queue_push_front(DbxQueue* q, const uint8_t* data,
+                                    size_t len, int64_t timeout_ms) {
+  std::unique_lock<std::mutex> lk(q->mu);
+  const bool ok = wait_on(q->not_full, lk, timeout_ms, [q] {
+    return q->closed || q->items.size() < q->capacity;
+  });
+  if (!ok) return 1;
+  if (q->closed) return 2;
+  q->items.emplace_front(data, data + len);
+  q->not_empty.notify_one();
+  return 0;
+}
+
 extern "C" int dbx_queue_pop(DbxQueue* q, uint8_t** data, size_t* len,
                              int64_t timeout_ms) {
   std::unique_lock<std::mutex> lk(q->mu);

@@ -71,6 +71,11 @@ DbxQueue* dbx_queue_new(size_t capacity);
 // Returns 0 ok, 1 timeout, 2 closed.
 int dbx_queue_push(DbxQueue* q, const uint8_t* data, size_t len,
                    int64_t timeout_ms);
+// Push to the FRONT of the queue (next pop returns it) — the dispatcher's
+// requeue-expired-lease path, which must re-dispatch recovered jobs before
+// fresh ones. Same blocking/return contract as dbx_queue_push.
+int dbx_queue_push_front(DbxQueue* q, const uint8_t* data, size_t len,
+                         int64_t timeout_ms);
 // Pop into a malloc'd buffer (*data, *len). Blocks up to timeout_ms when
 // empty. Returns 0 ok, 1 timeout, 2 closed-and-drained.
 int dbx_queue_pop(DbxQueue* q, uint8_t** data, size_t* len,

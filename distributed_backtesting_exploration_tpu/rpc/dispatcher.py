@@ -42,9 +42,57 @@ import numpy as np
 from . import backtesting_pb2 as pb
 from . import service, wire
 from .journal import Journal
+from ..runtime import _core as native_core
 from ..utils import data as data_mod
 
 log = logging.getLogger("dbx.dispatcher")
+
+
+class _PendingIds:
+    """FIFO of pending job ids, backed by the native MPMC queue when the C++
+    core is available (the reference's queue substrate is native; SURVEY.md
+    §2.2 native ledger) and by a deque otherwise. Single lock discipline is
+    owned by JobQueue — these methods are called under its lock.
+    """
+
+    # Far above any realistic pending backlog; push never blocks.
+    _NATIVE_CAPACITY = 1 << 20
+
+    def __init__(self, use_native: bool | None = None):
+        self._nq = None
+        if use_native is None:
+            use_native = native_core.available()
+        if use_native:
+            try:
+                self._nq = native_core.NativeQueue(self._NATIVE_CAPACITY)
+            except RuntimeError:
+                self._nq = None
+        self._dq: collections.deque[str] | None = (
+            None if self._nq is not None else collections.deque())
+        self.backend = "native" if self._nq is not None else "python"
+
+    def append(self, jid: str) -> None:
+        if self._nq is not None:
+            if not self._nq.push(jid.encode(), timeout_ms=0):
+                raise RuntimeError("native pending queue full")
+        else:
+            self._dq.append(jid)
+
+    def appendleft(self, jid: str) -> None:
+        if self._nq is not None:
+            if not self._nq.push_front(jid.encode(), timeout_ms=0):
+                raise RuntimeError("native pending queue full")
+        else:
+            self._dq.appendleft(jid)
+
+    def popleft(self) -> str | None:
+        if self._nq is not None:
+            b = self._nq.pop(timeout_ms=0)
+            return b.decode() if b is not None else None
+        return self._dq.popleft() if self._dq else None
+
+    def __len__(self) -> int:
+        return len(self._nq) if self._nq is not None else len(self._dq)
 
 
 # ---------------------------------------------------------------------------
@@ -111,7 +159,12 @@ class JobQueue:
     def __init__(self, journal: Journal | None = None, *,
                  lease_s: float = 60.0):
         self._lock = threading.Lock()
-        self._pending: collections.deque[str] = collections.deque()
+        self._pending = _PendingIds()
+        # Ids completed while still in the pending FIFO (late completions
+        # from a previous lease): the FIFO supports no interior removal, so
+        # take() skips tombstoned ids on pop. Invariant: every tombstone
+        # refers to an id currently in the FIFO.
+        self._tombstones: set[str] = set()
         self._records: dict[str, JobRecord] = {}
         self._leases: dict[str, Lease] = {}
         self._completed: dict[str, float] = {}   # id -> combos credited
@@ -121,6 +174,11 @@ class JobQueue:
         self.lease_s = lease_s
         self._t0 = time.monotonic()
         self._combos_done = 0.0
+
+    @property
+    def substrate(self) -> str:
+        """"native" when the C++ queue core backs the pending FIFO."""
+        return self._pending.backend
 
     # -- intake ------------------------------------------------------------
 
@@ -153,9 +211,12 @@ class JobQueue:
         now = time.monotonic()
         while len(out) < n:
             with self._lock:
-                if not self._pending:
-                    break
                 jid = self._pending.popleft()
+                if jid is None:
+                    break
+                if jid in self._tombstones:     # completed while pending
+                    self._tombstones.discard(jid)
+                    continue
                 rec = self._records[jid]
             payload = rec.ohlcv
             if payload is None:
@@ -187,13 +248,16 @@ class JobQueue:
         with self._lock:
             if jid not in self._records:
                 return False
-            self._leases.pop(jid, None)
+            had_lease = self._leases.pop(jid, None) is not None
             if jid in self._completed:
                 return True
-            try:
-                self._pending.remove(jid)   # rare path; deque.remove is O(n)
-            except ValueError:
-                pass
+            if (not had_lease and jid not in self._failed
+                    and jid not in self._tombstones):
+                # Rare path: completion for a job sitting in the pending
+                # FIFO (e.g. a completion RPC that straddled a lease expiry
+                # or restart). The FIFO has no interior removal; tombstone
+                # the id so take() skips it instead of re-dispatching.
+                self._tombstones.add(jid)
             combos = float(self._records[jid].combos)
             self._completed[jid] = combos
             self._combos_done += combos
@@ -231,7 +295,7 @@ class JobQueue:
         with self._lock:
             elapsed = max(time.monotonic() - self._t0, 1e-9)
             return {
-                "jobs_pending": len(self._pending),
+                "jobs_pending": len(self._pending) - len(self._tombstones),
                 "jobs_leased": len(self._leases),
                 "jobs_completed": len(self._completed),
                 "jobs_requeued": self._requeued,
@@ -242,7 +306,8 @@ class JobQueue:
     @property
     def drained(self) -> bool:
         with self._lock:
-            return not self._pending and not self._leases
+            live_pending = len(self._pending) - len(self._tombstones)
+            return live_pending == 0 and not self._leases
 
 
 def _read_payload(path: str) -> bytes:
@@ -270,19 +335,40 @@ class Peer:
 
 
 class PeerRegistry:
-    """Live workers keyed by stable worker_id; any RPC refreshes liveness."""
+    """Live workers keyed by stable worker_id; any RPC refreshes liveness.
 
-    def __init__(self, *, prune_window_s: float = 10.0):
+    Liveness timing (last-seen stamping + windowed pruning — the hot path
+    touched by every RPC and the maintenance thread) runs on the native C++
+    registry when available (SURVEY.md §2.2 native ledger; the reference's
+    pruning loop is native, reference ``src/server/main.rs:39-52``); the
+    Python side keeps only per-peer metadata (status, chips). Falls back to
+    a pure-Python clock map when the core is absent.
+    """
+
+    def __init__(self, *, prune_window_s: float = 10.0,
+                 use_native: bool | None = None):
         self._lock = threading.Lock()
         self._peers: dict[str, Peer] = {}
         self.prune_window_s = prune_window_s
+        self._native = None
+        if use_native is None:
+            use_native = native_core.available()
+        if use_native:
+            try:
+                self._native = native_core.NativeRegistry(prune_window_s)
+            except RuntimeError:
+                self._native = None
+        self.substrate = "native" if self._native is not None else "python"
 
     def touch(self, worker_id: str, *, chips: int | None = None,
               status: int | None = None) -> bool:
         """Refresh a peer; returns True if this is a new registration."""
         now = time.monotonic()
         with self._lock:
-            is_new = worker_id not in self._peers
+            if self._native is not None:
+                is_new = self._native.touch(worker_id)
+            else:
+                is_new = worker_id not in self._peers
             peer = self._peers.setdefault(worker_id, Peer())
             peer.last_seen = now
             if chips is not None:
@@ -296,16 +382,21 @@ class PeerRegistry:
 
     def prune(self) -> list[str]:
         """Drop peers silent for longer than the window; return their ids."""
-        cutoff = time.monotonic() - self.prune_window_s
         with self._lock:
-            dead = [wid for wid, p in self._peers.items()
-                    if p.last_seen < cutoff]
+            if self._native is not None:
+                dead = self._native.prune()
+            else:
+                cutoff = time.monotonic() - self.prune_window_s
+                dead = [wid for wid, p in self._peers.items()
+                        if p.last_seen < cutoff]
             for wid in dead:
-                del self._peers[wid]
+                self._peers.pop(wid, None)
         return dead
 
     def alive(self) -> int:
         with self._lock:
+            if self._native is not None:
+                return self._native.alive()
             return len(self._peers)
 
 

@@ -29,8 +29,94 @@ import grpc
 
 from . import backtesting_pb2 as pb
 from . import compute, service
+from ..runtime import _core as native_core
 
 log = logging.getLogger("dbx.worker")
+
+
+class _Channel:
+    """Bounded channel bridging the control and compute threads.
+
+    Backed by the native C++ MPMC queue when the core is available — the
+    role flume's bounded channels play in the reference worker (reference
+    ``src/worker/main.rs:32-42``; SURVEY.md §2.2 native ledger) — and by
+    ``queue.Queue`` otherwise. Items cross the boundary as proto bytes via
+    the ``enc``/``dec`` pair, so the native queue stays a plain blob queue.
+    """
+
+    _UNBOUNDED = 1 << 16
+
+    def __init__(self, capacity: int | None, enc, dec):
+        self._enc, self._dec = enc, dec
+        self._capacity = capacity
+        self._nq = None
+        if native_core.available():
+            try:
+                self._nq = native_core.NativeQueue(
+                    capacity or self._UNBOUNDED)
+            except RuntimeError:
+                self._nq = None
+        self._pq: queue_mod.Queue | None = (
+            None if self._nq is not None else queue_mod.Queue(capacity or 0))
+        self.backend = "native" if self._nq is not None else "python"
+
+    def put(self, item) -> None:
+        if self._nq is not None:
+            self._nq.push(self._enc(item))
+        else:
+            self._pq.put(item)
+
+    def get(self):
+        if self._nq is not None:
+            return self._dec(self._nq.pop())
+        return self._pq.get()
+
+    def get_nowait(self):
+        if self._nq is not None:
+            b = self._nq.pop(timeout_ms=0)
+            if b is None:
+                raise queue_mod.Empty
+            return self._dec(b)
+        return self._pq.get_nowait()
+
+    def full(self) -> bool:
+        if self._nq is not None:
+            return self._capacity is not None and len(self._nq) >= self._capacity
+        return self._pq.full()
+
+    def empty(self) -> bool:
+        if self._nq is not None:
+            return len(self._nq) == 0
+        return self._pq.empty()
+
+
+_BATCH_SENTINEL = b"S"
+
+
+def _encode_batch(batch) -> bytes:
+    if batch is None:
+        return _BATCH_SENTINEL
+    return b"B" + pb.JobsReply(jobs=batch).SerializeToString()
+
+
+def _decode_batch(data: bytes):
+    if data[:1] == _BATCH_SENTINEL:
+        return None
+    reply = pb.JobsReply()
+    reply.ParseFromString(data[1:])
+    return list(reply.jobs)
+
+
+def _encode_completion(c: compute.Completion) -> bytes:
+    return pb.CompleteRequest(
+        id=c.job_id, metrics=c.metrics,
+        elapsed_s=c.elapsed_s).SerializeToString()
+
+
+def _decode_completion(data: bytes) -> compute.Completion:
+    req = pb.CompleteRequest()
+    req.ParseFromString(data)
+    return compute.Completion(req.id, req.metrics, req.elapsed_s)
 
 
 class Worker:
@@ -48,8 +134,9 @@ class Worker:
         self.poll_interval_s = poll_interval_s
         self.status_interval_s = status_interval_s
         self.jobs_per_chip = jobs_per_chip
-        self._in: queue_mod.Queue = queue_mod.Queue(max_inflight_batches)
-        self._out: queue_mod.Queue = queue_mod.Queue()
+        self._in = _Channel(max_inflight_batches, _encode_batch,
+                            _decode_batch)
+        self._out = _Channel(None, _encode_completion, _decode_completion)
         self._stop = threading.Event()
         self._busy = threading.Event()
         self._connected = True  # edge-triggered logging, reference CONNECTED

@@ -66,6 +66,21 @@ def _build() -> bool:
     return False
 
 
+_PRUNED_CB = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p)
+
+
+def _stale() -> bool:
+    """True when the .so is missing or older than any cpp/ source file."""
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    for name in ("dbx_core.cc", "dbx_core.h"):
+        src = os.path.join(_CPP_DIR, name)
+        if os.path.exists(src) and os.path.getmtime(src) > lib_mtime:
+            return True
+    return False
+
+
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dbx_csv_decode.restype = ctypes.c_int
     lib.dbx_csv_decode.argtypes = [
@@ -85,6 +100,9 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dbx_queue_push.restype = ctypes.c_int
     lib.dbx_queue_push.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int64]
+    lib.dbx_queue_push_front.restype = ctypes.c_int
+    lib.dbx_queue_push_front.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int64]
     lib.dbx_queue_pop.restype = ctypes.c_int
     lib.dbx_queue_pop.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
@@ -99,7 +117,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dbx_registry_touch.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.dbx_registry_prune.restype = ctypes.c_int
     lib.dbx_registry_prune.argtypes = [
-        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+        ctypes.c_void_p, _PRUNED_CB, ctypes.c_void_p]
     lib.dbx_registry_alive.restype = ctypes.c_int
     lib.dbx_registry_alive.argtypes = [ctypes.c_void_p]
     lib.dbx_registry_free.argtypes = [ctypes.c_void_p]
@@ -115,7 +133,7 @@ def load() -> ctypes.CDLL | None:
         _tried = True
         if os.environ.get("DBX_NO_NATIVE") == "1":
             return None
-        if not os.path.exists(_LIB_PATH) and not _build():
+        if _stale() and not _build():
             log.info("native core unavailable; using pure-Python paths")
             return None
         try:
@@ -185,6 +203,14 @@ class NativeQueue:
             raise ValueError("queue closed")
         return rc == 0
 
+    def push_front(self, data: bytes, timeout_ms: int = -1) -> bool:
+        """LIFO insert: the next pop returns ``data`` (requeue-at-front)."""
+        rc = self._lib.dbx_queue_push_front(
+            self._h, data, len(data), timeout_ms)
+        if rc == 2:
+            raise ValueError("queue closed")
+        return rc == 0
+
     def pop(self, timeout_ms: int = -1) -> bytes | None:
         """None on timeout; raises ValueError once closed and drained."""
         buf = ctypes.POINTER(ctypes.c_uint8)()
@@ -213,4 +239,43 @@ class NativeQueue:
             # responsible for joining consumers before dropping the queue.
             self._lib.dbx_queue_close(h)
             self._lib.dbx_queue_free(h)
+            self._h = None
+
+
+class NativeRegistry:
+    """Peer liveness map backed by the C++ core (last-seen + windowed prune).
+
+    Owns only the *timing* state; callers keep any per-peer metadata
+    (status, capacity) in their own map keyed by the same ids.
+    """
+
+    def __init__(self, prune_window_s: float):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native core not available")
+        self._lib = lib
+        self._h = lib.dbx_registry_new(int(prune_window_s * 1000))
+
+    def touch(self, peer_id: str) -> bool:
+        """Stamp alive-now; True if newly registered."""
+        return self._lib.dbx_registry_touch(self._h, peer_id.encode()) == 1
+
+    def prune(self) -> list[str]:
+        """Drop peers silent past the window; return their ids."""
+        dead: list[str] = []
+
+        @_PRUNED_CB
+        def collect(peer_id, _ctx):
+            dead.append(peer_id.decode())
+
+        self._lib.dbx_registry_prune(self._h, collect, None)
+        return dead
+
+    def alive(self) -> int:
+        return self._lib.dbx_registry_alive(self._h)
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.dbx_registry_free(h)
             self._h = None

@@ -110,18 +110,25 @@ def test_native_queue_timeout():
 
 
 def test_native_registry_prune():
-    lib = _core.load()
-    reg = lib.dbx_registry_new(100)          # 100 ms window
-    assert lib.dbx_registry_touch(reg, b"w1") == 1
-    assert lib.dbx_registry_touch(reg, b"w1") == 0
-    lib.dbx_registry_touch(reg, b"w2")
-    assert lib.dbx_registry_alive(reg) == 2
+    reg = _core.NativeRegistry(0.1)          # 100 ms window
+    assert reg.touch("w1")
+    assert not reg.touch("w1")
+    reg.touch("w2")
+    assert reg.alive() == 2
     time.sleep(0.15)
-    lib.dbx_registry_touch(reg, b"w2")       # keep w2 alive
-    pruned = lib.dbx_registry_prune(reg, None, None)
-    assert pruned == 1
-    assert lib.dbx_registry_alive(reg) == 1
-    lib.dbx_registry_free(reg)
+    reg.touch("w2")                          # keep w2 alive
+    assert reg.prune() == ["w1"]
+    assert reg.alive() == 1
+
+
+def test_native_queue_push_front():
+    q = _core.NativeQueue(capacity=8)
+    q.push(b"a")
+    q.push(b"b")
+    q.push_front(b"requeued")
+    assert q.pop(0) == b"requeued"
+    assert q.pop(0) == b"a"
+    assert q.pop(0) == b"b"
 
 
 def test_native_worker_shell_selftest():
