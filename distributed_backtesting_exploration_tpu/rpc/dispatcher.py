@@ -171,6 +171,8 @@ class JobQueue:
         self._failed: set[str] = set()
         self._requeued = 0
         self._journal = journal or Journal(None)
+        self.known_paths: set[str] = set()
+        self.journaled_jobs = 0
         self.lease_s = lease_s
         self._t0 = time.monotonic()
         self._combos_done = 0.0
@@ -190,7 +192,14 @@ class JobQueue:
             self._journal.append("enqueue", **rec.journal_form())
 
     def restore(self, journal_path: str) -> int:
-        """Replay a journal; re-enqueue pending jobs. Returns count restored."""
+        """Replay a journal; re-enqueue pending jobs. Returns count restored.
+
+        Also records what the journal already covers — ``known_paths`` (every
+        file path ever enqueued, completed or not) and ``journaled_jobs`` —
+        so a restarted ``main()`` can skip re-enqueueing work the previous
+        run already dispatched (advisor finding: rerunning the documented
+        command line after a crash must not duplicate completed jobs).
+        """
         state = Journal.replay(journal_path)
         n = 0
         for jid in state.pending:
@@ -201,6 +210,9 @@ class JobQueue:
             for jid in state.completed:
                 self._completed.setdefault(jid, 0.0)
             self._failed |= state.failed
+        self.known_paths |= {rec["path"] for rec in state.jobs.values()
+                             if rec.get("path")}
+        self.journaled_jobs += len(state.jobs)
         return n
 
     # -- dispatch ----------------------------------------------------------
@@ -225,16 +237,34 @@ class JobQueue:
                         raise ValueError("job has neither payload nor path")
                     payload = _read_payload(rec.path)
                 except (OSError, ValueError) as e:
+                    with self._lock:
+                        if self._discard_if_completed_locked(jid):
+                            continue
+                        self._failed.add(jid)
                     log.error("job %s: unreadable %s (%s) -> failed",
                               jid, rec.path, e)
-                    with self._lock:
-                        self._failed.add(jid)
                     self._journal.append("fail", id=jid, reason=str(e))
                     continue
             with self._lock:
+                # The id left the FIFO at the top of the loop but is not
+                # leased yet; a completion landing in that unlocked window
+                # sees no lease and no FIFO entry and installs a tombstone
+                # for an id that will never be popped again. Re-check here:
+                # a job completed mid-take must be dropped (and its
+                # tombstone discarded), not leased and recomputed.
+                if self._discard_if_completed_locked(jid):
+                    continue
                 self._leases[jid] = Lease(worker_id, now + self.lease_s)
             out.append((rec, payload))
         return out
+
+    def _discard_if_completed_locked(self, jid: str) -> bool:
+        """Under the lock: True if ``jid`` completed while take() held it
+        outside the lock; clears the orphan tombstone complete() installed."""
+        if jid in self._completed:
+            self._tombstones.discard(jid)
+            return True
+        return False
 
     def complete(self, jid: str, worker_id: str) -> bool:
         """Record a completion (idempotent). Returns False for unknown ids.
@@ -560,7 +590,7 @@ def synthetic_jobs(n: int, n_bars: int, strategy: str, grid, *,
     return out
 
 
-def main(argv=None) -> None:
+def make_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         description="dbx dispatcher: serve backtest jobs to polling workers")
     ap.add_argument("--bind", default="[::]:50051")
@@ -579,12 +609,18 @@ def main(argv=None) -> None:
     ap.add_argument("--lease-s", type=float, default=60.0)
     ap.add_argument("--prune-window-s", type=float, default=10.0)
     ap.add_argument("--jobs-per-chip", type=int, default=1)
-    args = ap.parse_args(argv)
+    return ap
 
-    logging.basicConfig(
-        level=logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s")
 
+def build_dispatcher(args) -> Dispatcher:
+    """Queue construction + journal restore + restart-safe job intake.
+
+    Restart discipline (rerunning the same command line after a crash must
+    not re-dispatch finished work): file paths the journal already knows are
+    skipped, and synthetic seed jobs are only created when the journal holds
+    no jobs at all — otherwise the restored pending set IS the remaining
+    synthetic workload (synthetic payloads are journaled inline).
+    """
     queue = JobQueue(Journal(args.journal), lease_s=args.lease_s)
     restored = queue.restore(args.journal) if args.journal else 0
     if restored:
@@ -593,19 +629,38 @@ def main(argv=None) -> None:
     grid = parse_grid(args.grid)
     if args.data:
         paths = sorted(glob_mod.glob(args.data))
-        for rec in jobs_from_paths(paths, args.strategy, grid, cost=args.cost):
+        new_paths = [p for p in paths if p not in queue.known_paths]
+        if len(new_paths) < len(paths):
+            log.info("skipping %d already-journaled paths",
+                     len(paths) - len(new_paths))
+        for rec in jobs_from_paths(new_paths, args.strategy, grid,
+                                   cost=args.cost):
             queue.enqueue(rec)
-        log.info("enqueued %d file jobs", len(paths))
+        log.info("enqueued %d file jobs", len(new_paths))
     if args.synthetic:
-        for rec in synthetic_jobs(args.synthetic, args.bars, args.strategy,
-                                  grid, cost=args.cost):
-            queue.enqueue(rec)
-        log.info("enqueued %d synthetic jobs", args.synthetic)
+        if queue.journaled_jobs:
+            log.info("journal already holds %d jobs; not re-seeding "
+                     "%d synthetic jobs", queue.journaled_jobs,
+                     args.synthetic)
+        else:
+            for rec in synthetic_jobs(args.synthetic, args.bars,
+                                      args.strategy, grid, cost=args.cost):
+                queue.enqueue(rec)
+            log.info("enqueued %d synthetic jobs", args.synthetic)
 
-    dispatcher = Dispatcher(
+    return Dispatcher(
         queue, PeerRegistry(prune_window_s=args.prune_window_s),
         default_jobs_per_chip=args.jobs_per_chip,
         results_dir=args.results_dir)
+
+
+def main(argv=None) -> None:
+    args = make_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    dispatcher = build_dispatcher(args)
+    queue = dispatcher.queue
     server = DispatcherServer(dispatcher, bind=args.bind).start()
     try:
         while True:

@@ -17,6 +17,10 @@ import threading
 from dataclasses import dataclass, field
 
 
+class JournalCorruptError(ValueError):
+    """An interior (non-tail) journal line failed to decode."""
+
+
 @dataclass
 class ReplayState:
     """Result of replaying a journal file."""
@@ -24,6 +28,7 @@ class ReplayState:
     jobs: dict = field(default_factory=dict)        # id -> job record (dict)
     completed: set = field(default_factory=set)     # job ids
     failed: set = field(default_factory=set)        # job ids
+    corrupt_lines: int = 0                          # interior decode failures
 
     @property
     def pending(self) -> list[str]:
@@ -55,28 +60,43 @@ class Journal:
             self._fh = None
 
     @staticmethod
-    def replay(path: str) -> ReplayState:
+    def replay(path: str, *, strict: bool = True) -> ReplayState:
         """Reconstruct queue state from a journal file (missing file = empty).
 
-        Tolerates a torn final line (crash mid-append).
+        Tolerates a torn *final* line (crash mid-append) — that is the only
+        corruption an append+fsync discipline can produce. An undecodable
+        interior line means real damage (a silently dropped ``enqueue`` would
+        lose a job from recovery), so it raises :class:`JournalCorruptError`
+        by default; ``strict=False`` instead counts it loudly in
+        ``ReplayState.corrupt_lines``.
         """
         state = ReplayState()
         if not path or not os.path.exists(path):
             return state
         with open(path, encoding="utf-8") as fh:
-            for line in fh:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
+            lines = [ln.strip() for ln in fh]
+        while lines and not lines[-1]:
+            lines.pop()
+        for i, line in enumerate(lines):
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                if i == len(lines) - 1:
                     continue  # torn tail write from a crash
-                ev = rec.get("ev")
-                if ev == "enqueue":
-                    state.jobs[rec["id"]] = rec
-                elif ev == "complete":
-                    state.completed.add(rec["id"])
-                elif ev == "fail":
-                    state.failed.add(rec["id"])
+                if strict:
+                    raise JournalCorruptError(
+                        f"{path}:{i + 1}: undecodable interior journal "
+                        f"line ({e}); refusing to silently drop state"
+                    ) from e
+                state.corrupt_lines += 1
+                continue
+            ev = rec.get("ev")
+            if ev == "enqueue":
+                state.jobs[rec["id"]] = rec
+            elif ev == "complete":
+                state.completed.add(rec["id"])
+            elif ev == "fail":
+                state.failed.add(rec["id"])
         return state

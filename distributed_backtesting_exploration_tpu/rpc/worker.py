@@ -42,9 +42,17 @@ class _Channel:
     ``src/worker/main.rs:32-42``; SURVEY.md §2.2 native ledger) — and by
     ``queue.Queue`` otherwise. Items cross the boundary as proto bytes via
     the ``enc``/``dec`` pair, so the native queue stays a plain blob queue.
+
+    Capacity semantics: the native queue is always bounded, so an
+    "unbounded" channel gets the ``_UNBOUNDED`` sentinel capacity — past it
+    the producer *blocks* (backpressure), whereas the pure-Python
+    ``queue.Queue(0)`` fallback never would. At 2^20 undrained completions
+    that divergence only triggers after the control thread has been wedged
+    for far longer than the dispatcher's prune window, at which point
+    backpressure on the compute thread is the safer behavior anyway.
     """
 
-    _UNBOUNDED = 1 << 16
+    _UNBOUNDED = 1 << 20
 
     def __init__(self, capacity: int | None, enc, dec):
         self._enc, self._dec = enc, dec
@@ -141,7 +149,14 @@ class Worker:
         self._busy = threading.Event()
         self._connected = True  # edge-triggered logging, reference CONNECTED
         self.jobs_completed = 0
+        self.completions_dropped = 0
         self._compute_thread: threading.Thread | None = None
+        # Failed completion RPCs park here with a due time instead of
+        # sleep-retrying on the control thread (advisor finding: inline
+        # backoff sleeps starved SendStatus past the dispatcher's prune
+        # window, getting a healthy worker pruned mid-drain).
+        self._deferred: list[tuple[float, int, compute.Completion]] = []
+        self._next_status = 0.0
 
     # -- compute side ------------------------------------------------------
 
@@ -179,12 +194,11 @@ class Worker:
         idle_polls = 0
         saw_work = False
         next_poll = 0.0
-        next_status = 0.0
         try:
             while not self._stop.is_set():
                 now = time.monotonic()
-                if now >= next_status:
-                    next_status = now + self.status_interval_s
+                if now >= self._next_status:
+                    self._next_status = now + self.status_interval_s
                     self._send_status(stub)
                 if now >= next_poll:
                     next_poll = now + self.poll_interval_s
@@ -193,7 +207,8 @@ class Worker:
                         if got:
                             saw_work = True
                             idle_polls = 0
-                        elif not self._busy.is_set() and self._out.empty():
+                        elif (not self._busy.is_set() and self._out.empty()
+                                and not self._deferred):
                             idle_polls += 1
                 self._drain_completions(stub)
                 if (max_idle_polls is not None and saw_work
@@ -214,11 +229,21 @@ class Worker:
 
         The compute thread is joined first, so nothing produces into the
         completion queue anymore and a non-blocking drain is exhaustive.
+        Deferred (previously failed) completions get their remaining retry
+        attempts inside a bounded exit budget; whatever still fails is
+        re-queued by lease expiry dispatcher-side.
         """
         self._in.put(None)
         if self._compute_thread is not None:
             self._compute_thread.join(timeout=60.0)
-        self._drain_completions(stub)
+        deadline = time.monotonic() + 8.0
+        self._drain_completions(stub, ignore_status_deadline=True)
+        while self._deferred and time.monotonic() < deadline:
+            time.sleep(0.1)
+            self._drain_completions(stub, ignore_status_deadline=True)
+        if self._deferred:
+            log.error("exiting with %d undelivered completions "
+                      "(leases will re-queue them)", len(self._deferred))
 
     def _send_status(self, stub) -> None:
         status = (pb.WORKER_STATUS_RUNNING if self._busy.is_set()
@@ -248,34 +273,64 @@ class Worker:
             self._in.put(jobs)
         return jobs
 
-    def _drain_completions(self, stub) -> None:
-        while True:
+    # Retry due-times for failed completion RPCs. Worst case per completion
+    # (3 attempts, 5 s RPC timeout each, spread over due windows) stays well
+    # under the dispatcher's 10 s prune window because heartbeats keep
+    # flowing between attempts — nothing here ever sleeps.
+    _COMPLETION_BACKOFF_S = (0.5, 1.0, 2.0)
+
+    def _drain_completions(self, stub, *,
+                           ignore_status_deadline: bool = False) -> None:
+        """Report queued + due-for-retry completions; never sleeps.
+
+        Stops early when a status heartbeat is overdue so a slow/flaky
+        dispatcher cannot starve liveness (remaining items are picked up on
+        the next loop tick).
+        """
+        def status_overdue() -> bool:
+            return (not ignore_status_deadline
+                    and time.monotonic() >= self._next_status)
+
+        now = time.monotonic()
+        due = [d for d in self._deferred
+               if d[0] <= now or ignore_status_deadline]
+        self._deferred = [d for d in self._deferred
+                          if not (d[0] <= now or ignore_status_deadline)]
+        for _, attempts, comp in due:
+            if status_overdue():
+                self._deferred.append((now, attempts, comp))
+                continue
+            self._report_completion(stub, comp, attempts=attempts)
+        while not status_overdue():
             try:
                 comp = self._out.get_nowait()
             except queue_mod.Empty:
                 return
             self._report_completion(stub, comp)
 
-    def _report_completion(self, stub, comp) -> None:
+    def _report_completion(self, stub, comp, *, attempts: int = 0) -> None:
+        """One delivery attempt; on RPC failure, park for deferred retry."""
         req = pb.CompleteRequest(
             id=comp.job_id, worker_id=self.worker_id,
             metrics=comp.metrics, elapsed_s=comp.elapsed_s)
-        for backoff in (0.2, 1.0, 5.0, None):
-            try:
-                ack = stub.CompleteJob(req, timeout=10.0)
-                if ack.ok:
-                    self.jobs_completed += 1
-                else:
-                    log.warning("completion %s rejected: %s",
-                                comp.job_id, ack.detail)
+        try:
+            ack = stub.CompleteJob(req, timeout=5.0)
+            self._log_reconnected()
+            if ack.ok:
+                self.jobs_completed += 1
+            else:
+                log.warning("completion %s rejected: %s",
+                            comp.job_id, ack.detail)
+        except grpc.RpcError as e:
+            self._log_disconnected(e)
+            if attempts >= len(self._COMPLETION_BACKOFF_S):
+                self.completions_dropped += 1
+                log.error("dropping completion %s after %d attempts "
+                          "(lease will re-queue it)", comp.job_id,
+                          attempts + 1)
                 return
-            except grpc.RpcError as e:
-                self._log_disconnected(e)
-                if backoff is None:
-                    log.error("dropping completion %s after retries "
-                              "(lease will re-queue it)", comp.job_id)
-                    return
-                time.sleep(backoff)
+            due = time.monotonic() + self._COMPLETION_BACKOFF_S[attempts]
+            self._deferred.append((due, attempts + 1, comp))
 
     def _log_disconnected(self, err) -> None:
         if self._connected:

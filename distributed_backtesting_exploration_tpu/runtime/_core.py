@@ -138,6 +138,23 @@ def load() -> ctypes.CDLL | None:
             return None
         try:
             _lib = _bind(ctypes.CDLL(_LIB_PATH))
+        except AttributeError as e:
+            # A prebuilt/copied .so whose mtime passes _stale() but predates
+            # the current C ABI (missing symbol). Rebuild once from source,
+            # then degrade gracefully like any other load failure.
+            log.warning("stale ABI in %s (%s); rebuilding", _LIB_PATH, e)
+            try:
+                os.remove(_LIB_PATH)
+            except OSError:
+                pass
+            _lib = None
+            if _build():
+                try:
+                    _lib = _bind(ctypes.CDLL(_LIB_PATH))
+                except (OSError, AttributeError) as e2:
+                    log.warning("rebuild of %s did not load: %s",
+                                _LIB_PATH, e2)
+                    _lib = None
         except OSError as e:
             log.warning("failed to load %s: %s", _LIB_PATH, e)
             _lib = None

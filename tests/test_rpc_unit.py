@@ -254,6 +254,212 @@ def test_backend_fused_bollinger_matches_generic():
                 rtol=2e-4, atol=2e-5, err_msg=name)
 
 
+def _write_csv(path, n_bars=16, seed=0):
+    from distributed_backtesting_exploration_tpu.utils import data
+    s = data.synthetic_ohlcv(1, n_bars, seed=seed)
+    path.write_bytes(data.to_csv_bytes(type(s)(*(f[0] for f in s))))
+
+
+def test_complete_during_take_window_no_tombstone_leak(tmp_path, monkeypatch):
+    """ADVICE r2 (medium): a completion landing between take()'s FIFO pop
+    and lease creation installed a permanent tombstone, after which
+    jobs_pending under-counted and drained never flipped True."""
+    from distributed_backtesting_exploration_tpu.rpc import (
+        dispatcher as disp)
+
+    csv_path = tmp_path / "t.csv"
+    _write_csv(csv_path)
+    q = disp.JobQueue()
+    q.enqueue(disp.JobRecord(id="j0", strategy="s", grid={},
+                             path=str(csv_path)))
+    orig = disp._read_payload
+
+    def complete_mid_take(path):
+        # take() reads the payload outside its lock — exactly the window
+        # the race needs.
+        q.complete("j0", "late-worker")
+        return orig(path)
+
+    monkeypatch.setattr(disp, "_read_payload", complete_mid_take)
+    assert q.take(1, "w1") == []          # completed job must not dispatch
+    s = q.stats()
+    assert s["jobs_pending"] == 0 and s["jobs_leased"] == 0
+    assert s["jobs_completed"] == 1
+    assert q.drained                      # used to hang at live_pending == -1
+
+
+def test_complete_during_failed_read_not_marked_failed(tmp_path, monkeypatch):
+    """Same window, but the payload read fails: a job completed mid-take
+    must count as completed, not failed."""
+    from distributed_backtesting_exploration_tpu.rpc import (
+        dispatcher as disp)
+
+    q = disp.JobQueue()
+    q.enqueue(disp.JobRecord(id="j0", strategy="s", grid={},
+                             path=str(tmp_path / "gone.csv")))
+
+    def complete_then_fail(path):
+        q.complete("j0", "late-worker")
+        raise OSError("disk gone")
+
+    monkeypatch.setattr(disp, "_read_payload", complete_then_fail)
+    assert q.take(1, "w1") == []
+    s = q.stats()
+    assert s["jobs_failed"] == 0 and s["jobs_completed"] == 1
+    assert q.drained
+
+
+def test_journal_corrupt_interior_is_loud(tmp_path):
+    """ADVICE r1: replay used to skip EVERY undecodable line; an interior
+    corrupt enqueue silently dropped a job from recovery."""
+    from distributed_backtesting_exploration_tpu.rpc.journal import (
+        JournalCorruptError)
+
+    jpath = tmp_path / "j.jsonl"
+    jpath.write_text(
+        '{"ev":"enqueue","id":"a","strategy":"s","grid":{}}\n'
+        'GARBAGE-NOT-JSON\n'
+        '{"ev":"enqueue","id":"b","strategy":"s","grid":{}}\n')
+    with pytest.raises(JournalCorruptError):
+        Journal.replay(str(jpath))
+    state = Journal.replay(str(jpath), strict=False)
+    assert state.corrupt_lines == 1
+    assert set(state.jobs) == {"a", "b"}
+    # The benign torn-tail case stays tolerated in strict mode:
+    jpath.write_text(
+        '{"ev":"enqueue","id":"a","strategy":"s","grid":{}}\n'
+        '{"ev":"comp')
+    assert Journal.replay(str(jpath)).pending == ["a"]
+
+
+def test_restart_does_not_duplicate_file_jobs(tmp_path):
+    """ADVICE r1 (medium): rerunning the documented command line after a
+    crash re-enqueued every --data path under fresh UUIDs."""
+    from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+        build_dispatcher, make_parser)
+
+    for name in ("a", "b"):
+        _write_csv(tmp_path / f"{name}.csv")
+    argv = ["--data", str(tmp_path / "*.csv"),
+            "--journal", str(tmp_path / "j.jsonl"),
+            "--grid", "fast=3:5,slow=8:10"]
+    d1 = build_dispatcher(make_parser().parse_args(argv))
+    got = d1.queue.take(10, "w")
+    assert len(got) == 2
+    done_id, survivor_id = got[0][0].id, got[1][0].id
+    d1.queue.complete(done_id, "w")
+
+    # Crash (d1 dropped) + restart with the SAME argv:
+    d2 = build_dispatcher(make_parser().parse_args(argv))
+    assert d2.queue.stats()["jobs_pending"] == 1
+    ids = [r.id for r, _ in d2.queue.take(10, "w2")]
+    assert ids == [survivor_id], "only the unfinished job may re-dispatch"
+
+
+def test_restart_does_not_reseed_synthetic(tmp_path):
+    from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+        build_dispatcher, make_parser)
+
+    argv = ["--synthetic", "3", "--bars", "32",
+            "--journal", str(tmp_path / "j.jsonl"),
+            "--grid", "fast=3:5,slow=8:10"]
+    d1 = build_dispatcher(make_parser().parse_args(argv))
+    assert d1.queue.stats()["jobs_pending"] == 3
+    got = d1.queue.take(1, "w")
+    d1.queue.complete(got[0][0].id, "w")
+
+    d2 = build_dispatcher(make_parser().parse_args(argv))
+    assert d2.queue.stats()["jobs_pending"] == 2   # restored, not 3 + 2
+
+
+def test_completion_retry_never_blocks_control_thread():
+    """ADVICE r1: completion retry used to sleep 0.2+1+5s inline on the
+    control thread, starving heartbeats past the prune window."""
+    import time
+    from types import SimpleNamespace
+
+    import grpc
+
+    from distributed_backtesting_exploration_tpu.rpc import compute
+    from distributed_backtesting_exploration_tpu.rpc.worker import Worker
+
+    w = Worker("localhost:1", compute.InstantBackend())
+    w._next_status = time.monotonic() + 60.0      # heartbeat not due
+    calls = []
+
+    class FlakyStub:
+        fail = 2
+
+        def CompleteJob(self, req, timeout=None):
+            calls.append(req.id)
+            if self.fail:
+                self.fail -= 1
+                raise grpc.RpcError()
+            return SimpleNamespace(ok=True, detail="")
+
+    stub = FlakyStub()
+    w._out.put(compute.Completion("j1", b"", 0.0))
+    t0 = time.monotonic()
+    w._drain_completions(stub)                    # attempt 1 fails -> parks
+    assert time.monotonic() - t0 < 0.2, "drain must not sleep"
+    assert len(w._deferred) == 1 and w.jobs_completed == 0
+    w._drain_completions(stub)                    # not due yet: no attempt
+    assert len(calls) == 1
+
+    def force_due():
+        w._deferred = [(time.monotonic() - 1, a, c)
+                       for _, a, c in w._deferred]
+
+    force_due()
+    w._drain_completions(stub)                    # attempt 2 fails -> parks
+    force_due()
+    w._drain_completions(stub)                    # attempt 3 succeeds
+    assert w.jobs_completed == 1 and not w._deferred
+    assert w.completions_dropped == 0
+
+
+def test_completion_drain_yields_to_overdue_heartbeat():
+    import time
+
+    from distributed_backtesting_exploration_tpu.rpc import compute
+    from distributed_backtesting_exploration_tpu.rpc.worker import Worker
+
+    w = Worker("localhost:1", compute.InstantBackend())
+    w._next_status = time.monotonic() - 1.0       # heartbeat overdue
+
+    class NeverCalled:
+        def CompleteJob(self, req, timeout=None):
+            raise AssertionError("drain must yield to the heartbeat first")
+
+    w._out.put(compute.Completion("j1", b"", 0.0))
+    w._drain_completions(NeverCalled())           # returns without attempting
+    assert w.jobs_completed == 0
+
+
+def test_completion_dropped_after_attempts_exhausted():
+    import time
+
+    import grpc
+
+    from distributed_backtesting_exploration_tpu.rpc import compute
+    from distributed_backtesting_exploration_tpu.rpc.worker import Worker
+
+    w = Worker("localhost:1", compute.InstantBackend())
+    w._next_status = time.monotonic() + 60.0
+
+    class DeadStub:
+        def CompleteJob(self, req, timeout=None):
+            raise grpc.RpcError()
+
+    stub = DeadStub()
+    w._out.put(compute.Completion("j1", b"", 0.0))
+    for _ in range(1 + len(Worker._COMPLETION_BACKOFF_S)):
+        w._drain_completions(stub)
+        w._deferred = [(time.monotonic() - 1, a, c)
+                       for _, a, c in w._deferred]
+    assert w.completions_dropped == 1 and not w._deferred
+
+
 def test_native_substrate_live_by_default():
     """VERDICT r1: the C++ queue/registry must back the LIVE paths, not just
     tests. Default construction uses the native substrate when available."""
