@@ -23,10 +23,11 @@ Prints ONE JSON line to stdout:
     {"metric": ..., "value": N, "unit": "backtests/sec", "vs_baseline": N,
      "configs": {name: rate, ...}}
 
-``--verify`` mode instead runs fused-vs-generic parity for the SMA and
-Bollinger kernels ON THE CHIP and prints one JSON line with max relative
-error and the argmax/entry flip rates (the knife-edge MXU caveat, quantified
-fresh each round).
+``--verify`` mode instead runs fused-vs-generic parity for the SMA,
+Bollinger, and pairs kernels ON THE CHIP and prints one JSON line with max
+relative error and the argmax/entry flip rates (the knife-edge MXU caveat —
+plus, for pairs, the banded-tree-sum vs cumsum-difference caveat —
+quantified fresh each round).
 
 Env overrides (local smoke runs): DBX_BENCH_TICKERS, DBX_BENCH_BARS,
 DBX_BENCH_PARAMS, DBX_BENCH_ITERS, DBX_BENCH_WARMUP, DBX_BENCH_CPU=1 to
@@ -163,10 +164,17 @@ def main():
         pgrid = sweep.product_grid(
             lookback=jnp.arange(20, 70, 5, dtype=jnp.float32),
             z_entry=jnp.linspace(0.5, 3.0, 50).astype(jnp.float32))
+        plb = np.asarray(pgrid["lookback"])
+        pze = np.asarray(pgrid["z_entry"])
 
-        def run_pairs():
-            return pairs.chunked_pairs_sweep(
-                y_close, x_close, pgrid, param_chunk=50, cost=1e-3)
+        if os.environ.get("DBX_BENCH_GENERIC") == "1":
+            def run_pairs():
+                return pairs.chunked_pairs_sweep(
+                    y_close, x_close, pgrid, param_chunk=50, cost=1e-3)
+        else:
+            def run_pairs():
+                return fused.fused_pairs_sweep(
+                    y_close, x_close, plb, pze, cost=1e-3)
 
         rates["pairs"] = _measure(
             run_pairs, n_pairs * sweep.grid_size(pgrid),
@@ -226,7 +234,7 @@ def verify():
     import jax.numpy as jnp
     import numpy as np
 
-    from distributed_backtesting_exploration_tpu.models import base
+    from distributed_backtesting_exploration_tpu.models import base, pairs
     from distributed_backtesting_exploration_tpu.ops import fused
     from distributed_backtesting_exploration_tpu.parallel import sweep
     from distributed_backtesting_exploration_tpu.utils import data
@@ -238,8 +246,22 @@ def verify():
     panel = type(ohlcv)(*(jax.device_put(jnp.asarray(f), dev) for f in ohlcv))
     out = {"device": dev.device_kind}
 
+    def strat_case(strat_name, grid, run_fused):
+        return (lambda: sweep.jit_sweep(panel, base.get_strategy(strat_name),
+                                        dict(grid), cost=1e-3),
+                lambda: run_fused(grid))
+
+    if n_tickers < 2:
+        sys.exit("bench --verify: the pairs case needs DBX_BENCH_TICKERS >= 2 "
+                 "(each pair takes two ticker series)")
+    n_pairs = n_tickers // 2
+    y_close, x_close = panel.close[:n_pairs], panel.close[n_pairs:2 * n_pairs]
+    pgrid = sweep.product_grid(
+        lookback=jnp.arange(10, 50, 2, dtype=jnp.float32),
+        z_entry=jnp.linspace(0.5, 3.0, 20).astype(jnp.float32))
+
     cases = {
-        "sma": (
+        "sma": strat_case(
             "sma_crossover",
             sweep.product_grid(
                 fast=jnp.arange(5, 25, dtype=jnp.float32),
@@ -248,7 +270,7 @@ def verify():
                 panel.close, np.asarray(g["fast"]), np.asarray(g["slow"]),
                 cost=1e-3),
         ),
-        "bollinger": (
+        "bollinger": strat_case(
             "bollinger",
             sweep.product_grid(
                 k=jnp.linspace(0.5, 3.0, 20).astype(jnp.float32),
@@ -257,11 +279,17 @@ def verify():
                 panel.close, np.asarray(g["window"]), np.asarray(g["k"]),
                 cost=1e-3),
         ),
+        "pairs": (
+            lambda: pairs.run_pairs_sweep(y_close, x_close, dict(pgrid),
+                                          cost=1e-3),
+            lambda: fused.fused_pairs_sweep(
+                y_close, x_close, np.asarray(pgrid["lookback"]),
+                np.asarray(pgrid["z_entry"]), cost=1e-3),
+        ),
     }
-    for name, (strat_name, grid, run_fused) in cases.items():
-        ref = sweep.jit_sweep(panel, base.get_strategy(strat_name),
-                              dict(grid), cost=1e-3)
-        got = run_fused(grid)
+    for name, (run_ref, run_fused) in cases.items():
+        ref = run_ref()
+        got = run_fused()
         r = np.asarray(ref.sharpe)
         g = np.asarray(got.sharpe)
         rel = np.abs(g - r) / (np.abs(r) + 1e-6)

@@ -44,6 +44,28 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
+def _pad_last(close, T_pad: int):
+    """Pad ``(N, T)`` closes to ``T_pad`` bars by repeating the final close.
+
+    Load-bearing for the padding discipline shared by every kernel here: a
+    repeated last close makes the pad bars' returns exactly zero, so held
+    positions earn nothing and reductions over T_pad match T_real
+    (see ``_metrics_pack``).
+    """
+    pad_t = T_pad - close.shape[1]
+    if not pad_t:
+        return close
+    return jnp.concatenate(
+        [close, jnp.repeat(close[:, -1:], pad_t, axis=1)], axis=1)
+
+
+def _rets3(close_p):
+    """Per-bar simple returns of padded closes, shaped ``(N, T_pad, 1)`` for
+    a (1, T_pad, 1) kernel block (broadcasts over param lanes); ``r[0] = 0``."""
+    prev = jnp.concatenate([close_p[:, :1], close_p[:, :-1]], axis=1)
+    return (close_p / prev - 1.0)[..., None]
+
+
 def _shift_down(x, k: int, fill: float):
     """``y[t] = x[t-k]`` along axis 0 with ``fill`` for t < k (static k)."""
     pad = jnp.full((k,) + x.shape[1:], fill, x.dtype)
@@ -84,7 +106,16 @@ def _metrics_tail(pos, r, t_idx, *, T_real: int, cost: float, ppy: int):
 
     prev = _shift_down(pos, 1, 0.0)
     net = prev * r - cost * jnp.abs(pos - prev)
+    return _metrics_pack(pos, prev, net, row_ok, T_real=T_real, ppy=ppy)
 
+
+def _metrics_pack(pos, prev, net, row_ok, *, T_real: int, ppy: int):
+    """Reduce per-bar ``net``/positions to the packed (16, 128) metric rows.
+
+    Callers guarantee the padding discipline: ``pos`` holds its final real
+    value for ``t >= T_real`` and ``net`` is exactly zero there, so plain
+    reductions over T_pad equal the unpadded reductions over T_real.
+    """
     n = jnp.float32(T_real)
     s1 = jnp.sum(net, axis=0)
     s2 = jnp.sum(net * net, axis=0)
@@ -159,10 +190,7 @@ def _fused_call(close, onehot_f, onehot_s, warm, *, windows: tuple,
     not run eagerly (each eager op is a dispatch round-trip on the remote-
     proxy TPU backend — measured 13x slower end-to-end)."""
     N, T = close.shape
-    pad_t = T_pad - T
-    close_p = jnp.concatenate(
-        [close, jnp.repeat(close[:, -1:], pad_t, axis=1)], axis=1) \
-        if pad_t else close
+    close_p = _pad_last(close, T_pad)
 
     # Distinct-window SMA table (N, T_pad, W_pad): one cumsum + ONE gather.
     # (Stacking 120 per-window (N, T_pad) slices along a new minor axis makes
@@ -185,8 +213,7 @@ def _fused_call(close, onehot_f, onehot_s, warm, *, windows: tuple,
              jnp.zeros((N, T_pad, W_pad - len(windows)), jnp.float32)],
             axis=-1)
 
-    prev_close = jnp.concatenate([close_p[:, :1], close_p[:, :-1]], axis=1)
-    returns3 = (close_p / prev_close - 1.0)[..., None]         # (N,T_pad,1)
+    returns3 = _rets3(close_p)
     P_pad = onehot_f.shape[1]
     n_blocks = P_pad // _LANES
     grid = (N, n_blocks)
@@ -253,26 +280,16 @@ def fused_sma_sweep(close, fast, slow, *, cost: float = 0.0,
                        interpret=bool(interpret))
 
 
-def _boll_kernel(r_ref, z_ref, ow_ref, k_ref, warm_ref, out_ref, *,
-                 T_real: int, cost: float, ppy: int, z_exit: float):
-    """Bollinger mean-reversion cell: z-selection matmul + hysteresis ladder.
+def _band_ladder(z, valid, k, z_exit):
+    """Band-hysteresis position path over ``(T_pad, 128)`` tiles, in-kernel.
 
     The band machine's state space is {-1, 0, +1}; each bar is a 3-state
     transition map and composition of maps is associative, so the position
     path evaluates as a log2(T_pad)-round doubling ladder over the sublane
     axis — no serial scan (mirrors ``ops.signals.band_hysteresis_assoc``).
+    ``k``/``z_exit`` broadcast against the tile (scalars or (1, 128) lanes).
     """
-    T_pad = r_ref.shape[1]
-    r = r_ref[0]                     # (T_pad, 1)
-    z_tbl = z_ref[0]                 # (T_pad, W_pad) per-window z-scores
-    z = jnp.dot(z_tbl, ow_ref[:], preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST)   # (T_pad, 128)
-
-    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, _LANES), 0)
-    warm = warm_ref[0, :][None, :]
-    valid = t_idx >= (warm.astype(jnp.int32) - 1)
-    k = k_ref[0, :][None, :]                           # (1, 128) entry band
-
+    T_pad = z.shape[0]
     # Per-bar transition maps (next state when previous state is -1/0/+1).
     entered = jnp.where(z < -k, 1.0, jnp.where(z > k, -1.0, 0.0))
     pm = jnp.where(valid & (z > z_exit), -1.0, 0.0)
@@ -292,8 +309,24 @@ def _boll_kernel(r_ref, z_ref, ow_ref, k_ref, warm_ref, out_ref, *,
             jnp.where(ep < 0, pm, jnp.where(ep > 0, pp, p0)),
         )
         span *= 2
+    return p0   # start state is flat: the 0-component is the position path
 
-    pos = p0   # start state is flat
+
+def _boll_kernel(r_ref, z_ref, ow_ref, k_ref, warm_ref, out_ref, *,
+                 T_real: int, cost: float, ppy: int, z_exit: float):
+    """Bollinger mean-reversion cell: z-selection matmul + hysteresis ladder."""
+    T_pad = r_ref.shape[1]
+    r = r_ref[0]                     # (T_pad, 1)
+    z_tbl = z_ref[0]                 # (T_pad, W_pad) per-window z-scores
+    z = jnp.dot(z_tbl, ow_ref[:], preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)   # (T_pad, 128)
+
+    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, _LANES), 0)
+    warm = warm_ref[0, :][None, :]
+    valid = t_idx >= (warm.astype(jnp.int32) - 1)
+    k = k_ref[0, :][None, :]                           # (1, 128) entry band
+
+    pos = _band_ladder(z, valid, k, z_exit)
     out_ref[0, 0] = _metrics_tail(pos, r, t_idx, T_real=T_real, cost=cost,
                                   ppy=ppy)
 
@@ -314,10 +347,7 @@ def _fused_boll_call(close, onehot_w, k_lanes, warm, *, windows: tuple,
     second moments (rolling.py's cancellation guard), eps=1e-12.
     """
     N, T = close.shape
-    pad_t = T_pad - T
-    close_p = jnp.concatenate(
-        [close, jnp.repeat(close[:, -1:], pad_t, axis=1)], axis=1) \
-        if pad_t else close
+    close_p = _pad_last(close, T_pad)
 
     w_vec = jnp.asarray(np.asarray(windows, np.int32))          # (W,)
     w_f = w_vec.astype(jnp.float32)[None, None, :]              # (1,1,W)
@@ -345,8 +375,7 @@ def _fused_boll_call(close, onehot_w, k_lanes, warm, *, windows: tuple,
              jnp.zeros((N, T_pad, W_pad - len(windows)), jnp.float32)],
             axis=-1)
 
-    prev_close = jnp.concatenate([close_p[:, :1], close_p[:, :-1]], axis=1)
-    returns3 = (close_p / prev_close - 1.0)[..., None]          # (N,T_pad,1)
+    returns3 = _rets3(close_p)
     P_pad = k_lanes.shape[1]
     n_blocks = P_pad // _LANES
     kernel = functools.partial(_boll_kernel, T_real=T_real, cost=cost,
@@ -434,6 +463,247 @@ def _boll_grid_setup(window_bytes: bytes, k_bytes: bytes):
     warm[0, :P] = window
     return (tuple(int(w) for w in windows), jnp.asarray(oh),
             jnp.asarray(k_lanes), jnp.asarray(warm))
+
+
+def _pairs_kernel(ry_ref, rx_ref, z_ref, b_ref, ow_ref, k_ref, zx_ref,
+                  warm_ref, out_ref, *, T_real: int, cost: float, ppy: int):
+    """Pairs-trade cell: z/beta selection matmuls + hysteresis + spread PnL.
+
+    Two MXU contractions pick each lane's lookback column from the per-pair
+    z-score and hedge-ratio tables; the shared band ladder turns z into the
+    position path; the PnL differs from the single-asset tail — spread return
+    ``prev_pos * (r_y - prev_beta * r_x) / max(1 + |prev_beta|, 1)`` (gross-
+    exposure normalized, mirroring ``models.pairs.pair_backtest``) — so this
+    kernel computes its own ``net`` and shares only ``_metrics_pack``.
+    """
+    T_pad = ry_ref.shape[1]
+    ry = ry_ref[0]                   # (T_pad, 1)
+    rx = rx_ref[0]
+    # Tables arrive (W_pad, T_pad) — T on lanes, so the HBM layout pads W up
+    # to a sublane multiple (8) instead of a lane multiple (128); the 12.8x
+    # HBM blow-up of a W-minor table layout dominated the first cut of this
+    # kernel (measured: 601 of 716 ms/sweep in prep). The selection contracts
+    # dim 0 of both operands (tbl^T @ onehot on the MXU).
+    dn = (((0,), (0,)), ((), ()))
+    z = jax.lax.dot_general(z_ref[0], ow_ref[:], dn,
+                            preferred_element_type=jnp.float32,
+                            precision=jax.lax.Precision.HIGHEST)  # (T_pad,128)
+    beta = jax.lax.dot_general(b_ref[0], ow_ref[:], dn,
+                               preferred_element_type=jnp.float32,
+                               precision=jax.lax.Precision.HIGHEST)
+
+    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, _LANES), 0)
+    warm = warm_ref[0, :][None, :]                     # (1, 128) = 2*lb - 1
+    valid = t_idx >= (warm.astype(jnp.int32) - 1)
+    k = k_ref[0, :][None, :]                           # per-lane z_entry
+    zx = zx_ref[0, :][None, :]                         # per-lane z_exit
+
+    pos = _band_ladder(z, valid, k, zx)
+
+    row_ok = t_idx < T_real
+    pos = jnp.where(row_ok, pos, pos[T_real - 1:T_real, :])
+    prev = _shift_down(pos, 1, 0.0)
+    prev_beta = _shift_down(beta, 1, 0.0)
+    gross = 1.0 + jnp.abs(prev_beta)
+    spread_ret = prev * (ry - prev_beta * rx) / jnp.maximum(gross, 1.0)
+    net = spread_ret - cost * jnp.abs(pos - prev)
+    out_ref[0, 0] = _metrics_pack(pos, prev, net, row_ok, T_real=T_real,
+                                  ppy=ppy)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
+                     "ppy", "interpret"))
+def _fused_pairs_call(y_close, x_close, onehot_w, k_lanes, zx_lanes, warm, *,
+                      windows: tuple, T_pad: int, W_pad: int, P_real: int,
+                      T_real: int, cost: float, ppy: int, interpret: bool):
+    """Beta/z table prep + pallas call in one jit.
+
+    The tables follow ``rolling.rolling_ols`` / ``rolling.rolling_zscore``'s
+    formulas (series-centered moments, eps=1e-12, warmup fill 0 so the warmup
+    spread is exactly ``y`` — ``models.pairs.pair_signals`` semantics), but
+    windowed sums are banded-matmul tree sums rather than cumsum differences,
+    so results match the generic path to f32 tolerance, not bit-level (see
+    :func:`fused_pairs_sweep`).
+    """
+    N, T = y_close.shape
+    y_p, x_p = _pad_last(y_close, T_pad), _pad_last(x_close, T_pad)
+
+    # Tables are built (N, W, T_pad) — T on the minor axis — so HBM tiling
+    # pads W to a sublane multiple (8) rather than a lane multiple (128).
+    w_col = jnp.asarray(np.asarray(windows, np.int32))[:, None]  # (W,1)
+    w_f = w_col.astype(jnp.float32)[None]                        # (1,W,1)
+    t_row = jnp.arange(T_pad)[None, :]                           # (1,T_pad)
+
+    # Windowed sums as banded-matrix MXU contractions, not cumsum-and-gather:
+    # XLA's minor-axis cumsum on an (N, W, T) operand lowers to a serial scan
+    # that measured ~114 ms alone at the 1k-pair baseline, and the trailing
+    # gather is no better. One 0/1 band matrix per distinct lookback turns
+    # each windowed sum into an (N·W, T) @ (T, T) matmul — ~33 GFLOPs, a few
+    # ms on the MXU, and a *tree* sum per window (tighter f32 than the
+    # generic path's cumsum differencing, which parity tolerances absorb).
+    s_ax = jnp.arange(T_pad)[None, :, None]                      # source bar
+    u_ax = jnp.arange(T_pad)[None, None, :]                      # output bar
+    band = ((s_ax > u_ax - w_col[:, :1, None]) & (s_ax <= u_ax))
+    B = band.astype(jnp.float32)                                 # (W,T,T)
+
+    def windowed_sum(series):                                    # (N,T_pad) ->
+        return jnp.einsum("ns,wsu->nwu", series, B,              # (N,W,T_pad)
+                          precision=jax.lax.Precision.HIGHEST,
+                          preferred_element_type=jnp.float32)
+
+    def windowed_sum3(series):                                   # (N,W,T_pad)
+        return jnp.einsum("nws,wsu->nwu", series, B,
+                          precision=jax.lax.Precision.HIGHEST,
+                          preferred_element_type=jnp.float32)
+
+    # Rolling OLS of y on x per distinct lookback (closed form from windowed
+    # moments; centering with the real-bar means kills f32 cancellation —
+    # same guard as rolling.rolling_ols).
+    mx = jnp.mean(x_p[:, :T], axis=1, keepdims=True)             # (N,1)
+    my = jnp.mean(y_p[:, :T], axis=1, keepdims=True)
+    xc, yc = x_p - mx, y_p - my
+    sx = windowed_sum(xc)
+    sy = windowed_sum(yc)
+    sxx = windowed_sum(xc * xc)
+    sxy = windowed_sum(xc * yc)
+    cov = sxy - sx * sy / w_f
+    var = jnp.maximum(sxx - sx * sx / w_f, 0.0)
+    beta = cov / (var + 1e-12)
+    mx3, my3 = mx[:, :, None], my[:, :, None]                    # (N,1,1)
+    alpha = (sy / w_f + my3) - beta * (sx / w_f + mx3)
+    ok_w = (t_row >= w_col - 1)[None]                            # OLS warmup
+    beta_tbl = jnp.where(ok_w, beta, 0.0)
+    # Warmup spread is y - (0 + 0*x) = y (rolling_ols fill=0.0); those bars
+    # feed the z-score's *series mean* and early windowed sums, so they must
+    # hold exactly y for parity with the generic path.
+    y3, x3 = y_p[:, None, :], x_p[:, None, :]
+    spread = jnp.where(ok_w, y3 - (alpha + beta * x3), y3)
+
+    # Rolling z-score of the spread over the same lookback.
+    sp_mean = jnp.mean(spread[..., :T], axis=-1, keepdims=True)
+    sc = spread - sp_mean
+    s1 = windowed_sum3(sc)
+    s2 = windowed_sum3(sc * sc)
+    varz = jnp.maximum((s2 - s1 * s1 / w_f) / w_f, 0.0)
+    mz = windowed_sum3(spread) / w_f
+    z = (spread - mz) / (jnp.sqrt(varz) + 1e-12)
+    # Valid only after OLS warmup + z warmup: t >= 2*lb - 2. Zeroing the rest
+    # also keeps NaN/Inf out of the selection matmul.
+    z_tbl = jnp.where((t_row >= 2 * w_col - 2)[None], z, 0.0)
+
+    if W_pad > len(windows):
+        zpad = jnp.zeros((N, W_pad - len(windows), T_pad), jnp.float32)
+        z_tbl = jnp.concatenate([z_tbl, zpad], axis=1)
+        beta_tbl = jnp.concatenate([beta_tbl, zpad], axis=1)
+
+    P_pad = k_lanes.shape[1]
+    n_blocks = P_pad // _LANES
+    kernel = functools.partial(_pairs_kernel, T_real=T_real, cost=cost,
+                               ppy=ppy)
+    out = pl.pallas_call(
+        kernel,
+        grid=(N, n_blocks),
+        in_specs=[
+            pl.BlockSpec((1, T_pad, 1), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T_pad, 1), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, W_pad, T_pad), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, W_pad, T_pad), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((W_pad, _LANES), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, _METRIC_ROWS, _LANES), lambda i, j: (i, j, 0, 0),
+            memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(
+            (N, n_blocks, _METRIC_ROWS, _LANES), jnp.float32),
+        interpret=interpret,
+    )(_rets3(y_p), _rets3(x_p), z_tbl, beta_tbl, onehot_w, k_lanes, zx_lanes,
+      warm)
+    return Metrics(*(
+        jnp.reshape(out[:, :, k, :], (N, P_pad))[:, :P_real]
+        for k in range(9)))
+
+
+def fused_pairs_sweep(y_close, x_close, lookback, z_entry, *, z_exit=0.0,
+                      cost: float = 0.0, periods_per_year: int = 252,
+                      interpret: bool | None = None) -> Metrics:
+    """Fused rolling-OLS pairs sweep: ``(N, T)`` pair legs x ``(P,)`` lanes.
+
+    ``lookback``/``z_entry`` are flat per-combo arrays (:func:`product_grid`
+    order); ``z_exit`` may be a scalar or a per-combo array. Lookbacks are bar
+    counts and must be integral. Matches :func:`~..models.pairs.run_pairs_sweep`
+    (BASELINE.json configs[3]) to f32 tolerance — NOT bit-level (unlike the
+    SMA/Bollinger kernels): the banded-matmul windowed sums are *tree* sums
+    while the generic path differences a cumsum, so z-scores differ by ~1e-6
+    relative and a knife-edge band entry can flip, diverging that cell's
+    position path (rare; quantified on-chip by ``bench.py --verify``).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    y_close = jnp.asarray(y_close, jnp.float32)
+    x_close = jnp.asarray(x_close, jnp.float32)
+    lookback = np.asarray(lookback, np.float32)
+    z_entry = np.asarray(z_entry, np.float32)
+    z_exit_arr = np.ascontiguousarray(
+        np.broadcast_to(np.asarray(z_exit, np.float32), lookback.shape))
+    T = y_close.shape[1]
+    P = lookback.shape[0]
+
+    windows, onehot_w, k_lanes, zx_lanes, warm = _pairs_grid_setup(
+        lookback.tobytes(), z_entry.tobytes(), z_exit_arr.tobytes())
+    # T_pad is a lane multiple (128): T sits on the tables' minor axis AND on
+    # the working tiles' sublane axis, so 128 satisfies both constraints.
+    return _fused_pairs_call(y_close, x_close, onehot_w, k_lanes, zx_lanes,
+                             warm, windows=windows,
+                             T_pad=_round_up(T, 128), W_pad=onehot_w.shape[0],
+                             P_real=P, T_real=T, cost=float(cost),
+                             ppy=int(periods_per_year),
+                             interpret=bool(interpret))
+
+
+@functools.lru_cache(maxsize=4)
+def _pairs_grid_setup(lb_bytes: bytes, ze_bytes: bytes, zx_bytes: bytes):
+    """Distinct lookbacks + device-resident one-hot/band/warmup lanes
+    (cached, same rationale as :func:`_grid_setup`)."""
+    lookback = np.frombuffer(lb_bytes, np.float32)
+    z_entry = np.frombuffer(ze_bytes, np.float32)
+    z_exit = np.frombuffer(zx_bytes, np.float32)
+    P = lookback.shape[0]
+    if not np.allclose(lookback, np.round(lookback)):
+        raise ValueError(
+            "fused_pairs_sweep lookbacks are bar counts and must be "
+            "integral; got non-integer values")
+    windows = np.unique(np.round(lookback)).astype(np.float32)
+    W = windows.shape[0]
+    # The one-hot contracts over W as the *sublane* dim of both operands
+    # (tables are (W, T)-major), so W pads to 8, not 128.
+    W_pad = _round_up(max(W, 1), 8)
+    P_pad = _round_up(max(P, 1), _LANES)
+
+    oh = np.zeros((W_pad, P_pad), np.float32)
+    idx = np.searchsorted(windows, np.round(lookback).astype(np.float32))
+    oh[idx, np.arange(P)] = 1.0
+
+    k_lanes = np.full((1, P_pad), np.float32(np.inf))
+    k_lanes[0, :P] = z_entry      # padded lanes never enter (z_entry = +inf)
+    zx_lanes = np.zeros((1, P_pad), np.float32)
+    zx_lanes[0, :P] = z_exit
+    warm = np.ones((1, P_pad), np.float32)
+    warm[0, :P] = 2.0 * lookback - 1.0   # OLS warmup + z-score warmup
+    return (tuple(int(w) for w in windows), jnp.asarray(oh),
+            jnp.asarray(k_lanes), jnp.asarray(zx_lanes), jnp.asarray(warm))
 
 
 @functools.lru_cache(maxsize=4)

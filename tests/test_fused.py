@@ -102,3 +102,79 @@ def test_fused_bollinger_rejects_non_integer_windows():
     with pytest.raises(ValueError, match="integral"):
         fused.fused_bollinger_sweep(
             jnp.ones((1, 64)), np.asarray([10.5]), np.asarray([1.0]))
+
+
+def _check_pairs(n_pairs, T, lookback_axis, z_entry_axis, cost=1e-3, seed=0,
+                 z_exit=None):
+    from distributed_backtesting_exploration_tpu.models import pairs
+
+    ohlcv = data.synthetic_ohlcv(2 * n_pairs, T, seed=seed)
+    closes = jnp.asarray(ohlcv.close)
+    y_close, x_close = closes[:n_pairs], closes[n_pairs:]
+    axes = dict(lookback=jnp.asarray(lookback_axis, jnp.float32),
+                z_entry=jnp.asarray(z_entry_axis, jnp.float32))
+    if z_exit is not None:
+        axes["z_exit"] = jnp.asarray(z_exit, jnp.float32)
+    grid = sweep.product_grid(**axes)
+    ref = pairs.run_pairs_sweep(y_close, x_close, dict(grid), cost=cost)
+    got = fused.fused_pairs_sweep(
+        y_close, x_close, np.asarray(grid["lookback"]),
+        np.asarray(grid["z_entry"]),
+        z_exit=np.asarray(grid["z_exit"]) if z_exit is not None else 0.0,
+        cost=cost)
+    # The fused prep computes windowed sums as banded-matrix tree sums (MXU);
+    # the generic path differences a cumsum. Both are valid f32 evaluations,
+    # so z-scores differ by ~1e-6 — which (a) loosens per-metric tolerances
+    # vs the single-asset kernels and (b) can flip a knife-edge band entry,
+    # diverging that cell's whole position path. Flips must stay rare
+    # (<= 1% of cells); non-flipped cells must match tightly.
+    # A flipped cell shows a *gross* mismatch in at least one metric (a
+    # diverged path can coincidentally preserve, say, total turnover, so no
+    # single field is a reliable detector — union them).
+    flipped = np.zeros_like(np.asarray(got.turnover), dtype=bool)
+    for name in ref._fields:
+        a, b = np.asarray(getattr(got, name)), np.asarray(getattr(ref, name))
+        flipped |= np.abs(a - b) > (0.01 + 0.01 * np.abs(b))
+    n_flips = int(flipped.sum())
+    assert n_flips <= max(1, int(0.01 * flipped.size)), (
+        f"{n_flips}/{flipped.size} position-path flips")
+    for name in ref._fields:
+        a = np.asarray(getattr(got, name))[~flipped]
+        b = np.asarray(getattr(ref, name))[~flipped]
+        np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-4, err_msg=name)
+
+
+def test_fused_pairs_matches_generic_small():
+    _check_pairs(3, 200, [10, 20, 30], [0.5, 1.0, 2.0])
+
+
+def test_fused_pairs_unaligned_T():
+    # T=251 pads to 256: padded bars must not alter any metric.
+    _check_pairs(2, 251, [8, 16], [1.0, 1.5], seed=3)
+
+
+def test_fused_pairs_wide_grid():
+    # More params than one 128-lane block; shared lookbacks across combos.
+    _check_pairs(2, 320, list(range(5, 16)),
+                 [0.5, 1.0, 1.5, 2.0, 2.5, 3.0, 0.8, 1.2, 1.8, 2.2, 2.8, 0.6],
+                 seed=5)
+
+
+def test_fused_pairs_single_param():
+    _check_pairs(1, 137, [12], [1.5], seed=7)
+
+
+def test_fused_pairs_zero_cost():
+    _check_pairs(2, 200, [10, 25], [1.0, 2.0], cost=0.0, seed=9)
+
+
+def test_fused_pairs_per_lane_z_exit():
+    # z_exit in the grid: each lane carries its own exit band.
+    _check_pairs(2, 200, [10, 20], [1.0, 2.0], z_exit=[0.0, 0.5], seed=11)
+
+
+def test_fused_pairs_rejects_non_integer_lookbacks():
+    with pytest.raises(ValueError, match="integral"):
+        fused.fused_pairs_sweep(
+            jnp.ones((1, 64)), jnp.ones((1, 64)),
+            np.asarray([10.5]), np.asarray([1.0]))
