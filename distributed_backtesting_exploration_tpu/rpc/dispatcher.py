@@ -471,23 +471,44 @@ class Dispatcher(service.DispatcherServicer):
         self.peers.touch(request.worker_id, status=request.status)
         return pb.Ack(ok=True)
 
-    def CompleteJob(self, request: pb.CompleteRequest, context) -> pb.Ack:
-        self.peers.touch(request.worker_id)
-        known = self.queue.complete(request.id, request.worker_id)
+    def _complete_one(self, jid: str, worker_id: str, metrics: bytes,
+                      elapsed_s: float) -> bool:
+        known = self.queue.complete(jid, worker_id)
         if not known:
-            return pb.Ack(ok=False, detail=f"unknown job {request.id}")
-        if request.metrics:
+            return False
+        if metrics:
             if self.results_dir:
                 # Persist to disk only — keeping every DBXM block resident
                 # would grow without bound over a long run.
                 with open(os.path.join(self.results_dir,
-                                       f"{request.id}.dbxm"), "wb") as fh:
-                    fh.write(request.metrics)
+                                       f"{jid}.dbxm"), "wb") as fh:
+                    fh.write(metrics)
             else:
-                self.results[request.id] = request.metrics
-        log.info("job %s completed by %s in %.3fs",
-                 request.id, request.worker_id, request.elapsed_s)
+                self.results[jid] = metrics
+        log.info("job %s completed by %s in %.3fs", jid, worker_id, elapsed_s)
+        return True
+
+    def CompleteJob(self, request: pb.CompleteRequest, context) -> pb.Ack:
+        self.peers.touch(request.worker_id)
+        if not self._complete_one(request.id, request.worker_id,
+                                  request.metrics, request.elapsed_s):
+            return pb.Ack(ok=False, detail=f"unknown job {request.id}")
         return pb.Ack(ok=True)
+
+    def CompleteJobs(self, request: pb.CompleteBatch,
+                     context) -> pb.CompleteBatchReply:
+        """Batched completions: one round trip for a whole drained batch
+        (the per-item semantics are identical to CompleteJob and remain
+        idempotent; see backtesting.proto for the motivation numbers)."""
+        self.peers.touch(request.worker_id)
+        reply = pb.CompleteBatchReply()
+        for item in request.items:
+            if self._complete_one(item.id, request.worker_id, item.metrics,
+                                  item.elapsed_s):
+                reply.accepted += 1
+            else:
+                reply.unknown_ids.append(item.id)
+        return reply
 
     def GetStats(self, request: pb.StatsRequest, context) -> pb.StatsReply:
         s = self.queue.stats()

@@ -4,7 +4,7 @@ Hand-written equivalent of what ``grpc_tools.protoc``'s python-grpc plugin
 would generate from ``backtesting.proto`` (the plugin is not available in
 this environment; only message codegen is). The ``.proto`` file remains the
 single source of truth for the wire contract — this module only binds the
-four unary RPCs to the generated message classes, once, in one place.
+five unary RPCs to the generated message classes, once, in one place.
 
 The channel is gzip-compressed in both directions (the reference compressed
 only the server->worker leg, reference ``src/server/main.rs:212`` /
@@ -27,6 +27,7 @@ _METHODS = (
     ("RequestJobs", pb.JobsRequest, pb.JobsReply),
     ("SendStatus", pb.StatusRequest, pb.Ack),
     ("CompleteJob", pb.CompleteRequest, pb.Ack),
+    ("CompleteJobs", pb.CompleteBatch, pb.CompleteBatchReply),
     ("GetStats", pb.StatsRequest, pb.StatsReply),
 )
 
@@ -41,6 +42,10 @@ class DispatcherServicer:
         raise NotImplementedError
 
     def CompleteJob(self, request: pb.CompleteRequest, context) -> pb.Ack:
+        raise NotImplementedError
+
+    def CompleteJobs(self, request: pb.CompleteBatch,
+                     context) -> pb.CompleteBatchReply:
         raise NotImplementedError
 
     def GetStats(self, request: pb.StatsRequest, context) -> pb.StatsReply:

@@ -161,6 +161,13 @@ class Worker:
     # -- compute side ------------------------------------------------------
 
     def _compute_loop(self) -> None:
+        if hasattr(self.backend, "submit") and hasattr(self.backend,
+                                                       "collect"):
+            self._compute_loop_pipelined()
+        else:
+            self._compute_loop_simple()
+
+    def _compute_loop_simple(self) -> None:
         while True:
             batch = self._in.get()
             if batch is None:
@@ -174,6 +181,64 @@ class Worker:
                               "be re-queued by lease expiry", len(batch))
             finally:
                 self._busy.clear()
+
+    def _compute_loop_pipelined(self) -> None:
+        """Double-buffered compute: while batch N's results stream back from
+        the device, batch N+1 is decoded, transferred, and launched.
+
+        The reference worker's loop is fully serial — one job finishes
+        before the next is touched (reference ``src/worker/process.rs:21-25``);
+        SURVEY.md §2.3 (PP row) and §7 hard part (e) prescribe this
+        decode -> H2D -> compute overlap instead. Depth is bounded at two
+        in-flight batches (plus ``max_inflight_batches`` queued behind them).
+        """
+        pending = None            # in-flight (handle, batch) or None
+        shutdown = False
+        while not shutdown:
+            if pending is None:
+                batch = self._in.get()
+                if batch is None:
+                    return
+                self._busy.set()
+                pending = self._try_submit(batch)
+                if pending is None:
+                    self._busy.clear()
+                continue
+            # One batch in flight: opportunistically launch the next before
+            # blocking on the first's results.
+            nxt = None
+            try:
+                nxt = self._in.get_nowait()
+                if nxt is None:
+                    shutdown = True
+            except queue_mod.Empty:
+                pass
+            nxt_pending = self._try_submit(nxt) if nxt is not None else None
+            self._collect_into_out(pending)
+            pending = nxt_pending
+            if pending is None:
+                self._busy.clear()
+        # No in-flight batch can survive the loop: shutdown is only set in
+        # the pending-branch, whose same iteration collects `pending` and
+        # replaces it with None (the sentinel never coexists with a next
+        # batch).
+
+    def _try_submit(self, batch):
+        try:
+            return (self.backend.submit(batch), batch)
+        except Exception:
+            log.exception("backend failed submitting a %d-job batch; jobs "
+                          "will be re-queued by lease expiry", len(batch))
+            return None
+
+    def _collect_into_out(self, pending) -> None:
+        handle, batch = pending
+        try:
+            for completion in self.backend.collect(handle):
+                self._out.put(completion)
+        except Exception:
+            log.exception("backend failed on a %d-job batch; jobs will "
+                          "be re-queued by lease expiry", len(batch))
 
     # -- control side ------------------------------------------------------
 
@@ -273,64 +338,78 @@ class Worker:
             self._in.put(jobs)
         return jobs
 
-    # Retry due-times for failed completion RPCs. Worst case per completion
-    # (3 attempts, 5 s RPC timeout each, spread over due windows) stays well
-    # under the dispatcher's 10 s prune window because heartbeats keep
-    # flowing between attempts — nothing here ever sleeps.
+    # Retry due-times for failed completion RPCs. Attempts are spread over
+    # due windows with heartbeats flowing in between — nothing here ever
+    # sleeps, so a flaky dispatcher cannot starve liveness.
     _COMPLETION_BACKOFF_S = (0.5, 1.0, 2.0)
+    # Completions per CompleteJobs RPC. One unary RPC per completion
+    # measured ~2 ms on a loopback Python channel — a ~500 jobs/s control-
+    # plane ceiling; batching lifts it an order of magnitude.
+    _COMPLETION_BATCH = 256
 
     def _drain_completions(self, stub, *,
                            ignore_status_deadline: bool = False) -> None:
-        """Report queued + due-for-retry completions; never sleeps.
+        """Report queued + due-for-retry completions in batched RPCs.
 
-        Stops early when a status heartbeat is overdue so a slow/flaky
-        dispatcher cannot starve liveness (remaining items are picked up on
-        the next loop tick).
+        Never sleeps, and stops early when a status heartbeat is overdue so
+        a slow/flaky dispatcher cannot starve liveness (remaining items are
+        picked up on the next loop tick).
         """
         def status_overdue() -> bool:
             return (not ignore_status_deadline
                     and time.monotonic() >= self._next_status)
 
         now = time.monotonic()
-        due = [d for d in self._deferred
-               if d[0] <= now or ignore_status_deadline]
+        ready = [(a, c) for due, a, c in self._deferred
+                 if due <= now or ignore_status_deadline]
         self._deferred = [d for d in self._deferred
                           if not (d[0] <= now or ignore_status_deadline)]
-        for _, attempts, comp in due:
-            if status_overdue():
-                self._deferred.append((now, attempts, comp))
-                continue
-            self._report_completion(stub, comp, attempts=attempts)
-        while not status_overdue():
-            try:
-                comp = self._out.get_nowait()
-            except queue_mod.Empty:
+        while True:
+            while len(ready) < self._COMPLETION_BATCH:
+                try:
+                    ready.append((0, self._out.get_nowait()))
+                except queue_mod.Empty:
+                    break
+            if not ready:
                 return
-            self._report_completion(stub, comp)
+            if status_overdue():
+                now = time.monotonic()
+                self._deferred.extend((now, a, c) for a, c in ready)
+                return
+            chunk = ready[:self._COMPLETION_BATCH]
+            ready = ready[self._COMPLETION_BATCH:]
+            self._report_completions(stub, chunk)
 
-    def _report_completion(self, stub, comp, *, attempts: int = 0) -> None:
-        """One delivery attempt; on RPC failure, park for deferred retry."""
-        req = pb.CompleteRequest(
-            id=comp.job_id, worker_id=self.worker_id,
-            metrics=comp.metrics, elapsed_s=comp.elapsed_s)
+    def _report_completions(self, stub, chunk) -> None:
+        """One CompleteJobs attempt for ``chunk`` = [(attempts, completion)];
+        on RPC failure each item parks for deferred retry (or is dropped
+        once its attempts are exhausted — the lease re-queues the job)."""
+        req = pb.CompleteBatch(worker_id=self.worker_id, items=[
+            pb.CompleteItem(id=c.job_id, metrics=c.metrics,
+                            elapsed_s=c.elapsed_s) for _, c in chunk])
         try:
-            ack = stub.CompleteJob(req, timeout=5.0)
+            # Timeout stays under the dispatcher's default 10 s prune window:
+            # only ONE batch RPC can delay the next heartbeat (status_overdue
+            # yields between chunks), so 8 s bounds the worst heartbeat gap.
+            # A link too slow to move a chunk in 8 s fails the attempt; items
+            # park for retry and, if attempts exhaust, leases re-queue them.
+            reply = stub.CompleteJobs(req, timeout=8.0)
             self._log_reconnected()
-            if ack.ok:
-                self.jobs_completed += 1
-            else:
-                log.warning("completion %s rejected: %s",
-                            comp.job_id, ack.detail)
+            self.jobs_completed += reply.accepted
+            for jid in reply.unknown_ids:
+                log.warning("completion %s rejected: unknown job", jid)
         except grpc.RpcError as e:
             self._log_disconnected(e)
-            if attempts >= len(self._COMPLETION_BACKOFF_S):
-                self.completions_dropped += 1
-                log.error("dropping completion %s after %d attempts "
-                          "(lease will re-queue it)", comp.job_id,
-                          attempts + 1)
-                return
-            due = time.monotonic() + self._COMPLETION_BACKOFF_S[attempts]
-            self._deferred.append((due, attempts + 1, comp))
+            for attempts, comp in chunk:
+                if attempts >= len(self._COMPLETION_BACKOFF_S):
+                    self.completions_dropped += 1
+                    log.error("dropping completion %s after %d attempts "
+                              "(lease will re-queue it)", comp.job_id,
+                              attempts + 1)
+                else:
+                    due = (time.monotonic()
+                           + self._COMPLETION_BACKOFF_S[attempts])
+                    self._deferred.append((due, attempts + 1, comp))
 
     def _log_disconnected(self, err) -> None:
         if self._connected:

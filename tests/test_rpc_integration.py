@@ -88,6 +88,60 @@ def test_end_to_end_instant_backend(tmp_path):
         srv.stop()
 
 
+class _PipelineProbeBackend:
+    """submit/collect backend that records event order and slows collect,
+    so the worker's double-buffering is observable: with several batches
+    queued, submit(k+1) must precede collected(k) for some k."""
+
+    chips = 1
+
+    def __init__(self, delay_s: float = 0.15):
+        self.delay_s = delay_s
+        self.events: list = []
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def submit(self, jobs):
+        with self._lock:
+            self._n += 1
+            n = self._n
+        self.events.append(("submit", n))
+        return (n, list(jobs))
+
+    def collect(self, handle):
+        n, jobs = handle
+        time.sleep(self.delay_s)
+        self.events.append(("collected", n))
+        return [compute.Completion(j.id, b"", self.delay_s) for j in jobs]
+
+
+def test_pipelined_backend_overlaps_batches():
+    """The compute loop must launch batch k+1 while batch k's results are
+    still being collected (SURVEY.md §2.3 PP row: decode/H2D/compute
+    double-buffering vs the reference's serial loop,
+    reference src/worker/process.rs:21-25)."""
+    queue = JobQueue()
+    for rec in synthetic_jobs(8, 32, "sma_crossover", GRID):
+        queue.enqueue(rec)
+    disp, srv = _server(queue)
+    backend = _PipelineProbeBackend()
+    try:
+        w, t = _run_worker(f"localhost:{srv.port}", backend, jobs_per_chip=2)
+        _wait(lambda: queue.drained, msg="queue drained")
+        # drained flips inside the dispatcher's handler, possibly before the
+        # worker thread has counted the reply — join before asserting.
+        t.join(timeout=10)
+    finally:
+        srv.stop()
+    assert w.jobs_completed == 8
+    ev = backend.events
+    overlapped = any(
+        ev.index(("submit", k + 1)) < ev.index(("collected", k))
+        for k in range(1, backend._n)
+        if ("submit", k + 1) in ev and ("collected", k) in ev)
+    assert overlapped, f"no overlapped batch observed in {ev}"
+
+
 def test_end_to_end_jax_backend_matches_direct_sweep():
     import jax.numpy as jnp
 

@@ -390,12 +390,12 @@ def test_completion_retry_never_blocks_control_thread():
     class FlakyStub:
         fail = 2
 
-        def CompleteJob(self, req, timeout=None):
-            calls.append(req.id)
+        def CompleteJobs(self, req, timeout=None):
+            calls.extend(i.id for i in req.items)
             if self.fail:
                 self.fail -= 1
                 raise grpc.RpcError()
-            return SimpleNamespace(ok=True, detail="")
+            return SimpleNamespace(accepted=len(req.items), unknown_ids=[])
 
     stub = FlakyStub()
     w._out.put(compute.Completion("j1", b"", 0.0))
@@ -428,7 +428,7 @@ def test_completion_drain_yields_to_overdue_heartbeat():
     w._next_status = time.monotonic() - 1.0       # heartbeat overdue
 
     class NeverCalled:
-        def CompleteJob(self, req, timeout=None):
+        def CompleteJobs(self, req, timeout=None):
             raise AssertionError("drain must yield to the heartbeat first")
 
     w._out.put(compute.Completion("j1", b"", 0.0))
@@ -448,7 +448,7 @@ def test_completion_dropped_after_attempts_exhausted():
     w._next_status = time.monotonic() + 60.0
 
     class DeadStub:
-        def CompleteJob(self, req, timeout=None):
+        def CompleteJobs(self, req, timeout=None):
             raise grpc.RpcError()
 
     stub = DeadStub()
