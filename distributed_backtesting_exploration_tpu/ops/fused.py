@@ -59,6 +59,17 @@ def _pad_last(close, T_pad: int):
         [close, jnp.repeat(close[:, -1:], pad_t, axis=1)], axis=1)
 
 
+def _t_real_col(t_real, close):
+    """Per-ticker real bar counts as an (N, 1) int32 column for the kernels'
+    SMEM array, or None for uniform histories (the kernels then specialize
+    on a static length — measured ~25% faster than the dynamic path on the
+    headline sweep). Ragged callers pass the lengths from
+    :func:`~..utils.data.pad_and_stack`."""
+    if t_real is None:
+        return None
+    return jnp.asarray(t_real, jnp.int32).reshape(close.shape[0], 1)
+
+
 def _rets3(close_p):
     """Per-bar simple returns of padded closes, shaped ``(N, T_pad, 1)`` for
     a (1, T_pad, 1) kernel block (broadcasts over param lanes); ``r[0] = 0``."""
@@ -92,31 +103,68 @@ def _cummax0(x):
     return x
 
 
-def _metrics_tail(pos, r, t_idx, *, T_real: int, cost: float, ppy: int):
+def _unpack_tr(refs, T_real):
+    """Shared ragged-vs-uniform ref plumbing for all sweep kernels: with a
+    static ``T_real`` the refs are just ``(out_ref,)``; in ragged mode an
+    SMEM lengths array precedes it and this grid row's length is read out.
+    Returns ``(tr, out_ref)``."""
+    if T_real is None:
+        tr_ref, out_ref = refs
+        return tr_ref[pl.program_id(0), 0], out_ref
+    (out_ref,) = refs
+    return T_real, out_ref
+
+
+def _tr_specs(T_real):
+    """Extra in_specs for ragged mode (whole lengths array in SMEM)."""
+    return [] if T_real is not None else [
+        pl.BlockSpec(memory_space=pltpu.SMEM)]
+
+
+def _tr_args(t_real, T_real):
+    """Extra pallas operands for ragged mode."""
+    return [] if T_real is not None else [t_real]
+
+
+def _row_at(x, tr, t_idx, *, keepdims: bool):
+    """Row ``tr - 1`` of a (T_pad, 128) tile. Static ``tr`` folds to a plain
+    slice (zero runtime cost — the uniform-history fast path); a traced
+    ``tr`` uses a one-hot masked sum, bit-identical to the slice (exactly
+    one nonzero row) but one extra VPU pass."""
+    if isinstance(tr, int):
+        row = x[tr - 1:tr, :]
+        return row if keepdims else row[0]
+    return jnp.sum(jnp.where(t_idx == tr - 1, x, 0.0), axis=0,
+                   keepdims=keepdims)
+
+
+def _metrics_tail(pos, r, t_idx, tr, *, cost: float, ppy: int):
     """Shared kernel tail: positions -> packed (16, 128) metric rows.
 
     ``pos`` is the per-lane position path over ``(T_pad, 128)`` (any signal
-    kernel produces it); bars at ``t >= T_real`` are overwritten to hold the
-    final real position so every reduction over T_pad equals the unpadded
-    reduction over T_real (zero return, zero turnover in the pad).
+    kernel produces it); ``tr`` is this ticker's real bar count (an int32
+    scalar — traced, so ragged groups work with one compiled kernel). Bars
+    at ``t >= tr`` are overwritten to hold the final real position so every
+    reduction over T_pad equals the unpadded reduction over tr (zero
+    return, zero turnover in the pad).
     """
-    row_ok = t_idx < T_real
-    pos_last = pos[T_real - 1:T_real, :]
+    row_ok = t_idx < tr
+    pos_last = _row_at(pos, tr, t_idx, keepdims=True)
     pos = jnp.where(row_ok, pos, pos_last)
 
     prev = _shift_down(pos, 1, 0.0)
     net = prev * r - cost * jnp.abs(pos - prev)
-    return _metrics_pack(pos, prev, net, row_ok, T_real=T_real, ppy=ppy)
+    return _metrics_pack(pos, prev, net, row_ok, t_idx, tr, ppy=ppy)
 
 
-def _metrics_pack(pos, prev, net, row_ok, *, T_real: int, ppy: int):
+def _metrics_pack(pos, prev, net, row_ok, t_idx, tr, *, ppy: int):
     """Reduce per-bar ``net``/positions to the packed (16, 128) metric rows.
 
     Callers guarantee the padding discipline: ``pos`` holds its final real
-    value for ``t >= T_real`` and ``net`` is exactly zero there, so plain
-    reductions over T_pad equal the unpadded reductions over T_real.
+    value for ``t >= tr`` and ``net`` is exactly zero there, so plain
+    reductions over T_pad equal the unpadded reductions over tr.
     """
-    n = jnp.float32(T_real)
+    n = jnp.asarray(tr, jnp.float32)
     s1 = jnp.sum(net, axis=0)
     s2 = jnp.sum(net * net, axis=0)
     mean = s1 / n
@@ -130,7 +178,7 @@ def _metrics_pack(pos, prev, net, row_ok, *, T_real: int, ppy: int):
     peak = _cummax0(equity)
     dd = (peak - equity) / jnp.maximum(peak, _EPS)
     mdd = jnp.max(jnp.where(row_ok, dd, 0.0), axis=0)
-    eq_final = equity[T_real - 1, :]
+    eq_final = _row_at(equity, tr, t_idx, keepdims=False)
 
     active = (jnp.abs(prev) > 0) & row_ok
     wins = (net > 0) & active
@@ -158,8 +206,9 @@ def _metrics_pack(pos, prev, net, row_ok, *, T_real: int, ppy: int):
         [rows, jnp.zeros((_METRIC_ROWS - 9, _LANES), jnp.float32)], axis=0)
 
 
-def _kernel(r_ref, sma_ref, of_ref, os_ref, warm_ref, out_ref, *,
-            T_real: int, cost: float, ppy: int):
+def _kernel(r_ref, sma_ref, of_ref, os_ref, warm_ref, *refs,
+            cost: float, ppy: int, T_real: int | None):
+    tr, out_ref = _unpack_tr(refs, T_real)
     T_pad = r_ref.shape[1]
     r = r_ref[0]                     # (T_pad, 1) -> broadcasts over lanes
     sma = sma_ref[0]                 # (T_pad, W_pad)
@@ -175,17 +224,16 @@ def _kernel(r_ref, sma_ref, of_ref, os_ref, warm_ref, out_ref, *,
     warm = warm_ref[0, :][None, :]               # (1, 128) max(fast, slow)
     valid = t_idx >= (warm.astype(jnp.int32) - 1)
     pos = jnp.where(valid, jnp.sign(f - s), 0.0)
-    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, T_real=T_real, cost=cost,
-                                  ppy=ppy)
+    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
                      "ppy", "interpret"))
-def _fused_call(close, onehot_f, onehot_s, warm, *, windows: tuple,
-                T_pad: int, W_pad: int, P_real: int, T_real: int, cost: float,
-                ppy: int, interpret: bool):
+def _fused_call(close, onehot_f, onehot_s, warm, t_real, *, windows: tuple,
+                T_pad: int, W_pad: int, P_real: int, T_real: int | None,
+                cost: float, ppy: int, interpret: bool):
     """Table prep + pallas call in ONE jit: the prep is ~500 XLA ops and must
     not run eagerly (each eager op is a dispatch round-trip on the remote-
     proxy TPU backend — measured 13x slower end-to-end)."""
@@ -217,7 +265,7 @@ def _fused_call(close, onehot_f, onehot_s, warm, *, windows: tuple,
     P_pad = onehot_f.shape[1]
     n_blocks = P_pad // _LANES
     grid = (N, n_blocks)
-    kernel = functools.partial(_kernel, T_real=T_real, cost=cost, ppy=ppy)
+    kernel = functools.partial(_kernel, cost=cost, ppy=ppy, T_real=T_real)
     out = pl.pallas_call(
         kernel,
         grid=grid,
@@ -232,14 +280,15 @@ def _fused_call(close, onehot_f, onehot_s, warm, *, windows: tuple,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
-        ],
+        ] + _tr_specs(T_real),
         out_specs=pl.BlockSpec(
             (1, 1, _METRIC_ROWS, _LANES), lambda i, j: (i, j, 0, 0),
             memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(
             (N, n_blocks, _METRIC_ROWS, _LANES), jnp.float32),
         interpret=interpret,
-    )(returns3, sma_table, onehot_f, onehot_s, warm)
+    )(returns3, sma_table, onehot_f, onehot_s, warm,
+      *_tr_args(t_real, T_real))
     # (N, n_blocks, 16, 128) -> nine (N, P_real) fields. The slice to P_real
     # stays inside the jit: eagerly slicing nine arrays after the call costs
     # nine dispatch round-trips on the remote-proxy backend.
@@ -248,7 +297,7 @@ def _fused_call(close, onehot_f, onehot_s, warm, *, windows: tuple,
         for k in range(9)))
 
 
-def fused_sma_sweep(close, fast, slow, *, cost: float = 0.0,
+def fused_sma_sweep(close, fast, slow, *, t_real=None, cost: float = 0.0,
                     periods_per_year: int = 252,
                     interpret: bool | None = None) -> Metrics:
     """Fused SMA-crossover sweep: ``(N, T)`` closes x ``(P,)`` param lanes.
@@ -273,9 +322,10 @@ def fused_sma_sweep(close, fast, slow, *, cost: float = 0.0,
     windows, onehot_f, onehot_s, warm = _grid_setup(
         fast.astype(np.float32).tobytes(), slow.astype(np.float32).tobytes())
     return _fused_call(close, onehot_f, onehot_s, warm,
+                       _t_real_col(t_real, close),
                        windows=windows,
                        T_pad=_round_up(T, 8), W_pad=onehot_f.shape[0],
-                       P_real=P, T_real=T,
+                       P_real=P, T_real=T if t_real is None else None,
                        cost=float(cost), ppy=int(periods_per_year),
                        interpret=bool(interpret))
 
@@ -312,9 +362,11 @@ def _band_ladder(z, valid, k, z_exit):
     return p0   # start state is flat: the 0-component is the position path
 
 
-def _boll_kernel(r_ref, z_ref, ow_ref, k_ref, warm_ref, out_ref, *,
-                 T_real: int, cost: float, ppy: int, z_exit: float):
+def _boll_kernel(r_ref, z_ref, ow_ref, k_ref, warm_ref, *refs,
+                 cost: float, ppy: int, z_exit: float,
+                 T_real: int | None):
     """Bollinger mean-reversion cell: z-selection matmul + hysteresis ladder."""
+    tr, out_ref = _unpack_tr(refs, T_real)
     T_pad = r_ref.shape[1]
     r = r_ref[0]                     # (T_pad, 1)
     z_tbl = z_ref[0]                 # (T_pad, W_pad) per-window z-scores
@@ -327,16 +379,15 @@ def _boll_kernel(r_ref, z_ref, ow_ref, k_ref, warm_ref, out_ref, *,
     k = k_ref[0, :][None, :]                           # (1, 128) entry band
 
     pos = _band_ladder(z, valid, k, z_exit)
-    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, T_real=T_real, cost=cost,
-                                  ppy=ppy)
+    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
                      "ppy", "z_exit", "interpret"))
-def _fused_boll_call(close, onehot_w, k_lanes, warm, *, windows: tuple,
-                     T_pad: int, W_pad: int, P_real: int, T_real: int,
+def _fused_boll_call(close, onehot_w, k_lanes, warm, t_real, *, windows: tuple,
+                     T_pad: int, W_pad: int, P_real: int, T_real: int | None,
                      cost: float, ppy: int, z_exit: float, interpret: bool):
     """Z-score table prep + pallas call in one jit (same dispatch-economy
     rationale as ``_fused_call``).
@@ -378,8 +429,8 @@ def _fused_boll_call(close, onehot_w, k_lanes, warm, *, windows: tuple,
     returns3 = _rets3(close_p)
     P_pad = k_lanes.shape[1]
     n_blocks = P_pad // _LANES
-    kernel = functools.partial(_boll_kernel, T_real=T_real, cost=cost,
-                               ppy=ppy, z_exit=z_exit)
+    kernel = functools.partial(_boll_kernel, cost=cost, ppy=ppy,
+                               z_exit=z_exit, T_real=T_real)
     out = pl.pallas_call(
         kernel,
         grid=(N, n_blocks),
@@ -394,20 +445,22 @@ def _fused_boll_call(close, onehot_w, k_lanes, warm, *, windows: tuple,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
-        ],
+        ] + _tr_specs(T_real),
         out_specs=pl.BlockSpec(
             (1, 1, _METRIC_ROWS, _LANES), lambda i, j: (i, j, 0, 0),
             memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(
             (N, n_blocks, _METRIC_ROWS, _LANES), jnp.float32),
         interpret=interpret,
-    )(returns3, z_table, onehot_w, k_lanes, warm)
+    )(returns3, z_table, onehot_w, k_lanes, warm,
+      *_tr_args(t_real, T_real))
     return Metrics(*(
         jnp.reshape(out[:, :, k, :], (N, P_pad))[:, :P_real]
         for k in range(9)))
 
 
-def fused_bollinger_sweep(close, window, k, *, z_exit: float = 0.0,
+def fused_bollinger_sweep(close, window, k, *, t_real=None,
+                          z_exit: float = 0.0,
                           cost: float = 0.0, periods_per_year: int = 252,
                           interpret: bool | None = None) -> Metrics:
     """Fused Bollinger mean-reversion sweep: ``(N, T)`` closes x ``(P,)`` lanes.
@@ -430,9 +483,11 @@ def fused_bollinger_sweep(close, window, k, *, z_exit: float = 0.0,
     windows, onehot_w, k_lanes, warm = _boll_grid_setup(
         window.astype(np.float32).tobytes(), k.tobytes())
     return _fused_boll_call(close, onehot_w, k_lanes, warm,
+                            _t_real_col(t_real, close),
                             windows=windows,
                             T_pad=_round_up(T, 8), W_pad=onehot_w.shape[0],
-                            P_real=P, T_real=T, cost=float(cost),
+                            P_real=P, T_real=T if t_real is None else None,
+                            cost=float(cost),
                             ppy=int(periods_per_year),
                             z_exit=float(z_exit), interpret=bool(interpret))
 
@@ -466,7 +521,8 @@ def _boll_grid_setup(window_bytes: bytes, k_bytes: bytes):
 
 
 def _pairs_kernel(ry_ref, rx_ref, z_ref, b_ref, ow_ref, k_ref, zx_ref,
-                  warm_ref, out_ref, *, T_real: int, cost: float, ppy: int):
+                  warm_ref, *refs, cost: float, ppy: int,
+                  T_real: int | None):
     """Pairs-trade cell: z/beta selection matmuls + hysteresis + spread PnL.
 
     Two MXU contractions pick each lane's lookback column from the per-pair
@@ -476,6 +532,7 @@ def _pairs_kernel(ry_ref, rx_ref, z_ref, b_ref, ow_ref, k_ref, zx_ref,
     exposure normalized, mirroring ``models.pairs.pair_backtest``) — so this
     kernel computes its own ``net`` and shares only ``_metrics_pack``.
     """
+    tr, out_ref = _unpack_tr(refs, T_real)
     T_pad = ry_ref.shape[1]
     ry = ry_ref[0]                   # (T_pad, 1)
     rx = rx_ref[0]
@@ -500,14 +557,15 @@ def _pairs_kernel(ry_ref, rx_ref, z_ref, b_ref, ow_ref, k_ref, zx_ref,
 
     pos = _band_ladder(z, valid, k, zx)
 
-    row_ok = t_idx < T_real
-    pos = jnp.where(row_ok, pos, pos[T_real - 1:T_real, :])
+    row_ok = t_idx < tr
+    pos_last = _row_at(pos, tr, t_idx, keepdims=True)
+    pos = jnp.where(row_ok, pos, pos_last)
     prev = _shift_down(pos, 1, 0.0)
     prev_beta = _shift_down(beta, 1, 0.0)
     gross = 1.0 + jnp.abs(prev_beta)
     spread_ret = prev * (ry - prev_beta * rx) / jnp.maximum(gross, 1.0)
     net = spread_ret - cost * jnp.abs(pos - prev)
-    out_ref[0, 0] = _metrics_pack(pos, prev, net, row_ok, T_real=T_real,
+    out_ref[0, 0] = _metrics_pack(pos, prev, net, row_ok, t_idx, tr,
                                   ppy=ppy)
 
 
@@ -515,9 +573,11 @@ def _pairs_kernel(ry_ref, rx_ref, z_ref, b_ref, ow_ref, k_ref, zx_ref,
     jax.jit,
     static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
                      "ppy", "interpret"))
-def _fused_pairs_call(y_close, x_close, onehot_w, k_lanes, zx_lanes, warm, *,
+def _fused_pairs_call(y_close, x_close, onehot_w, k_lanes, zx_lanes, warm,
+                      t_real, *,
                       windows: tuple, T_pad: int, W_pad: int, P_real: int,
-                      T_real: int, cost: float, ppy: int, interpret: bool):
+                      T_real: int | None,
+                      cost: float, ppy: int, interpret: bool):
     """Beta/z table prep + pallas call in one jit.
 
     The tables follow ``rolling.rolling_ols`` / ``rolling.rolling_zscore``'s
@@ -600,8 +660,8 @@ def _fused_pairs_call(y_close, x_close, onehot_w, k_lanes, zx_lanes, warm, *,
 
     P_pad = k_lanes.shape[1]
     n_blocks = P_pad // _LANES
-    kernel = functools.partial(_pairs_kernel, T_real=T_real, cost=cost,
-                               ppy=ppy)
+    kernel = functools.partial(_pairs_kernel, cost=cost, ppy=ppy,
+                               T_real=T_real)
     out = pl.pallas_call(
         kernel,
         grid=(N, n_blocks),
@@ -622,7 +682,7 @@ def _fused_pairs_call(y_close, x_close, onehot_w, k_lanes, zx_lanes, warm, *,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
-        ],
+        ] + _tr_specs(T_real),
         out_specs=pl.BlockSpec(
             (1, 1, _METRIC_ROWS, _LANES), lambda i, j: (i, j, 0, 0),
             memory_space=pltpu.VMEM),
@@ -630,13 +690,14 @@ def _fused_pairs_call(y_close, x_close, onehot_w, k_lanes, zx_lanes, warm, *,
             (N, n_blocks, _METRIC_ROWS, _LANES), jnp.float32),
         interpret=interpret,
     )(_rets3(y_p), _rets3(x_p), z_tbl, beta_tbl, onehot_w, k_lanes, zx_lanes,
-      warm)
+      warm, *_tr_args(t_real, T_real))
     return Metrics(*(
         jnp.reshape(out[:, :, k, :], (N, P_pad))[:, :P_real]
         for k in range(9)))
 
 
-def fused_pairs_sweep(y_close, x_close, lookback, z_entry, *, z_exit=0.0,
+def fused_pairs_sweep(y_close, x_close, lookback, z_entry, *, t_real=None,
+                      z_exit=0.0,
                       cost: float = 0.0, periods_per_year: int = 252,
                       interpret: bool | None = None) -> Metrics:
     """Fused rolling-OLS pairs sweep: ``(N, T)`` pair legs x ``(P,)`` lanes.
@@ -666,9 +727,11 @@ def fused_pairs_sweep(y_close, x_close, lookback, z_entry, *, z_exit=0.0,
     # T_pad is a lane multiple (128): T sits on the tables' minor axis AND on
     # the working tiles' sublane axis, so 128 satisfies both constraints.
     return _fused_pairs_call(y_close, x_close, onehot_w, k_lanes, zx_lanes,
-                             warm, windows=windows,
+                             warm, _t_real_col(t_real, y_close),
+                             windows=windows,
                              T_pad=_round_up(T, 128), W_pad=onehot_w.shape[0],
-                             P_real=P, T_real=T, cost=float(cost),
+                             P_real=P, T_real=T if t_real is None else None,
+                             cost=float(cost),
                              ppy=int(periods_per_year),
                              interpret=bool(interpret))
 

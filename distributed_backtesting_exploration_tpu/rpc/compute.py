@@ -103,18 +103,18 @@ class JaxSweepBackend:
     # axes, window-bearing axes whose values must be integral, runner).
     # Eligibility and dispatch share this table so they cannot drift.
     @staticmethod
-    def _run_fused_sma(close, grid, cost, ppy):
+    def _run_fused_sma(close, grid, cost, ppy, t_real):
         from ..ops import fused
         return fused.fused_sma_sweep(
             close, np.asarray(grid["fast"]), np.asarray(grid["slow"]),
-            cost=cost, periods_per_year=ppy)
+            t_real=t_real, cost=cost, periods_per_year=ppy)
 
     @staticmethod
-    def _run_fused_bollinger(close, grid, cost, ppy):
+    def _run_fused_bollinger(close, grid, cost, ppy, t_real):
         from ..ops import fused
         return fused.fused_bollinger_sweep(
             close, np.asarray(grid["window"]), np.asarray(grid["k"]),
-            cost=cost, periods_per_year=ppy)
+            t_real=t_real, cost=cost, periods_per_year=ppy)
 
     _FUSED_STRATEGIES = {
         "sma_crossover": ({"fast", "slow"}, ("fast", "slow"),
@@ -125,8 +125,10 @@ class JaxSweepBackend:
     @classmethod
     def _fused_eligible(cls, job, grid, lengths) -> bool:
         """Jobs with a fused kernel (SMA-crossover, Bollinger), integral
-        window grids, equal history lengths, and a VMEM-sized working set
-        route to Pallas (no padding mask needed)."""
+        window grids, and a VMEM-sized working set route to Pallas. Mixed
+        history lengths are fine: the kernels take per-ticker real lengths
+        (round 3 — a ragged fleet used to silently drop to the ~6x-slower
+        generic path)."""
         import numpy as np
 
         spec = cls._FUSED_STRATEGIES.get(job.strategy)
@@ -140,9 +142,7 @@ class JaxSweepBackend:
             return False
         if np.unique(np.round(wins)).size > cls._FUSED_MAX_WINDOWS:
             return False
-        if len(set(int(x) for x in lengths)) != 1:
-            return False
-        return int(lengths[0]) <= cls._FUSED_MAX_BARS
+        return int(max(lengths)) <= cls._FUSED_MAX_BARS
 
     def submit(self, jobs) -> list:
         """Dispatch a batch: decode, transfer, launch kernels, start the
@@ -161,13 +161,20 @@ class JaxSweepBackend:
         from ..parallel import sweep as sweep_mod
 
         jobs = list(jobs)
-        # Group stackable jobs: same strategy, same grid, same history length.
+        # Group stackable jobs: same strategy, grid, cost. Mixed history
+        # lengths stack fine — both the fused kernels (per-ticker t_real)
+        # and the generic path (pad_and_stack + bar_mask) handle ragged
+        # groups — but lengths are bucketed by power of two (on the wire
+        # byte length, which is linear in bars) so co-batching never pads a
+        # job more than ~2x, and one oversized job cannot push a whole
+        # group over the fused VMEM cap onto the generic path.
         groups: dict[tuple, list[pb.JobSpec]] = {}
         for job in jobs:
             grid = wire.grid_from_proto(job.grid)
             key = (job.strategy,
                    tuple(sorted((k, v.tobytes()) for k, v in grid.items())),
-                   len(job.ohlcv), job.cost, job.periods_per_year)
+                   len(job.ohlcv).bit_length(), job.cost,
+                   job.periods_per_year)
             groups.setdefault(key, []).append(job)
 
         pending = []
@@ -183,12 +190,19 @@ class JaxSweepBackend:
             ppy = group[0].periods_per_year or 252
             if self.use_fused and self._fused_eligible(group[0], axes,
                                                        lengths):
-                # Equal-length group: hand the kernel the unpadded closes
-                # (it does its own sublane-aligned padding internally; no
-                # device transfer of the unused open/high/low/volume).
-                close = np.stack([np.asarray(s.close) for s in series])
+                # Repeat-last padding + per-ticker lengths: the kernels'
+                # padding discipline makes pad bars earn zero return and
+                # hold the final position, and all metric reductions use
+                # each ticker's real length. Only close reaches the device
+                # (no transfer of the unused open/high/low/volume).
+                if len(set(int(x) for x in lengths)) == 1:
+                    close = np.stack([np.asarray(s.close) for s in series])
+                    t_real = None
+                else:
+                    batch, lens, _ = data_mod.pad_and_stack(series)
+                    close, t_real = batch.close, lens
                 runner = self._FUSED_STRATEGIES[group[0].strategy][2]
-                m = runner(close, grid, group[0].cost, ppy)
+                m = runner(close, grid, group[0].cost, ppy, t_real)
             else:
                 batch, _, mask = data_mod.pad_and_stack(series)
                 panel = type(batch)(*(jnp.asarray(f) for f in batch))

@@ -266,8 +266,13 @@ class JobQueue:
             return True
         return False
 
-    def complete(self, jid: str, worker_id: str) -> bool:
-        """Record a completion (idempotent). Returns False for unknown ids.
+    def complete(self, jid: str, worker_id: str) -> str:
+        """Record a completion (idempotent). Returns ``"new"`` for a first
+        completion, ``"dup"`` for a known-and-already-completed id, and
+        ``"unknown"`` for ids the queue has never seen. (The new/dup split
+        lets batched-completion replies report only newly-recorded jobs, so
+        a worker retrying a deadline-expired-but-processed RPC does not
+        over-count its own jobs_completed.)
 
         Handles late/duplicate completions from retrying workers: the lease is
         always cleared (a re-leased job completed twice must not pin a ghost
@@ -277,10 +282,10 @@ class JobQueue:
         """
         with self._lock:
             if jid not in self._records:
-                return False
+                return "unknown"
             had_lease = self._leases.pop(jid, None) is not None
             if jid in self._completed:
-                return True
+                return "dup"
             if (not had_lease and jid not in self._failed
                     and jid not in self._tombstones):
                 # Rare path: completion for a job sitting in the pending
@@ -292,7 +297,7 @@ class JobQueue:
             self._completed[jid] = combos
             self._combos_done += combos
         self._journal.append("complete", id=jid, worker=worker_id)
-        return True
+        return "new"
 
     # -- recovery ----------------------------------------------------------
 
@@ -472,10 +477,10 @@ class Dispatcher(service.DispatcherServicer):
         return pb.Ack(ok=True)
 
     def _complete_one(self, jid: str, worker_id: str, metrics: bytes,
-                      elapsed_s: float) -> bool:
-        known = self.queue.complete(jid, worker_id)
-        if not known:
-            return False
+                      elapsed_s: float) -> str:
+        outcome = self.queue.complete(jid, worker_id)
+        if outcome == "unknown":
+            return outcome
         if metrics:
             if self.results_dir:
                 # Persist to disk only — keeping every DBXM block resident
@@ -486,12 +491,13 @@ class Dispatcher(service.DispatcherServicer):
             else:
                 self.results[jid] = metrics
         log.info("job %s completed by %s in %.3fs", jid, worker_id, elapsed_s)
-        return True
+        return outcome
 
     def CompleteJob(self, request: pb.CompleteRequest, context) -> pb.Ack:
         self.peers.touch(request.worker_id)
-        if not self._complete_one(request.id, request.worker_id,
-                                  request.metrics, request.elapsed_s):
+        if self._complete_one(request.id, request.worker_id,
+                              request.metrics,
+                              request.elapsed_s) == "unknown":
             return pb.Ack(ok=False, detail=f"unknown job {request.id}")
         return pb.Ack(ok=True)
 
@@ -503,11 +509,15 @@ class Dispatcher(service.DispatcherServicer):
         self.peers.touch(request.worker_id)
         reply = pb.CompleteBatchReply()
         for item in request.items:
-            if self._complete_one(item.id, request.worker_id, item.metrics,
-                                  item.elapsed_s):
+            outcome = self._complete_one(item.id, request.worker_id,
+                                         item.metrics, item.elapsed_s)
+            if outcome == "new":
                 reply.accepted += 1
-            else:
+            elif outcome == "unknown":
                 reply.unknown_ids.append(item.id)
+            # "dup" (a retried delivery the dispatcher already recorded) is
+            # deliberately neither accepted nor unknown: the worker already
+            # counted it on the attempt the dispatcher processed.
         return reply
 
     def GetStats(self, request: pb.StatsRequest, context) -> pb.StatsReply:

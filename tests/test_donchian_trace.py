@@ -68,7 +68,10 @@ def test_fused_routing_eligibility():
     ok_job = pb.JobSpec(strategy="sma_crossover")
     grids = {"fast": np.array([5.0, 10.0]), "slow": np.array([20.0, 40.0])}
     assert JaxSweepBackend._fused_eligible(ok_job, grids, [64, 64])
-    assert not JaxSweepBackend._fused_eligible(ok_job, grids, [64, 128])
+    # Mixed lengths stay fused (round 3): the kernels take per-ticker
+    # real lengths, so a ragged fleet no longer drops to the generic path.
+    assert JaxSweepBackend._fused_eligible(ok_job, grids, [64, 128])
+    assert not JaxSweepBackend._fused_eligible(ok_job, grids, [64, 30000])
     # bollinger has its own fused kernel keyed on (window, k) axes.
     boll = pb.JobSpec(strategy="bollinger")
     bgrid = {"window": np.array([10.0, 20.0]), "k": np.array([1.0, 2.5])}

@@ -104,6 +104,63 @@ def test_fused_bollinger_rejects_non_integer_windows():
             jnp.ones((1, 64)), np.asarray([10.5]), np.asarray([1.0]))
 
 
+def _check_ragged(strategy, fused_fn, axes, lengths, cost=1e-3, seed=0):
+    """Fused with per-ticker t_real vs the generic ragged path
+    (pad_and_stack + bar_mask)."""
+    series = []
+    for i, T in enumerate(lengths):
+        one = data.synthetic_ohlcv(1, T, seed=seed + i)
+        series.append(type(one)(*(f[0] for f in one)))
+    batch, lens, mask = data.pad_and_stack(series)
+    panel = type(batch)(*(jnp.asarray(f) for f in batch))
+    grid = sweep.product_grid(**axes)
+    ref = sweep.jit_sweep(panel, get_strategy(strategy), dict(grid),
+                          cost=cost, bar_mask=jnp.asarray(mask))
+    got = fused_fn(batch.close, grid, lens)
+    for name in ref._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(got, name)), np.asarray(getattr(ref, name)),
+            rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_fused_sma_ragged_lengths():
+    _check_ragged(
+        "sma_crossover",
+        lambda close, g, lens: fused.fused_sma_sweep(
+            close, np.asarray(g["fast"]), np.asarray(g["slow"]),
+            t_real=lens, cost=1e-3),
+        dict(fast=jnp.asarray([3, 5, 8], jnp.float32),
+             slow=jnp.asarray([13, 21], jnp.float32)),
+        lengths=[150, 200, 97, 200])
+
+
+def test_fused_bollinger_ragged_lengths():
+    _check_ragged(
+        "bollinger",
+        lambda close, g, lens: fused.fused_bollinger_sweep(
+            close, np.asarray(g["window"]), np.asarray(g["k"]),
+            t_real=lens, cost=1e-3),
+        dict(window=jnp.asarray([10, 20], jnp.float32),
+             k=jnp.asarray([1.0, 2.0], jnp.float32)),
+        lengths=[180, 131, 256], seed=11)
+
+
+def test_fused_uniform_t_real_matches_default():
+    # An explicit full-length t_real routes through the dynamic-length
+    # kernel; it must agree with the static fast path to float noise
+    # (ulp-level fusion differences only).
+    ohlcv = data.synthetic_ohlcv(2, 100, seed=3)
+    close = jnp.asarray(ohlcv.close)
+    fa, sl = np.asarray([3.0, 5.0]), np.asarray([11.0, 17.0])
+    a = fused.fused_sma_sweep(close, fa, sl, cost=1e-3)
+    b = fused.fused_sma_sweep(close, fa, sl, t_real=np.asarray([100, 100]),
+                              cost=1e-3)
+    for name in a._fields:
+        np.testing.assert_allclose(np.asarray(getattr(a, name)),
+                                   np.asarray(getattr(b, name)),
+                                   rtol=1e-5, atol=1e-6, err_msg=name)
+
+
 def _check_pairs(n_pairs, T, lookback_axis, z_entry_axis, cost=1e-3, seed=0,
                  z_exit=None):
     from distributed_backtesting_exploration_tpu.models import pairs

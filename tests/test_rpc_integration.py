@@ -88,6 +88,44 @@ def test_end_to_end_instant_backend(tmp_path):
         srv.stop()
 
 
+def test_jax_backend_fused_ragged_batch_matches_direct():
+    """A mixed-length job batch stays on the fused path (use_fused=True,
+    interpret mode on CPU) and matches per-job direct sweeps — the routing
+    must not silently drop ragged fleets to the generic path (VERDICT r2 #6).
+    """
+    import jax.numpy as jnp
+
+    from distributed_backtesting_exploration_tpu.models import base
+    from distributed_backtesting_exploration_tpu.parallel import sweep
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    grid = parse_grid("fast=3:5,slow=10:14:2")
+    jobs = (synthetic_jobs(2, 96, "sma_crossover", grid, cost=1e-3, seed=6)
+            + synthetic_jobs(2, 150, "sma_crossover", grid, cost=1e-3,
+                             seed=7))
+    specs = [pb.JobSpec(id=r.id, strategy=r.strategy, ohlcv=r.ohlcv,
+                        grid=wire.grid_to_proto(r.grid), cost=r.cost,
+                        periods_per_year=252) for r in jobs]
+    backend = compute.JaxSweepBackend(use_fused=True)
+    completions = backend.process(specs)
+    assert len(completions) == len(jobs)
+    by_id = {c.job_id: c for c in completions}
+
+    for rec in jobs:
+        series = data.from_wire_bytes(rec.ohlcv)
+        panel = type(series)(*(jnp.asarray(f)[None, :] for f in series))
+        canonical_axes = dict(sorted(rec.grid.items()))
+        want = sweep.jit_sweep(
+            panel, base.get_strategy("sma_crossover"),
+            sweep.product_grid(**canonical_axes), cost=1e-3)
+        got = wire.metrics_from_bytes(by_id[rec.id].metrics)
+        for name in want._fields:
+            np.testing.assert_allclose(
+                np.asarray(getattr(got, name)),
+                np.asarray(getattr(want, name))[0], rtol=2e-4, atol=2e-5,
+                err_msg=name)
+
+
 class _PipelineProbeBackend:
     """submit/collect backend that records event order and slows collect,
     so the worker's double-buffering is observable: with several batches

@@ -62,9 +62,9 @@ def test_complete_idempotent_and_unknown():
     for r in _mk_jobs(1):
         q.enqueue(r)
     q.take(1, "w1")
-    assert q.complete("j0", "w1") is True
-    assert q.complete("j0", "w1") is True    # duplicate is fine
-    assert q.complete("nope", "w1") is False
+    assert q.complete("j0", "w1") == "new"
+    assert q.complete("j0", "w1") == "dup"   # duplicate is fine, and visible
+    assert q.complete("nope", "w1") == "unknown"
     assert q.stats()["jobs_completed"] == 1
     assert q.drained
 
@@ -180,7 +180,7 @@ def test_late_completion_of_pending_job_removes_it():
     for r in _mk_jobs(2):
         q.enqueue(r)
     # j0 completed while still pending (late RPC after a restart replay):
-    assert q.complete("j0", "w1") is True
+    assert q.complete("j0", "w1") == "new"
     got = q.take(5, "w2")
     assert [r.id for r, _ in got] == ["j1"], "completed job must not dispatch"
     # duplicate completion of a re-leased job clears the lease:
