@@ -16,9 +16,11 @@ the reference scales only by adding worker machines (reference
    collectives over ICI within the slice. The code path is identical to the
    single-host mesh; only initialization differs.
 
-No multi-host hardware is present in CI, so :func:`initialize` is exercised
-by its single-process no-op path; the mesh math it feeds is covered by the
-8-virtual-device tests (``tests/test_sharding.py``).
+The distributed path runs under test without multi-host hardware: two OS
+processes with 4 virtual CPU devices each form one 8-device slice through a
+loopback coordinator and run a ticker-sharded sweep over the global mesh
+(``tests/test_multihost.py``); the mesh math is additionally covered by the
+single-process 8-virtual-device tests (``tests/test_sharding.py``).
 """
 
 from __future__ import annotations
@@ -51,11 +53,30 @@ def initialize(coordinator_address: str | None = None,
     if single:
         log.info("multihost: single-process mode (no coordinator configured)")
         return 1
+    platforms = str(getattr(jax.config, "jax_platforms", "") or "")
+    if not platforms or "cpu" in platforms.split(","):
+        # Multi-process CPU slices need a cross-process collectives backend;
+        # without gloo the cpu client ignores the distributed runtime and
+        # reports a single-process world (process_count() == 1) even though
+        # the coordination handshake succeeded. Harmless when another
+        # platform wins backend selection — the setting only affects the
+        # cpu client.
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id)
     n = jax.process_count()
+    if num_processes is not None and n != num_processes:
+        # Never degrade silently: a backend that ignored the distributed
+        # runtime would make every host redo the full work list and split
+        # the "global" mesh into disjoint per-host worlds.
+        raise RuntimeError(
+            f"multihost: coordination handshake succeeded but the "
+            f"{jax.default_backend()!r} backend reports "
+            f"process_count()={n}, expected {num_processes}. For CPU "
+            f"slices this usually means cross-process collectives are "
+            f"unavailable (gloo).")
     log.info("multihost: process %d/%d, %d local / %d global devices",
              jax.process_index(), n,
              jax.local_device_count(), jax.device_count())

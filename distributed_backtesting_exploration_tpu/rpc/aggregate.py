@@ -1,0 +1,111 @@
+"""Fleet-level result aggregation: read stored DBXM blocks back into
+decisions — best parameters per job, fleet-wide top performers.
+
+The reference records only a completion bit and never reads a result back
+(reference ``src/server/main.rs:66-78`` — ``CompleteRequest.data`` is
+ignored, and the ``jobs_completed`` map is write-only per
+``src/server/main.rs:33``). Here completions carry per-job metric matrices
+that the dispatcher persists (``--results-dir``); this module is the read
+path: it joins those blocks with the journal's job records (strategy, grid,
+source path) and reports the best parameter set per job plus a fleet-level
+ranking.
+
+Param order contract: DBXM rows are the cartesian product of grid axes
+sorted by name (the worker materializes ``product_grid`` over sorted axes —
+proto map iteration order is unspecified), so aggregation re-sorts the
+journaled axes the same way before indexing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+
+import numpy as np
+
+from . import wire
+from .journal import Journal
+from ..ops.metrics import Metrics, metric_sign
+
+log = logging.getLogger("dbx.aggregate")
+
+
+def _np_product_grid(axes: dict) -> dict:
+    """NumPy twin of :func:`~..parallel.sweep.product_grid` (same row-major
+    ``indexing="ij"`` order — golden-tested against it). Aggregation runs on
+    dispatcher hosts that may have no accelerator, so this module must not
+    touch jax/device state at all."""
+    names = list(axes)
+    mesh = np.meshgrid(*(np.asarray(axes[n]) for n in names), indexing="ij")
+    return {n: m.reshape(-1) for n, m in zip(names, mesh)}
+
+
+def aggregate(results_dir: str, journal_path: str, *,
+              metric: str = "sharpe", top: int = 10) -> dict:
+    """Join stored DBXM blocks with journaled job records.
+
+    Returns ``{"metric", "jobs_aggregated", "jobs_missing", "best"}`` where
+    ``best`` is the fleet-wide top-``top`` list of
+    ``{job, strategy, path, value, params}`` rows sorted best-first in the
+    metric's own direction (lower-is-better metrics sort ascending).
+    """
+    if metric not in Metrics._fields:
+        raise ValueError(f"unknown metric {metric!r}; one of "
+                         f"{Metrics._fields}")
+    state = Journal.replay(journal_path)
+    rows = []
+    missing = 0
+    for jid, rec in state.jobs.items():
+        path = os.path.join(results_dir, f"{jid}.dbxm")
+        if not os.path.exists(path):
+            if jid in state.completed:
+                missing += 1   # completed per journal but block not stored
+            continue
+        with open(path, "rb") as fh:
+            m = wire.metrics_from_bytes(fh.read())
+        axes = {k: np.asarray(v, np.float32)
+                for k, v in sorted(rec.get("grid", {}).items())}
+        grid = _np_product_grid(axes) if axes else {}
+        values = np.asarray(getattr(m, metric)).reshape(-1)
+        sign_ = metric_sign(metric)
+        idx = int(np.argmax(sign_ * values))
+        best = float(values[idx])
+        params = {k: float(v[idx]) for k, v in grid.items()}
+        rows.append({
+            "job": jid,
+            "strategy": rec.get("strategy"),
+            "path": rec.get("path"),
+            "value": float(best),
+            "params": params,
+        })
+    sign = metric_sign(metric)
+    rows.sort(key=lambda r: sign * r["value"], reverse=True)
+    return {
+        "metric": metric,
+        "jobs_aggregated": len(rows),
+        "jobs_missing": missing,
+        "best": rows[:top],
+    }
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        description="dbx aggregate: best params per job from stored results")
+    ap.add_argument("--results-dir", required=True,
+                    help="directory of <job-id>.dbxm blocks (dispatcher "
+                         "--results-dir)")
+    ap.add_argument("--journal", required=True,
+                    help="dispatcher journal (maps job ids to specs)")
+    ap.add_argument("--metric", default="sharpe",
+                    choices=list(Metrics._fields))
+    ap.add_argument("--top", type=int, default=10)
+    args = ap.parse_args(argv)
+    out = aggregate(args.results_dir, args.journal, metric=args.metric,
+                    top=args.top)
+    print(json.dumps(out, indent=2))
+
+
+if __name__ == "__main__":
+    main()

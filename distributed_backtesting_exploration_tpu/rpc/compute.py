@@ -199,8 +199,17 @@ class JaxSweepBackend:
                     close = np.stack([np.asarray(s.close) for s in series])
                     t_real = None
                 else:
-                    batch, lens, _ = data_mod.pad_and_stack(series)
-                    close, t_real = batch.close, lens
+                    # Close-only ragged stack (pad_and_stack would also pad
+                    # the four unused fields — wasted memcpy on the hot
+                    # dispatch path). Repeat-last padding keeps the kernels'
+                    # zero-return pad discipline.
+                    t_max = int(max(lengths))
+                    close = np.empty((len(series), t_max), np.float32)
+                    for i, s in enumerate(series):
+                        a = np.asarray(s.close, np.float32)
+                        close[i, :a.shape[0]] = a
+                        close[i, a.shape[0]:] = a[-1]
+                    t_real = np.asarray(lengths, np.int32)
                 runner = self._FUSED_STRATEGIES[group[0].strategy][2]
                 m = runner(close, grid, group[0].cost, ppy, t_real)
             else:

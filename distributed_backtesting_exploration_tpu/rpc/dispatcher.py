@@ -440,7 +440,13 @@ class PeerRegistry:
 # ---------------------------------------------------------------------------
 
 class Dispatcher(service.DispatcherServicer):
-    """Wires the queue + registry behind the 4-RPC contract."""
+    """Wires the queue + registry behind the 5-RPC contract."""
+
+    # In-memory DBXM blocks kept when no results_dir is configured. Beyond
+    # this, the oldest block is evicted with a loud warning — an unbounded
+    # dict would grow forever over a long fleet run (each block is
+    # n_params x 9 float32s; 4096 blocks of a 2k-param grid ~ 300 MB).
+    MAX_RESIDENT_RESULTS = 4096
 
     def __init__(self, queue: JobQueue, peers: PeerRegistry | None = None, *,
                  default_jobs_per_chip: int = 1,
@@ -450,6 +456,11 @@ class Dispatcher(service.DispatcherServicer):
         self.default_jobs_per_chip = default_jobs_per_chip
         self.results_dir = results_dir
         self.results: dict[str, bytes] = {}
+        self.results_evicted = 0
+        # Guards results insert+evict: completions run on the gRPC thread
+        # pool, and the eviction loop's iterate+delete must not race a
+        # concurrent insert.
+        self._results_lock = threading.Lock()
         if results_dir:
             os.makedirs(results_dir, exist_ok=True)
 
@@ -489,7 +500,19 @@ class Dispatcher(service.DispatcherServicer):
                                        f"{jid}.dbxm"), "wb") as fh:
                     fh.write(metrics)
             else:
-                self.results[jid] = metrics
+                with self._results_lock:
+                    self.results[jid] = metrics
+                    while len(self.results) > self.MAX_RESIDENT_RESULTS:
+                        evicted = next(iter(self.results))
+                        del self.results[evicted]
+                        if self.results_evicted == 0:
+                            log.warning(
+                                "in-memory results exceeded %d blocks; "
+                                "evicting oldest (job %s). Pass "
+                                "--results-dir to persist every result to "
+                                "disk.",
+                                self.MAX_RESIDENT_RESULTS, evicted)
+                        self.results_evicted += 1
         log.info("job %s completed by %s in %.3fs", jid, worker_id, elapsed_s)
         return outcome
 
@@ -679,10 +702,21 @@ def build_dispatcher(args) -> Dispatcher:
                 queue.enqueue(rec)
             log.info("enqueued %d synthetic jobs", args.synthetic)
 
+    results_dir = args.results_dir
+    if not results_dir:
+        # Spill by default: an in-memory-only dispatcher run would cap (and
+        # then drop) results after MAX_RESIDENT_RESULTS blocks.
+        import tempfile
+
+        results_dir = tempfile.mkdtemp(prefix="dbx-results-")
+        log.warning("no --results-dir given; persisting DBXM results to %s "
+                    "(aggregate them with python -m "
+                    "distributed_backtesting_exploration_tpu.rpc.aggregate)",
+                    results_dir)
     return Dispatcher(
         queue, PeerRegistry(prune_window_s=args.prune_window_s),
         default_jobs_per_chip=args.jobs_per_chip,
-        results_dir=args.results_dir)
+        results_dir=results_dir)
 
 
 def main(argv=None) -> None:
