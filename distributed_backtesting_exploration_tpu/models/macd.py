@@ -1,0 +1,45 @@
+"""MACD signal-line crossover (path-free).
+
+``macd = ema(close, fast) - ema(close, slow)``; the trade is the sign of
+``macd - ema(macd, signal)``. Every EMA evaluates as an associative scan
+(``ops.rolling.ema`` — O(log T) fused VPU passes), so the whole strategy is
+prefix-engine work with no serial time loop: the same shape as the SMA
+crossover but with exponential windows, giving the sweep engine a second
+path-free trend family.
+
+Warmup: EMAs are defined from bar 0 (seed ``y0 = x0``) but are dominated by
+the seed early on; positions are masked flat for ``t < slow + signal - 2``
+— the span after which every constituent EMA has seen a full window's worth
+of decay, mirroring the SMA crossover's ``max(fast, slow)`` warmup rule.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops import rolling
+from .base import Strategy, register
+
+
+def macd_lines(close, fast, slow, signal):
+    """``(macd, signal_line)`` for spans ``fast``/``slow``/``signal``
+    (traced scalars allowed; shapes ``(..., T)``)."""
+    macd = rolling.ema(close, span=fast) - rolling.ema(close, span=slow)
+    return macd, rolling.ema(macd, span=signal)
+
+
+def _positions(ohlcv, params):
+    close = ohlcv.close
+    macd, sig = macd_lines(close, params["fast"], params["slow"],
+                           params["signal"])
+    warm = jnp.asarray(params["slow"]) + jnp.asarray(params["signal"]) - 1.0
+    valid = rolling.valid_mask(close.shape[-1], warm)
+    return jnp.where(valid, jnp.sign(macd - sig), 0.0)
+
+
+MACD = register(Strategy(
+    name="macd",
+    param_fields=("fast", "slow", "signal"),
+    positions_fn=_positions,
+    stateful=False,
+))

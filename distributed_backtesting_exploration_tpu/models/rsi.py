@@ -1,0 +1,58 @@
+"""RSI mean-reversion (stateful): Wilder's relative strength index with the
+shared band-hysteresis machine.
+
+RSI is an EMA-smoothed ratio of up-moves to down-moves mapped into
+``[0, 100]``. The classic symmetric mean-reversion trade: enter long when
+RSI drops below ``50 - band`` (oversold), enter short above ``50 + band``
+(overbought), hold until RSI re-crosses 50. Centering the index
+(``rsi - 50``) makes this exactly the band machine shared with Bollinger
+and pairs (``ops.signals.band_hysteresis_assoc`` — O(log T) depth, no
+serial scan), so one hysteresis implementation serves all three families.
+
+Smoothing uses this library's EMA (``y0 = x0`` seed, associative-scan form,
+``alpha = 1/period`` — Wilder's decay). Classic Wilder seeds the average
+with an SMA over the first ``period`` bars instead; after a few multiples
+of ``period`` the two are indistinguishable, and the warmup region is
+masked flat anyway. The golden test pins these semantics against a pure
+NumPy recurrence.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops import rolling, signals
+from .base import Strategy, register
+
+
+def rsi_index(close, period):
+    """Wilder's RSI in ``[0, 100]``; shapes ``(..., T)`` -> same.
+
+    ``period`` may be traced (vmap over period grids).
+    """
+    diff = jnp.diff(close, axis=-1, prepend=close[..., :1])
+    gains = jnp.maximum(diff, 0.0)
+    losses = jnp.maximum(-diff, 0.0)
+    alpha = 1.0 / jnp.asarray(period, close.dtype)
+    avg_gain = rolling.ema(gains, alpha=alpha)
+    avg_loss = rolling.ema(losses, alpha=alpha)
+    return 100.0 - 100.0 / (1.0 + avg_gain / (avg_loss + 1e-12))
+
+
+def _positions(ohlcv, params):
+    close = ohlcv.close
+    rsi = rsi_index(close, params["period"])
+    valid = rolling.valid_mask(close.shape[-1],
+                               jnp.asarray(params["period"]) + 1)
+    # Centered index: long when rsi < 50 - band, short when rsi > 50 + band,
+    # exit when rsi re-crosses 50 — the shared machine with z_exit = 0.
+    return signals.band_hysteresis_assoc(
+        rsi - 50.0, valid, params["band"], 0.0)
+
+
+RSI = register(Strategy(
+    name="rsi",
+    param_fields=("period", "band"),
+    positions_fn=_positions,
+    stateful=True,
+))

@@ -53,7 +53,14 @@ def _build() -> bool:
                 check=True, capture_output=True, timeout=120)
             subprocess.run(["cmake", "--build", _BUILD_DIR],
                            check=True, capture_output=True, timeout=300)
-            return os.path.exists(_LIB_PATH)
+            if os.path.exists(_LIB_PATH):
+                # _stale() keys on the .so's mtime, but ninja relinks it
+                # only when dbx_core sources changed — touching e.g.
+                # worker_native.cc would otherwise leave the .so "stale"
+                # forever and re-run cmake in every fresh process.
+                os.utime(_LIB_PATH)
+                return True
+            return False
         if shutil.which("g++"):
             os.makedirs(_BUILD_DIR, exist_ok=True)
             subprocess.run(
@@ -74,8 +81,16 @@ def _stale() -> bool:
     if not os.path.exists(_LIB_PATH):
         return True
     lib_mtime = os.path.getmtime(_LIB_PATH)
-    for name in ("dbx_core.cc", "dbx_core.h"):
-        src = os.path.join(_CPP_DIR, name)
+    # worker_native.cc / CMakeLists.txt / the shared .proto feed the other
+    # cmake targets; building on any of them changing keeps the shell binary
+    # and its generated proto code fresh too (one cmake --build covers all).
+    srcs = [os.path.join(_CPP_DIR, n)
+            for n in ("dbx_core.cc", "dbx_core.h", "worker_native.cc",
+                      "CMakeLists.txt")]
+    srcs.append(os.path.join(
+        _REPO_ROOT, "distributed_backtesting_exploration_tpu", "rpc",
+        "backtesting.proto"))
+    for src in srcs:
         if os.path.exists(src) and os.path.getmtime(src) > lib_mtime:
             return True
     return False

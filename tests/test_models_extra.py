@@ -1,0 +1,115 @@
+"""RSI and MACD model families: golden tests vs pure NumPy recurrences.
+
+The strategies themselves run as fused vectorized transforms (associative
+EMA scans, log-depth hysteresis); the references here are deliberately
+naive per-bar Python/NumPy loops — trivially auditable semantics.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_backtesting_exploration_tpu.models import base, macd, rsi
+from distributed_backtesting_exploration_tpu.parallel import sweep
+from distributed_backtesting_exploration_tpu.utils import data
+
+
+def _np_ema(x, alpha):
+    out = np.empty_like(x)
+    out[0] = x[0]
+    for t in range(1, len(x)):
+        out[t] = (1.0 - alpha) * out[t - 1] + alpha * x[t]
+    return out
+
+
+def _np_rsi(close, period):
+    diff = np.diff(close, prepend=close[:1])
+    gains, losses = np.maximum(diff, 0.0), np.maximum(-diff, 0.0)
+    ag = _np_ema(gains, 1.0 / period)
+    al = _np_ema(losses, 1.0 / period)
+    return 100.0 - 100.0 / (1.0 + ag / (al + 1e-12))
+
+
+def _one_close(T=220, seed=0):
+    s = data.synthetic_ohlcv(1, T, seed=seed)
+    return np.asarray(s.close[0], np.float64)
+
+
+def test_rsi_index_matches_numpy():
+    close = _one_close()
+    got = np.asarray(rsi.rsi_index(jnp.asarray(close, jnp.float32), 14.0))
+    want = _np_rsi(close, 14.0)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-3)
+
+
+def test_rsi_positions_hysteresis_semantics():
+    close = _one_close(seed=3)
+    period, band = 14.0, 20.0
+    strat = base.get_strategy("rsi")
+
+    class _O:
+        pass
+
+    o = _O()
+    o.close = jnp.asarray(close, jnp.float32)
+    got = np.asarray(strat.positions(
+        o, dict(period=jnp.float32(period), band=jnp.float32(band))))
+
+    # Serial reference machine over the numpy RSI.
+    r = _np_rsi(close, period)
+    pos = np.zeros_like(r)
+    p = 0.0
+    for t in range(len(r)):
+        x = r[t] - 50.0
+        if p == 0.0:
+            p = 1.0 if x < -band else (-1.0 if x > band else 0.0)
+        elif p > 0 and x >= 0.0:
+            p = 0.0
+        elif p < 0 and x <= 0.0:
+            p = 0.0
+        if t < period:   # warmup masked flat (valid = t >= period)
+            p = 0.0
+        pos[t] = p
+    # f32 RSI vs f64 RSI can disagree exactly at a band edge; allow a
+    # vanishing flip count rather than bit-chasing the EMA rounding.
+    assert (got != pos).mean() < 0.02
+
+
+def test_macd_lines_match_numpy():
+    close = _one_close(seed=5)
+    got_macd, got_sig = macd.macd_lines(
+        jnp.asarray(close, jnp.float32), 12.0, 26.0, 9.0)
+    ema = lambda x, span: _np_ema(x, 2.0 / (span + 1.0))
+    want_macd = ema(close, 12.0) - ema(close, 26.0)
+    want_sig = ema(want_macd, 9.0)
+    np.testing.assert_allclose(np.asarray(got_macd), want_macd,
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(got_sig), want_sig,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_rsi_macd_sweep_end_to_end():
+    """Both families run through the standard sweep engine."""
+    ohlcv = data.synthetic_ohlcv(3, 160, seed=7)
+    panel = type(ohlcv)(*(jnp.asarray(f) for f in ohlcv))
+
+    rgrid = sweep.product_grid(
+        period=jnp.asarray([7.0, 14.0], jnp.float32),
+        band=jnp.asarray([15.0, 25.0], jnp.float32))
+    m = sweep.jit_sweep(panel, base.get_strategy("rsi"), dict(rgrid),
+                        cost=1e-3)
+    assert np.asarray(m.sharpe).shape == (3, 4)
+    assert np.isfinite(np.asarray(m.sharpe)).all()
+
+    mgrid = sweep.product_grid(
+        fast=jnp.asarray([8.0, 12.0], jnp.float32),
+        slow=jnp.asarray([26.0, 35.0], jnp.float32),
+        signal=jnp.asarray([9.0], jnp.float32))
+    m2 = sweep.jit_sweep(panel, base.get_strategy("macd"), dict(mgrid),
+                         cost=1e-3)
+    assert np.asarray(m2.sharpe).shape == (3, 4)
+    assert np.isfinite(np.asarray(m2.sharpe)).all()
+
+
+def test_new_strategies_registered():
+    names = base.available_strategies()
+    assert "rsi" in names and "macd" in names

@@ -151,6 +151,17 @@ def test_native_worker_shell_selftest():
     res = subprocess.run([binary, "--help"], env=env, capture_output=True,
                          timeout=120, text=True)
     assert "core selftest ok" in res.stderr
+    # C++ codegen from the shared .proto: whenever the environment can
+    # build it (protoc present), the native round-trip MUST run and pass —
+    # accepting 'skipped' unconditionally would let a broken
+    # find_package(Protobuf) silently drop the codegen path's only
+    # coverage. Protobuf-less environments get the skip path.
+    import shutil
+    if shutil.which("protoc"):
+        assert "proto selftest ok" in res.stderr
+    else:
+        assert "proto selftest skipped" in res.stderr
+    assert "proto selftest FAILED" not in res.stderr
     assert "dbx worker" in res.stdout
     assert res.returncode == 0
 
