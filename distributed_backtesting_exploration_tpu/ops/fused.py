@@ -369,9 +369,14 @@ def _boll_kernel(r_ref, z_ref, ow_ref, k_ref, warm_ref, *refs,
     tr, out_ref = _unpack_tr(refs, T_real)
     T_pad = r_ref.shape[1]
     r = r_ref[0]                     # (T_pad, 1)
-    z_tbl = z_ref[0]                 # (T_pad, W_pad) per-window z-scores
-    z = jnp.dot(z_tbl, ow_ref[:], preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST)   # (T_pad, 128)
+    # Table arrives (W_pad, T_pad) — T on lanes, so HBM tiling pads W to a
+    # sublane multiple (8) instead of a lane multiple (128); at the baseline
+    # grid's ~20 distinct windows the old (T, W)-minor layout inflated every
+    # table and prep intermediate 6.4x (same fix as the pairs kernel).
+    dn = (((0,), (0,)), ((), ()))
+    z = jax.lax.dot_general(z_ref[0], ow_ref[:], dn,
+                            preferred_element_type=jnp.float32,
+                            precision=jax.lax.Precision.HIGHEST)  # (T_pad,128)
 
     t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, _LANES), 0)
     warm = warm_ref[0, :][None, :]
@@ -400,31 +405,33 @@ def _fused_boll_call(close, onehot_w, k_lanes, warm, t_real, *, windows: tuple,
     N, T = close.shape
     close_p = _pad_last(close, T_pad)
 
-    w_vec = jnp.asarray(np.asarray(windows, np.int32))          # (W,)
-    w_f = w_vec.astype(jnp.float32)[None, None, :]              # (1,1,W)
-    t_idx = jnp.arange(T_pad)[:, None]                          # (T_pad,1)
-    gather_idx = jnp.clip(t_idx - w_vec[None, :], 0, T_pad - 1)
-    in_win = (t_idx >= w_vec[None, :])[None]                    # (1,T_pad,W)
+    # Tables are built (N, W, T_pad) — T on the minor axis — so HBM tiling
+    # pads W to a sublane multiple (8) rather than a lane multiple (128).
+    w_col = jnp.asarray(np.asarray(windows, np.int32))[:, None]  # (W,1)
+    w_f = w_col.astype(jnp.float32)[None]                        # (1,W,1)
+    t_row = jnp.arange(T_pad)[None, :]                           # (1,T_pad)
+    gather_idx = jnp.clip(t_row - w_col, 0, T_pad - 1)           # (W,T_pad)
+    in_win = (t_row >= w_col)[None]                              # (1,W,T_pad)
 
-    def windowed_sum(series):                                   # (N,T_pad) ->
-        cs = jnp.cumsum(series, axis=1)                         # (N,T_pad,W)
+    def windowed_sum(series):                                    # (N,T_pad) ->
+        cs = jnp.cumsum(series, axis=1)                          # (N,W,T_pad)
         shifted = jnp.where(in_win, jnp.take(cs, gather_idx, axis=1), 0.0)
-        return cs[:, :, None] - shifted
+        return cs[:, None, :] - shifted
 
-    m = windowed_sum(close_p) / w_f                             # rolling mean
+    m = windowed_sum(close_p) / w_f                              # rolling mean
     # Center with the mean over the REAL bars only (the generic path sees the
     # unpadded series); the pad region's xc values never reach a real output.
     xc = close_p - jnp.mean(close_p[:, :T], axis=1, keepdims=True)
     s1 = windowed_sum(xc)
     s2 = windowed_sum(xc * xc)
     var = jnp.maximum((s2 - s1 * s1 / w_f) / w_f, 0.0)
-    z_table = (close_p[:, :, None] - m) / (jnp.sqrt(var) + 1e-12)
-    z_table = jnp.where((t_idx >= w_vec[None, :] - 1)[None], z_table, 0.0)
+    z_table = (close_p[:, None, :] - m) / (jnp.sqrt(var) + 1e-12)
+    z_table = jnp.where((t_row >= w_col - 1)[None], z_table, 0.0)
     if W_pad > len(windows):
         z_table = jnp.concatenate(
             [z_table,
-             jnp.zeros((N, T_pad, W_pad - len(windows)), jnp.float32)],
-            axis=-1)
+             jnp.zeros((N, W_pad - len(windows), T_pad), jnp.float32)],
+            axis=1)
 
     returns3 = _rets3(close_p)
     P_pad = k_lanes.shape[1]
@@ -437,7 +444,7 @@ def _fused_boll_call(close, onehot_w, k_lanes, warm, t_real, *, windows: tuple,
         in_specs=[
             pl.BlockSpec((1, T_pad, 1), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, T_pad, W_pad), lambda i, j: (i, 0, 0),
+            pl.BlockSpec((1, W_pad, T_pad), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((W_pad, _LANES), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
@@ -482,10 +489,12 @@ def fused_bollinger_sweep(close, window, k, *, t_real=None,
 
     windows, onehot_w, k_lanes, warm = _boll_grid_setup(
         window.astype(np.float32).tobytes(), k.tobytes())
+    # T_pad is a lane multiple (128): T sits on the table's minor axis AND
+    # on the working tiles' sublane axis.
     return _fused_boll_call(close, onehot_w, k_lanes, warm,
                             _t_real_col(t_real, close),
                             windows=windows,
-                            T_pad=_round_up(T, 8), W_pad=onehot_w.shape[0],
+                            T_pad=_round_up(T, 128), W_pad=onehot_w.shape[0],
                             P_real=P, T_real=T if t_real is None else None,
                             cost=float(cost),
                             ppy=int(periods_per_year),
@@ -505,7 +514,9 @@ def _boll_grid_setup(window_bytes: bytes, k_bytes: bytes):
             "integral; got non-integer values")
     windows = np.unique(np.round(window)).astype(np.float32)
     W = windows.shape[0]
-    W_pad = _round_up(max(W, 1), _LANES)
+    # One-hot contracts over W as the *sublane* dim of both operands (the
+    # table is (W, T)-major), so W pads to 8, not 128.
+    W_pad = _round_up(max(W, 1), 8)
     P_pad = _round_up(max(P, 1), _LANES)
 
     oh = np.zeros((W_pad, P_pad), np.float32)
