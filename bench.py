@@ -350,8 +350,11 @@ def verify():
                 cost=1e-3),
         ),
         "pairs": (
-            lambda: pairs.run_pairs_sweep(y_close, x_close, dict(pgrid),
-                                          cost=1e-3),
+            # Chunked generic reference: the unchunked vmap materializes the
+            # whole (pairs, P, T) hysteresis-scan tree at once — several GB
+            # at verify scale, which crashes/OOMs the chip.
+            lambda: pairs.chunked_pairs_sweep(y_close, x_close, pgrid,
+                                              param_chunk=40, cost=1e-3),
             lambda: fused.fused_pairs_sweep(
                 y_close, x_close, np.asarray(pgrid["lookback"]),
                 np.asarray(pgrid["z_entry"]), cost=1e-3),

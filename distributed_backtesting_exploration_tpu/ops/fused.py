@@ -718,9 +718,13 @@ def fused_pairs_sweep(y_close, x_close, lookback, z_entry, *, t_real=None,
     counts and must be integral. Matches :func:`~..models.pairs.run_pairs_sweep`
     (BASELINE.json configs[3]) to f32 tolerance — NOT bit-level (unlike the
     SMA/Bollinger kernels): the banded-matmul windowed sums are *tree* sums
-    while the generic path differences a cumsum, so z-scores differ by ~1e-6
-    relative and a knife-edge band entry can flip, diverging that cell's
-    position path (rare; quantified on-chip by ``bench.py --verify``).
+    while the generic path differences a cumsum, so z-scores differ near the
+    band and a knife-edge entry can flip, diverging that cell's position
+    path. On-chip this is a few % of cells at the verify scale (the
+    cumsum-difference reference loses ~1e-4 absolute z precision to
+    cancellation over long histories — the tree sums are the *tighter*
+    evaluation), while best-param decisions stay stable (0 argmax flips
+    measured); ``bench.py --verify`` re-quantifies both every round.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
