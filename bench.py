@@ -157,6 +157,29 @@ def main():
             run_boll, n_tickers * sweep.grid_size(bgrid), iters=iters,
             warmup=warmup, name="bollinger_fused")
 
+    # --- momentum / donchian: the round-3 single-window-axis kernels ------
+    if enabled("momentum_fused"):
+        mlbs = np.tile(np.arange(5, 130, dtype=np.float32),
+                       max(n_params // 125, 1))
+
+        def run_mom():
+            return fused.fused_momentum_sweep(panel.close, mlbs, cost=1e-3)
+
+        rates["momentum_fused"] = _measure(
+            run_mom, n_tickers * len(mlbs), iters=iters, warmup=warmup,
+            name="momentum_fused")
+
+    if enabled("donchian_fused"):
+        dwins = np.tile(np.arange(10, 135, dtype=np.float32),
+                        max(min(n_params, 1000) // 125, 1))
+
+        def run_don():
+            return fused.fused_donchian_sweep(panel.close, dwins, cost=1e-3)
+
+        rates["donchian_fused"] = _measure(
+            run_don, n_tickers * len(dwins), iters=iters, warmup=warmup,
+            name="donchian_fused")
+
     # --- configs[3]: rolling-OLS pairs (lookback, z_entry) ----------------
     if enabled("pairs"):
         n_pairs = min(2 * n_tickers, 1000)
@@ -274,7 +297,8 @@ def main():
             name="walkforward")
 
     if not rates:
-        known = "sma_fused, bollinger_fused, pairs, e2e, walkforward"
+        known = ("sma_fused, bollinger_fused, momentum_fused, "
+                 "donchian_fused, pairs, e2e, walkforward")
         sys.exit(f"bench: no configs ran — DBX_BENCH_CONFIGS={only} matched "
                  f"nothing (known: {known})")
     # The headline is the north-star config when it ran; otherwise label the
@@ -348,6 +372,20 @@ def verify():
             lambda g: fused.fused_bollinger_sweep(
                 panel.close, np.asarray(g["window"]), np.asarray(g["k"]),
                 cost=1e-3),
+        ),
+        "momentum": strat_case(
+            "momentum",
+            sweep.product_grid(
+                lookback=jnp.arange(5, 85, 2, dtype=jnp.float32)),
+            lambda g: fused.fused_momentum_sweep(
+                panel.close, np.asarray(g["lookback"]), cost=1e-3),
+        ),
+        "donchian": strat_case(
+            "donchian",
+            sweep.product_grid(
+                window=jnp.arange(10, 90, 2, dtype=jnp.float32)),
+            lambda g: fused.fused_donchian_sweep(
+                panel.close, np.asarray(g["window"]), cost=1e-3),
         ),
         "pairs": (
             # Chunked generic reference: the unchunked vmap materializes the

@@ -330,24 +330,17 @@ def fused_sma_sweep(close, fast, slow, *, t_real=None, cost: float = 0.0,
                        interpret=bool(interpret))
 
 
-def _band_ladder(z, valid, k, z_exit):
-    """Band-hysteresis position path over ``(T_pad, 128)`` tiles, in-kernel.
+def _prefix_compose3(pm, p0, pp):
+    """Prefix-compose per-bar 3-state transition maps over the sublane axis.
 
-    The band machine's state space is {-1, 0, +1}; each bar is a 3-state
-    transition map and composition of maps is associative, so the position
-    path evaluates as a log2(T_pad)-round doubling ladder over the sublane
-    axis — no serial scan (mirrors ``ops.signals.band_hysteresis_assoc``).
-    ``k``/``z_exit`` broadcast against the tile (scalars or (1, 128) lanes).
+    ``(pm, p0, pp)[t]`` give the next state when the previous state is
+    -1/0/+1. Composition of such maps is associative, so the full position
+    path evaluates as a log2(T_pad)-round doubling ladder — no serial scan
+    (mirrors ``ops.signals.band_hysteresis_assoc``). Returns the composed
+    maps; a start-state of flat means ``p0`` IS the position path.
     """
-    T_pad = z.shape[0]
-    # Per-bar transition maps (next state when previous state is -1/0/+1).
-    entered = jnp.where(z < -k, 1.0, jnp.where(z > k, -1.0, 0.0))
-    pm = jnp.where(valid & (z > z_exit), -1.0, 0.0)
-    p0 = jnp.where(valid, entered, 0.0)
-    pp = jnp.where(valid & (z < -z_exit), 1.0, 0.0)
-
-    # Prefix composition: after the ladder, (pm, p0, pp)[t] is the composite
-    # map of bars (0..t]; identity fill (-1/0/+1) pads the shifted reads.
+    T_pad = pm.shape[0]
+    # Identity fill (-1/0/+1) pads the shifted reads.
     span = 1
     while span < T_pad:
         em = _shift_down(pm, span, -1.0)
@@ -359,6 +352,20 @@ def _band_ladder(z, valid, k, z_exit):
             jnp.where(ep < 0, pm, jnp.where(ep > 0, pp, p0)),
         )
         span *= 2
+    return pm, p0, pp
+
+
+def _band_ladder(z, valid, k, z_exit):
+    """Band-hysteresis position path over ``(T_pad, 128)`` tiles, in-kernel.
+
+    ``k``/``z_exit`` broadcast against the tile (scalars or (1, 128) lanes).
+    """
+    # Per-bar transition maps (next state when previous state is -1/0/+1).
+    entered = jnp.where(z < -k, 1.0, jnp.where(z > k, -1.0, 0.0))
+    pm = jnp.where(valid & (z > z_exit), -1.0, 0.0)
+    p0 = jnp.where(valid, entered, 0.0)
+    pp = jnp.where(valid & (z < -z_exit), 1.0, 0.0)
+    _, p0, _ = _prefix_compose3(pm, p0, pp)
     return p0   # start state is flat: the 0-component is the position path
 
 
@@ -821,4 +828,241 @@ def _grid_setup(fast_bytes: bytes, slow_bytes: bytes):
     warm[0, :P] = np.maximum(fast, slow)
     warm[0, P:] = 1.0
     return (tuple(int(w) for w in windows), onehot(fast), onehot(slow),
+            jnp.asarray(warm))
+
+
+# ---------------------------------------------------------------------------
+# Momentum and Donchian fused kernels (T-minor tables, shared machinery)
+# ---------------------------------------------------------------------------
+
+# NOTE: channel/warmup fills use a finite 1e30 instead of +/-inf — an inf
+# entry in a selection table would turn the one-hot MXU contraction into
+# 0 * inf = NaN. Closes are ~1e2, so comparisons behave identically.
+
+
+def _mom_kernel(r_ref, c_ref, past_ref, ol_ref, warm_ref, *refs,
+                cost: float, ppy: int, T_real: int | None):
+    """Momentum cell: the signal is exact — the past-close table holds raw
+    close values, the one-hot contraction copies one of them per lane, and
+    ``sign(close - past)`` involves no rounding at all."""
+    tr, out_ref = _unpack_tr(refs, T_real)
+    T_pad = r_ref.shape[1]
+    r = r_ref[0]                       # (T_pad, 1)
+    close = c_ref[0]                   # (T_pad, 1)
+    dn = (((0,), (0,)), ((), ()))
+    past = jax.lax.dot_general(past_ref[0], ol_ref[:], dn,
+                               preferred_element_type=jnp.float32,
+                               precision=jax.lax.Precision.HIGHEST)
+
+    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, _LANES), 0)
+    warm = warm_ref[0, :][None, :]     # lookback + 1
+    valid = t_idx >= (warm.astype(jnp.int32) - 1)
+    pos = jnp.where(valid, jnp.sign(close - past), 0.0)
+    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
+
+
+def _don_kernel(r_ref, c_ref, hi_ref, lo_ref, ow_ref, warm_ref, *refs,
+                cost: float, ppy: int, T_real: int | None):
+    """Donchian cell: channel selection + the latch machine as a 3-state
+    prefix composition (breakout latches the position until the opposite
+    channel is touched — associative like the band machine, so the same
+    log-depth ladder applies; mirrors ``models.donchian``'s lax.scan)."""
+    tr, out_ref = _unpack_tr(refs, T_real)
+    T_pad = r_ref.shape[1]
+    r = r_ref[0]
+    close = c_ref[0]
+    dn = (((0,), (0,)), ((), ()))
+    hp = jax.lax.Precision.HIGHEST
+    hi = jax.lax.dot_general(hi_ref[0], ow_ref[:], dn,
+                             preferred_element_type=jnp.float32, precision=hp)
+    lo = jax.lax.dot_general(lo_ref[0], ow_ref[:], dn,
+                             preferred_element_type=jnp.float32, precision=hp)
+    # Channel known at the close of t-1, applied to bar t.
+    hi_prev = _shift_down(hi, 1, 1e30)
+    lo_prev = _shift_down(lo, 1, -1e30)
+    up = close >= hi_prev
+    down = close <= lo_prev
+
+    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, _LANES), 0)
+    warm = warm_ref[0, :][None, :]     # window + 1
+    valid = t_idx >= (warm.astype(jnp.int32) - 1)
+    # Latch transition maps (up wins over down, else hold the prior state),
+    # invalid bars force flat — models.donchian's scan body, vectorized.
+    enter = lambda hold: jnp.where(up, 1.0, jnp.where(down, -1.0, hold))
+    pm = jnp.where(valid, enter(-1.0), 0.0)
+    p0 = jnp.where(valid, enter(0.0), 0.0)
+    pp = jnp.where(valid, enter(1.0), 0.0)
+    _, pos, _ = _prefix_compose3(pm, p0, pp)
+    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
+
+
+def _single_window_pallas(kernel, close, tables, onehot_w, warm, t_real, *,
+                          T_pad: int, W_pad: int, P_real: int,
+                          T_real: int | None, interpret: bool):
+    """Shared pallas_call plumbing for the momentum/donchian kernels:
+    returns + close columns, one or two (N, W_pad, T_pad) tables, the
+    one-hot/warmup lanes, optional ragged lengths."""
+    N = close.shape[0]
+    P_pad = onehot_w.shape[1]
+    n_blocks = P_pad // _LANES
+    table_specs = [
+        pl.BlockSpec((1, W_pad, T_pad), lambda i, j: (i, 0, 0),
+                     memory_space=pltpu.VMEM)
+        for _ in tables
+    ]
+    out = pl.pallas_call(
+        kernel,
+        grid=(N, n_blocks),
+        in_specs=[
+            pl.BlockSpec((1, T_pad, 1), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T_pad, 1), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ] + table_specs + [
+            pl.BlockSpec((W_pad, _LANES), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ] + _tr_specs(T_real),
+        out_specs=pl.BlockSpec(
+            (1, 1, _METRIC_ROWS, _LANES), lambda i, j: (i, j, 0, 0),
+            memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(
+            (N, n_blocks, _METRIC_ROWS, _LANES), jnp.float32),
+        interpret=interpret,
+    )(_rets3(close), close[..., None], *tables, onehot_w, warm,
+      *_tr_args(t_real, T_real))
+    return Metrics(*(
+        jnp.reshape(out[:, :, k, :], (N, P_pad))[:, :P_real]
+        for k in range(9)))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
+                     "ppy", "interpret"))
+def _fused_mom_call(close, onehot_l, warm, t_real, *, windows: tuple,
+                    T_pad: int, W_pad: int, P_real: int, T_real: int | None,
+                    cost: float, ppy: int, interpret: bool):
+    """Past-close table prep + pallas call in one jit. The table is a single
+    clipped gather of raw closes — exact values, no arithmetic."""
+    close_p = _pad_last(close, T_pad)
+    w_col = jnp.asarray(np.asarray(windows, np.int32))[:, None]  # (W,1)
+    t_row = jnp.arange(T_pad)[None, :]
+    gather_idx = jnp.clip(t_row - w_col, 0, T_pad - 1)           # (W,T_pad)
+    past_tbl = jnp.take(close_p, gather_idx, axis=1)             # (N,W,T_pad)
+    if W_pad > len(windows):
+        past_tbl = jnp.concatenate(
+            [past_tbl,
+             jnp.zeros((close.shape[0], W_pad - len(windows), T_pad),
+                       jnp.float32)], axis=1)
+    kernel = functools.partial(_mom_kernel, cost=cost, ppy=ppy,
+                               T_real=T_real)
+    return _single_window_pallas(
+        kernel, close_p, [past_tbl], onehot_l, warm, t_real, T_pad=T_pad,
+        W_pad=W_pad, P_real=P_real, T_real=T_real, interpret=interpret)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
+                     "ppy", "interpret"))
+def _fused_don_call(close, onehot_w, warm, t_real, *, windows: tuple,
+                    T_pad: int, W_pad: int, P_real: int, T_real: int | None,
+                    cost: float, ppy: int, interpret: bool):
+    """Channel-extrema table prep + pallas call in one jit. Windows are
+    static, so each distinct window's rolling max/min uses the exact
+    O(T log W) doubling ladder (``ops.rolling.rolling_max``); max/min of
+    exact closes is exact, so the channel — and hence every breakout
+    comparison — matches the generic path bit-for-bit."""
+    from . import rolling as rolling_mod
+
+    close_p = _pad_last(close, T_pad)
+    N = close.shape[0]
+    his, los = [], []
+    for w in windows:
+        his.append(rolling_mod.rolling_max(close_p, int(w), fill=1e30))
+        los.append(rolling_mod.rolling_min(close_p, int(w), fill=-1e30))
+    hi_tbl = jnp.stack(his, axis=1)                              # (N,W,T_pad)
+    lo_tbl = jnp.stack(los, axis=1)
+    if W_pad > len(windows):
+        zpad = jnp.zeros((N, W_pad - len(windows), T_pad), jnp.float32)
+        hi_tbl = jnp.concatenate([hi_tbl, zpad], axis=1)
+        lo_tbl = jnp.concatenate([lo_tbl, zpad], axis=1)
+    kernel = functools.partial(_don_kernel, cost=cost, ppy=ppy,
+                               T_real=T_real)
+    return _single_window_pallas(
+        kernel, close_p, [hi_tbl, lo_tbl], onehot_w, warm, t_real,
+        T_pad=T_pad, W_pad=W_pad, P_real=P_real, T_real=T_real,
+        interpret=interpret)
+
+
+def fused_momentum_sweep(close, lookback, *, t_real=None, cost: float = 0.0,
+                         periods_per_year: int = 252,
+                         interpret: bool | None = None) -> Metrics:
+    """Fused time-series momentum sweep: ``(N, T)`` closes x ``(P,)`` lanes.
+
+    Matches ``run_sweep(..., "momentum")`` with an *exact* signal (the
+    past-close selection involves no arithmetic); metrics carry the usual
+    f32 reduction tolerance.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    close = jnp.asarray(close, jnp.float32)
+    lookback = np.asarray(lookback)
+    T = close.shape[1]
+    windows, onehot_l, warm = _single_window_grid_setup(
+        lookback.astype(np.float32).tobytes(), 1.0, "lookbacks")
+    return _fused_mom_call(close, onehot_l, warm, _t_real_col(t_real, close),
+                           windows=windows, T_pad=_round_up(T, 128),
+                           W_pad=onehot_l.shape[0], P_real=lookback.shape[0],
+                           T_real=T if t_real is None else None,
+                           cost=float(cost), ppy=int(periods_per_year),
+                           interpret=bool(interpret))
+
+
+def fused_donchian_sweep(close, window, *, t_real=None, cost: float = 0.0,
+                         periods_per_year: int = 252,
+                         interpret: bool | None = None) -> Metrics:
+    """Fused Donchian-breakout sweep: ``(N, T)`` closes x ``(P,)`` lanes.
+
+    Matches ``run_sweep(..., "donchian")``: the channel extrema are exact
+    (max/min of raw closes), so breakout comparisons and the latch path are
+    bit-identical to the generic scan; metrics carry f32 tolerance.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    close = jnp.asarray(close, jnp.float32)
+    window = np.asarray(window)
+    T = close.shape[1]
+    windows, onehot_w, warm = _single_window_grid_setup(
+        window.astype(np.float32).tobytes(), 1.0, "windows")
+    return _fused_don_call(close, onehot_w, warm, _t_real_col(t_real, close),
+                           windows=windows, T_pad=_round_up(T, 128),
+                           W_pad=onehot_w.shape[0], P_real=window.shape[0],
+                           T_real=T if t_real is None else None,
+                           cost=float(cost), ppy=int(periods_per_year),
+                           interpret=bool(interpret))
+
+
+@functools.lru_cache(maxsize=8)
+def _single_window_grid_setup(vals_bytes: bytes, warm_offset: float,
+                              what: str):
+    """Distinct windows + one-hot/warmup lanes for single-window-axis
+    strategies (momentum, donchian). ``warm = value + warm_offset``."""
+    vals = np.frombuffer(vals_bytes, np.float32)
+    P = vals.shape[0]
+    if not np.allclose(vals, np.round(vals)):
+        raise ValueError(
+            f"fused sweep {what} are bar counts and must be integral; "
+            "got non-integer values")
+    windows = np.unique(np.round(vals)).astype(np.float32)
+    W_pad = _round_up(max(windows.shape[0], 1), 8)
+    P_pad = _round_up(max(P, 1), _LANES)
+    oh = np.zeros((W_pad, P_pad), np.float32)
+    idx = np.searchsorted(windows, np.round(vals).astype(np.float32))
+    oh[idx, np.arange(P)] = 1.0
+    warm = np.ones((1, P_pad), np.float32)
+    warm[0, :P] = vals + warm_offset
+    return (tuple(int(w) for w in windows), jnp.asarray(oh),
             jnp.asarray(warm))

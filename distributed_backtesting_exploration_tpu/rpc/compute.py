@@ -116,17 +116,34 @@ class JaxSweepBackend:
             close, np.asarray(grid["window"]), np.asarray(grid["k"]),
             t_real=t_real, cost=cost, periods_per_year=ppy)
 
+    @staticmethod
+    def _run_fused_momentum(close, grid, cost, ppy, t_real):
+        from ..ops import fused
+        return fused.fused_momentum_sweep(
+            close, np.asarray(grid["lookback"]), t_real=t_real, cost=cost,
+            periods_per_year=ppy)
+
+    @staticmethod
+    def _run_fused_donchian(close, grid, cost, ppy, t_real):
+        from ..ops import fused
+        return fused.fused_donchian_sweep(
+            close, np.asarray(grid["window"]), t_real=t_real, cost=cost,
+            periods_per_year=ppy)
+
     _FUSED_STRATEGIES = {
         "sma_crossover": ({"fast", "slow"}, ("fast", "slow"),
                           _run_fused_sma),
         "bollinger": ({"window", "k"}, ("window",), _run_fused_bollinger),
+        "momentum": ({"lookback"}, ("lookback",), _run_fused_momentum),
+        "donchian": ({"window"}, ("window",), _run_fused_donchian),
     }
 
     @classmethod
     def _fused_eligible(cls, job, grid, lengths) -> bool:
-        """Jobs with a fused kernel (SMA-crossover, Bollinger), integral
-        window grids, and a VMEM-sized working set route to Pallas. Mixed
-        history lengths are fine: the kernels take per-ticker real lengths
+        """Jobs with a fused kernel (every _FUSED_STRATEGIES entry:
+        SMA-crossover, Bollinger, momentum, Donchian), integral window
+        grids, and a VMEM-sized working set route to Pallas. Mixed history
+        lengths are fine: the kernels take per-ticker real lengths
         (round 3 — a ragged fleet used to silently drop to the ~6x-slower
         generic path)."""
         import numpy as np
@@ -142,6 +159,15 @@ class JaxSweepBackend:
             return False
         if np.unique(np.round(wins)).size > cls._FUSED_MAX_WINDOWS:
             return False
+        if job.strategy == "donchian":
+            # The generic donchian path poisons windows beyond its static
+            # view bound (models.donchian.MAX_WINDOW) to NaN; the fused
+            # kernel has no such bound, so larger windows would silently
+            # diverge from the semantics-defining path — keep them generic.
+            from ..models import donchian as donchian_mod
+
+            if float(wins.max()) > donchian_mod.MAX_WINDOW:
+                return False
         return int(max(lengths)) <= cls._FUSED_MAX_BARS
 
     def submit(self, jobs) -> list:

@@ -82,8 +82,18 @@ def test_fused_routing_eligibility():
     # non-integral k is fine — k is a band width, not a bar count.
     assert JaxSweepBackend._fused_eligible(
         boll, {"window": np.array([10.0]), "k": np.array([1.37])}, [64])
+    # momentum/donchian gained fused kernels in round 3.
+    assert JaxSweepBackend._fused_eligible(
+        pb.JobSpec(strategy="momentum"),
+        {"lookback": np.array([10.0, 21.0])}, [64, 64])
     assert not JaxSweepBackend._fused_eligible(
-        pb.JobSpec(strategy="momentum"), grids, [64, 64])
+        pb.JobSpec(strategy="momentum"), grids, [64, 64])  # wrong axes
+    don = pb.JobSpec(strategy="donchian")
+    assert JaxSweepBackend._fused_eligible(
+        don, {"window": np.array([20.0, 55.0])}, [64])
+    # beyond the generic path's static view bound -> stays generic
+    assert not JaxSweepBackend._fused_eligible(
+        don, {"window": np.array([20.0, 300.0])}, [64])
     assert not JaxSweepBackend._fused_eligible(
         ok_job, {"fast": np.array([5.0])}, [64])
     assert not JaxSweepBackend._fused_eligible(

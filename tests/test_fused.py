@@ -161,6 +161,33 @@ def test_fused_uniform_t_real_matches_default():
                                    rtol=1e-5, atol=1e-6, err_msg=name)
 
 
+def test_padding_invariance_property():
+    """Appending pad bars (repeat-last close + t_real) must not change any
+    metric — the padding-discipline invariant every kernel's correctness
+    rests on, asserted directly rather than only via generic-path parity."""
+    ohlcv = data.synthetic_ohlcv(3, 150, seed=13)
+    close = np.asarray(ohlcv.close)
+    padded = np.concatenate(
+        [close, np.repeat(close[:, -1:], 37, axis=1)], axis=1)
+    t_real = np.full(3, 150, np.int32)
+
+    fa, sl = np.asarray([4.0, 7.0]), np.asarray([15.0, 25.0])
+    a = fused.fused_sma_sweep(close, fa, sl, t_real=t_real, cost=1e-3)
+    b = fused.fused_sma_sweep(padded, fa, sl, t_real=t_real, cost=1e-3)
+    for name in a._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(a, name)), np.asarray(getattr(b, name)),
+            rtol=1e-5, atol=1e-6, err_msg=f"sma/{name}")
+
+    w, k = np.asarray([10.0, 20.0]), np.asarray([1.0, 2.0])
+    a = fused.fused_bollinger_sweep(close, w, k, t_real=t_real, cost=1e-3)
+    b = fused.fused_bollinger_sweep(padded, w, k, t_real=t_real, cost=1e-3)
+    for name in a._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(a, name)), np.asarray(getattr(b, name)),
+            rtol=1e-5, atol=1e-6, err_msg=f"boll/{name}")
+
+
 def _check_pairs(n_pairs, T, lookback_axis, z_entry_axis, cost=1e-3, seed=0,
                  z_exit=None):
     from distributed_backtesting_exploration_tpu.models import pairs
@@ -235,3 +262,66 @@ def test_fused_pairs_rejects_non_integer_lookbacks():
         fused.fused_pairs_sweep(
             jnp.ones((1, 64)), jnp.ones((1, 64)),
             np.asarray([10.5]), np.asarray([1.0]))
+
+
+def _check_single_axis(strategy, fused_fn, axis_name, axis_vals, n_tickers=3,
+                       T=200, cost=1e-3, seed=0):
+    ohlcv = data.synthetic_ohlcv(n_tickers, T, seed=seed)
+    panel = type(ohlcv)(*(jnp.asarray(f) for f in ohlcv))
+    grid = sweep.product_grid(
+        **{axis_name: jnp.asarray(axis_vals, jnp.float32)})
+    ref = sweep.jit_sweep(panel, get_strategy(strategy), dict(grid),
+                          cost=cost)
+    got = fused_fn(panel.close, np.asarray(grid[axis_name]), cost=cost)
+    for name in ref._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(got, name)), np.asarray(getattr(ref, name)),
+            rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_fused_momentum_matches_generic():
+    _check_single_axis("momentum", fused.fused_momentum_sweep, "lookback",
+                       [5, 10, 21, 63])
+
+
+def test_fused_momentum_unaligned_T():
+    _check_single_axis("momentum", fused.fused_momentum_sweep, "lookback",
+                       [8, 13], T=251, seed=3)
+
+
+def test_fused_donchian_matches_generic():
+    _check_single_axis("donchian", fused.fused_donchian_sweep, "window",
+                       [10, 20, 55], seed=5)
+
+
+def test_fused_donchian_unaligned_T():
+    _check_single_axis("donchian", fused.fused_donchian_sweep, "window",
+                       [15, 30], T=251, seed=7)
+
+
+def test_fused_momentum_donchian_ragged():
+    series = []
+    for i, T in enumerate([150, 200, 97]):
+        one = data.synthetic_ohlcv(1, T, seed=20 + i)
+        series.append(type(one)(*(f[0] for f in one)))
+    batch, lens, mask = data.pad_and_stack(series)
+    panel = type(batch)(*(jnp.asarray(f) for f in batch))
+    for strategy, fused_fn, axis in (
+            ("momentum", fused.fused_momentum_sweep, "lookback"),
+            ("donchian", fused.fused_donchian_sweep, "window")):
+        grid = sweep.product_grid(
+            **{axis: jnp.asarray([10.0, 20.0], jnp.float32)})
+        ref = sweep.jit_sweep(panel, get_strategy(strategy), dict(grid),
+                              cost=1e-3, bar_mask=jnp.asarray(mask))
+        got = fused_fn(batch.close, np.asarray(grid[axis]), t_real=lens,
+                       cost=1e-3)
+        for name in ref._fields:
+            np.testing.assert_allclose(
+                np.asarray(getattr(got, name)),
+                np.asarray(getattr(ref, name)),
+                rtol=2e-4, atol=2e-5, err_msg=f"{strategy}/{name}")
+
+
+def test_fused_momentum_rejects_non_integer_lookbacks():
+    with pytest.raises(ValueError, match="integral"):
+        fused.fused_momentum_sweep(jnp.ones((1, 64)), np.asarray([10.5]))
