@@ -508,6 +508,31 @@ def fused_bollinger_sweep(close, window, k, *, t_real=None,
                             z_exit=float(z_exit), interpret=bool(interpret))
 
 
+
+
+def _distinct_windows(vals: np.ndarray, what: str) -> np.ndarray:
+    """Validate integral bar counts and return the sorted distinct windows."""
+    if not np.allclose(vals, np.round(vals)):
+        raise ValueError(
+            f"fused sweep {what} are bar counts and must be integral; got "
+            f"non-integer values "
+            f"(e.g. {vals[~np.isclose(vals, np.round(vals))][0]})")
+    return np.unique(np.round(vals)).astype(np.float32)
+
+
+def _window_onehot(windows: np.ndarray, vals: np.ndarray, W_pad: int,
+                   P_pad: int) -> np.ndarray:
+    """(W_pad, P_pad) selector, one 1.0 per real lane.
+
+    Search with the same rounding used to build ``windows``, or a value
+    like 200.001 (passes the integrality tolerance) lands one row off.
+    """
+    oh = np.zeros((W_pad, P_pad), np.float32)
+    idx = np.searchsorted(windows, np.round(vals).astype(np.float32))
+    oh[idx, np.arange(vals.shape[0])] = 1.0
+    return oh
+
+
 @functools.lru_cache(maxsize=4)
 def _boll_grid_setup(window_bytes: bytes, k_bytes: bytes):
     """Distinct windows + device-resident one-hot/k/warmup lanes (cached, same
@@ -515,20 +540,12 @@ def _boll_grid_setup(window_bytes: bytes, k_bytes: bytes):
     window = np.frombuffer(window_bytes, np.float32)
     k = np.frombuffer(k_bytes, np.float32)
     P = window.shape[0]
-    if not np.allclose(window, np.round(window)):
-        raise ValueError(
-            "fused_bollinger_sweep windows are bar counts and must be "
-            "integral; got non-integer values")
-    windows = np.unique(np.round(window)).astype(np.float32)
-    W = windows.shape[0]
+    windows = _distinct_windows(window, "windows")
     # One-hot contracts over W as the *sublane* dim of both operands (the
     # table is (W, T)-major), so W pads to 8, not 128.
-    W_pad = _round_up(max(W, 1), 8)
+    W_pad = _round_up(max(windows.shape[0], 1), 8)
     P_pad = _round_up(max(P, 1), _LANES)
-
-    oh = np.zeros((W_pad, P_pad), np.float32)
-    idx = np.searchsorted(windows, np.round(window).astype(np.float32))
-    oh[idx, np.arange(P)] = 1.0
+    oh = _window_onehot(windows, window, W_pad, P_pad)
 
     k_lanes = np.full((1, P_pad), np.float32(np.inf))
     k_lanes[0, :P] = k            # padded lanes never enter (k = +inf)
@@ -766,20 +783,12 @@ def _pairs_grid_setup(lb_bytes: bytes, ze_bytes: bytes, zx_bytes: bytes):
     z_entry = np.frombuffer(ze_bytes, np.float32)
     z_exit = np.frombuffer(zx_bytes, np.float32)
     P = lookback.shape[0]
-    if not np.allclose(lookback, np.round(lookback)):
-        raise ValueError(
-            "fused_pairs_sweep lookbacks are bar counts and must be "
-            "integral; got non-integer values")
-    windows = np.unique(np.round(lookback)).astype(np.float32)
-    W = windows.shape[0]
+    windows = _distinct_windows(lookback, "lookbacks")
     # The one-hot contracts over W as the *sublane* dim of both operands
     # (tables are (W, T)-major), so W pads to 8, not 128.
-    W_pad = _round_up(max(W, 1), 8)
+    W_pad = _round_up(max(windows.shape[0], 1), 8)
     P_pad = _round_up(max(P, 1), _LANES)
-
-    oh = np.zeros((W_pad, P_pad), np.float32)
-    idx = np.searchsorted(windows, np.round(lookback).astype(np.float32))
-    oh[idx, np.arange(P)] = 1.0
+    oh = _window_onehot(windows, lookback, W_pad, P_pad)
 
     k_lanes = np.full((1, P_pad), np.float32(np.inf))
     k_lanes[0, :P] = z_entry      # padded lanes never enter (z_entry = +inf)
@@ -805,29 +814,18 @@ def _grid_setup(fast_bytes: bytes, slow_bytes: bytes):
     fast = np.frombuffer(fast_bytes, np.float32)
     slow = np.frombuffer(slow_bytes, np.float32)
     P = fast.shape[0]
-    both = np.concatenate([fast, slow])
-    if not np.allclose(both, np.round(both)):
-        raise ValueError(
-            "fused_sma_sweep windows are bar counts and must be integral; "
-            f"got non-integer values "
-            f"(e.g. {both[~np.isclose(both, np.round(both))][0]})")
-    windows = np.unique(np.round(both)).astype(np.float32)
-    W = windows.shape[0]
-    W_pad = _round_up(max(W, 1), _LANES)
+    windows = _distinct_windows(np.concatenate([fast, slow]), "windows")
+    # The SMA table keeps its (T, W)-major layout, so W pads to 128 lanes
+    # here (the headline grid's ~120 distinct windows fill it anyway).
+    W_pad = _round_up(max(windows.shape[0], 1), _LANES)
     P_pad = _round_up(max(P, 1), _LANES)
-
-    def onehot(vals):
-        oh = np.zeros((W_pad, P_pad), np.float32)
-        # Search with the same rounding used to build `windows`, or a value
-        # like 200.001 (passes the integrality tolerance) lands one row off.
-        idx = np.searchsorted(windows, np.round(vals).astype(np.float32))
-        oh[idx, np.arange(P)] = 1.0
-        return jnp.asarray(oh)
 
     warm = np.zeros((1, P_pad), np.float32)
     warm[0, :P] = np.maximum(fast, slow)
     warm[0, P:] = 1.0
-    return (tuple(int(w) for w in windows), onehot(fast), onehot(slow),
+    return (tuple(int(w) for w in windows),
+            jnp.asarray(_window_onehot(windows, fast, W_pad, P_pad)),
+            jnp.asarray(_window_onehot(windows, slow, W_pad, P_pad)),
             jnp.asarray(warm))
 
 
@@ -1052,16 +1050,10 @@ def _single_window_grid_setup(vals_bytes: bytes, warm_offset: float,
     strategies (momentum, donchian). ``warm = value + warm_offset``."""
     vals = np.frombuffer(vals_bytes, np.float32)
     P = vals.shape[0]
-    if not np.allclose(vals, np.round(vals)):
-        raise ValueError(
-            f"fused sweep {what} are bar counts and must be integral; "
-            "got non-integer values")
-    windows = np.unique(np.round(vals)).astype(np.float32)
+    windows = _distinct_windows(vals, what)
     W_pad = _round_up(max(windows.shape[0], 1), 8)
     P_pad = _round_up(max(P, 1), _LANES)
-    oh = np.zeros((W_pad, P_pad), np.float32)
-    idx = np.searchsorted(windows, np.round(vals).astype(np.float32))
-    oh[idx, np.arange(P)] = 1.0
+    oh = _window_onehot(windows, vals, W_pad, P_pad)
     warm = np.ones((1, P_pad), np.float32)
     warm[0, :P] = vals + warm_offset
     return (tuple(int(w) for w in windows), jnp.asarray(oh),

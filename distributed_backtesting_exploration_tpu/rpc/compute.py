@@ -155,6 +155,8 @@ class JaxSweepBackend:
         if set(grid) != axes:
             return False
         wins = np.concatenate([grid[a] for a in window_axes])
+        if wins.size == 0:
+            return False   # empty grid: route to generic, don't crash
         if not np.allclose(wins, np.round(wins)):
             return False
         if np.unique(np.round(wins)).size > cls._FUSED_MAX_WINDOWS:

@@ -720,6 +720,8 @@ def build_dispatcher(args) -> Dispatcher:
 
 
 def main(argv=None) -> None:
+    import signal
+
     args = make_parser().parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
@@ -727,13 +729,19 @@ def main(argv=None) -> None:
     dispatcher = build_dispatcher(args)
     queue = dispatcher.queue
     server = DispatcherServer(dispatcher, bind=args.bind).start()
+    # Graceful shutdown on SIGTERM too (k8s/systemd stop), not just ^C —
+    # the journal is append-only so either way nothing is lost, but a clean
+    # stop flushes in-flight RPCs (the reference had no shutdown path at
+    # all; its own limitations list, reference README.md:75-88).
+    stopping = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stopping.set())
     try:
-        while True:
-            time.sleep(5)
+        while not stopping.wait(timeout=5):
             log.info("stats: %s", queue.stats())
     except KeyboardInterrupt:
-        log.info("shutting down")
-        server.stop()
+        pass
+    log.info("shutting down")
+    server.stop()
 
 
 if __name__ == "__main__":

@@ -456,6 +456,14 @@ def main(argv=None) -> None:
                     poll_interval_s=args.poll_s,
                     status_interval_s=args.status_s,
                     jobs_per_chip=args.jobs_per_chip)
+    # SIGTERM/SIGINT -> worker.stop(): run() then drains the compute queue
+    # and flushes completions before exiting (_shutdown), so a fleet
+    # scale-down loses no finished work (the reference worker had no
+    # shutdown path; reference README.md:75-88).
+    import signal
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: worker.stop())
     log.info("worker %s -> %s (backend=%s, chips=%d)",
              worker.worker_id, args.connect, args.backend, backend.chips)
     worker.run(max_idle_polls=args.exit_after_idle)

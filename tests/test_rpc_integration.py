@@ -249,6 +249,35 @@ def test_dead_worker_jobs_requeued_and_finished_by_second_worker():
         srv.stop()
 
 
+def test_three_workers_share_queue_without_double_compute():
+    """Contention: several live workers race the queue; every job completes
+    exactly once (lease discipline + new/dup completion accounting), and
+    the per-worker completion counts sum to the job count."""
+    queue = JobQueue()
+    for rec in synthetic_jobs(30, 32, "sma_crossover", GRID):
+        queue.enqueue(rec)
+    disp, srv = _server(queue)
+    workers = []
+    try:
+        for i in range(3):
+            backend = compute.InstantBackend()
+            w, t = _run_worker(f"localhost:{srv.port}", backend,
+                               worker_id=f"w{i}", jobs_per_chip=2)
+            workers.append((w, t, backend))
+        _wait(lambda: queue.drained, msg="queue drained")
+        for w, t, _ in workers:
+            t.join(timeout=15)
+    finally:
+        srv.stop()
+    s = queue.stats()
+    assert s["jobs_completed"] == 30 and s["jobs_failed"] == 0
+    total = sum(w.jobs_completed for w, _, _ in workers)
+    assert total == 30, f"double-counted completions: {total}"
+    # Every job ran exactly once: the backends' seen-lists are disjoint.
+    seen = [j for _, _, b in workers for j in b.seen]
+    assert len(seen) == len(set(seen)) == 30
+
+
 def test_worker_survives_dispatcher_restart(tmp_path):
     """The reference panics if the server dies mid-completion; ours retries.
 
@@ -288,6 +317,43 @@ def test_worker_survives_dispatcher_restart(tmp_path):
         assert queue2.stats()["jobs_completed"] == len(pending)
     finally:
         srv2.stop()
+
+
+def test_worker_cli_sigterm_graceful_drain():
+    """SIGTERM mid-run: the worker CLI finishes its in-flight batch, flushes
+    completions, and exits 0 (the reference worker had no shutdown path —
+    its own limitations list, reference README.md:75-88)."""
+    import os
+    import signal as signal_mod
+    import subprocess
+    import sys
+
+    queue = JobQueue()
+    for rec in synthetic_jobs(6, 32, "sma_crossover", GRID):
+        queue.enqueue(rec)
+    disp, srv = _server(queue)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "distributed_backtesting_exploration_tpu.rpc.worker",
+             "--connect", f"localhost:{srv.port}", "--backend", "sleep",
+             "--poll-s", "0.02", "--status-s", "0.1"],
+            cwd=repo_root, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        _wait(lambda: queue.stats()["jobs_completed"] >= 1,
+              timeout=60.0, msg="first completion before SIGTERM")
+        proc.send_signal(signal_mod.SIGTERM)
+        out, err = proc.communicate(timeout=60)
+        assert proc.returncode == 0, err[-2000:]
+    finally:
+        srv.stop()
+    s = queue.stats()
+    # At least the pre-signal completion landed, and nothing was lost in a
+    # crash: every non-completed job is either still pending or back on the
+    # queue via its lease (not stuck leased to a dead process forever).
+    assert s["jobs_completed"] >= 1
+    assert s["jobs_completed"] + s["jobs_pending"] + s["jobs_leased"] == 6
 
 
 def test_empty_queue_returns_empty_reply_not_error():
