@@ -4,11 +4,12 @@ Headline workload = the BASELINE.json north star (configs[1]): a 500-ticker
 SMA-crossover sweep over 5 years of daily bars with a 2,000-point
 (fast, slow) grid — 1,000,000 full backtests (indicators, positions, PnL,
 9 summary metrics) per sweep call, via the fused Pallas kernel. The suite
-also measures configs[2]-[4]: fused Bollinger (500 x 1k (window, k)),
-rolling-OLS pairs (1k pairs x 500 (lookback, z_entry)), and walk-forward
-(12 refit windows x param grid), plus an ``e2e`` config that pushes the
-headline workload through a loopback gRPC dispatcher + worker (decode, RPC
-and metric reporting included), printing a per-config line to stderr.
+also measures configs[2]-[4] and the rest of the fused family: Bollinger
+(500 x 1k (window, k)), momentum, Donchian, RSI, MACD, rolling-OLS pairs
+(1k pairs x 500 (lookback, z_entry)), and walk-forward (12 refit windows x
+param grid), plus an ``e2e`` config that pushes the headline workload
+through a loopback gRPC dispatcher + worker (decode, RPC and metric
+reporting included), printing a per-config line to stderr.
 
 Baseline: the reference's worker processes jobs serially at 1 job/sec (its
 compute slot sleeps 1 s per job — reference ``src/worker/process.rs:23``), so
@@ -25,11 +26,11 @@ Prints ONE JSON line to stdout:
     {"metric": ..., "value": N, "unit": "backtests/sec", "vs_baseline": N,
      "configs": {name: rate, ...}}
 
-``--verify`` mode instead runs fused-vs-generic parity for the SMA,
-Bollinger, and pairs kernels ON THE CHIP and prints one JSON line with max
-relative error and the argmax/entry flip rates (the knife-edge MXU caveat —
-plus, for pairs, the banded-tree-sum vs cumsum-difference caveat —
-quantified fresh each round).
+``--verify`` mode instead runs fused-vs-generic parity for every fused
+kernel (SMA, Bollinger, momentum, Donchian, RSI, MACD, pairs) ON THE CHIP
+and prints one JSON line with max relative error and the argmax/entry flip
+rates (the knife-edge MXU caveat — plus, for pairs, the banded-tree-sum vs
+cumsum-difference caveat — quantified fresh each round).
 
 Env overrides (local smoke runs): DBX_BENCH_TICKERS, DBX_BENCH_BARS,
 DBX_BENCH_PARAMS, DBX_BENCH_ITERS, DBX_BENCH_WARMUP, DBX_BENCH_CPU=1 to
@@ -180,6 +181,33 @@ def main():
             run_don, n_tickers * len(dwins), iters=iters, warmup=warmup,
             name="donchian_fused")
 
+    # --- rsi / macd: the EMA-family fused kernels -------------------------
+    if enabled("rsi_fused"):
+        rp = np.tile(np.arange(5, 55, dtype=np.float32),
+                     max(min(n_params, 1000) // 50, 1))
+        rb = np.repeat(np.linspace(10, 30, max(min(n_params, 1000) // 50, 1)
+                                   ).astype(np.float32), 50)
+
+        def run_rsi():
+            return fused.fused_rsi_sweep(panel.close, rp, rb, cost=1e-3)
+
+        rates["rsi_fused"] = _measure(
+            run_rsi, n_tickers * len(rp), iters=iters, warmup=warmup,
+            name="rsi_fused")
+
+    if enabled("macd_fused"):
+        mf = np.repeat(np.arange(5, 15, dtype=np.float32), 100)
+        ms = np.tile(np.repeat(np.arange(20, 60, 4, dtype=np.float32), 10),
+                     10)
+        mg = np.tile(np.arange(5, 15, dtype=np.float32), 100)
+
+        def run_macd():
+            return fused.fused_macd_sweep(panel.close, mf, ms, mg, cost=1e-3)
+
+        rates["macd_fused"] = _measure(
+            run_macd, n_tickers * len(mf), iters=iters, warmup=warmup,
+            name="macd_fused")
+
     # --- configs[3]: rolling-OLS pairs (lookback, z_entry) ----------------
     if enabled("pairs"):
         n_pairs = min(2 * n_tickers, 1000)
@@ -298,7 +326,8 @@ def main():
 
     if not rates:
         known = ("sma_fused, bollinger_fused, momentum_fused, "
-                 "donchian_fused, pairs, e2e, walkforward")
+                 "donchian_fused, rsi_fused, macd_fused, pairs, e2e, "
+                 "walkforward")
         sys.exit(f"bench: no configs ran — DBX_BENCH_CONFIGS={only} matched "
                  f"nothing (known: {known})")
     # The headline is the north-star config when it ran; otherwise label the
@@ -386,6 +415,25 @@ def verify():
                 window=jnp.arange(10, 90, 2, dtype=jnp.float32)),
             lambda g: fused.fused_donchian_sweep(
                 panel.close, np.asarray(g["window"]), cost=1e-3),
+        ),
+        "rsi": strat_case(
+            "rsi",
+            sweep.product_grid(
+                period=jnp.arange(5, 45, 2, dtype=jnp.float32),
+                band=jnp.linspace(10.0, 30.0, 4).astype(jnp.float32)),
+            lambda g: fused.fused_rsi_sweep(
+                panel.close, np.asarray(g["period"]), np.asarray(g["band"]),
+                cost=1e-3),
+        ),
+        "macd": strat_case(
+            "macd",
+            sweep.product_grid(
+                fast=jnp.arange(5, 13, dtype=jnp.float32),
+                slow=jnp.arange(20, 52, 8, dtype=jnp.float32),
+                signal=jnp.asarray([5.0, 9.0], jnp.float32)),
+            lambda g: fused.fused_macd_sweep(
+                panel.close, np.asarray(g["fast"]), np.asarray(g["slow"]),
+                np.asarray(g["signal"]), cost=1e-3),
         ),
         "pairs": (
             # Chunked generic reference: the unchunked vmap materializes the

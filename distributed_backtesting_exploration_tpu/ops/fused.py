@@ -1058,3 +1058,278 @@ def _single_window_grid_setup(vals_bytes: bytes, warm_offset: float,
     warm[0, :P] = vals + warm_offset
     return (tuple(int(w) for w in windows), jnp.asarray(oh),
             jnp.asarray(warm))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
+                     "ppy", "interpret"))
+def _fused_rsi_call(close, onehot_p, band_lanes, warm, t_real, *,
+                    windows: tuple, T_pad: int, W_pad: int, P_real: int,
+                    T_real: int | None, cost: float, ppy: int,
+                    interpret: bool):
+    """RSI table prep + the *Bollinger* kernel: ``rsi - 50`` is just another
+    z-score feeding the shared band machine (enter beyond ±band, exit at the
+    centerline), so the whole kernel is reused verbatim with z_exit=0.
+
+    Each distinct period's Wilder EMA runs as the library associative scan
+    (``rolling.ema`` with static alpha = 1/period) over ``(N, T_pad)`` —
+    ``models.rsi.rsi_index``'s exact formula per window.
+    """
+    from . import rolling as rolling_mod
+
+    close_p = _pad_last(close, T_pad)
+    N = close.shape[0]
+    diff = jnp.diff(close_p, axis=-1, prepend=close_p[..., :1])
+    gains = jnp.maximum(diff, 0.0)
+    losses = jnp.maximum(-diff, 0.0)
+    # Per-distinct-period scans as a static python loop: a single batched
+    # (W, N, T_pad) scan was also tried and measured *slower* on chip (the
+    # broadcast + transpose cost more than the extra scan launches).
+    rows = []
+    for p_ in windows:
+        alpha = 1.0 / float(p_)
+        ag = rolling_mod.ema(gains, alpha=alpha)
+        al = rolling_mod.ema(losses, alpha=alpha)
+        rsi = 100.0 - 100.0 / (1.0 + ag / (al + 1e-12))
+        rows.append(rsi - 50.0)
+    z_tbl = jnp.stack(rows, axis=1)                              # (N,W,T_pad)
+    if W_pad > len(windows):
+        z_tbl = jnp.concatenate(
+            [z_tbl, jnp.zeros((N, W_pad - len(windows), T_pad),
+                              jnp.float32)], axis=1)
+
+    P_pad = band_lanes.shape[1]
+    n_blocks = P_pad // _LANES
+    kernel = functools.partial(_boll_kernel, cost=cost, ppy=ppy,
+                               z_exit=0.0, T_real=T_real)
+    out = pl.pallas_call(
+        kernel,
+        grid=(N, n_blocks),
+        in_specs=[
+            pl.BlockSpec((1, T_pad, 1), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, W_pad, T_pad), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((W_pad, _LANES), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ] + _tr_specs(T_real),
+        out_specs=pl.BlockSpec(
+            (1, 1, _METRIC_ROWS, _LANES), lambda i, j: (i, j, 0, 0),
+            memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(
+            (N, n_blocks, _METRIC_ROWS, _LANES), jnp.float32),
+        interpret=interpret,
+    )(_rets3(close_p), z_tbl, onehot_p, band_lanes, warm,
+      *_tr_args(t_real, T_real))
+    return Metrics(*(
+        jnp.reshape(out[:, :, k, :], (N, P_pad))[:, :P_real]
+        for k in range(9)))
+
+
+def fused_rsi_sweep(close, period, band, *, t_real=None, cost: float = 0.0,
+                    periods_per_year: int = 252,
+                    interpret: bool | None = None) -> Metrics:
+    """Fused RSI mean-reversion sweep: ``(N, T)`` closes x ``(P,)`` lanes.
+
+    ``period``/``band`` are flat per-combo arrays (:func:`product_grid`
+    order); periods must be integral bar counts. Matches
+    ``run_sweep(..., "rsi")`` (``models.rsi``) to f32 tolerance.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    close = jnp.asarray(close, jnp.float32)
+    period = np.asarray(period)
+    band = np.asarray(band, np.float32)
+    T = close.shape[1]
+    windows, onehot_p, band_lanes, warm = _rsi_grid_setup(
+        period.astype(np.float32).tobytes(), band.tobytes())
+    return _fused_rsi_call(close, onehot_p, band_lanes, warm,
+                           _t_real_col(t_real, close),
+                           windows=windows, T_pad=_round_up(T, 128),
+                           W_pad=onehot_p.shape[0], P_real=period.shape[0],
+                           T_real=T if t_real is None else None,
+                           cost=float(cost), ppy=int(periods_per_year),
+                           interpret=bool(interpret))
+
+
+@functools.lru_cache(maxsize=4)
+def _rsi_grid_setup(period_bytes: bytes, band_bytes: bytes):
+    """Distinct periods + one-hot/band/warmup lanes (warm = period + 1)."""
+    period = np.frombuffer(period_bytes, np.float32)
+    band = np.frombuffer(band_bytes, np.float32)
+    P = period.shape[0]
+    windows = _distinct_windows(period, "periods")
+    W_pad = _round_up(max(windows.shape[0], 1), 8)
+    P_pad = _round_up(max(P, 1), _LANES)
+    oh = _window_onehot(windows, period, W_pad, P_pad)
+    band_lanes = np.full((1, P_pad), np.float32(np.inf))
+    band_lanes[0, :P] = band      # padded lanes never enter (band = +inf)
+    warm = np.ones((1, P_pad), np.float32)
+    warm[0, :P] = period + 1.0    # models.rsi: valid_mask(T, period + 1)
+    return (tuple(int(w) for w in windows), jnp.asarray(oh),
+            jnp.asarray(band_lanes), jnp.asarray(warm))
+
+
+def _ema_ladder(x, a):
+    """Per-lane EMA over the sublane axis: ``y[t] = (1-a)*y[t-1] + a*x[t]``
+    with ``y[0] = x[0]`` and a per-lane decay ``a`` ((1, 128) or scalar).
+
+    The first-order recurrence is associative under
+    ``(A2,B2) ∘ (A1,B1) = (A1*A2, A2*B1 + B2)``, so it evaluates as a
+    log-depth doubling ladder — the in-kernel analogue of
+    ``ops.rolling.ema``'s associative_scan, needed here because the decay
+    varies per *lane* (each param lane has its own span).
+    """
+    T_pad = x.shape[0]
+    t0 = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0) == 0
+    A = jnp.where(t0, 0.0, jnp.broadcast_to(1.0 - a, x.shape))
+    B = jnp.where(t0, x, a * x)
+    span = 1
+    while span < T_pad:
+        Ae = _shift_down(A, span, 1.0)   # identity element (A=1, B=0)
+        Be = _shift_down(B, span, 0.0)
+        A, B = Ae * A, A * Be + B
+        span *= 2
+    return B
+
+
+def _macd_kernel(r_ref, ema_ref, of_ref, os_ref, asig_ref, warm_ref, *refs,
+                 cost: float, ppy: int, T_real: int | None):
+    """MACD cell: two span-table selections give the macd line; the signal
+    line is a per-lane EMA (decay = 2/(signal_span+1)) evaluated with the
+    in-kernel associative ladder; position = sign(macd - signal)."""
+    tr, out_ref = _unpack_tr(refs, T_real)
+    T_pad = r_ref.shape[1]
+    r = r_ref[0]
+    dn = (((0,), (0,)), ((), ()))
+    hp = jax.lax.Precision.HIGHEST
+    ema_f = jax.lax.dot_general(ema_ref[0], of_ref[:], dn,
+                                preferred_element_type=jnp.float32,
+                                precision=hp)
+    ema_s = jax.lax.dot_general(ema_ref[0], os_ref[:], dn,
+                                preferred_element_type=jnp.float32,
+                                precision=hp)
+    macd = ema_f - ema_s
+    a_sig = asig_ref[0, :][None, :]                  # (1, 128)
+    sig = _ema_ladder(macd, a_sig)
+
+    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, _LANES), 0)
+    warm = warm_ref[0, :][None, :]                   # slow + signal - 1
+    valid = t_idx >= (warm.astype(jnp.int32) - 1)
+    pos = jnp.where(valid, jnp.sign(macd - sig), 0.0)
+    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("spans", "T_pad", "W_pad", "P_real", "T_real", "cost",
+                     "ppy", "interpret"))
+def _fused_macd_call(close, onehot_f, onehot_s, a_sig, warm, t_real, *,
+                     spans: tuple, T_pad: int, W_pad: int, P_real: int,
+                     T_real: int | None, cost: float, ppy: int,
+                     interpret: bool):
+    """Distinct-span EMA table prep + pallas call in one jit."""
+    from . import rolling as rolling_mod
+
+    close_p = _pad_last(close, T_pad)
+    N = close.shape[0]
+    rows = [rolling_mod.ema(close_p, span=float(s)) for s in spans]
+    ema_tbl = jnp.stack(rows, axis=1)                            # (N,W,T_pad)
+    if W_pad > len(spans):
+        ema_tbl = jnp.concatenate(
+            [ema_tbl, jnp.zeros((N, W_pad - len(spans), T_pad),
+                                jnp.float32)], axis=1)
+
+    P_pad = a_sig.shape[1]
+    n_blocks = P_pad // _LANES
+    kernel = functools.partial(_macd_kernel, cost=cost, ppy=ppy,
+                               T_real=T_real)
+    out = pl.pallas_call(
+        kernel,
+        grid=(N, n_blocks),
+        in_specs=[
+            pl.BlockSpec((1, T_pad, 1), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, W_pad, T_pad), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((W_pad, _LANES), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((W_pad, _LANES), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ] + _tr_specs(T_real),
+        out_specs=pl.BlockSpec(
+            (1, 1, _METRIC_ROWS, _LANES), lambda i, j: (i, j, 0, 0),
+            memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(
+            (N, n_blocks, _METRIC_ROWS, _LANES), jnp.float32),
+        interpret=interpret,
+    )(_rets3(close_p), ema_tbl, onehot_f, onehot_s, a_sig, warm,
+      *_tr_args(t_real, T_real))
+    return Metrics(*(
+        jnp.reshape(out[:, :, k, :], (N, P_pad))[:, :P_real]
+        for k in range(9)))
+
+
+def fused_macd_sweep(close, fast, slow, signal, *, t_real=None,
+                     cost: float = 0.0, periods_per_year: int = 252,
+                     interpret: bool | None = None) -> Metrics:
+    """Fused MACD signal-line crossover sweep: ``(N, T)`` x ``(P,)`` lanes.
+
+    ``fast``/``slow``/``signal`` are flat per-combo span arrays
+    (:func:`product_grid` order); spans must be integral. Matches
+    ``run_sweep(..., "macd")`` (``models.macd``) to f32 tolerance: the
+    signal-line EMA runs as an in-kernel associative ladder whose rounding
+    differs slightly from XLA's associative_scan, so a knife-edge
+    macd/signal crossing can resolve differently (rare; same caveat class
+    as the MXU selection matmuls).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    close = jnp.asarray(close, jnp.float32)
+    fast = np.asarray(fast)
+    slow = np.asarray(slow)
+    signal = np.asarray(signal)
+    T = close.shape[1]
+    spans, onehot_f, onehot_s, a_sig, warm = _macd_grid_setup(
+        fast.astype(np.float32).tobytes(),
+        slow.astype(np.float32).tobytes(),
+        signal.astype(np.float32).tobytes())
+    return _fused_macd_call(close, onehot_f, onehot_s, a_sig, warm,
+                            _t_real_col(t_real, close),
+                            spans=spans, T_pad=_round_up(T, 128),
+                            W_pad=onehot_f.shape[0], P_real=fast.shape[0],
+                            T_real=T if t_real is None else None,
+                            cost=float(cost), ppy=int(periods_per_year),
+                            interpret=bool(interpret))
+
+
+@functools.lru_cache(maxsize=4)
+def _macd_grid_setup(fast_bytes: bytes, slow_bytes: bytes,
+                     signal_bytes: bytes):
+    """Distinct spans (fast ∪ slow) + selectors, per-lane signal decay and
+    warmup (= slow + signal - 1, ``models.macd``'s rule)."""
+    fast = np.frombuffer(fast_bytes, np.float32)
+    slow = np.frombuffer(slow_bytes, np.float32)
+    signal = np.frombuffer(signal_bytes, np.float32)
+    P = fast.shape[0]
+    spans = _distinct_windows(np.concatenate([fast, slow]), "spans")
+    _distinct_windows(signal, "signal spans")   # validate integrality only
+    W_pad = _round_up(max(spans.shape[0], 1), 8)
+    P_pad = _round_up(max(P, 1), _LANES)
+    oh_f = _window_onehot(spans, fast, W_pad, P_pad)
+    oh_s = _window_onehot(spans, slow, W_pad, P_pad)
+    a_sig = np.zeros((1, P_pad), np.float32)
+    a_sig[0, :P] = 2.0 / (signal + 1.0)
+    warm = np.ones((1, P_pad), np.float32)
+    warm[0, :P] = slow + signal - 1.0
+    return (tuple(int(s) for s in spans), jnp.asarray(oh_f),
+            jnp.asarray(oh_s), jnp.asarray(a_sig), jnp.asarray(warm))

@@ -100,8 +100,12 @@ class JaxSweepBackend:
     _FUSED_MAX_WINDOWS = 128
 
     # Fused Pallas kernels per strategy: strategy name -> (required grid
-    # axes, window-bearing axes whose values must be integral, runner).
-    # Eligibility and dispatch share this table so they cannot drift.
+    # axes, window-bearing axes whose values must be integral, runner[,
+    # table axes]). "Table axes" are the ones whose distinct values size the
+    # kernel's selection table (defaults to the integral axes); MACD's
+    # signal spans are per-lane decays, not a table dimension, so they must
+    # not count toward the window cap. Eligibility and dispatch share this
+    # table so they cannot drift.
     @staticmethod
     def _run_fused_sma(close, grid, cost, ppy, t_real):
         from ..ops import fused
@@ -130,28 +134,46 @@ class JaxSweepBackend:
             close, np.asarray(grid["window"]), t_real=t_real, cost=cost,
             periods_per_year=ppy)
 
+    @staticmethod
+    def _run_fused_rsi(close, grid, cost, ppy, t_real):
+        from ..ops import fused
+        return fused.fused_rsi_sweep(
+            close, np.asarray(grid["period"]), np.asarray(grid["band"]),
+            t_real=t_real, cost=cost, periods_per_year=ppy)
+
+    @staticmethod
+    def _run_fused_macd(close, grid, cost, ppy, t_real):
+        from ..ops import fused
+        return fused.fused_macd_sweep(
+            close, np.asarray(grid["fast"]), np.asarray(grid["slow"]),
+            np.asarray(grid["signal"]), t_real=t_real, cost=cost,
+            periods_per_year=ppy)
+
     _FUSED_STRATEGIES = {
         "sma_crossover": ({"fast", "slow"}, ("fast", "slow"),
                           _run_fused_sma),
         "bollinger": ({"window", "k"}, ("window",), _run_fused_bollinger),
         "momentum": ({"lookback"}, ("lookback",), _run_fused_momentum),
         "donchian": ({"window"}, ("window",), _run_fused_donchian),
+        "rsi": ({"period", "band"}, ("period",), _run_fused_rsi),
+        "macd": ({"fast", "slow", "signal"}, ("fast", "slow", "signal"),
+                 _run_fused_macd, ("fast", "slow")),
     }
 
     @classmethod
     def _fused_eligible(cls, job, grid, lengths) -> bool:
-        """Jobs with a fused kernel (every _FUSED_STRATEGIES entry:
-        SMA-crossover, Bollinger, momentum, Donchian), integral window
-        grids, and a VMEM-sized working set route to Pallas. Mixed history
-        lengths are fine: the kernels take per-ticker real lengths
-        (round 3 — a ragged fleet used to silently drop to the ~6x-slower
-        generic path)."""
+        """Jobs whose strategy has a ``_FUSED_STRATEGIES`` entry, with
+        integral window grids and a VMEM-sized working set, route to
+        Pallas. Mixed history lengths are fine: the kernels take per-ticker
+        real lengths (round 3 — a ragged fleet used to silently drop to the
+        ~6x-slower generic path)."""
         import numpy as np
 
         spec = cls._FUSED_STRATEGIES.get(job.strategy)
         if spec is None:
             return False
-        axes, window_axes, _ = spec
+        axes, window_axes = spec[0], spec[1]
+        table_axes = spec[3] if len(spec) > 3 else window_axes
         if set(grid) != axes:
             return False
         wins = np.concatenate([grid[a] for a in window_axes])
@@ -159,7 +181,8 @@ class JaxSweepBackend:
             return False   # empty grid: route to generic, don't crash
         if not np.allclose(wins, np.round(wins)):
             return False
-        if np.unique(np.round(wins)).size > cls._FUSED_MAX_WINDOWS:
+        tbl = np.concatenate([grid[a] for a in table_axes])
+        if np.unique(np.round(tbl)).size > cls._FUSED_MAX_WINDOWS:
             return False
         if job.strategy == "donchian":
             # The generic donchian path poisons windows beyond its static

@@ -325,3 +325,88 @@ def test_fused_momentum_donchian_ragged():
 def test_fused_momentum_rejects_non_integer_lookbacks():
     with pytest.raises(ValueError, match="integral"):
         fused.fused_momentum_sweep(jnp.ones((1, 64)), np.asarray([10.5]))
+
+
+def test_fused_rsi_matches_generic():
+    ohlcv = data.synthetic_ohlcv(3, 200, seed=17)
+    panel = type(ohlcv)(*(jnp.asarray(f) for f in ohlcv))
+    grid = sweep.product_grid(
+        period=jnp.asarray([7.0, 14.0, 21.0], jnp.float32),
+        band=jnp.asarray([15.0, 20.0, 25.0], jnp.float32))
+    ref = sweep.jit_sweep(panel, get_strategy("rsi"), dict(grid), cost=1e-3)
+    got = fused.fused_rsi_sweep(panel.close, np.asarray(grid["period"]),
+                                np.asarray(grid["band"]), cost=1e-3)
+    for name in ref._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(got, name)), np.asarray(getattr(ref, name)),
+            rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_fused_rsi_ragged():
+    series = []
+    for i, T in enumerate([150, 200, 97]):
+        one = data.synthetic_ohlcv(1, T, seed=30 + i)
+        series.append(type(one)(*(f[0] for f in one)))
+    batch, lens, mask = data.pad_and_stack(series)
+    panel = type(batch)(*(jnp.asarray(f) for f in batch))
+    grid = sweep.product_grid(period=jnp.asarray([10.0, 14.0], jnp.float32),
+                              band=jnp.asarray([20.0], jnp.float32))
+    ref = sweep.jit_sweep(panel, get_strategy("rsi"), dict(grid), cost=1e-3,
+                          bar_mask=jnp.asarray(mask))
+    got = fused.fused_rsi_sweep(batch.close, np.asarray(grid["period"]),
+                                np.asarray(grid["band"]), t_real=lens,
+                                cost=1e-3)
+    for name in ref._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(got, name)), np.asarray(getattr(ref, name)),
+            rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def _macd_flip_aware_check(got, ref):
+    # The in-kernel signal-EMA ladder rounds differently from XLA's
+    # associative_scan, so a knife-edge macd/signal crossing can resolve
+    # differently and diverge that cell's path; require such flips rare and
+    # everything else tight (same discipline as the pairs kernel).
+    flipped = np.zeros_like(np.asarray(got.turnover), dtype=bool)
+    for name in ref._fields:
+        a, b = np.asarray(getattr(got, name)), np.asarray(getattr(ref, name))
+        flipped |= np.abs(a - b) > (0.01 + 0.01 * np.abs(b))
+    assert int(flipped.sum()) <= max(1, int(0.01 * flipped.size)), (
+        f"{int(flipped.sum())}/{flipped.size} flips")
+    for name in ref._fields:
+        a = np.asarray(getattr(got, name))[~flipped]
+        b = np.asarray(getattr(ref, name))[~flipped]
+        np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-4, err_msg=name)
+
+
+def test_fused_macd_matches_generic():
+    ohlcv = data.synthetic_ohlcv(3, 200, seed=19)
+    panel = type(ohlcv)(*(jnp.asarray(f) for f in ohlcv))
+    grid = sweep.product_grid(
+        fast=jnp.asarray([8.0, 12.0], jnp.float32),
+        slow=jnp.asarray([26.0, 35.0], jnp.float32),
+        signal=jnp.asarray([9.0, 5.0], jnp.float32))
+    ref = sweep.jit_sweep(panel, get_strategy("macd"), dict(grid), cost=1e-3)
+    got = fused.fused_macd_sweep(
+        panel.close, np.asarray(grid["fast"]), np.asarray(grid["slow"]),
+        np.asarray(grid["signal"]), cost=1e-3)
+    _macd_flip_aware_check(got, ref)
+
+
+def test_fused_macd_ragged():
+    series = []
+    for i, T in enumerate([150, 200, 97]):
+        one = data.synthetic_ohlcv(1, T, seed=40 + i)
+        series.append(type(one)(*(f[0] for f in one)))
+    batch, lens, mask = data.pad_and_stack(series)
+    panel = type(batch)(*(jnp.asarray(f) for f in batch))
+    grid = sweep.product_grid(
+        fast=jnp.asarray([8.0, 12.0], jnp.float32),
+        slow=jnp.asarray([26.0], jnp.float32),
+        signal=jnp.asarray([9.0], jnp.float32))
+    ref = sweep.jit_sweep(panel, get_strategy("macd"), dict(grid), cost=1e-3,
+                          bar_mask=jnp.asarray(mask))
+    got = fused.fused_macd_sweep(
+        batch.close, np.asarray(grid["fast"]), np.asarray(grid["slow"]),
+        np.asarray(grid["signal"]), t_real=lens, cost=1e-3)
+    _macd_flip_aware_check(got, ref)
