@@ -183,10 +183,13 @@ def main():
 
     # --- rsi / macd: the EMA-family fused kernels -------------------------
     if enabled("rsi_fused"):
-        rp = np.tile(np.arange(5, 55, dtype=np.float32),
-                     max(min(n_params, 1000) // 50, 1))
-        rb = np.repeat(np.linspace(10, 30, max(min(n_params, 1000) // 50, 1)
-                                   ).astype(np.float32), 50)
+        # 25 distinct periods (not 50): each distinct period unrolls an
+        # associative EMA scan in the prep, and XLA compile time scales with
+        # the count — the proxy backend cannot persistently cache compiles.
+        rp = np.tile(np.arange(5, 55, 2, dtype=np.float32),
+                     max(min(n_params, 1000) // 25, 1))
+        rb = np.repeat(np.linspace(10, 30, max(min(n_params, 1000) // 25, 1)
+                                   ).astype(np.float32), 25)
 
         def run_rsi():
             return fused.fused_rsi_sweep(panel.close, rp, rb, cost=1e-3)
