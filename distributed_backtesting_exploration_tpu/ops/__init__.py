@@ -39,3 +39,12 @@ from .metrics import (  # noqa: F401
     Metrics,
 )
 from .signals import band_hysteresis  # noqa: F401
+from .fused import (  # noqa: F401
+    fused_sma_sweep,
+    fused_bollinger_sweep,
+    fused_momentum_sweep,
+    fused_donchian_sweep,
+    fused_rsi_sweep,
+    fused_macd_sweep,
+    fused_pairs_sweep,
+)
