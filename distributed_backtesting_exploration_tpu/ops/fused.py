@@ -215,15 +215,20 @@ def _kernel(r_ref, sma_ref, of_ref, os_ref, warm_ref, *refs,
     # Per-lane window selection as MXU contractions. HIGHEST precision: the
     # default bf16 MXU pass truncates price-level SMAs enough to flip
     # sign(fast - slow) near crossovers.
-    f = jnp.dot(sma, of_ref[:], preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST)
-    s = jnp.dot(sma, os_ref[:], preferred_element_type=jnp.float32,
+    # ONE selection matmul on the DIFFERENCE one-hot (+1 at the fast row,
+    # -1 at the slow row): each lane's contraction has exactly two nonzero
+    # terms, so d == sma_fast - sma_slow and sign(d) is the crossover —
+    # half the MXU work of selecting f and s separately. HIGHEST precision:
+    # the default bf16 pass truncates price-level SMAs enough to flip
+    # sign(d) near crossovers.
+    d = jnp.dot(sma, of_ref[:] - os_ref[:],
+                preferred_element_type=jnp.float32,
                 precision=jax.lax.Precision.HIGHEST)
 
     t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, _LANES), 0)
     warm = warm_ref[0, :][None, :]               # (1, 128) max(fast, slow)
     valid = t_idx >= (warm.astype(jnp.int32) - 1)
-    pos = jnp.where(valid, jnp.sign(f - s), 0.0)
+    pos = jnp.where(valid, jnp.sign(d), 0.0)
     out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
 
 
@@ -1207,14 +1212,11 @@ def _macd_kernel(r_ref, ema_ref, of_ref, os_ref, asig_ref, warm_ref, *refs,
     T_pad = r_ref.shape[1]
     r = r_ref[0]
     dn = (((0,), (0,)), ((), ()))
-    hp = jax.lax.Precision.HIGHEST
-    ema_f = jax.lax.dot_general(ema_ref[0], of_ref[:], dn,
-                                preferred_element_type=jnp.float32,
-                                precision=hp)
-    ema_s = jax.lax.dot_general(ema_ref[0], os_ref[:], dn,
-                                preferred_element_type=jnp.float32,
-                                precision=hp)
-    macd = ema_f - ema_s
+    # Difference one-hot (+1 fast row, -1 slow row): one matmul yields the
+    # macd line directly — same trick as the SMA kernel, half the MXU work.
+    macd = jax.lax.dot_general(ema_ref[0], of_ref[:] - os_ref[:], dn,
+                               preferred_element_type=jnp.float32,
+                               precision=jax.lax.Precision.HIGHEST)
     a_sig = asig_ref[0, :][None, :]                  # (1, 128)
     sig = _ema_ladder(macd, a_sig)
 
