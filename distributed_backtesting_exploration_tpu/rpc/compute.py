@@ -50,6 +50,32 @@ class ComputeBackend(Protocol):
     # (reference src/worker/process.rs:21-25).
 
 
+def _stack_close_ragged(series_list, t_max: int) -> np.ndarray:
+    """Close-only ragged stack with repeat-last padding to ``t_max`` bars.
+
+    Repeat-last padding is load-bearing: pad bars earn exactly zero return
+    and hold the final position, so the kernels' reductions over the padded
+    width equal the unpadded ones (see ops.fused). Shared by the
+    single-asset and pairs submit paths so the discipline cannot diverge.
+    """
+    out = np.empty((len(series_list), t_max), np.float32)
+    for i, s in enumerate(series_list):
+        a = np.asarray(s.close, np.float32)
+        out[i, :a.shape[0]] = a
+        out[i, a.shape[0]:] = a[-1]
+    return out
+
+
+def _start_result_copy(m):
+    """Stack the 9 metric fields on device and begin the async d2h copy."""
+    stacked = _stack_metrics(*m)
+    try:
+        stacked.copy_to_host_async()
+    except AttributeError:
+        pass   # non-jax array (already host-resident)
+    return stacked
+
+
 _STACK_METRICS_CACHE: dict = {}
 
 
@@ -224,13 +250,17 @@ class JaxSweepBackend:
             grid = wire.grid_from_proto(job.grid)
             key = (job.strategy,
                    tuple(sorted((k, v.tobytes()) for k, v in grid.items())),
-                   len(job.ohlcv).bit_length(), job.cost,
-                   job.periods_per_year)
+                   len(job.ohlcv).bit_length(),
+                   len(job.ohlcv2).bit_length(),   # 0 for single-asset jobs
+                   job.cost, job.periods_per_year)
             groups.setdefault(key, []).append(job)
 
         pending = []
         for group in groups.values():
             t0 = time.perf_counter()
+            if group[0].strategy == "pairs":
+                pending.append(self._submit_pairs_group(group, t0))
+                continue
             series = [data_mod.from_wire_bytes(j.ohlcv) for j in group]
             lengths = [s.n_bars for s in series]
             # JobSpec.grid carries per-parameter AXES; the cartesian product
@@ -250,16 +280,9 @@ class JaxSweepBackend:
                     close = np.stack([np.asarray(s.close) for s in series])
                     t_real = None
                 else:
-                    # Close-only ragged stack (pad_and_stack would also pad
-                    # the four unused fields — wasted memcpy on the hot
-                    # dispatch path). Repeat-last padding keeps the kernels'
-                    # zero-return pad discipline.
-                    t_max = int(max(lengths))
-                    close = np.empty((len(series), t_max), np.float32)
-                    for i, s in enumerate(series):
-                        a = np.asarray(s.close, np.float32)
-                        close[i, :a.shape[0]] = a
-                        close[i, a.shape[0]:] = a[-1]
+                    # Close-only stack (pad_and_stack would also pad the
+                    # four unused fields — wasted memcpy on the hot path).
+                    close = _stack_close_ragged(series, int(max(lengths)))
                     t_real = np.asarray(lengths, np.int32)
                 runner = self._FUSED_STRATEGIES[group[0].strategy][2]
                 m = runner(close, grid, group[0].cost, ppy, t_real)
@@ -275,13 +298,87 @@ class JaxSweepBackend:
                         **kwargs)
                 else:
                     m = sweep_mod.jit_sweep(panel, strategy, grid, **kwargs)
-            stacked = _stack_metrics(*m)          # (9, n, P) device array
-            try:
-                stacked.copy_to_host_async()
-            except AttributeError:
-                pass   # non-jax array (already host-resident)
-            pending.append((group, stacked, t0))
+            pending.append((group, _start_result_copy(m), t0))
         return pending
+
+    def _submit_pairs_group(self, group, t0):
+        """Two-legged jobs: stack both legs, run the pairs sweep.
+
+        The fused pairs kernel takes per-pair ragged lengths; on CPU the
+        generic path has no mask support, so ragged groups fall back to a
+        per-job loop (grouping already buckets lengths by power of two, so
+        this is rare and bounded).
+        """
+        import logging
+
+        import jax.numpy as jnp
+
+        from ..models import pairs as pairs_mod
+        from ..parallel import sweep as sweep_mod
+
+        log = logging.getLogger("dbx.compute")
+        # Per-job validation at decode time: a malformed pair (missing
+        # second leg, or legs of different lengths — padding one leg would
+        # fabricate bars the PnL treats as real) is completed with an EMPTY
+        # metric block and a loud error rather than poisoning the whole
+        # co-batched group or looping forever through lease requeues.
+        good, bad = [], []
+        for j in group:
+            if not j.ohlcv2:
+                log.error("pairs job %s has no second leg (ohlcv2); "
+                          "completing with empty metrics", j.id)
+                bad.append(j)
+                continue
+            y = data_mod.from_wire_bytes(j.ohlcv)
+            x = data_mod.from_wire_bytes(j.ohlcv2)
+            if y.n_bars != x.n_bars:
+                log.error("pairs job %s legs differ in length (%d vs %d); "
+                          "completing with empty metrics", j.id, y.n_bars,
+                          x.n_bars)
+                bad.append(j)
+                continue
+            good.append((j, y, x))
+        if not good:
+            return (bad, None, t0)
+        group = [j for j, _, _ in good]
+        ys = [y for _, y, _ in good]
+        xs = [x for _, _, x in good]
+        axes = wire.grid_from_proto(group[0].grid)
+        grid = sweep_mod.product_grid(**axes)
+        ppy = group[0].periods_per_year or 252
+        cost = group[0].cost
+        lens = np.asarray([y.n_bars for y in ys], np.int32)
+        t_max = int(lens.max())
+        y_close = _stack_close_ragged(ys, t_max)
+        x_close = _stack_close_ragged(xs, t_max)
+        uniform = len(set(int(v) for v in lens)) == 1
+        lb = np.asarray(grid.get("lookback", np.empty(0)))
+        fused_ok = (lb.size > 0 and np.allclose(lb, np.round(lb))
+                    and np.unique(np.round(lb)).size
+                    <= self._FUSED_MAX_WINDOWS
+                    and t_max <= self._FUSED_MAX_BARS)
+        if self.use_fused and fused_ok:
+            from ..ops import fused
+            m = fused.fused_pairs_sweep(
+                y_close, x_close, np.asarray(grid["lookback"]),
+                np.asarray(grid["z_entry"]),
+                z_exit=np.asarray(grid["z_exit"])
+                if "z_exit" in grid else 0.0,
+                t_real=None if uniform else lens, cost=cost,
+                periods_per_year=ppy)
+        elif uniform:
+            m = pairs_mod.run_pairs_sweep(
+                jnp.asarray(y_close), jnp.asarray(x_close), dict(grid),
+                cost=cost, periods_per_year=ppy)
+        else:
+            rows = [pairs_mod.run_pairs_sweep(
+                jnp.asarray(y_close[i:i + 1, :int(lens[i])]),
+                jnp.asarray(x_close[i:i + 1, :int(lens[i])]), dict(grid),
+                cost=cost, periods_per_year=ppy)
+                for i in range(len(group))]
+            m = type(rows[0])(*(jnp.concatenate(f, axis=0)
+                                for f in zip(*rows)))
+        return (list(group) + bad, _start_result_copy(m), t0)
 
     def collect(self, pending) -> list[Completion]:
         """Block for a submitted batch's results and pack completions."""
@@ -289,13 +386,17 @@ class JaxSweepBackend:
 
         out: list[Completion] = []
         for group, stacked, t0 in pending:
-            host = np.asarray(stacked)            # joins the async copy
+            host = None if stacked is None else np.asarray(stacked)
             elapsed = time.perf_counter() - t0
-            per_job = elapsed / len(group)
+            per_job = elapsed / max(len(group), 1)
+            n_rows = 0 if host is None else host.shape[1]
             for i, job in enumerate(group):
-                row = Metrics(*(host[k, i] for k in range(9)))
-                out.append(Completion(
-                    job.id, wire.metrics_to_bytes(row), per_job))
+                if i < n_rows:
+                    row = Metrics(*(host[k, i] for k in range(9)))
+                    blob = wire.metrics_to_bytes(row)
+                else:
+                    blob = b""   # validated-bad job: complete, no result
+                out.append(Completion(job.id, blob, per_job))
         return out
 
     def process(self, jobs) -> list[Completion]:

@@ -110,6 +110,7 @@ class JobRecord:
     periods_per_year: int = 252
     path: str | None = None       # file-backed source (CSV or DBX1)
     ohlcv: bytes | None = None    # inline source (already-encoded DBX1)
+    ohlcv2: bytes | None = None   # second leg for two-legged strategies
 
     @property
     def combos(self) -> int:
@@ -128,18 +129,22 @@ class JobRecord:
             # Inline payloads must be journaled too, or a restart would
             # restore a job with nothing to dispatch.
             rec["ohlcv_b64"] = base64.b64encode(self.ohlcv).decode("ascii")
+        if self.ohlcv2 is not None:
+            rec["ohlcv2_b64"] = base64.b64encode(self.ohlcv2).decode("ascii")
         return rec
 
     @staticmethod
     def from_journal(rec: dict) -> "JobRecord":
         ohlcv = rec.get("ohlcv_b64")
+        ohlcv2 = rec.get("ohlcv2_b64")
         return JobRecord(
             id=rec["id"], strategy=rec["strategy"],
             grid={k: np.asarray(v, np.float32)
                   for k, v in rec.get("grid", {}).items()},
             cost=rec.get("cost", 0.0), periods_per_year=rec.get("ppy", 252),
             path=rec.get("path"),
-            ohlcv=base64.b64decode(ohlcv) if ohlcv else None)
+            ohlcv=base64.b64decode(ohlcv) if ohlcv else None,
+            ohlcv2=base64.b64decode(ohlcv2) if ohlcv2 else None)
 
 
 @dataclasses.dataclass
@@ -478,7 +483,8 @@ class Dispatcher(service.DispatcherServicer):
             reply.jobs.append(pb.JobSpec(
                 id=rec.id, strategy=rec.strategy, ohlcv=payload,
                 grid=wire.grid_to_proto(rec.grid), cost=rec.cost,
-                periods_per_year=rec.periods_per_year))
+                periods_per_year=rec.periods_per_year,
+                ohlcv2=rec.ohlcv2 or b""))
         if taken:
             log.info("dispatched %d jobs to %s", len(taken), request.worker_id)
         return reply
@@ -633,14 +639,23 @@ def jobs_from_paths(paths, strategy: str, grid, *, cost: float = 0.0,
 
 def synthetic_jobs(n: int, n_bars: int, strategy: str, grid, *,
                    cost: float = 0.0, seed: int = 0) -> list[JobRecord]:
-    """Inline synthetic-OHLCV jobs (benchmarks / demos without data files)."""
-    batch = data_mod.synthetic_ohlcv(n, n_bars, seed=seed)
+    """Inline synthetic-OHLCV jobs (benchmarks / demos without data files).
+
+    ``strategy="pairs"`` jobs carry two legs (``ohlcv`` = y, ``ohlcv2`` = x).
+    """
+    two_legged = strategy == "pairs"
+    batch = data_mod.synthetic_ohlcv(n * (2 if two_legged else 1), n_bars,
+                                     seed=seed)
     out = []
     for i in range(n):
         series = type(batch)(*(np.asarray(f[i]) for f in batch))
+        ohlcv2 = None
+        if two_legged:
+            leg_x = type(batch)(*(np.asarray(f[n + i]) for f in batch))
+            ohlcv2 = data_mod.to_wire_bytes(leg_x)
         out.append(JobRecord(
             id=str(uuid.uuid4()), strategy=strategy, grid=grid, cost=cost,
-            ohlcv=data_mod.to_wire_bytes(series)))
+            ohlcv=data_mod.to_wire_bytes(series), ohlcv2=ohlcv2))
     return out
 
 
@@ -681,6 +696,12 @@ def build_dispatcher(args) -> Dispatcher:
         log.info("restored %d pending jobs from journal", restored)
 
     grid = parse_grid(args.grid)
+    if args.data and args.strategy == "pairs":
+        raise SystemExit(
+            "--data with --strategy pairs is not supported: file-backed "
+            "jobs carry one instrument; pairs jobs need two legs "
+            "(use --synthetic, or enqueue JobRecords with ohlcv/ohlcv2 "
+            "programmatically)")
     if args.data:
         paths = sorted(glob_mod.glob(args.data))
         new_paths = [p for p in paths if p not in queue.known_paths]

@@ -126,6 +126,129 @@ def test_jax_backend_fused_ragged_batch_matches_direct():
                 err_msg=name)
 
 
+def test_pairs_jobs_over_the_wire_match_direct_sweep():
+    """Two-legged pairs jobs travel the full dispatch loop (JobSpec.ohlcv2,
+    round 3) and the recorded metrics match a direct run_pairs_sweep — the
+    distributed plane covers every strategy family, including BASELINE
+    configs[3]."""
+    import jax.numpy as jnp
+
+    from distributed_backtesting_exploration_tpu.models import pairs
+    from distributed_backtesting_exploration_tpu.parallel import sweep
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    grid = {"lookback": np.asarray([8.0, 10.0], np.float32),
+            "z_entry": np.asarray([1.0, 2.0], np.float32)}
+    queue = JobQueue()
+    jobs = synthetic_jobs(3, 96, "pairs", grid, cost=1e-3, seed=9)
+    for rec in jobs:
+        queue.enqueue(rec)
+    disp, srv = _server(queue)
+    try:
+        w, t = _run_worker(f"localhost:{srv.port}",
+                           compute.JaxSweepBackend())
+        _wait(lambda: queue.drained, timeout=120.0, msg="queue drained")
+        t.join(timeout=10)
+    finally:
+        srv.stop()
+    assert queue.stats()["jobs_completed"] == 3
+
+    for rec in jobs:
+        y = data.from_wire_bytes(rec.ohlcv)
+        x = data.from_wire_bytes(rec.ohlcv2)
+        canonical_axes = dict(sorted(rec.grid.items()))
+        want = pairs.run_pairs_sweep(
+            jnp.asarray(y.close)[None, :], jnp.asarray(x.close)[None, :],
+            sweep.product_grid(**canonical_axes), cost=1e-3)
+        got = wire.metrics_from_bytes(disp.results[rec.id])
+        for name in want._fields:
+            np.testing.assert_allclose(
+                np.asarray(getattr(got, name)),
+                np.asarray(getattr(want, name))[0], rtol=2e-4, atol=2e-5,
+                err_msg=name)
+
+
+def test_pairs_jobs_fused_backend_path():
+    """use_fused=True routes pairs groups to the Pallas kernel (interpret
+    mode on CPU); results match the generic sweep modulo the documented
+    knife-edge flip allowance."""
+    grid = {"lookback": np.asarray([8.0, 10.0], np.float32),
+            "z_entry": np.asarray([1.0, 2.0], np.float32)}
+    jobs = synthetic_jobs(2, 96, "pairs", grid, cost=1e-3, seed=11)
+    specs = [pb.JobSpec(id=r.id, strategy=r.strategy, ohlcv=r.ohlcv,
+                        ohlcv2=r.ohlcv2, grid=wire.grid_to_proto(r.grid),
+                        cost=r.cost, periods_per_year=252) for r in jobs]
+    fused_out = {c.job_id: wire.metrics_from_bytes(c.metrics)
+                 for c in compute.JaxSweepBackend(use_fused=True
+                                                  ).process(specs)}
+    generic_out = {c.job_id: wire.metrics_from_bytes(c.metrics)
+                   for c in compute.JaxSweepBackend(use_fused=False
+                                                    ).process(specs)}
+    assert set(fused_out) == set(generic_out) == {r.id for r in jobs}
+    for jid in fused_out:
+        a, b = fused_out[jid], generic_out[jid]
+        flipped = np.zeros_like(np.asarray(a.turnover), dtype=bool)
+        for name in a._fields:
+            av, bv = np.asarray(getattr(a, name)), np.asarray(
+                getattr(b, name))
+            flipped |= np.abs(av - bv) > (0.01 + 0.01 * np.abs(bv))
+        assert flipped.mean() <= 0.05
+        for name in a._fields:
+            np.testing.assert_allclose(
+                np.asarray(getattr(a, name))[~flipped],
+                np.asarray(getattr(b, name))[~flipped],
+                rtol=2e-3, atol=2e-4, err_msg=name)
+
+
+def test_pairs_jobs_malformed_complete_empty_not_requeue_loop():
+    """A pairs job missing its second leg (or with unequal legs) completes
+    with empty metrics and a logged error instead of poisoning co-batched
+    jobs or looping through lease requeues forever."""
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    grid = {"lookback": np.asarray([8.0], np.float32),
+            "z_entry": np.asarray([1.0], np.float32)}
+    good = synthetic_jobs(1, 96, "pairs", grid, cost=1e-3, seed=13)[0]
+    no_leg = synthetic_jobs(1, 96, "pairs", grid, cost=1e-3, seed=14)[0]
+    short = data.synthetic_ohlcv(1, 50, seed=15)
+    uneven = synthetic_jobs(1, 96, "pairs", grid, cost=1e-3, seed=16)[0]
+    uneven_x = data.to_wire_bytes(type(short)(*(f[0] for f in short)))
+    specs = [
+        pb.JobSpec(id=good.id, strategy="pairs", ohlcv=good.ohlcv,
+                   ohlcv2=good.ohlcv2, grid=wire.grid_to_proto(grid),
+                   cost=1e-3, periods_per_year=252),
+        pb.JobSpec(id=no_leg.id, strategy="pairs", ohlcv=no_leg.ohlcv,
+                   grid=wire.grid_to_proto(grid), cost=1e-3,
+                   periods_per_year=252),
+        pb.JobSpec(id=uneven.id, strategy="pairs", ohlcv=uneven.ohlcv,
+                   ohlcv2=uneven_x, grid=wire.grid_to_proto(grid),
+                   cost=1e-3, periods_per_year=252),
+    ]
+    out = {c.job_id: c for c in compute.JaxSweepBackend().process(specs)}
+    assert set(out) == {good.id, no_leg.id, uneven.id}
+    assert len(out[good.id].metrics) > 0
+    assert out[no_leg.id].metrics == b"" and out[uneven.id].metrics == b""
+
+
+def test_pairs_job_record_journal_roundtrip(tmp_path):
+    """ohlcv2 survives the journal (restart must not lose the second leg)."""
+    from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+        JobRecord)
+    from distributed_backtesting_exploration_tpu.rpc.journal import Journal
+
+    jp = str(tmp_path / "j.jsonl")
+    queue = JobQueue(Journal(jp))
+    rec = synthetic_jobs(1, 32, "pairs",
+                         {"lookback": np.asarray([8.0], np.float32),
+                          "z_entry": np.asarray([1.0], np.float32)})[0]
+    queue.enqueue(rec)
+    q2 = JobQueue()
+    assert q2.restore(jp) == 1
+    restored = q2.take(1, "w")[0][0]
+    assert restored.ohlcv2 == rec.ohlcv2 and restored.ohlcv == rec.ohlcv
+    assert isinstance(restored, JobRecord)
+
+
 class _PipelineProbeBackend:
     """submit/collect backend that records event order and slows collect,
     so the worker's double-buffering is observable: with several batches
