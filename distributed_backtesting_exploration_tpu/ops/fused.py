@@ -843,6 +843,37 @@ def _grid_setup(fast_bytes: bytes, slow_bytes: bytes):
 # 0 * inf = NaN. Closes are ~1e2, so comparisons behave identically.
 
 
+def _ema_rows(x, alpha: float):
+    """EMA along the last axis with a scalar decay, as a shift-based
+    doubling ladder (the prep-side twin of the in-kernel ``_ema_ladder``).
+
+    Same recurrence as ``rolling.ema`` — ``y[0] = x[0]``,
+    ``y[t] = (1-a) y[t-1] + a x[t]`` — but built from ~log2(T) elementwise
+    passes instead of ``associative_scan``: XLA compiles the scan's deep
+    slice graph ~30x slower (measured ~4 s/scan at the bench shape, and the
+    remote-proxy backend cannot persistently cache compiles), while the
+    runtime difference is noise. Rounding differs from associative_scan by
+    float-order only.
+    """
+    T = x.shape[-1]
+    t0 = jnp.arange(T) == 0
+    A = jnp.where(t0, 0.0, jnp.float32(1.0 - alpha))
+    A = jnp.broadcast_to(A, x.shape)
+    B = jnp.where(t0, x, x * jnp.float32(alpha))
+
+    def shift(v, k, fill):
+        pad = jnp.full(v.shape[:-1] + (k,), fill, v.dtype)
+        return jnp.concatenate([pad, v[..., :-k]], axis=-1)
+
+    span = 1
+    while span < T:
+        Ae = shift(A, span, 1.0)    # identity element (A=1, B=0)
+        Be = shift(B, span, 0.0)
+        A, B = Ae * A, A * Be + B
+        span *= 2
+    return B
+
+
 def _mom_kernel(r_ref, c_ref, past_ref, ol_ref, warm_ref, *refs,
                 cost: float, ppy: int, T_real: int | None):
     """Momentum cell: the signal is exact — the past-close table holds raw
@@ -1077,25 +1108,24 @@ def _fused_rsi_call(close, onehot_p, band_lanes, warm, t_real, *,
     z-score feeding the shared band machine (enter beyond ±band, exit at the
     centerline), so the whole kernel is reused verbatim with z_exit=0.
 
-    Each distinct period's Wilder EMA runs as the library associative scan
-    (``rolling.ema`` with static alpha = 1/period) over ``(N, T_pad)`` —
-    ``models.rsi.rsi_index``'s exact formula per window.
+    Each distinct period's Wilder EMA (static alpha = 1/period) runs as the
+    shift-ladder (``_ema_rows``) over ``(N, T_pad)`` —
+    ``models.rsi.rsi_index``'s formula per window, float-order modulo the
+    scan algorithm.
     """
-    from . import rolling as rolling_mod
-
     close_p = _pad_last(close, T_pad)
     N = close.shape[0]
     diff = jnp.diff(close_p, axis=-1, prepend=close_p[..., :1])
     gains = jnp.maximum(diff, 0.0)
     losses = jnp.maximum(-diff, 0.0)
-    # Per-distinct-period scans as a static python loop: a single batched
-    # (W, N, T_pad) scan was also tried and measured *slower* on chip (the
-    # broadcast + transpose cost more than the extra scan launches).
+    # Per-distinct-period EMAs via the shift-ladder (see _ema_rows: the
+    # associative_scan version compiled ~30x slower with no runtime win; a
+    # batched (W, N, T_pad) scan was also slower on chip).
     rows = []
     for p_ in windows:
         alpha = 1.0 / float(p_)
-        ag = rolling_mod.ema(gains, alpha=alpha)
-        al = rolling_mod.ema(losses, alpha=alpha)
+        ag = _ema_rows(gains, alpha)
+        al = _ema_rows(losses, alpha)
         rsi = 100.0 - 100.0 / (1.0 + ag / (al + 1e-12))
         rows.append(rsi - 50.0)
     z_tbl = jnp.stack(rows, axis=1)                              # (N,W,T_pad)
@@ -1236,11 +1266,9 @@ def _fused_macd_call(close, onehot_f, onehot_s, a_sig, warm, t_real, *,
                      T_real: int | None, cost: float, ppy: int,
                      interpret: bool):
     """Distinct-span EMA table prep + pallas call in one jit."""
-    from . import rolling as rolling_mod
-
     close_p = _pad_last(close, T_pad)
     N = close.shape[0]
-    rows = [rolling_mod.ema(close_p, span=float(s)) for s in spans]
+    rows = [_ema_rows(close_p, 2.0 / (float(s) + 1.0)) for s in spans]
     ema_tbl = jnp.stack(rows, axis=1)                            # (N,W,T_pad)
     if W_pad > len(spans):
         ema_tbl = jnp.concatenate(
