@@ -215,6 +215,12 @@ class JobQueue:
             for jid in state.completed:
                 self._completed.setdefault(jid, 0.0)
             self._failed |= state.failed
+            # Register terminal jobs' (slim) records too: a late duplicate
+            # completion arriving after a restart must be answered as an
+            # idempotent "dup", not "unknown".
+            for jid, rec in state.jobs.items():
+                if jid not in self._records:
+                    self._records[jid] = JobRecord.from_journal(rec)
         self.known_paths |= {rec["path"] for rec in state.jobs.values()
                              if rec.get("path")}
         self.journaled_jobs += len(state.jobs)
@@ -690,6 +696,19 @@ def build_dispatcher(args) -> Dispatcher:
     no jobs at all — otherwise the restored pending set IS the remaining
     synthetic workload (synthetic payloads are journaled inline).
     """
+    if args.journal:
+        # Compact BEFORE opening the appending journal handle (the rewrite
+        # replaces the inode): terminal jobs' payload blobs are dropped so
+        # replay cost stops growing across restarts. Progress is reported
+        # in bytes — stripping payloads usually preserves the LINE count.
+        size_before = (os.path.getsize(args.journal)
+                       if os.path.exists(args.journal) else 0)
+        Journal.compact(args.journal)
+        size_after = (os.path.getsize(args.journal)
+                      if os.path.exists(args.journal) else 0)
+        if size_after < size_before:
+            log.info("compacted journal %s: %d -> %d bytes", args.journal,
+                     size_before, size_after)
     queue = JobQueue(Journal(args.journal), lease_s=args.lease_s)
     restored = queue.restore(args.journal) if args.journal else 0
     if restored:

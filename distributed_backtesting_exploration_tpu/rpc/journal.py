@@ -29,6 +29,11 @@ class ReplayState:
     completed: set = field(default_factory=set)     # job ids
     failed: set = field(default_factory=set)        # job ids
     corrupt_lines: int = 0                          # interior decode failures
+    total_lines: int = 0                            # non-empty lines seen
+    # Raw complete/fail records in order, first occurrence per id — they
+    # carry worker ids and failure reasons that the id sets drop, and
+    # compaction must not erase that post-mortem record.
+    terminal_events: list = field(default_factory=list)
 
     @property
     def pending(self) -> list[str]:
@@ -58,6 +63,56 @@ class Journal:
         if self._fh is not None:
             self._fh.close()
             self._fh = None
+
+    # Journal keys that carry bulk payloads; dropped from terminal jobs'
+    # records at compaction (identity/grid/path survive for restart dedupe
+    # and result aggregation).
+    _PAYLOAD_KEYS = ("ohlcv_b64", "ohlcv2_b64")
+
+    @staticmethod
+    def compact(path: str) -> tuple[int, int]:
+        """Rewrite the journal to its live state; returns (before, after)
+        line counts.
+
+        An append-only journal grows without bound across restarts and
+        replay cost grows with it. Compaction keeps exactly what recovery
+        and tooling need: full enqueue records for PENDING jobs, slim
+        enqueue records (payload fields dropped) for completed/failed jobs
+        — their ids keep completions idempotent, their paths keep restart
+        dedupe working, and their grids keep ``rpc.aggregate`` joins alive
+        — plus the original terminal complete/fail records (first
+        occurrence per id: worker ids and failure reasons survive for
+        post-mortems). A journal with nothing to shrink (no terminal jobs,
+        no duplicate/torn/corrupt lines) is left untouched. The rewrite is
+        atomic (tmp + fsync + rename), and MUST run before an appending
+        :class:`Journal` opens the path (the open handle would keep
+        writing to the replaced inode).
+        """
+        if not path or not os.path.exists(path):
+            return (0, 0)
+        state = Journal.replay(path)
+        before = state.total_lines
+        if (not state.completed and not state.failed
+                and not state.corrupt_lines
+                and before == len(state.jobs)):
+            return (before, before)   # nothing to shrink: skip the rewrite
+        done = state.completed | state.failed
+        tmp = f"{path}.compact.{os.getpid()}"
+        after = 0
+        with open(tmp, "w", encoding="utf-8") as fh:
+            for jid, rec in state.jobs.items():
+                if jid in done:
+                    rec = {k: v for k, v in rec.items()
+                           if k not in Journal._PAYLOAD_KEYS}
+                fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
+                after += 1
+            for rec in state.terminal_events:
+                fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
+                after += 1
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        return (before, after)
 
     @staticmethod
     def replay(path: str, *, strict: bool = True) -> ReplayState:
@@ -92,11 +147,16 @@ class Journal:
                     ) from e
                 state.corrupt_lines += 1
                 continue
+            state.total_lines += 1
             ev = rec.get("ev")
             if ev == "enqueue":
                 state.jobs[rec["id"]] = rec
             elif ev == "complete":
+                if rec["id"] not in state.completed:
+                    state.terminal_events.append(rec)
                 state.completed.add(rec["id"])
             elif ev == "fail":
+                if rec["id"] not in state.failed:
+                    state.terminal_events.append(rec)
                 state.failed.add(rec["id"])
         return state

@@ -482,3 +482,69 @@ def test_native_substrate_live_by_default():
         p.append("a"); p.append("b"); p.appendleft("front")
         assert [p.popleft(), p.popleft(), p.popleft()] == ["front", "a", "b"]
         assert p.popleft() is None and len(p) == 0
+
+
+def test_journal_compaction_preserves_live_state(tmp_path):
+    """Compaction drops terminal jobs' payload blobs but preserves exactly
+    what recovery and tooling need: pending payloads, completed/failed ids
+    (idempotency + tombstones), paths (restart dedupe), and grids
+    (aggregation joins)."""
+    import json
+    import os
+
+    import numpy as np
+
+    from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+        JobQueue, synthetic_jobs)
+    from distributed_backtesting_exploration_tpu.rpc.journal import Journal
+
+    jp = str(tmp_path / "j.jsonl")
+    queue = JobQueue(Journal(jp))
+    grid = {"fast": np.asarray([3.0], np.float32),
+            "slow": np.asarray([8.0], np.float32)}
+    recs = synthetic_jobs(4, 64, "sma_crossover", grid, seed=2)
+    for rec in recs:
+        queue.enqueue(rec)
+    queue.take(2, "w1")
+    assert queue.complete(recs[0].id, "w1") == "new"
+    assert queue.complete(recs[1].id, "w1") == "new"
+
+    size_before = os.path.getsize(jp)
+    before, after = Journal.compact(jp)
+    assert before >= after       # line count can only shrink...
+    assert os.path.getsize(jp) < size_before   # ...and payload bytes MUST
+
+    state = Journal.replay(jp)
+    assert set(state.pending) == {recs[2].id, recs[3].id}
+    assert state.completed == {recs[0].id, recs[1].id}
+    # Completed jobs keep grid (aggregation) but lose the payload.
+    done_rec = state.jobs[recs[0].id]
+    assert "ohlcv_b64" not in done_rec and "grid" in done_rec
+    # Pending jobs keep their full inline payload.
+    assert "ohlcv_b64" in state.jobs[recs[2].id]
+
+    # A restored queue behaves identically: pending re-dispatches with
+    # payload intact, duplicate completion stays idempotent.
+    q2 = JobQueue()
+    assert q2.restore(jp) == 2
+    taken = q2.take(2, "w2")
+    assert {r.id for r, _ in taken} == {recs[2].id, recs[3].id}
+    assert all(payload for _, payload in taken)
+    assert q2.complete(recs[0].id, "w2") == "dup"
+    # Compacted output is well-formed JSONL throughout.
+    with open(jp) as fh:
+        for line in fh:
+            json.loads(line)
+
+
+def test_journal_compaction_idempotent_and_empty(tmp_path):
+    from distributed_backtesting_exploration_tpu.rpc.journal import Journal
+
+    assert Journal.compact(str(tmp_path / "missing.jsonl")) == (0, 0)
+    jp = str(tmp_path / "j.jsonl")
+    j = Journal(jp)
+    j.append("enqueue", id="a", strategy="sma_crossover", grid={})
+    j.close()
+    b1, a1 = Journal.compact(jp)
+    b2, a2 = Journal.compact(jp)
+    assert (b2, a2) == (a1, a1)   # second pass is a no-op rewrite
