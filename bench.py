@@ -315,12 +315,30 @@ def main():
         n_windows = int((n_bars - train) // test)
         strat = base.get_strategy("sma_crossover")
 
+        import functools
         from types import SimpleNamespace
 
-        def run_wf():
-            r = walkforward.walk_forward(
-                panel, strat, wgrid, train=train, test=test, cost=1e-3)
-            return SimpleNamespace(sharpe=r.oos_metrics.sharpe)
+        # Generic walk_forward is ONE fused XLA program end to end and wins
+        # at this grid size (11.5M/s vs 5.5M/s measured for the
+        # walk_forward_fused two-phase split at P=400 — the fused train
+        # kernel only pays off at much larger param grids). Set
+        # DBX_BENCH_WF_FUSED=1 to measure the fused variant.
+        if os.environ.get("DBX_BENCH_WF_FUSED") == "1":
+            wfa = np.asarray(wgrid["fast"])
+            wsl = np.asarray(wgrid["slow"])
+
+            def run_wf():
+                r = walkforward.walk_forward_fused(
+                    panel, strat, wgrid,
+                    functools.partial(fused.fused_sma_sweep, fast=wfa,
+                                      slow=wsl, cost=1e-3),
+                    train=train, test=test, cost=1e-3)
+                return SimpleNamespace(sharpe=r.oos_metrics.sharpe)
+        else:
+            def run_wf():
+                r = walkforward.walk_forward(
+                    panel, strat, wgrid, train=train, test=test, cost=1e-3)
+                return SimpleNamespace(sharpe=r.oos_metrics.sharpe)
 
         rates["walkforward"] = _measure(
             run_wf, n_tickers * sweep.grid_size(wgrid) * n_windows,

@@ -122,15 +122,26 @@ def walk_forward(
     _, (train_best, best_idx, oos_r, oos_p, prev_in, rf) = jax.lax.scan(
         one_window, 0, starts)
     # scan outputs are window-major: (n_windows, n_tickers, ...)
+    chosen = {k: jnp.moveaxis(jnp.take(v, best_idx), 0, 1)
+              for k, v in grid.items()}
+    return _stitch(oos_r, oos_p, prev_in, rf, train_best, chosen,
+                   n_tickers=n_tickers, cost=cost,
+                   periods_per_year=periods_per_year)
 
-    # Boundary fix-up. Each window's first OOS bar was priced by
-    # backtest_prefix against that window's own train-span position at
-    # ``train-1`` (``prev_in``): it earned ``prev_in * r`` and paid turnover
-    # ``|pos - prev_in|``. A sequential deployment instead carries the
-    # *previous window's* final OOS position into that bar (window w's last
-    # test bar is the bar before window w+1's first one) — and starts flat at
-    # window 0. Swap both the earnings and the cost terms so the stitched
-    # series prices exactly the positions it reports.
+
+def _stitch(oos_r, oos_p, prev_in, rf, train_best, chosen, *, n_tickers,
+            cost, periods_per_year) -> WalkForwardResult:
+    """Window-major per-window outputs -> stitched WalkForwardResult.
+
+    Boundary fix-up: each window's first OOS bar was priced by
+    backtest_prefix against that window's own train-span position at
+    ``train-1`` (``prev_in``): it earned ``prev_in * r`` and paid turnover
+    ``|pos - prev_in|``. A sequential deployment instead carries the
+    *previous window's* final OOS position into that bar (window w's last
+    test bar is the bar before window w+1's first one) — and starts flat at
+    window 0. Swap both the earnings and the cost terms so the stitched
+    series prices exactly the positions it reports.
+    """
     first_pos = oos_p[:, :, 0]                                # (W, n_tickers)
     prev_deployed = jnp.concatenate(
         [jnp.zeros_like(first_pos[:1]), oos_p[:-1, :, -1]], axis=0)
@@ -141,8 +152,6 @@ def walk_forward(
 
     oos_returns = jnp.moveaxis(oos_r, 0, 1).reshape(n_tickers, -1)
     oos_positions = jnp.moveaxis(oos_p, 0, 1).reshape(n_tickers, -1)
-    chosen = {k: jnp.moveaxis(jnp.take(v, best_idx), 0, 1)
-              for k, v in grid.items()}
     equity = 1.0 + jnp.cumsum(oos_returns, axis=-1)
     oos_metrics = metrics_mod.summary_metrics(
         oos_returns, equity, oos_positions,
@@ -154,3 +163,108 @@ def walk_forward(
         chosen=chosen,
         train_metric=jnp.moveaxis(train_best, 0, 1),
     )
+
+
+@functools.partial(jax.jit, static_argnames=("starts", "train"))
+def _stack_train_windows(close, starts: tuple, train: int):
+    """All windows' train slices as one ``(W * n_tickers, train)`` panel."""
+    rows = [jax.lax.dynamic_slice_in_dim(close, s0, train, axis=-1)
+            for s0 in starts]
+    stacked = jnp.stack(rows)                            # (W, N, train)
+    return stacked.reshape(-1, train)
+
+
+@functools.partial(jax.jit, static_argnames=("W", "n_tickers"))
+def _window_argmax(vals, sign, W: int, n_tickers: int):
+    """(W*N, P) metric values -> per-(window, ticker) argmax index + value."""
+    v = vals.reshape(W, n_tickers, -1)
+    idx = jnp.argmax(sign * v, axis=-1)
+    best = jnp.take_along_axis(v, idx[..., None], -1)[..., 0]
+    return idx, best
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("strategy", "train", "test", "periods_per_year"))
+def _reprice_chosen(ohlcv, strategy: Strategy, chosen_per_window, starts, *,
+                    train: int, test: int, cost=0.0,
+                    periods_per_year: int = 252):
+    """Phase 2 of the fused walk-forward: re-price each ticker's CHOSEN
+    param per window (P=1 per ticker — the cheap part)."""
+    span = train + test
+
+    def slice_win(a, s0):
+        return jax.lax.dynamic_slice_in_dim(a, s0, span, axis=-1)
+
+    def one_window(carry, inp):
+        s0, params_n = inp
+        win = type(ohlcv)(*(slice_win(f, s0) for f in ohlcv))
+
+        def per_ticker(ohlcv_1, p1):
+            pos = strategy.positions(ohlcv_1, p1)
+            res = pnl_mod.backtest_prefix(ohlcv_1.close, pos, cost=cost)
+            return (res.returns[..., train:], res.positions[..., train:],
+                    res.positions[..., train - 1])
+
+        oos_r, oos_p, prev_in = jax.vmap(per_ticker)(win, params_n)
+        rf = win.close[:, train] / win.close[:, train - 1] - 1.0
+        return carry, (oos_r, oos_p, prev_in, rf)
+
+    _, outs = jax.lax.scan(one_window, 0, (starts, chosen_per_window))
+    return outs
+
+
+def walk_forward_fused(
+    ohlcv,
+    strategy: Strategy,
+    grid: Mapping[str, Array],
+    train_metrics_fn,
+    *,
+    train: int,
+    test: int,
+    metric: str = "sharpe",
+    cost: float = 0.0,
+    periods_per_year: int = 252,
+) -> WalkForwardResult:
+    """Walk-forward with the TRAIN sweep on a fused Pallas kernel.
+
+    The expensive phase — the full (ticker x param) grid per refit window —
+    runs as ``train_metrics_fn(close_slice) -> Metrics`` (e.g. a
+    ``functools.partial`` of :func:`~..ops.fused.fused_sma_sweep` with the
+    flat grid arrays bound); only each ticker's argmax-chosen param is then
+    re-priced over the (train+test) span, and the stitched result uses the
+    same boundary fix-up as :func:`walk_forward`. Results match
+    :func:`walk_forward` exactly wherever the fused and generic train
+    metrics agree on the argmax (knife-edge metric ties can flip a chosen
+    param — the caveat class ``bench.py --verify`` quantifies).
+    """
+    import numpy as np
+
+    T = ohlcv.close.shape[-1]
+    starts_np = np.asarray(window_starts(T, train, test))
+    n_tickers = ohlcv.close.shape[0]
+    W = len(starts_np)
+    sign = metrics_mod.metric_sign(metric)
+
+    # Phase 1: ONE fused train sweep over all windows at once — the W
+    # train slices stack into a (W * n_tickers, train) panel so the whole
+    # phase is a single kernel launch (a per-window python loop was ~5x
+    # slower end to end on a remote-proxy chip: every eager slice/argmax
+    # op pays a dispatch round trip).
+    stacked = _stack_train_windows(
+        ohlcv.close, tuple(int(s) for s in starts_np), train)
+    m = train_metrics_fn(stacked)                        # (W*N, P) fields
+    best_idx, train_best = _window_argmax(
+        getattr(m, metric), sign, W, n_tickers)          # (W, N) each
+
+    chosen_per_window = {k: jnp.take(jnp.asarray(v), best_idx)
+                         for k, v in grid.items()}       # (W, n_tickers)
+    oos_r, oos_p, prev_in, rf = _reprice_chosen(
+        ohlcv, strategy, chosen_per_window, jnp.asarray(starts_np),
+        train=train, test=test, cost=cost,
+        periods_per_year=periods_per_year)
+    chosen = {k: jnp.moveaxis(v, 0, 1)
+              for k, v in chosen_per_window.items()}
+    return _stitch(oos_r, oos_p, prev_in, rf, train_best, chosen,
+                   n_tickers=n_tickers, cost=cost,
+                   periods_per_year=periods_per_year)

@@ -174,3 +174,54 @@ def test_chunked_pairs_sweep_matches_full():
             rtol=1e-6, atol=1e-7, err_msg=name)
     with pytest.raises(ValueError, match="divisible"):
         pm.chunked_pairs_sweep(yj, xj, grid, param_chunk=4)
+
+
+def test_walk_forward_fused_matches_generic():
+    """walk_forward_fused (fused train sweep + chosen-param repricing) must
+    reproduce walk_forward wherever the train argmax agrees — on CPU
+    interpret mode that is everywhere for this grid/seed."""
+    import functools
+
+    import numpy as np
+
+    from distributed_backtesting_exploration_tpu.models import base
+    from distributed_backtesting_exploration_tpu.ops import fused
+    from distributed_backtesting_exploration_tpu.parallel import (
+        sweep, walkforward)
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    ohlcv = data.synthetic_ohlcv(4, 260, seed=21)
+    panel = type(ohlcv)(*(jnp.asarray(f) for f in ohlcv))
+    grid = sweep.product_grid(fast=jnp.asarray([3.0, 5.0], jnp.float32),
+                              slow=jnp.asarray([13.0, 21.0], jnp.float32))
+    strat = base.get_strategy("sma_crossover")
+    train, test = 120, 40
+
+    want = walkforward.walk_forward(panel, strat, grid, train=train,
+                                    test=test, cost=1e-3)
+    fa, sl = np.asarray(grid["fast"]), np.asarray(grid["slow"])
+    got = walkforward.walk_forward_fused(
+        panel, strat, grid,
+        functools.partial(fused.fused_sma_sweep, fast=fa, slow=sl,
+                          cost=1e-3),
+        train=train, test=test, cost=1e-3)
+
+    # Chosen params should agree (knife-edge argmax ties could differ on
+    # TPU; on CPU interpret mode the train metrics match tightly).
+    for k in grid:
+        np.testing.assert_array_equal(np.asarray(got.chosen[k]),
+                                      np.asarray(want.chosen[k]), err_msg=k)
+    np.testing.assert_allclose(np.asarray(got.oos_returns),
+                               np.asarray(want.oos_returns),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got.oos_positions),
+                               np.asarray(want.oos_positions),
+                               rtol=0, atol=0)
+    for name in want.oos_metrics._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(got.oos_metrics, name)),
+            np.asarray(getattr(want.oos_metrics, name)),
+            rtol=1e-4, atol=1e-5, err_msg=name)
+    np.testing.assert_allclose(np.asarray(got.train_metric),
+                               np.asarray(want.train_metric),
+                               rtol=2e-4, atol=2e-5)
