@@ -131,3 +131,128 @@ def chunked_scan(step, init_carry, inputs, *, chunk: int, unroll: int = 8):
     carry, ys = jax.lax.scan(run_chunk, init_carry, chunked)
     return carry, jax.tree_util.tree_map(
         lambda y: y.reshape((T,) + y.shape[2:]), ys)
+
+
+def sharded_sma_backtest(mesh: Mesh, close, fast: int, slow: int, *,
+                         cost: float = 0.0, periods_per_year: int = 252,
+                         axis_name: str = TIME_AXIS):
+    """End-to-end SMA-crossover backtest with the TIME axis sharded.
+
+    The composed long-context path: for a ``(..., T)`` close panel whose
+    bar axis is sharded across ``mesh``, every stage runs blockwise with
+    O(1)-per-chip ICI traffic — returns via a one-bar halo exchange
+    (``ppermute``), rolling SMAs via the distributed cumsum plus a
+    ``max(fast, slow)``-bar halo for the lagged prefix, PnL locally, and
+    the summary metrics as ``psum``/``pmax`` reductions (the running-peak
+    drawdown uses an exclusive cross-chip max of block maxima). One
+    history longer than any single chip's memory therefore backtests
+    without ever materializing the full series in one place.
+
+    ``fast``/``slow`` are static ints with ``slow <= block length`` (the
+    halo must fit one neighbor block). Returns
+    :class:`~..ops.metrics.Metrics` with scalar-per-series fields,
+    replicated across the mesh. Matches the unsharded
+    single-device computation to f32 tolerance.
+    """
+    from ..ops.metrics import Metrics
+
+    if not (0 < fast < slow):
+        raise ValueError(f"need 0 < fast < slow, got {fast}, {slow}")
+    n_dev = mesh.devices.size
+    T = close.shape[-1]
+    if T % n_dev:
+        raise ValueError(f"T={T} not divisible by {n_dev} devices")
+    if slow > T // n_dev:
+        raise ValueError(
+            f"slow={slow} exceeds the {T // n_dev}-bar block; the halo "
+            "exchange needs the window to fit one neighbor block")
+    halo_w = slow
+    eps = 1e-12
+    spec = P(*((None,) * (close.ndim - 1) + (axis_name,)))
+    rep = P(*((None,) * (close.ndim - 1)))   # metrics drop the time axis
+    n_f = jnp.float32(T)
+    ann = jnp.sqrt(jnp.float32(periods_per_year))
+
+    def from_left(x_blk, k):
+        """Last ``k`` elements of the LEFT neighbor's block (zeros on chip 0)."""
+        n = jax.lax.axis_size(axis_name)
+        perm = [(i, i + 1) for i in range(n - 1)]
+        return jax.lax.ppermute(x_blk[..., -k:], axis_name, perm)
+
+    def local(close_blk):
+        Tb = close_blk.shape[-1]
+        idx = jax.lax.axis_index(axis_name)
+        gidx = jnp.arange(Tb) + idx * Tb                  # global bar index
+
+        # Per-bar simple returns with a one-bar halo (r[0] = 0 globally).
+        prev_close = jnp.concatenate(
+            [from_left(close_blk, 1), close_blk[..., :-1]], axis=-1)
+        r = jnp.where(gidx == 0, 0.0,
+                      close_blk / jnp.where(gidx == 0, 1.0, prev_close) - 1.0)
+
+        # Global prefix sum of closes; lagged reads via a slow-bar halo.
+        cs = jnp.cumsum(close_blk, axis=-1)
+        cs = cs + _exclusive_block_offset(cs[..., -1], axis_name)[..., None]
+        cs_ext = jnp.concatenate([from_left(cs, halo_w), cs], axis=-1)
+
+        def sma(w):
+            lagged = jax.lax.slice_in_dim(
+                cs_ext, halo_w - w, halo_w - w + Tb, axis=-1)
+            lagged = jnp.where(gidx >= w, lagged, 0.0)    # cs[t-w], 0 if t<w
+            return (cs - lagged) / jnp.float32(w)
+
+        valid = gidx >= slow - 1
+        pos = jnp.where(valid, jnp.sign(sma(fast) - sma(slow)), 0.0)
+        prev_pos = jnp.concatenate(
+            [from_left(pos, 1), pos[..., :-1]], axis=-1)
+        net = prev_pos * r - jnp.float32(cost) * jnp.abs(pos - prev_pos)
+
+        # Moments / downside via global sums.
+        s1 = jax.lax.psum(jnp.sum(net, axis=-1), axis_name)
+        s2 = jax.lax.psum(jnp.sum(net * net, axis=-1), axis_name)
+        mean = s1 / n_f
+        std = jnp.sqrt(jnp.maximum(s2 / n_f - mean * mean, 0.0))
+        down = jnp.minimum(net, 0.0)
+        dstd = jnp.sqrt(
+            jax.lax.psum(jnp.sum(down * down, axis=-1), axis_name) / n_f)
+
+        # Equity + running peak across blocks for drawdown.
+        eq = 1.0 + jnp.cumsum(net, axis=-1)
+        eq = eq + _exclusive_block_offset(net.sum(-1), axis_name)[..., None]
+        peak_local = jax.lax.cummax(eq, axis=eq.ndim - 1)
+        block_max = jnp.max(eq, axis=-1)
+        all_max = jax.lax.all_gather(block_max, axis_name)  # (n, ...)
+        n = all_max.shape[0]
+        mask = (jnp.arange(n) < idx).reshape((n,) + (1,) * (block_max.ndim))
+        left_peak = jnp.max(
+            jnp.where(mask, all_max, -jnp.inf), axis=0)
+        peak = jnp.maximum(peak_local, left_peak[..., None])
+        dd = (peak - eq) / jnp.maximum(peak, eps)
+        mdd = jax.lax.pmax(jnp.max(dd, axis=-1), axis_name)
+        eq_final = jax.lax.psum(
+            jnp.sum(jnp.where(gidx == T - 1, eq, 0.0), axis=-1), axis_name)
+
+        active = jnp.abs(prev_pos) > 0
+        wins = (net > 0) & active
+        hit = (jax.lax.psum(jnp.sum(wins.astype(jnp.float32), -1), axis_name)
+               / (jax.lax.psum(jnp.sum(active.astype(jnp.float32), -1),
+                               axis_name) + eps))
+        turnover = jax.lax.psum(
+            jnp.sum(jnp.abs(pos - prev_pos), axis=-1), axis_name)
+        years = jnp.maximum(n_f / jnp.float32(periods_per_year), eps)
+        final = jnp.maximum(eq_final, eps)
+        return Metrics(
+            sharpe=mean / (std + eps) * ann,
+            sortino=mean / (dstd + eps) * ann,
+            max_drawdown=mdd,
+            total_return=eq_final - 1.0,
+            cagr=jnp.power(final, 1.0 / years) - 1.0,
+            volatility=std * ann,
+            hit_rate=hit,
+            n_trades=0.5 * turnover,
+            turnover=turnover,
+        )
+
+    out_specs = Metrics(*(rep for _ in Metrics._fields))
+    return jax.shard_map(local, mesh=mesh, in_specs=spec,
+                         out_specs=out_specs, check_vma=False)(close)

@@ -73,3 +73,40 @@ def test_chunked_scan_equals_flat_scan():
     np.testing.assert_allclose(float(got_carry), float(want_carry), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(got_ys), np.asarray(want_ys),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_sma_backtest_matches_single_device(devices):
+    """The composed long-context path: a full SMA backtest with the bar
+    axis sharded over 8 devices matches the unsharded computation."""
+    from distributed_backtesting_exploration_tpu.models import base
+    from distributed_backtesting_exploration_tpu.ops import (
+        metrics as metrics_mod, pnl)
+    from distributed_backtesting_exploration_tpu.parallel import timeshard
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    mesh = Mesh(np.asarray(devices[:8]), (timeshard.TIME_AXIS,))
+    ohlcv = data.synthetic_ohlcv(3, 1024, seed=23)
+    close = jnp.asarray(ohlcv.close)
+    fast, slow = 5, 21
+
+    got = timeshard.sharded_sma_backtest(mesh, close, fast, slow, cost=1e-3)
+
+    strat = base.get_strategy("sma_crossover")
+    panel = type(ohlcv)(*(jnp.asarray(f) for f in ohlcv))
+    pos = jax.vmap(lambda o: strat.positions(
+        o, dict(fast=jnp.float32(fast), slow=jnp.float32(slow))))(panel)
+    res = pnl.backtest_prefix(close, pos, cost=1e-3)
+    want = metrics_mod.summary_metrics(res.returns, res.equity,
+                                       res.positions)
+    for name in want._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(got, name)), np.asarray(getattr(want, name)),
+            rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_sharded_sma_backtest_rejects_oversized_window(devices):
+    from distributed_backtesting_exploration_tpu.parallel import timeshard
+
+    mesh = Mesh(np.asarray(devices[:8]), (timeshard.TIME_AXIS,))
+    with pytest.raises(ValueError, match="halo"):
+        timeshard.sharded_sma_backtest(mesh, jnp.ones((1, 256)), 5, 100)
