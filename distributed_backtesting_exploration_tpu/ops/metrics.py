@@ -141,6 +141,41 @@ def n_trades(positions: Array) -> Array:
     return 0.5 * turnover_total(positions)
 
 
+def metrics_from_reductions(*, s1, s2, downside_sq_sum, mdd, eq_final,
+                            wins_sum, active_sum, turnover, n,
+                            periods_per_year: int = 252,
+                            eps: float = 1e-12) -> Metrics:
+    """Assemble a :class:`Metrics` from already-reduced per-series sums.
+
+    The scalar tail of :func:`summary_metrics`, factored out for callers
+    whose reductions happen elsewhere — e.g. the time-sharded backtest,
+    where ``s1``/``s2``/... arrive from ``psum``/``pmax`` collectives. The
+    formulas here are the definitions; distributed callers contribute only
+    the reduction topology. (``summary_metrics`` and the fused Pallas
+    kernels keep their own evaluation order on purpose — golden tests pin
+    their equivalence — because op order is part of their bit-level
+    contracts.)
+    """
+    n = jnp.asarray(n, jnp.float32)
+    mean = s1 / n
+    std = jnp.sqrt(jnp.maximum(s2 / n - mean * mean, 0.0))
+    dstd = jnp.sqrt(downside_sq_sum / n)
+    ann = jnp.sqrt(jnp.float32(periods_per_year))
+    years = jnp.maximum(n / jnp.float32(periods_per_year), eps)
+    final = jnp.maximum(eq_final, eps)
+    return Metrics(
+        sharpe=mean / (std + eps) * ann,
+        sortino=mean / (dstd + eps) * ann,
+        max_drawdown=mdd,
+        total_return=eq_final - 1.0,
+        cagr=jnp.power(final, 1.0 / years) - 1.0,
+        volatility=std * ann,
+        hit_rate=wins_sum / (active_sum + eps),
+        n_trades=0.5 * turnover,
+        turnover=turnover,
+    )
+
+
 def summary_metrics(returns: Array, equity: Array, positions: Array, *,
                     periods_per_year: int = 252, mask=None) -> Metrics:
     """All metrics in one fused pass; this is the standard job result payload."""

@@ -38,18 +38,25 @@ from jax.sharding import Mesh, PartitionSpec as P
 TIME_AXIS = "time"
 
 
-def _exclusive_block_offset(block_total, axis: str):
-    """Sum of ``block_total`` over all chips strictly left of this one.
+def _exclusive_block_reduce(block_val, axis: str, op, identity):
+    """Reduce ``block_val`` with ``op`` over all chips strictly left of this
+    one (``identity`` on chip 0).
 
-    ``all_gather`` of one value per chip + masked sum — O(n_chips) scalars
-    over ICI, no host round-trip.
+    ``all_gather`` of one value per chip + masked reduce — O(n_chips)
+    scalars over ICI, no host round-trip. The exclusive-prefix pattern
+    behind the distributed cumsum (op=sum) and the cross-chip running peak
+    (op=max).
     """
     idx = jax.lax.axis_index(axis)
-    totals = jax.lax.all_gather(block_total, axis)          # (n, ...)
-    n = totals.shape[0]
-    mask = (jnp.arange(n) < idx).astype(totals.dtype)
-    mask = mask.reshape((n,) + (1,) * (totals.ndim - 1))
-    return jnp.sum(totals * mask, axis=0)
+    vals = jax.lax.all_gather(block_val, axis)              # (n, ...)
+    n = vals.shape[0]
+    mask = (jnp.arange(n) < idx).reshape((n,) + (1,) * block_val.ndim)
+    return op(jnp.where(mask, vals, identity), axis=0)
+
+
+def _exclusive_block_offset(block_total, axis: str):
+    """Sum of ``block_total`` over all chips strictly left of this one."""
+    return _exclusive_block_reduce(block_total, axis, jnp.sum, 0.0)
 
 
 def sharded_cumsum(mesh: Mesh, x, *, axis_name: str = TIME_AXIS):
@@ -154,14 +161,15 @@ def sharded_sma_backtest(mesh: Mesh, close, fast: int, slow: int, *,
     replicated across the mesh. Matches the unsharded
     single-device computation to f32 tolerance.
     """
-    from ..ops.metrics import Metrics
+    from ..ops.metrics import Metrics, metrics_from_reductions
 
     if not (0 < fast < slow):
         raise ValueError(f"need 0 < fast < slow, got {fast}, {slow}")
-    n_dev = mesh.devices.size
+    n_dev = mesh.shape[axis_name]   # the TIME axis size, not total devices
     T = close.shape[-1]
     if T % n_dev:
-        raise ValueError(f"T={T} not divisible by {n_dev} devices")
+        raise ValueError(
+            f"T={T} not divisible by the {n_dev}-way {axis_name!r} axis")
     if slow > T // n_dev:
         raise ValueError(
             f"slow={slow} exceeds the {T // n_dev}-bar block; the halo "
@@ -171,7 +179,6 @@ def sharded_sma_backtest(mesh: Mesh, close, fast: int, slow: int, *,
     spec = P(*((None,) * (close.ndim - 1) + (axis_name,)))
     rep = P(*((None,) * (close.ndim - 1)))   # metrics drop the time axis
     n_f = jnp.float32(T)
-    ann = jnp.sqrt(jnp.float32(periods_per_year))
 
     def from_left(x_blk, k):
         """Last ``k`` elements of the LEFT neighbor's block (zeros on chip 0)."""
@@ -210,22 +217,15 @@ def sharded_sma_backtest(mesh: Mesh, close, fast: int, slow: int, *,
         # Moments / downside via global sums.
         s1 = jax.lax.psum(jnp.sum(net, axis=-1), axis_name)
         s2 = jax.lax.psum(jnp.sum(net * net, axis=-1), axis_name)
-        mean = s1 / n_f
-        std = jnp.sqrt(jnp.maximum(s2 / n_f - mean * mean, 0.0))
         down = jnp.minimum(net, 0.0)
-        dstd = jnp.sqrt(
-            jax.lax.psum(jnp.sum(down * down, axis=-1), axis_name) / n_f)
+        down_sq = jax.lax.psum(jnp.sum(down * down, axis=-1), axis_name)
 
         # Equity + running peak across blocks for drawdown.
         eq = 1.0 + jnp.cumsum(net, axis=-1)
         eq = eq + _exclusive_block_offset(net.sum(-1), axis_name)[..., None]
         peak_local = jax.lax.cummax(eq, axis=eq.ndim - 1)
-        block_max = jnp.max(eq, axis=-1)
-        all_max = jax.lax.all_gather(block_max, axis_name)  # (n, ...)
-        n = all_max.shape[0]
-        mask = (jnp.arange(n) < idx).reshape((n,) + (1,) * (block_max.ndim))
-        left_peak = jnp.max(
-            jnp.where(mask, all_max, -jnp.inf), axis=0)
+        left_peak = _exclusive_block_reduce(
+            jnp.max(eq, axis=-1), axis_name, jnp.max, -jnp.inf)
         peak = jnp.maximum(peak_local, left_peak[..., None])
         dd = (peak - eq) / jnp.maximum(peak, eps)
         mdd = jax.lax.pmax(jnp.max(dd, axis=-1), axis_name)
@@ -234,24 +234,17 @@ def sharded_sma_backtest(mesh: Mesh, close, fast: int, slow: int, *,
 
         active = jnp.abs(prev_pos) > 0
         wins = (net > 0) & active
-        hit = (jax.lax.psum(jnp.sum(wins.astype(jnp.float32), -1), axis_name)
-               / (jax.lax.psum(jnp.sum(active.astype(jnp.float32), -1),
-                               axis_name) + eps))
+        wins_sum = jax.lax.psum(
+            jnp.sum(wins.astype(jnp.float32), -1), axis_name)
+        active_sum = jax.lax.psum(
+            jnp.sum(active.astype(jnp.float32), -1), axis_name)
         turnover = jax.lax.psum(
             jnp.sum(jnp.abs(pos - prev_pos), axis=-1), axis_name)
-        years = jnp.maximum(n_f / jnp.float32(periods_per_year), eps)
-        final = jnp.maximum(eq_final, eps)
-        return Metrics(
-            sharpe=mean / (std + eps) * ann,
-            sortino=mean / (dstd + eps) * ann,
-            max_drawdown=mdd,
-            total_return=eq_final - 1.0,
-            cagr=jnp.power(final, 1.0 / years) - 1.0,
-            volatility=std * ann,
-            hit_rate=hit,
-            n_trades=0.5 * turnover,
-            turnover=turnover,
-        )
+        return metrics_from_reductions(
+            s1=s1, s2=s2, downside_sq_sum=down_sq, mdd=mdd,
+            eq_final=eq_final, wins_sum=wins_sum, active_sum=active_sum,
+            turnover=turnover, n=n_f, periods_per_year=periods_per_year,
+            eps=eps)
 
     out_specs = Metrics(*(rep for _ in Metrics._fields))
     return jax.shard_map(local, mesh=mesh, in_specs=spec,

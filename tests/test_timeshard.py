@@ -110,3 +110,18 @@ def test_sharded_sma_backtest_rejects_oversized_window(devices):
     mesh = Mesh(np.asarray(devices[:8]), (timeshard.TIME_AXIS,))
     with pytest.raises(ValueError, match="halo"):
         timeshard.sharded_sma_backtest(mesh, jnp.ones((1, 256)), 5, 100)
+
+
+def test_sharded_sma_backtest_2d_mesh(devices):
+    """Divisibility/halo checks key on the TIME axis size, not total
+    devices: a (batch=2, time=4) mesh shards bars 4-way."""
+    from distributed_backtesting_exploration_tpu.parallel import timeshard
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    mesh = Mesh(np.asarray(devices[:8]).reshape(2, 4),
+                ("batch", timeshard.TIME_AXIS))
+    close = jnp.asarray(data.synthetic_ohlcv(2, 512, seed=29).close)
+    # slow=100 fits the 128-bar time block (would be spuriously rejected if
+    # the check divided by all 8 devices).
+    m = timeshard.sharded_sma_backtest(mesh, close, 5, 100, cost=1e-3)
+    assert np.isfinite(np.asarray(m.sharpe)).all()
