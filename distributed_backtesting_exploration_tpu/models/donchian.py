@@ -21,13 +21,9 @@ from .base import Strategy, register
 MAX_WINDOW = 256
 
 
-def _positions(ohlcv, params):
-    close = ohlcv.close
-    w = params["window"]
-    hi = rolling.rolling_extrema_traced(
-        close, w, max_window=MAX_WINDOW, mode="max", fill=jnp.inf)
-    lo = rolling.rolling_extrema_traced(
-        close, w, max_window=MAX_WINDOW, mode="min", fill=-jnp.inf)
+def _latch(close, hi, lo, w):
+    """Shared breakout latch: +1 above the prior channel high, -1 below the
+    prior low, hold otherwise; warmup flat."""
     # Channel known at the close of t-1, applied to bar t.
     hi_prev = jnp.concatenate([jnp.full_like(hi[..., :1], jnp.inf),
                                hi[..., :-1]], axis=-1)
@@ -49,9 +45,39 @@ def _positions(ohlcv, params):
     return jnp.moveaxis(pos_t, 0, -1)
 
 
+def _positions(ohlcv, params):
+    close = ohlcv.close
+    w = params["window"]
+    hi = rolling.rolling_extrema_traced(
+        close, w, max_window=MAX_WINDOW, mode="max", fill=jnp.inf)
+    lo = rolling.rolling_extrema_traced(
+        close, w, max_window=MAX_WINDOW, mode="min", fill=-jnp.inf)
+    return _latch(close, hi, lo, w)
+
+
+def _positions_hl(ohlcv, params):
+    """Classic Donchian channels from the HIGH/LOW columns: breakout when
+    the close clears the trailing extreme of the *highs*/*lows* — the first
+    family to consume the high/low fields (the close-only variant above is
+    kept as `donchian`; the fused kernel routes that one)."""
+    w = params["window"]
+    hi = rolling.rolling_extrema_traced(
+        ohlcv.high, w, max_window=MAX_WINDOW, mode="max", fill=jnp.inf)
+    lo = rolling.rolling_extrema_traced(
+        ohlcv.low, w, max_window=MAX_WINDOW, mode="min", fill=-jnp.inf)
+    return _latch(ohlcv.close, hi, lo, w)
+
+
 DONCHIAN = register(Strategy(
     name="donchian",
     param_fields=("window",),
     positions_fn=_positions,
+    stateful=True,
+))
+
+DONCHIAN_HL = register(Strategy(
+    name="donchian_hl",
+    param_fields=("window",),
+    positions_fn=_positions_hl,
     stateful=True,
 ))

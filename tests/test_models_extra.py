@@ -113,3 +113,82 @@ def test_rsi_macd_sweep_end_to_end():
 def test_new_strategies_registered():
     names = base.available_strategies()
     assert "rsi" in names and "macd" in names
+
+
+def test_rolling_vwap_matches_numpy():
+    from distributed_backtesting_exploration_tpu.models import vwap
+
+    s = data.synthetic_ohlcv(1, 120, seed=31)
+    close = np.asarray(s.close[0], np.float64)
+    volume = np.asarray(s.volume[0], np.float64)
+    w = 10
+    got = np.asarray(vwap.rolling_vwap(
+        jnp.asarray(close, jnp.float32), jnp.asarray(volume, jnp.float32),
+        jnp.float32(w)))
+    want = np.full_like(close, np.nan)
+    for t in range(w - 1, len(close)):
+        sl = slice(t - w + 1, t + 1)
+        want[t] = (close[sl] * volume[sl]).sum() / volume[sl].sum()
+    np.testing.assert_allclose(got[w - 1:], want[w - 1:], rtol=2e-5)
+
+
+def test_vwap_and_donchian_hl_sweep_end_to_end():
+    """The volume- and high/low-consuming families run through the sweep
+    engine — the OHLCV panel's non-close columns carry real signal."""
+    ohlcv = data.synthetic_ohlcv(3, 160, seed=33)
+    panel = type(ohlcv)(*(jnp.asarray(f) for f in ohlcv))
+
+    vgrid = sweep.product_grid(
+        window=jnp.asarray([10.0, 20.0], jnp.float32),
+        k=jnp.asarray([1.0, 2.0], jnp.float32))
+    m = sweep.jit_sweep(panel, base.get_strategy("vwap_reversion"),
+                        dict(vgrid), cost=1e-3)
+    assert np.isfinite(np.asarray(m.sharpe)).all()
+    # Volume must matter: doubling volume on later bars changes the signal.
+    skew = panel._replace(volume=panel.volume *
+                          jnp.linspace(1.0, 4.0, 160)[None, :])
+    m2 = sweep.jit_sweep(skew, base.get_strategy("vwap_reversion"),
+                         dict(vgrid), cost=1e-3)
+    assert not np.allclose(np.asarray(m.sharpe), np.asarray(m2.sharpe))
+
+    dgrid = sweep.product_grid(window=jnp.asarray([15.0, 30.0], jnp.float32))
+    d = sweep.jit_sweep(panel, base.get_strategy("donchian_hl"),
+                        dict(dgrid), cost=1e-3)
+    assert np.isfinite(np.asarray(d.sharpe)).all()
+    # High/low channels differ from close-only channels.
+    d_close = sweep.jit_sweep(panel, base.get_strategy("donchian"),
+                              dict(dgrid), cost=1e-3)
+    assert not np.allclose(np.asarray(d.sharpe), np.asarray(d_close.sharpe))
+
+
+def test_donchian_hl_serial_reference():
+    """Golden: the HL-channel latch vs a naive per-bar loop."""
+    s = data.synthetic_ohlcv(1, 140, seed=35)
+    high = np.asarray(s.high[0])
+    low = np.asarray(s.low[0])
+    close = np.asarray(s.close[0])
+    w = 12
+
+    class _O:
+        pass
+
+    o = _O()
+    o.high, o.low, o.close = (jnp.asarray(high), jnp.asarray(low),
+                              jnp.asarray(close))
+    got = np.asarray(base.get_strategy("donchian_hl").positions(
+        o, dict(window=jnp.float32(w))))
+
+    pos = np.zeros_like(close)
+    p = 0.0
+    for t in range(len(close)):
+        hi_prev = high[max(0, t - w):t].max() if t >= 1 else np.inf
+        lo_prev = low[max(0, t - w):t].min() if t >= 1 else -np.inf
+        if t >= w:   # valid after a full prior channel
+            if close[t] >= hi_prev:
+                p = 1.0
+            elif close[t] <= lo_prev:
+                p = -1.0
+        else:
+            p = 0.0
+        pos[t] = p
+    np.testing.assert_array_equal(got, pos)
