@@ -59,7 +59,7 @@ def _positions_hl(ohlcv, params):
     """Classic Donchian channels from the HIGH/LOW columns: breakout when
     the close clears the trailing extreme of the *highs*/*lows* — the first
     family to consume the high/low fields (the close-only variant above is
-    kept as `donchian`; the fused kernel routes that one)."""
+    kept as `donchian`; both route to `ops.fused` kernels)."""
     w = params["window"]
     hi = rolling.rolling_extrema_traced(
         ohlcv.high, w, max_window=MAX_WINDOW, mode="max", fill=jnp.inf)

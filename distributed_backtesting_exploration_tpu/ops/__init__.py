@@ -44,6 +44,8 @@ from .fused import (  # noqa: F401
     fused_bollinger_sweep,
     fused_momentum_sweep,
     fused_donchian_sweep,
+    fused_donchian_hl_sweep,
+    fused_vwap_sweep,
     fused_rsi_sweep,
     fused_macd_sweep,
     fused_pairs_sweep,

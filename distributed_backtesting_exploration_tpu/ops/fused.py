@@ -399,26 +399,25 @@ def _boll_kernel(r_ref, z_ref, ow_ref, k_ref, warm_ref, *refs,
     out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
-                     "ppy", "z_exit", "interpret"))
-def _fused_boll_call(close, onehot_w, k_lanes, warm, t_real, *, windows: tuple,
-                     T_pad: int, W_pad: int, P_real: int, T_real: int | None,
-                     cost: float, ppy: int, z_exit: float, interpret: bool):
-    """Z-score table prep + pallas call in one jit (same dispatch-economy
-    rationale as ``_fused_call``).
+def _pad_w(tbl, W_pad: int):
+    """Zero-pad an ``(N, W, T_pad)`` table's window axis up to ``W_pad``."""
+    N, W, T_pad = tbl.shape
+    if W_pad == W:
+        return tbl
+    return jnp.concatenate(
+        [tbl, jnp.zeros((N, W_pad - W, T_pad), jnp.float32)], axis=1)
 
-    The table replicates ``rolling.rolling_zscore``'s exact float op order so
-    CPU interpret-mode results are bit-identical to the generic path:
-    numerator from the *uncentered* rolling mean, std from series-centered
-    second moments (rolling.py's cancellation guard), eps=1e-12.
+
+def _cumsum_window_tools(windows: tuple, T_pad: int):
+    """Scaffolding for per-distinct-window cumsum-difference rolling sums.
+
+    Returns ``(w_col, w_f, t_row, windowed_sum, windowed_sum3)`` where the
+    two closures map ``(N, T_pad)`` / ``(N, W, T_pad)`` inputs to windowed
+    trailing sums, replicating ``rolling.rolling_sum``'s exact float op
+    order (inclusive prefix sum minus the clipped-gather shifted read).
+    Tables built with these are (N, W, T_pad) — T on the minor axis — so
+    HBM tiling pads W to a sublane multiple (8), not a lane multiple (128).
     """
-    N, T = close.shape
-    close_p = _pad_last(close, T_pad)
-
-    # Tables are built (N, W, T_pad) — T on the minor axis — so HBM tiling
-    # pads W to a sublane multiple (8) rather than a lane multiple (128).
     w_col = jnp.asarray(np.asarray(windows, np.int32))[:, None]  # (W,1)
     w_f = w_col.astype(jnp.float32)[None]                        # (1,W,1)
     t_row = jnp.arange(T_pad)[None, :]                           # (1,T_pad)
@@ -430,26 +429,25 @@ def _fused_boll_call(close, onehot_w, k_lanes, warm, t_real, *, windows: tuple,
         shifted = jnp.where(in_win, jnp.take(cs, gather_idx, axis=1), 0.0)
         return cs[:, None, :] - shifted
 
-    m = windowed_sum(close_p) / w_f                              # rolling mean
-    # Center with the mean over the REAL bars only (the generic path sees the
-    # unpadded series); the pad region's xc values never reach a real output.
-    xc = close_p - jnp.mean(close_p[:, :T], axis=1, keepdims=True)
-    s1 = windowed_sum(xc)
-    s2 = windowed_sum(xc * xc)
-    var = jnp.maximum((s2 - s1 * s1 / w_f) / w_f, 0.0)
-    z_table = (close_p[:, None, :] - m) / (jnp.sqrt(var) + 1e-12)
-    z_table = jnp.where((t_row >= w_col - 1)[None], z_table, 0.0)
-    if W_pad > len(windows):
-        z_table = jnp.concatenate(
-            [z_table,
-             jnp.zeros((N, W_pad - len(windows), T_pad), jnp.float32)],
-            axis=1)
+    def windowed_sum3(series):                                   # (N,W,T_pad)
+        cs = jnp.cumsum(series, axis=2)
+        idx = jnp.broadcast_to(gather_idx[None], cs.shape)
+        shifted = jnp.where(in_win,
+                            jnp.take_along_axis(cs, idx, axis=2), 0.0)
+        return cs - shifted
 
-    returns3 = _rets3(close_p)
+    return w_col, w_f, t_row, windowed_sum, windowed_sum3
+
+
+def _band_machine_pallas(kernel, close_p, z_table, onehot_w, k_lanes, warm,
+                         t_real, *, T_pad: int, W_pad: int, P_real: int,
+                         T_real: int | None, interpret: bool):
+    """Shared launch for every band-machine strategy (Bollinger, RSI, VWAP):
+    returns column + ``(N, W_pad, T_pad)`` z-table + one-hot/band/warmup
+    lanes into ``_boll_kernel``-shaped cells, :class:`Metrics` out."""
+    N = close_p.shape[0]
     P_pad = k_lanes.shape[1]
     n_blocks = P_pad // _LANES
-    kernel = functools.partial(_boll_kernel, cost=cost, ppy=ppy,
-                               z_exit=z_exit, T_real=T_real)
     out = pl.pallas_call(
         kernel,
         grid=(N, n_blocks),
@@ -471,11 +469,49 @@ def _fused_boll_call(close, onehot_w, k_lanes, warm, t_real, *, windows: tuple,
         out_shape=jax.ShapeDtypeStruct(
             (N, n_blocks, _METRIC_ROWS, _LANES), jnp.float32),
         interpret=interpret,
-    )(returns3, z_table, onehot_w, k_lanes, warm,
+    )(_rets3(close_p), z_table, onehot_w, k_lanes, warm,
       *_tr_args(t_real, T_real))
     return Metrics(*(
         jnp.reshape(out[:, :, k, :], (N, P_pad))[:, :P_real]
         for k in range(9)))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
+                     "ppy", "z_exit", "interpret"))
+def _fused_boll_call(close, onehot_w, k_lanes, warm, t_real, *, windows: tuple,
+                     T_pad: int, W_pad: int, P_real: int, T_real: int | None,
+                     cost: float, ppy: int, z_exit: float, interpret: bool):
+    """Z-score table prep + pallas call in one jit (same dispatch-economy
+    rationale as ``_fused_call``).
+
+    The table replicates ``rolling.rolling_zscore``'s exact float op order so
+    CPU interpret-mode results are bit-identical to the generic path:
+    numerator from the *uncentered* rolling mean, std from series-centered
+    second moments (rolling.py's cancellation guard), eps=1e-12.
+    """
+    N, T = close.shape
+    close_p = _pad_last(close, T_pad)
+    w_col, w_f, t_row, windowed_sum, _ = _cumsum_window_tools(windows, T_pad)
+
+    m = windowed_sum(close_p) / w_f                              # rolling mean
+    # Center with the mean over the REAL bars only (the generic path sees the
+    # unpadded series); the pad region's xc values never reach a real output.
+    xc = close_p - jnp.mean(close_p[:, :T], axis=1, keepdims=True)
+    s1 = windowed_sum(xc)
+    s2 = windowed_sum(xc * xc)
+    var = jnp.maximum((s2 - s1 * s1 / w_f) / w_f, 0.0)
+    z_table = (close_p[:, None, :] - m) / (jnp.sqrt(var) + 1e-12)
+    z_table = _pad_w(jnp.where((t_row >= w_col - 1)[None], z_table, 0.0),
+                     W_pad)
+
+    kernel = functools.partial(_boll_kernel, cost=cost, ppy=ppy,
+                               z_exit=z_exit, T_real=T_real)
+    return _band_machine_pallas(
+        kernel, close_p, z_table, onehot_w, k_lanes, warm, t_real,
+        T_pad=T_pad, W_pad=W_pad, P_real=P_real, T_real=T_real,
+        interpret=interpret)
 
 
 def fused_bollinger_sweep(close, window, k, *, t_real=None,
@@ -984,12 +1020,7 @@ def _fused_mom_call(close, onehot_l, warm, t_real, *, windows: tuple,
     w_col = jnp.asarray(np.asarray(windows, np.int32))[:, None]  # (W,1)
     t_row = jnp.arange(T_pad)[None, :]
     gather_idx = jnp.clip(t_row - w_col, 0, T_pad - 1)           # (W,T_pad)
-    past_tbl = jnp.take(close_p, gather_idx, axis=1)             # (N,W,T_pad)
-    if W_pad > len(windows):
-        past_tbl = jnp.concatenate(
-            [past_tbl,
-             jnp.zeros((close.shape[0], W_pad - len(windows), T_pad),
-                       jnp.float32)], axis=1)
+    past_tbl = _pad_w(jnp.take(close_p, gather_idx, axis=1), W_pad)
     kernel = functools.partial(_mom_kernel, cost=cost, ppy=ppy,
                                T_real=T_real)
     return _single_window_pallas(
@@ -1001,28 +1032,33 @@ def _fused_mom_call(close, onehot_l, warm, t_real, *, windows: tuple,
     jax.jit,
     static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
                      "ppy", "interpret"))
-def _fused_don_call(close, onehot_w, warm, t_real, *, windows: tuple,
-                    T_pad: int, W_pad: int, P_real: int, T_real: int | None,
-                    cost: float, ppy: int, interpret: bool):
+def _fused_don_call(close, hi_src, lo_src, onehot_w, warm, t_real, *,
+                    windows: tuple, T_pad: int, W_pad: int, P_real: int,
+                    T_real: int | None, cost: float, ppy: int,
+                    interpret: bool):
     """Channel-extrema table prep + pallas call in one jit. Windows are
     static, so each distinct window's rolling max/min uses the exact
     O(T log W) doubling ladder (``ops.rolling.rolling_max``); max/min of
-    exact closes is exact, so the channel — and hence every breakout
-    comparison — matches the generic path bit-for-bit."""
+    exact prices is exact, so the channel — and hence every breakout
+    comparison — matches the generic path bit-for-bit.
+
+    ``hi_src``/``lo_src`` are the columns the channel extrema come from:
+    the close itself for the close-only variant, the HIGH/LOW columns for
+    the classic channel (``models.donchian._positions_hl``). 1e30 stands in
+    for the generic path's ±inf warmup fill: the one-hot contraction would
+    turn inf into NaN via 0*inf, and no finite price ever clears 1e30, so
+    every breakout comparison is identical."""
     from . import rolling as rolling_mod
 
     close_p = _pad_last(close, T_pad)
-    N = close.shape[0]
+    hi_p = _pad_last(hi_src, T_pad)
+    lo_p = _pad_last(lo_src, T_pad)
     his, los = [], []
     for w in windows:
-        his.append(rolling_mod.rolling_max(close_p, int(w), fill=1e30))
-        los.append(rolling_mod.rolling_min(close_p, int(w), fill=-1e30))
-    hi_tbl = jnp.stack(his, axis=1)                              # (N,W,T_pad)
-    lo_tbl = jnp.stack(los, axis=1)
-    if W_pad > len(windows):
-        zpad = jnp.zeros((N, W_pad - len(windows), T_pad), jnp.float32)
-        hi_tbl = jnp.concatenate([hi_tbl, zpad], axis=1)
-        lo_tbl = jnp.concatenate([lo_tbl, zpad], axis=1)
+        his.append(rolling_mod.rolling_max(hi_p, int(w), fill=1e30))
+        los.append(rolling_mod.rolling_min(lo_p, int(w), fill=-1e30))
+    hi_tbl = _pad_w(jnp.stack(his, axis=1), W_pad)               # (N,W,T_pad)
+    lo_tbl = _pad_w(jnp.stack(los, axis=1), W_pad)
     kernel = functools.partial(_don_kernel, cost=cost, ppy=ppy,
                                T_real=T_real)
     return _single_window_pallas(
@@ -1071,7 +1107,37 @@ def fused_donchian_sweep(close, window, *, t_real=None, cost: float = 0.0,
     T = close.shape[1]
     windows, onehot_w, warm = _single_window_grid_setup(
         window.astype(np.float32).tobytes(), 1.0, "windows")
-    return _fused_don_call(close, onehot_w, warm, _t_real_col(t_real, close),
+    return _fused_don_call(close, close, close, onehot_w, warm,
+                           _t_real_col(t_real, close),
+                           windows=windows, T_pad=_round_up(T, 128),
+                           W_pad=onehot_w.shape[0], P_real=window.shape[0],
+                           T_real=T if t_real is None else None,
+                           cost=float(cost), ppy=int(periods_per_year),
+                           interpret=bool(interpret))
+
+
+def fused_donchian_hl_sweep(close, high, low, window, *, t_real=None,
+                            cost: float = 0.0, periods_per_year: int = 252,
+                            interpret: bool | None = None) -> Metrics:
+    """Fused high/low-channel Donchian sweep: ``(N, T)`` panels x ``(P,)``.
+
+    Matches ``run_sweep(..., "donchian_hl")`` — breakout when the close
+    clears the trailing extreme of the *highs*/*lows* (the classic channel;
+    the first fused kernel consuming the high/low columns). Channel extrema
+    are exact, so breakouts and the latch path are bit-identical to the
+    generic scan; metrics carry f32 tolerance.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    close = jnp.asarray(close, jnp.float32)
+    high = jnp.asarray(high, jnp.float32)
+    low = jnp.asarray(low, jnp.float32)
+    window = np.asarray(window)
+    T = close.shape[1]
+    windows, onehot_w, warm = _single_window_grid_setup(
+        window.astype(np.float32).tobytes(), 1.0, "windows")
+    return _fused_don_call(close, high, low, onehot_w, warm,
+                           _t_real_col(t_real, close),
                            windows=windows, T_pad=_round_up(T, 128),
                            W_pad=onehot_w.shape[0], P_real=window.shape[0],
                            T_real=T if t_real is None else None,
@@ -1114,7 +1180,6 @@ def _fused_rsi_call(close, onehot_p, band_lanes, warm, t_real, *,
     scan algorithm.
     """
     close_p = _pad_last(close, T_pad)
-    N = close.shape[0]
     diff = jnp.diff(close_p, axis=-1, prepend=close_p[..., :1])
     gains = jnp.maximum(diff, 0.0)
     losses = jnp.maximum(-diff, 0.0)
@@ -1128,42 +1193,14 @@ def _fused_rsi_call(close, onehot_p, band_lanes, warm, t_real, *,
         al = _ema_rows(losses, alpha)
         rsi = 100.0 - 100.0 / (1.0 + ag / (al + 1e-12))
         rows.append(rsi - 50.0)
-    z_tbl = jnp.stack(rows, axis=1)                              # (N,W,T_pad)
-    if W_pad > len(windows):
-        z_tbl = jnp.concatenate(
-            [z_tbl, jnp.zeros((N, W_pad - len(windows), T_pad),
-                              jnp.float32)], axis=1)
+    z_tbl = _pad_w(jnp.stack(rows, axis=1), W_pad)               # (N,W,T_pad)
 
-    P_pad = band_lanes.shape[1]
-    n_blocks = P_pad // _LANES
     kernel = functools.partial(_boll_kernel, cost=cost, ppy=ppy,
                                z_exit=0.0, T_real=T_real)
-    out = pl.pallas_call(
-        kernel,
-        grid=(N, n_blocks),
-        in_specs=[
-            pl.BlockSpec((1, T_pad, 1), lambda i, j: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, W_pad, T_pad), lambda i, j: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((W_pad, _LANES), lambda i, j: (0, j),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
-                         memory_space=pltpu.VMEM),
-        ] + _tr_specs(T_real),
-        out_specs=pl.BlockSpec(
-            (1, 1, _METRIC_ROWS, _LANES), lambda i, j: (i, j, 0, 0),
-            memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct(
-            (N, n_blocks, _METRIC_ROWS, _LANES), jnp.float32),
-        interpret=interpret,
-    )(_rets3(close_p), z_tbl, onehot_p, band_lanes, warm,
-      *_tr_args(t_real, T_real))
-    return Metrics(*(
-        jnp.reshape(out[:, :, k, :], (N, P_pad))[:, :P_real]
-        for k in range(9)))
+    return _band_machine_pallas(
+        kernel, close_p, z_tbl, onehot_p, band_lanes, warm, t_real,
+        T_pad=T_pad, W_pad=W_pad, P_real=P_real, T_real=T_real,
+        interpret=interpret)
 
 
 def fused_rsi_sweep(close, period, band, *, t_real=None, cost: float = 0.0,
@@ -1363,3 +1400,101 @@ def _macd_grid_setup(fast_bytes: bytes, slow_bytes: bytes,
     warm[0, :P] = slow + signal - 1.0
     return (tuple(int(s) for s in spans), jnp.asarray(oh_f),
             jnp.asarray(oh_s), jnp.asarray(a_sig), jnp.asarray(warm))
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
+                     "ppy", "interpret"))
+def _fused_vwap_call(close, volume, onehot_w, k_lanes, warm, t_real, *,
+                     windows: tuple, T_pad: int, W_pad: int, P_real: int,
+                     T_real: int | None, cost: float, ppy: int,
+                     interpret: bool):
+    """VWAP-deviation z-table prep + the *Bollinger* kernel.
+
+    ``models.vwap`` vectorized over the distinct-window axis: rolling VWAP =
+    windowed ``sum(close*volume) / sum(volume)`` (two cumsum differences),
+    the close's deviation from it z-scored over the same window, fed to the
+    shared band machine (enter beyond ±k, exit when price re-crosses the
+    anchor: z_exit = 0). The first fused kernel consuming the volume column.
+
+    Replicates the generic float op order on the real-bar region (cumsum-
+    difference rolling sums, uncentered rolling-mean numerator, series-
+    centered second moments, eps = 1e-12). Warmup rows — where the generic
+    path's NaN-filled window sums make ``v > eps`` False and the deviation
+    falls back to exactly 0 — are forced to 0 explicitly, as is the
+    zero-volume-window fallback.
+    """
+    T = close.shape[1]
+    close_p = _pad_last(close, T_pad)
+    vol_p = _pad_last(volume, T_pad)
+    w_col, w_f, t_row, windowed_sum, windowed_sum3 = _cumsum_window_tools(
+        windows, T_pad)
+
+    pv = windowed_sum(close_p * vol_p)
+    v = windowed_sum(vol_p)
+    have = (t_row >= (w_col - 1))[None] & (v > _EPS)
+    dev = jnp.where(have, close_p[:, None, :] - pv / (v + _EPS), 0.0)
+
+    m = windowed_sum3(dev) / w_f
+    # Center with the deviation's mean over the REAL bars (rolling.py's
+    # cancellation guard); the pad region never reaches a real output.
+    mu = jnp.mean(dev[:, :, :T], axis=2, keepdims=True)
+    xc = dev - mu
+    s1 = windowed_sum3(xc)
+    s2 = windowed_sum3(xc * xc)
+    var = jnp.maximum((s2 - s1 * s1 / w_f) / w_f, 0.0)
+    z_table = (dev - m) / (jnp.sqrt(var) + _EPS)
+    z_table = _pad_w(jnp.where((t_row >= w_col - 1)[None], z_table, 0.0),
+                     W_pad)
+
+    kernel = functools.partial(_boll_kernel, cost=cost, ppy=ppy,
+                               z_exit=0.0, T_real=T_real)
+    return _band_machine_pallas(
+        kernel, close_p, z_table, onehot_w, k_lanes, warm, t_real,
+        T_pad=T_pad, W_pad=W_pad, P_real=P_real, T_real=T_real,
+        interpret=interpret)
+
+
+def fused_vwap_sweep(close, volume, window, k, *, t_real=None,
+                     cost: float = 0.0, periods_per_year: int = 252,
+                     interpret: bool | None = None) -> Metrics:
+    """Fused VWAP-deviation reversion sweep: ``(N, T)`` panels x ``(P,)``.
+
+    ``window``/``k`` are flat per-combo arrays (:func:`product_grid` order);
+    windows must be integral bar counts. Matches the generic
+    ``run_sweep(..., "vwap_reversion")`` path (``models.vwap`` +
+    ``signals.band_hysteresis_assoc``): bit-level on CPU interpret mode; on
+    TPU the MXU z-selection matmul shares the knife-edge caveat of the other
+    band-machine kernels for |z - k| ~ 1e-7 relative.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    close = jnp.asarray(close, jnp.float32)
+    volume = jnp.asarray(volume, jnp.float32)
+    window = np.asarray(window)
+    k = np.asarray(k, np.float32)
+    T = close.shape[1]
+    P = window.shape[0]
+
+    windows, onehot_w, k_lanes, warm = _vwap_grid_setup(
+        window.astype(np.float32).tobytes(), k.tobytes())
+    return _fused_vwap_call(close, volume, onehot_w, k_lanes, warm,
+                            _t_real_col(t_real, close),
+                            windows=windows,
+                            T_pad=_round_up(T, 128), W_pad=onehot_w.shape[0],
+                            P_real=P, T_real=T if t_real is None else None,
+                            cost=float(cost), ppy=int(periods_per_year),
+                            interpret=bool(interpret))
+
+
+@functools.lru_cache(maxsize=4)
+def _vwap_grid_setup(window_bytes: bytes, k_bytes: bytes):
+    """Like :func:`_boll_grid_setup` but the warmup is ``2*window - 1``:
+    the VWAP needs ``window`` bars and its deviation's z-score another
+    ``window`` (``models.vwap._positions``'s validity rule)."""
+    windows, oh, k_lanes, warm = _boll_grid_setup(window_bytes, k_bytes)
+    window = np.frombuffer(window_bytes, np.float32)
+    P = window.shape[0]
+    warm = np.ones((1, warm.shape[1]), np.float32)
+    warm[0, :P] = 2.0 * window - 1.0
+    return windows, oh, k_lanes, jnp.asarray(warm)

@@ -11,7 +11,7 @@ the stub's isolation suggested (SURVEY.md §4).
 from __future__ import annotations
 
 import time
-from typing import Iterable, Protocol
+from typing import Callable, Iterable, NamedTuple, Protocol
 
 import numpy as np
 
@@ -50,20 +50,33 @@ class ComputeBackend(Protocol):
     # (reference src/worker/process.rs:21-25).
 
 
-def _stack_close_ragged(series_list, t_max: int) -> np.ndarray:
-    """Close-only ragged stack with repeat-last padding to ``t_max`` bars.
+def _stack_field_ragged(series_list, t_max: int,
+                        field: str = "close") -> np.ndarray:
+    """Single-column ragged stack with repeat-last padding to ``t_max`` bars.
 
     Repeat-last padding is load-bearing: pad bars earn exactly zero return
     and hold the final position, so the kernels' reductions over the padded
     width equal the unpadded ones (see ops.fused). Shared by the
     single-asset and pairs submit paths so the discipline cannot diverge.
+    (For non-close columns — high/low channels, volume — the repeated last
+    value changes nothing either: pad-bar positions never reach a metric.)
     """
     out = np.empty((len(series_list), t_max), np.float32)
     for i, s in enumerate(series_list):
-        a = np.asarray(s.close, np.float32)
+        a = np.asarray(getattr(s, field), np.float32)
         out[i, :a.shape[0]] = a
         out[i, a.shape[0]:] = a[-1]
     return out
+
+
+class _FusedSpec(NamedTuple):
+    """One fused-kernel routing row (see ``_FUSED_STRATEGIES``)."""
+
+    axes: set               # required grid axes, exactly
+    window_axes: tuple      # axes whose values must be integral bar counts
+    run: Callable           # (*field_arrays, grid, cost, ppy, t_real) -> Metrics
+    table_axes: tuple | None = None   # axes sizing the selection table
+    fields: tuple = ("close",)        # OHLCV columns the kernel consumes
 
 
 def _start_result_copy(m):
@@ -125,13 +138,13 @@ class JaxSweepBackend:
     _FUSED_MAX_BARS = 8192
     _FUSED_MAX_WINDOWS = 128
 
-    # Fused Pallas kernels per strategy: strategy name -> (required grid
-    # axes, window-bearing axes whose values must be integral, runner[,
-    # table axes]). "Table axes" are the ones whose distinct values size the
-    # kernel's selection table (defaults to the integral axes); MACD's
-    # signal spans are per-lane decays, not a table dimension, so they must
-    # not count toward the window cap. Eligibility and dispatch share this
-    # table so they cannot drift.
+    # Fused Pallas kernels per strategy, described by _FusedSpec rows.
+    # "Table axes" are the ones whose distinct values size the kernel's
+    # selection table (defaults to the integral window axes); MACD's signal
+    # spans are per-lane decays, not a table dimension, so they must not
+    # count toward the window cap. "Fields" are the OHLCV columns the kernel
+    # consumes — only those reach the device. Eligibility and dispatch share
+    # this table so they cannot drift.
     @staticmethod
     def _run_fused_sma(close, grid, cost, ppy, t_real):
         from ..ops import fused
@@ -175,15 +188,39 @@ class JaxSweepBackend:
             np.asarray(grid["signal"]), t_real=t_real, cost=cost,
             periods_per_year=ppy)
 
+    @staticmethod
+    def _run_fused_donchian_hl(close, high, low, grid, cost, ppy, t_real):
+        from ..ops import fused
+        return fused.fused_donchian_hl_sweep(
+            close, high, low, np.asarray(grid["window"]), t_real=t_real,
+            cost=cost, periods_per_year=ppy)
+
+    @staticmethod
+    def _run_fused_vwap(close, volume, grid, cost, ppy, t_real):
+        from ..ops import fused
+        return fused.fused_vwap_sweep(
+            close, volume, np.asarray(grid["window"]),
+            np.asarray(grid["k"]), t_real=t_real, cost=cost,
+            periods_per_year=ppy)
+
     _FUSED_STRATEGIES = {
-        "sma_crossover": ({"fast", "slow"}, ("fast", "slow"),
-                          _run_fused_sma),
-        "bollinger": ({"window", "k"}, ("window",), _run_fused_bollinger),
-        "momentum": ({"lookback"}, ("lookback",), _run_fused_momentum),
-        "donchian": ({"window"}, ("window",), _run_fused_donchian),
-        "rsi": ({"period", "band"}, ("period",), _run_fused_rsi),
-        "macd": ({"fast", "slow", "signal"}, ("fast", "slow", "signal"),
-                 _run_fused_macd, ("fast", "slow")),
+        "sma_crossover": _FusedSpec({"fast", "slow"}, ("fast", "slow"),
+                                    _run_fused_sma),
+        "bollinger": _FusedSpec({"window", "k"}, ("window",),
+                                _run_fused_bollinger),
+        "momentum": _FusedSpec({"lookback"}, ("lookback",),
+                               _run_fused_momentum),
+        "donchian": _FusedSpec({"window"}, ("window",), _run_fused_donchian),
+        "donchian_hl": _FusedSpec({"window"}, ("window",),
+                                  _run_fused_donchian_hl,
+                                  fields=("close", "high", "low")),
+        "rsi": _FusedSpec({"period", "band"}, ("period",), _run_fused_rsi),
+        "macd": _FusedSpec({"fast", "slow", "signal"},
+                           ("fast", "slow", "signal"), _run_fused_macd,
+                           table_axes=("fast", "slow")),
+        "vwap_reversion": _FusedSpec({"window", "k"}, ("window",),
+                                     _run_fused_vwap,
+                                     fields=("close", "volume")),
     }
 
     @classmethod
@@ -198,22 +235,21 @@ class JaxSweepBackend:
         spec = cls._FUSED_STRATEGIES.get(job.strategy)
         if spec is None:
             return False
-        axes, window_axes = spec[0], spec[1]
-        table_axes = spec[3] if len(spec) > 3 else window_axes
-        if set(grid) != axes:
+        if set(grid) != spec.axes:
             return False
-        wins = np.concatenate([grid[a] for a in window_axes])
+        wins = np.concatenate([grid[a] for a in spec.window_axes])
         if wins.size == 0:
             return False   # empty grid: route to generic, don't crash
         if not np.allclose(wins, np.round(wins)):
             return False
-        tbl = np.concatenate([grid[a] for a in table_axes])
+        tbl = np.concatenate(
+            [grid[a] for a in (spec.table_axes or spec.window_axes)])
         if np.unique(np.round(tbl)).size > cls._FUSED_MAX_WINDOWS:
             return False
-        if job.strategy == "donchian":
-            # The generic donchian path poisons windows beyond its static
+        if job.strategy in ("donchian", "donchian_hl"):
+            # The generic donchian paths poison windows beyond their static
             # view bound (models.donchian.MAX_WINDOW) to NaN; the fused
-            # kernel has no such bound, so larger windows would silently
+            # kernels have no such bound, so larger windows would silently
             # diverge from the semantics-defining path — keep them generic.
             from ..models import donchian as donchian_mod
 
@@ -274,18 +310,23 @@ class JaxSweepBackend:
                 # Repeat-last padding + per-ticker lengths: the kernels'
                 # padding discipline makes pad bars earn zero return and
                 # hold the final position, and all metric reductions use
-                # each ticker's real length. Only close reaches the device
-                # (no transfer of the unused open/high/low/volume).
+                # each ticker's real length. Only the columns the kernel
+                # consumes (spec.fields — close for most; +high/low or
+                # +volume for the channel/VWAP families) reach the device.
+                spec = self._FUSED_STRATEGIES[group[0].strategy]
                 if len(set(int(x) for x in lengths)) == 1:
-                    close = np.stack([np.asarray(s.close) for s in series])
+                    arrays = [np.stack([np.asarray(getattr(s, f))
+                                        for s in series])
+                              for f in spec.fields]
                     t_real = None
                 else:
-                    # Close-only stack (pad_and_stack would also pad the
-                    # four unused fields — wasted memcpy on the hot path).
-                    close = _stack_close_ragged(series, int(max(lengths)))
+                    # Column-wise stack (pad_and_stack would also pad the
+                    # unused fields — wasted memcpy on the hot path).
+                    t_max = int(max(lengths))
+                    arrays = [_stack_field_ragged(series, t_max, f)
+                              for f in spec.fields]
                     t_real = np.asarray(lengths, np.int32)
-                runner = self._FUSED_STRATEGIES[group[0].strategy][2]
-                m = runner(close, grid, group[0].cost, ppy, t_real)
+                m = spec.run(*arrays, grid, group[0].cost, ppy, t_real)
             else:
                 batch, _, mask = data_mod.pad_and_stack(series)
                 panel = type(batch)(*(jnp.asarray(f) for f in batch))
@@ -349,8 +390,8 @@ class JaxSweepBackend:
         cost = group[0].cost
         lens = np.asarray([y.n_bars for y in ys], np.int32)
         t_max = int(lens.max())
-        y_close = _stack_close_ragged(ys, t_max)
-        x_close = _stack_close_ragged(xs, t_max)
+        y_close = _stack_field_ragged(ys, t_max)
+        x_close = _stack_field_ragged(xs, t_max)
         uniform = len(set(int(v) for v in lens)) == 1
         lb = np.asarray(grid.get("lookback", np.empty(0)))
         fused_ok = (lb.size > 0 and np.allclose(lb, np.round(lb))

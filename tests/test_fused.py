@@ -410,3 +410,95 @@ def test_fused_macd_ragged():
         batch.close, np.asarray(grid["fast"]), np.asarray(grid["slow"]),
         np.asarray(grid["signal"]), t_real=lens, cost=1e-3)
     _macd_flip_aware_check(got, ref)
+
+def _check_panel_sweep(strategy, fused_call, grid_axes, n_tickers=3, T=200,
+                       cost=1e-3, seed=0, rtol=2e-4, atol=2e-5):
+    """Generic-vs-fused parity for strategies consuming non-close columns:
+    the fused callable receives the full panel + materialized grid."""
+    ohlcv = data.synthetic_ohlcv(n_tickers, T, seed=seed)
+    panel = type(ohlcv)(*(jnp.asarray(f) for f in ohlcv))
+    grid = sweep.product_grid(**grid_axes)
+    ref = sweep.jit_sweep(panel, get_strategy(strategy), dict(grid),
+                          cost=cost)
+    got = fused_call(panel, grid, None)
+    for name in ref._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(got, name)), np.asarray(getattr(ref, name)),
+            rtol=rtol, atol=atol, err_msg=name)
+
+
+def _check_panel_ragged(strategy, fused_call, grid_axes, lengths, cost=1e-3,
+                        seed=0):
+    series = []
+    for i, T in enumerate(lengths):
+        one = data.synthetic_ohlcv(1, T, seed=seed + i)
+        series.append(type(one)(*(f[0] for f in one)))
+    batch, lens, mask = data.pad_and_stack(series)
+    panel = type(batch)(*(jnp.asarray(f) for f in batch))
+    grid = sweep.product_grid(**grid_axes)
+    ref = sweep.jit_sweep(panel, get_strategy(strategy), dict(grid),
+                          cost=cost, bar_mask=jnp.asarray(mask))
+    got = fused_call(panel, grid, lens)
+    for name in ref._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(got, name)), np.asarray(getattr(ref, name)),
+            rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def _don_hl_call(panel, grid, lens):
+    return fused.fused_donchian_hl_sweep(
+        panel.close, panel.high, panel.low, np.asarray(grid["window"]),
+        t_real=lens, cost=1e-3)
+
+
+def _vwap_call(panel, grid, lens):
+    return fused.fused_vwap_sweep(
+        panel.close, panel.volume, np.asarray(grid["window"]),
+        np.asarray(grid["k"]), t_real=lens, cost=1e-3)
+
+
+def test_fused_donchian_hl_matches_generic():
+    _check_panel_sweep(
+        "donchian_hl", _don_hl_call,
+        dict(window=jnp.asarray([10, 20, 55], jnp.float32)), seed=5)
+
+
+def test_fused_donchian_hl_unaligned_T():
+    _check_panel_sweep(
+        "donchian_hl", _don_hl_call,
+        dict(window=jnp.asarray([15, 30], jnp.float32)), T=251, seed=7)
+
+
+def test_fused_donchian_hl_ragged():
+    _check_panel_ragged(
+        "donchian_hl", _don_hl_call,
+        dict(window=jnp.asarray([10.0, 20.0], jnp.float32)),
+        lengths=[150, 200, 97], seed=50)
+
+
+def test_fused_vwap_matches_generic():
+    _check_panel_sweep(
+        "vwap_reversion", _vwap_call,
+        dict(window=jnp.asarray([10, 20, 30], jnp.float32),
+             k=jnp.asarray([0.5, 1.0, 2.0], jnp.float32)), seed=13)
+
+
+def test_fused_vwap_unaligned_T():
+    _check_panel_sweep(
+        "vwap_reversion", _vwap_call,
+        dict(window=jnp.asarray([8, 16], jnp.float32),
+             k=jnp.asarray([1.0, 1.5], jnp.float32)), T=251, seed=15)
+
+
+def test_fused_vwap_ragged():
+    _check_panel_ragged(
+        "vwap_reversion", _vwap_call,
+        dict(window=jnp.asarray([10.0, 20.0], jnp.float32),
+             k=jnp.asarray([1.0, 2.0], jnp.float32)),
+        lengths=[180, 131, 256], seed=60)
+
+
+def test_fused_vwap_rejects_non_integer_windows():
+    with pytest.raises(ValueError, match="integral"):
+        fused.fused_vwap_sweep(jnp.ones((1, 64)), jnp.ones((1, 64)),
+                               np.asarray([10.5]), np.asarray([1.0]))

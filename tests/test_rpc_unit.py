@@ -548,3 +548,57 @@ def test_journal_compaction_idempotent_and_empty(tmp_path):
     b1, a1 = Journal.compact(jp)
     b2, a2 = Journal.compact(jp)
     assert (b2, a2) == (a1, a1)   # second pass is a no-op rewrite
+
+
+def test_backend_fused_multifield_strategies_match_generic():
+    """donchian_hl and vwap_reversion jobs route to fused kernels that
+    consume non-close columns (high/low, volume); the backend must ship
+    those columns and produce the generic path's DBXM payload."""
+    import numpy as np
+    from distributed_backtesting_exploration_tpu.rpc import compute, wire
+    from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+        synthetic_jobs)
+    from distributed_backtesting_exploration_tpu.rpc import backtesting_pb2 as pb
+
+    cases = [
+        ("donchian_hl", {"window": np.float32([10, 20])}),
+        ("vwap_reversion", {"window": np.float32([8, 16]),
+                            "k": np.float32([1.0, 2.0])}),
+    ]
+    for strategy, grid in cases:
+        recs = synthetic_jobs(2, 160, strategy, grid, cost=1e-3, seed=21)
+        specs = [pb.JobSpec(id=r.id, strategy=r.strategy, ohlcv=r.ohlcv,
+                            grid=wire.grid_to_proto(r.grid), cost=r.cost)
+                 for r in recs]
+        fused_backend = compute.JaxSweepBackend(use_fused=True)
+        assert fused_backend._fused_eligible(
+            specs[0], wire.grid_from_proto(specs[0].grid), [160]), strategy
+        got_f = {c.job_id: c.metrics
+                 for c in fused_backend.process(specs)}
+        got_g = {c.job_id: c.metrics
+                 for c in compute.JaxSweepBackend(use_fused=False
+                                                  ).process(specs)}
+        assert set(got_f) == {r.id for r in recs}
+        for jid in got_f:
+            mf = wire.metrics_from_bytes(got_f[jid])
+            mg = wire.metrics_from_bytes(got_g[jid])
+            for name in mf._fields:
+                np.testing.assert_allclose(
+                    np.asarray(getattr(mf, name)),
+                    np.asarray(getattr(mg, name)),
+                    rtol=2e-4, atol=2e-5, err_msg=f"{strategy}/{name}")
+
+
+def test_backend_fused_donchian_hl_big_window_stays_generic():
+    """Windows beyond models.donchian.MAX_WINDOW poison the generic
+    (semantics-defining) path to NaN; the hl router must not let the fused
+    kernel silently diverge there."""
+    import numpy as np
+    from distributed_backtesting_exploration_tpu.models import donchian
+    from distributed_backtesting_exploration_tpu.rpc import compute
+
+    class _Job:
+        strategy = "donchian_hl"
+
+    grid = {"window": np.float32([10, donchian.MAX_WINDOW + 1])}
+    assert not compute.JaxSweepBackend._fused_eligible(_Job(), grid, [160])
