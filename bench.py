@@ -5,9 +5,10 @@ SMA-crossover sweep over 5 years of daily bars with a 2,000-point
 (fast, slow) grid — 1,000,000 full backtests (indicators, positions, PnL,
 9 summary metrics) per sweep call, via the fused Pallas kernel. The suite
 also measures configs[2]-[4] and the rest of the fused family: Bollinger
-(500 x 1k (window, k)), momentum, Donchian, RSI, MACD, rolling-OLS pairs
-(1k pairs x 500 (lookback, z_entry)), and walk-forward (12 refit windows x
-param grid), plus an ``e2e`` config that pushes the headline workload
+(500 x 1k (window, k)), momentum, Donchian (close and high/low channels),
+VWAP reversion, RSI, MACD, rolling-OLS pairs (1k pairs x 500 (lookback,
+z_entry)), and walk-forward (12 refit windows x param grid), plus an
+``e2e`` config that pushes the headline workload
 through a loopback gRPC dispatcher + worker (decode, RPC and metric
 reporting included), printing a per-config line to stderr.
 
@@ -27,7 +28,8 @@ Prints ONE JSON line to stdout:
      "configs": {name: rate, ...}}
 
 ``--verify`` mode instead runs fused-vs-generic parity for every fused
-kernel (SMA, Bollinger, momentum, Donchian, RSI, MACD, pairs) ON THE CHIP
+kernel (SMA, Bollinger, momentum, Donchian close + high/low, VWAP, RSI,
+MACD, pairs) ON THE CHIP
 and prints one JSON line with max relative error and the argmax/entry flip
 rates (the knife-edge MXU caveat — plus, for pairs, the banded-tree-sum vs
 cumsum-difference caveat — quantified fresh each round).
@@ -180,6 +182,35 @@ def main():
         rates["donchian_fused"] = _measure(
             run_don, n_tickers * len(dwins), iters=iters, warmup=warmup,
             name="donchian_fused")
+
+    if enabled("donchian_hl_fused"):
+        hwins = np.tile(np.arange(10, 135, dtype=np.float32),
+                        max(min(n_params, 1000) // 125, 1))
+
+        def run_don_hl():
+            return fused.fused_donchian_hl_sweep(
+                panel.close, panel.high, panel.low, hwins, cost=1e-3)
+
+        rates["donchian_hl_fused"] = _measure(
+            run_don_hl, n_tickers * len(hwins), iters=iters, warmup=warmup,
+            name="donchian_hl_fused")
+
+    # --- vwap: the volume-consuming band-machine kernel -------------------
+    if enabled("vwap_fused"):
+        n_win, n_k = 20, max(min(n_params, 1000) // 20, 1)
+        vgrid = sweep.product_grid(
+            k=jnp.linspace(0.5, 3.0, n_k).astype(jnp.float32),
+            window=jnp.arange(10, 10 + 2 * n_win, 2, dtype=jnp.float32))
+        vw = np.asarray(vgrid["window"])
+        vk = np.asarray(vgrid["k"])
+
+        def run_vwap():
+            return fused.fused_vwap_sweep(panel.close, panel.volume, vw, vk,
+                                          cost=1e-3)
+
+        rates["vwap_fused"] = _measure(
+            run_vwap, n_tickers * sweep.grid_size(vgrid), iters=iters,
+            warmup=warmup, name="vwap_fused")
 
     # --- rsi / macd: the EMA-family fused kernels -------------------------
     if enabled("rsi_fused"):
@@ -347,8 +378,8 @@ def main():
 
     if not rates:
         known = ("sma_fused, bollinger_fused, momentum_fused, "
-                 "donchian_fused, rsi_fused, macd_fused, pairs, e2e, "
-                 "walkforward")
+                 "donchian_fused, donchian_hl_fused, vwap_fused, rsi_fused, "
+                 "macd_fused, pairs, e2e, walkforward")
         sys.exit(f"bench: no configs ran — DBX_BENCH_CONFIGS={only} matched "
                  f"nothing (known: {known})")
     # The headline is the north-star config when it ran; otherwise label the
@@ -436,6 +467,23 @@ def verify():
                 window=jnp.arange(10, 90, 2, dtype=jnp.float32)),
             lambda g: fused.fused_donchian_sweep(
                 panel.close, np.asarray(g["window"]), cost=1e-3),
+        ),
+        "donchian_hl": strat_case(
+            "donchian_hl",
+            sweep.product_grid(
+                window=jnp.arange(10, 90, 2, dtype=jnp.float32)),
+            lambda g: fused.fused_donchian_hl_sweep(
+                panel.close, panel.high, panel.low,
+                np.asarray(g["window"]), cost=1e-3),
+        ),
+        "vwap": strat_case(
+            "vwap_reversion",
+            sweep.product_grid(
+                k=jnp.linspace(0.5, 3.0, 20).astype(jnp.float32),
+                window=jnp.arange(10, 50, 2, dtype=jnp.float32)),
+            lambda g: fused.fused_vwap_sweep(
+                panel.close, panel.volume, np.asarray(g["window"]),
+                np.asarray(g["k"]), cost=1e-3),
         ),
         "rsi": strat_case(
             "rsi",

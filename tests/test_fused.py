@@ -502,3 +502,13 @@ def test_fused_vwap_rejects_non_integer_windows():
     with pytest.raises(ValueError, match="integral"):
         fused.fused_vwap_sweep(jnp.ones((1, 64)), jnp.ones((1, 64)),
                                np.asarray([10.5]), np.asarray([1.0]))
+
+
+def test_fused_vwap_window_beyond_history():
+    # A window larger than the padded history must not crash the static
+    # slicing in the table prep; such lanes never pass warmup, so they must
+    # match the generic path's all-flat result.
+    _check_panel_sweep(
+        "vwap_reversion", _vwap_call,
+        dict(window=jnp.asarray([10.0, 150.0], jnp.float32),
+             k=jnp.asarray([1.0], jnp.float32)), T=100, seed=23)
