@@ -44,6 +44,18 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
+def _const(a):
+    """Concrete device array, safe to build *inside* a trace.
+
+    The lru-cached grid setups convert their numpy tables once and reuse the
+    device buffer across calls. When a sweep is first invoked under an outer
+    trace (e.g. ``jit(shard_map(...))`` in the multi-chip worker backend), a
+    plain ``jnp.asarray`` would produce a tracer and the cache would capture
+    it — escaping the trace and poisoning every later call."""
+    with jax.ensure_compile_time_eval():
+        return jnp.asarray(a)
+
+
 def _pad_last(close, T_pad: int):
     """Pad ``(N, T)`` closes to ``T_pad`` bars by repeating the final close.
 
@@ -604,8 +616,8 @@ def _boll_grid_setup(window_bytes: bytes, k_bytes: bytes):
     k_lanes[0, :P] = k            # padded lanes never enter (k = +inf)
     warm = np.ones((1, P_pad), np.float32)
     warm[0, :P] = window
-    return (tuple(int(w) for w in windows), jnp.asarray(oh),
-            jnp.asarray(k_lanes), jnp.asarray(warm))
+    return (tuple(int(w) for w in windows), _const(oh),
+            _const(k_lanes), _const(warm))
 
 
 def _pairs_kernel(ry_ref, rx_ref, z_ref, b_ref, ow_ref, k_ref, zx_ref,
@@ -849,8 +861,8 @@ def _pairs_grid_setup(lb_bytes: bytes, ze_bytes: bytes, zx_bytes: bytes):
     zx_lanes[0, :P] = z_exit
     warm = np.ones((1, P_pad), np.float32)
     warm[0, :P] = 2.0 * lookback - 1.0   # OLS warmup + z-score warmup
-    return (tuple(int(w) for w in windows), jnp.asarray(oh),
-            jnp.asarray(k_lanes), jnp.asarray(zx_lanes), jnp.asarray(warm))
+    return (tuple(int(w) for w in windows), _const(oh),
+            _const(k_lanes), _const(zx_lanes), _const(warm))
 
 
 @functools.lru_cache(maxsize=4)
@@ -877,9 +889,9 @@ def _grid_setup(fast_bytes: bytes, slow_bytes: bytes):
     warm[0, :P] = np.maximum(fast, slow)
     warm[0, P:] = 1.0
     return (tuple(int(w) for w in windows),
-            jnp.asarray(_window_onehot(windows, fast, W_pad, P_pad)),
-            jnp.asarray(_window_onehot(windows, slow, W_pad, P_pad)),
-            jnp.asarray(warm))
+            _const(_window_onehot(windows, fast, W_pad, P_pad)),
+            _const(_window_onehot(windows, slow, W_pad, P_pad)),
+            _const(warm))
 
 
 # ---------------------------------------------------------------------------
@@ -1170,8 +1182,8 @@ def _single_window_grid_setup(vals_bytes: bytes, warm_offset: float,
     oh = _window_onehot(windows, vals, W_pad, P_pad)
     warm = np.ones((1, P_pad), np.float32)
     warm[0, :P] = vals + warm_offset
-    return (tuple(int(w) for w in windows), jnp.asarray(oh),
-            jnp.asarray(warm))
+    return (tuple(int(w) for w in windows), _const(oh),
+            _const(warm))
 
 
 @functools.partial(
@@ -1255,8 +1267,8 @@ def _rsi_grid_setup(period_bytes: bytes, band_bytes: bytes):
     band_lanes[0, :P] = band      # padded lanes never enter (band = +inf)
     warm = np.ones((1, P_pad), np.float32)
     warm[0, :P] = period + 1.0    # models.rsi: valid_mask(T, period + 1)
-    return (tuple(int(w) for w in windows), jnp.asarray(oh),
-            jnp.asarray(band_lanes), jnp.asarray(warm))
+    return (tuple(int(w) for w in windows), _const(oh),
+            _const(band_lanes), _const(warm))
 
 
 def _ema_ladder(x, a):
@@ -1410,8 +1422,8 @@ def _macd_grid_setup(fast_bytes: bytes, slow_bytes: bytes,
     a_sig[0, :P] = 2.0 / (signal + 1.0)
     warm = np.ones((1, P_pad), np.float32)
     warm[0, :P] = slow + signal - 1.0
-    return (tuple(int(s) for s in spans), jnp.asarray(oh_f),
-            jnp.asarray(oh_s), jnp.asarray(a_sig), jnp.asarray(warm))
+    return (tuple(int(s) for s in spans), _const(oh_f),
+            _const(oh_s), _const(a_sig), _const(warm))
 
 @functools.partial(
     jax.jit,
@@ -1509,4 +1521,4 @@ def _vwap_grid_setup(window_bytes: bytes, k_bytes: bytes):
     P = window.shape[0]
     warm = np.ones((1, warm.shape[1]), np.float32)
     warm[0, :P] = 2.0 * window - 1.0
-    return windows, oh, k_lanes, jnp.asarray(warm)
+    return windows, oh, k_lanes, _const(warm)

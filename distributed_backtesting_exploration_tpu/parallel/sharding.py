@@ -79,20 +79,32 @@ def device_put_sweep(mesh: Mesh, ohlcv, grid: Mapping[str, jax.Array],
 
 
 @functools.partial(
-    jax.jit, static_argnames=("mesh", "strategy", "periods_per_year"))
+    jax.jit, static_argnames=("mesh", "strategy", "periods_per_year",
+                              "param_chunk"))
 def sharded_sweep(mesh: Mesh, ohlcv, strategy, grid, *, cost=0.0,
-                  bar_mask=None, periods_per_year: int = 252):
+                  bar_mask=None, periods_per_year: int = 252,
+                  param_chunk: int | None = None):
     """The multi-chip sweep: ``shard_map`` of the fused kernel over tickers.
 
     Each chip runs :func:`~.sweep.run_sweep` on its ticker block; outputs are
     ``(n_tickers, P)`` metrics sharded the same way, so nothing but the caller
     ever moves them. Inputs should be placed with :func:`device_put_sweep`.
+
+    ``param_chunk`` composes the two memory valves: the mesh divides the
+    ticker axis, the ``lax.map`` chunking bounds the param axis's live
+    working set per chip (see :func:`~.sweep.chunked_sweep` — the bound
+    survives under ``shard_map`` because ``lax.map`` is sequential).
     """
     axis = mesh.axis_names[0]
     row, rep = P(axis, None), P()
     mask_spec = rep if bar_mask is None else row
 
     def local(ohlcv_blk, grid_rep, mask_blk):
+        if param_chunk:
+            return sweep_mod.chunked_sweep(
+                ohlcv_blk, strategy, grid_rep, param_chunk=param_chunk,
+                cost=cost, bar_mask=mask_blk,
+                periods_per_year=periods_per_year)
         return sweep_mod.run_sweep(
             ohlcv_blk, strategy, grid_rep, cost=cost, bar_mask=mask_blk,
             periods_per_year=periods_per_year)

@@ -117,7 +117,8 @@ class JaxSweepBackend:
     """
 
     def __init__(self, *, param_chunk: int | None = None,
-                 use_fused: bool | None = None):
+                 use_fused: bool | None = None,
+                 use_mesh: bool | None = None):
         import jax  # deferred: workers decide platform via env/config
 
         self._jax = jax
@@ -128,6 +129,19 @@ class JaxSweepBackend:
         if use_fused is None:
             use_fused = jax.default_backend() == "tpu"
         self.use_fused = use_fused
+        # Multi-chip workers shard every job group's ticker axis over a 1-D
+        # mesh of the local chips (advertising N chips while computing on
+        # one would leave N-1 idle). Defaults on for real multi-chip TPU
+        # hosts; tests opt in on the virtual CPU mesh.
+        if use_mesh is None:
+            use_mesh = (len(self._devices) > 1
+                        and jax.default_backend() == "tpu")
+        self._mesh = None
+        self._mesh_fns: dict = {}
+        if use_mesh and len(self._devices) > 1:
+            from ..parallel import sharding as sharding_mod
+
+            self._mesh = sharding_mod.make_mesh(self._devices)
 
     @property
     def chips(self) -> int:
@@ -257,6 +271,82 @@ class JaxSweepBackend:
                 return False
         return int(max(lengths)) <= cls._FUSED_MAX_BARS
 
+    def _mesh_call(self, key, runner, row_arrays, t_real):
+        """Run ``runner(*blocks, t_real_block)`` with ticker rows sharded
+        over the worker's chip mesh.
+
+        The (ticker x param) sweep is embarrassingly parallel, so the SPMD
+        program has no collectives: each chip runs the fused kernel on its
+        row block and the metrics stay row-sharded until the stacked result
+        copy. Rows pad to a mesh multiple by repeating the last row (the pad
+        rows are real compute but land beyond ``len(group)`` in collect, so
+        they are never reported). The jit(shard_map) wrapper is cached per
+        (strategy, grid, cost) key — rebuilding it per batch would retrace
+        every poll.
+        """
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel import sharding as sharding_mod
+
+        mesh = self._mesh
+        axis = mesh.axis_names[0]
+        n = row_arrays[0].shape[0]
+        n_pad = sharding_mod.pad_tickers(n, mesh.devices.size)
+
+        def pad(a):
+            if a.shape[0] == n_pad:
+                return a
+            return np.concatenate(
+                [a, np.repeat(a[-1:], n_pad - a.shape[0], axis=0)], axis=0)
+
+        row = NamedSharding(mesh, P(axis, None))
+        args = [self._jax.device_put(pad(np.asarray(a, np.float32)), row)
+                for a in row_arrays]
+        ragged = t_real is not None
+        if ragged:
+            args.append(self._jax.device_put(
+                pad(np.asarray(t_real, np.int32).reshape(-1, 1)), row))
+
+        key = key + (ragged,)
+        fn = self._mesh_fns.get(key)
+        if fn is None:
+            from ..ops.metrics import Metrics
+
+            def local(*blks):
+                if ragged:
+                    *data, tr_blk = blks
+                    return runner(*data, tr_blk[:, 0])
+                return runner(*blks, None)
+
+            fn = jax.jit(jax.shard_map(
+                local, mesh=mesh,
+                in_specs=tuple(P(axis, None) for _ in args),
+                out_specs=Metrics(*(P(axis, None)
+                                    for _ in Metrics._fields)),
+                check_vma=False))
+            if len(self._mesh_fns) >= self._MESH_FN_CAP:
+                # FIFO eviction: a long-lived worker cycling through many
+                # distinct grids must not grow compiled executables forever
+                # (an evicted entry simply recompiles on next use).
+                self._mesh_fns.pop(next(iter(self._mesh_fns)))
+            self._mesh_fns[key] = fn
+        return fn(*args)
+
+    _MESH_FN_CAP = 32
+
+    @staticmethod
+    def _group_key(job, axes) -> tuple:
+        """Cache key capturing everything a mesh runner closes over.
+
+        Hashes the per-parameter AXES (small, as the submit grouping key
+        does), not the materialized cartesian product — the product is a
+        deterministic function of the axes."""
+        return (job.strategy,
+                tuple(sorted((k, np.asarray(v).tobytes())
+                             for k, v in axes.items())),
+                float(job.cost), int(job.periods_per_year or 252))
+
     def submit(self, jobs) -> list:
         """Dispatch a batch: decode, transfer, launch kernels, start the
         device->host result copy — all without blocking on the device.
@@ -326,20 +416,56 @@ class JaxSweepBackend:
                     arrays = [_stack_field_ragged(series, t_max, f)
                               for f in spec.fields]
                     t_real = np.asarray(lengths, np.int32)
-                m = spec.run(*arrays, grid, group[0].cost, ppy, t_real)
+                cost = group[0].cost
+                if self._mesh is not None:
+                    run = spec.run
+
+                    def runner(*a, run=run, grid=grid, cost=cost, ppy=ppy):
+                        return run(*a[:-1], grid, cost, ppy, a[-1])
+
+                    m = self._mesh_call(
+                        ("fused",) + self._group_key(group[0], axes),
+                        runner, arrays, t_real)
+                else:
+                    m = spec.run(*arrays, grid, cost, ppy, t_real)
             else:
                 batch, _, mask = data_mod.pad_and_stack(series)
-                panel = type(batch)(*(jnp.asarray(f) for f in batch))
-                kwargs = dict(cost=group[0].cost, bar_mask=jnp.asarray(mask),
-                              periods_per_year=ppy)
-                P = sweep_mod.grid_size(grid) if grid else 1
-                if self.param_chunk and P % self.param_chunk == 0:
-                    m = sweep_mod.chunked_sweep(
-                        panel, strategy, grid, param_chunk=self.param_chunk,
-                        **kwargs)
+                if self._mesh is not None:
+                    # The generic path's multi-chip story already exists in
+                    # the library: device_put_sweep + sharded_sweep (tickers
+                    # over the mesh, grid replicated). The two memory valves
+                    # compose: the mesh divides the ticker axis, param_chunk
+                    # still bounds the param axis's live set per chip.
+                    from ..parallel import sharding as sharding_mod
+
+                    P = sweep_mod.grid_size(grid) if grid else 1
+                    chunk = (self.param_chunk
+                             if self.param_chunk and P % self.param_chunk == 0
+                             else None)
+                    sh_panel, sh_grid, sh_mask, _ = (
+                        sharding_mod.device_put_sweep(
+                            self._mesh, batch,
+                            {k: jnp.asarray(v) for k, v in grid.items()},
+                            bar_mask=mask))
+                    m = sharding_mod.sharded_sweep(
+                        self._mesh, sh_panel, strategy, sh_grid,
+                        cost=group[0].cost, bar_mask=sh_mask,
+                        periods_per_year=ppy, param_chunk=chunk)
                 else:
-                    m = sweep_mod.jit_sweep(panel, strategy, grid, **kwargs)
-            pending.append((group, _start_result_copy(m), t0))
+                    panel = type(batch)(*(jnp.asarray(f) for f in batch))
+                    kwargs = dict(cost=group[0].cost,
+                                  bar_mask=jnp.asarray(mask),
+                                  periods_per_year=ppy)
+                    P = sweep_mod.grid_size(grid) if grid else 1
+                    if self.param_chunk and P % self.param_chunk == 0:
+                        m = sweep_mod.chunked_sweep(
+                            panel, strategy, grid,
+                            param_chunk=self.param_chunk, **kwargs)
+                    else:
+                        m = sweep_mod.jit_sweep(panel, strategy, grid,
+                                                **kwargs)
+            pending.append((group, _start_result_copy(m), t0,
+                            len(group)))
         return pending
 
     def _submit_pairs_group(self, group, t0):
@@ -380,7 +506,7 @@ class JaxSweepBackend:
                 continue
             good.append((j, y, x))
         if not good:
-            return (bad, None, t0)
+            return (bad, None, t0, 0)
         group = [j for j, _, _ in good]
         ys = [y for _, y, _ in good]
         xs = [x for _, _, x in good]
@@ -400,17 +526,37 @@ class JaxSweepBackend:
                     and t_max <= self._FUSED_MAX_BARS)
         if self.use_fused and fused_ok:
             from ..ops import fused
-            m = fused.fused_pairs_sweep(
-                y_close, x_close, np.asarray(grid["lookback"]),
-                np.asarray(grid["z_entry"]),
-                z_exit=np.asarray(grid["z_exit"])
-                if "z_exit" in grid else 0.0,
-                t_real=None if uniform else lens, cost=cost,
-                periods_per_year=ppy)
+
+            plb = np.asarray(grid["lookback"])
+            pze = np.asarray(grid["z_entry"])
+            pzx = (np.asarray(grid["z_exit"]) if "z_exit" in grid else 0.0)
+            t_real = None if uniform else lens
+            if self._mesh is not None:
+                def runner(yb, xb, tr):
+                    return fused.fused_pairs_sweep(
+                        yb, xb, plb, pze, z_exit=pzx, t_real=tr, cost=cost,
+                        periods_per_year=ppy)
+
+                m = self._mesh_call(
+                    ("pairs-fused",) + self._group_key(group[0], axes),
+                    runner, [y_close, x_close], t_real)
+            else:
+                m = fused.fused_pairs_sweep(
+                    y_close, x_close, plb, pze, z_exit=pzx, t_real=t_real,
+                    cost=cost, periods_per_year=ppy)
         elif uniform:
-            m = pairs_mod.run_pairs_sweep(
-                jnp.asarray(y_close), jnp.asarray(x_close), dict(grid),
-                cost=cost, periods_per_year=ppy)
+            if self._mesh is not None:
+                def runner(yb, xb, tr):
+                    return pairs_mod.run_pairs_sweep(
+                        yb, xb, dict(grid), cost=cost, periods_per_year=ppy)
+
+                m = self._mesh_call(
+                    ("pairs-generic",) + self._group_key(group[0], axes),
+                    runner, [y_close, x_close], None)
+            else:
+                m = pairs_mod.run_pairs_sweep(
+                    jnp.asarray(y_close), jnp.asarray(x_close), dict(grid),
+                    cost=cost, periods_per_year=ppy)
         else:
             rows = [pairs_mod.run_pairs_sweep(
                 jnp.asarray(y_close[i:i + 1, :int(lens[i])]),
@@ -419,18 +565,22 @@ class JaxSweepBackend:
                 for i in range(len(group))]
             m = type(rows[0])(*(jnp.concatenate(f, axis=0)
                                 for f in zip(*rows)))
-        return (list(group) + bad, _start_result_copy(m), t0)
+        return (list(group) + bad, _start_result_copy(m), t0,
+                len(group))
 
     def collect(self, pending) -> list[Completion]:
         """Block for a submitted batch's results and pack completions."""
         from ..ops.metrics import Metrics
 
         out: list[Completion] = []
-        for group, stacked, t0 in pending:
+        for group, stacked, t0, n_real in pending:
             host = None if stacked is None else np.asarray(stacked)
             elapsed = time.perf_counter() - t0
             per_job = elapsed / max(len(group), 1)
-            n_rows = 0 if host is None else host.shape[1]
+            # n_real (the jobs actually computed), NOT host.shape[1]: the
+            # mesh path pads rows to a chip multiple, and a pad row must
+            # never be reported as a validated-bad job's "result".
+            n_rows = 0 if host is None else min(host.shape[1], n_real)
             for i, job in enumerate(group):
                 if i < n_rows:
                     row = Metrics(*(host[k, i] for k in range(9)))

@@ -1,0 +1,175 @@
+"""Multi-chip worker backend: job groups sharded over the local chip mesh.
+
+A worker advertising N chips must actually use them: ``JaxSweepBackend``
+with ``use_mesh=True`` shards every job group's ticker axis over a 1-D mesh
+of the local devices (8 virtual CPU devices here — SURVEY.md §4's strategy)
+and must produce the same DBXM payloads as the single-device backend for
+every routing path: fused uniform, fused ragged, generic, pairs fused, and
+pairs generic.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_backtesting_exploration_tpu.rpc import (
+    backtesting_pb2 as pb, compute, wire)
+from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+    synthetic_jobs)
+
+
+def _specs(recs):
+    return [pb.JobSpec(id=r.id, strategy=r.strategy, ohlcv=r.ohlcv,
+                       ohlcv2=r.ohlcv2 or b"", grid=wire.grid_to_proto(r.grid),
+                       cost=r.cost) for r in recs]
+
+
+def _assert_same_payloads(got_a, got_b, *, rtol=2e-4, atol=2e-5):
+    assert set(got_a) == set(got_b)
+    for jid in got_a:
+        ma = wire.metrics_from_bytes(got_a[jid])
+        mb = wire.metrics_from_bytes(got_b[jid])
+        for name in ma._fields:
+            np.testing.assert_allclose(
+                np.asarray(getattr(ma, name)), np.asarray(getattr(mb, name)),
+                rtol=rtol, atol=atol, err_msg=f"{jid}/{name}")
+
+
+def _run(backend, specs):
+    return {c.job_id: c.metrics for c in backend.process(specs)}
+
+
+@pytest.fixture(scope="module")
+def mesh_backends(devices):
+    """(mesh, single-device) backend pairs for the fused and generic paths."""
+    return {
+        "fused_mesh": compute.JaxSweepBackend(use_fused=True, use_mesh=True),
+        "fused_one": compute.JaxSweepBackend(use_fused=True, use_mesh=False),
+        "generic_mesh": compute.JaxSweepBackend(use_fused=False,
+                                                use_mesh=True),
+        "generic_one": compute.JaxSweepBackend(use_fused=False,
+                                               use_mesh=False),
+    }
+
+
+def test_mesh_backend_builds_mesh(mesh_backends):
+    b = mesh_backends["fused_mesh"]
+    assert b._mesh is not None and b._mesh.devices.size >= 8
+    assert b.chips >= 8
+    assert mesh_backends["fused_one"]._mesh is None
+
+
+def test_mesh_fused_group_matches_single_device(mesh_backends):
+    # 11 jobs over 8 shards: uneven split, last block padded by repetition.
+    grid = {"fast": np.float32([3, 5]), "slow": np.float32([13, 21])}
+    specs = _specs(synthetic_jobs(11, 160, "sma_crossover", grid,
+                                  cost=1e-3, seed=3))
+    _assert_same_payloads(_run(mesh_backends["fused_mesh"], specs),
+                          _run(mesh_backends["fused_one"], specs))
+
+
+def test_mesh_fused_multifield_group(mesh_backends):
+    grid = {"window": np.float32([8, 16]), "k": np.float32([1.0, 2.0])}
+    specs = _specs(synthetic_jobs(5, 160, "vwap_reversion", grid,
+                                  cost=1e-3, seed=5))
+    _assert_same_payloads(_run(mesh_backends["fused_mesh"], specs),
+                          _run(mesh_backends["fused_one"], specs))
+
+
+def test_mesh_fused_ragged_group(mesh_backends):
+    # Mixed history lengths keep the fused path (per-ticker t_real) and the
+    # ragged lengths column must shard with its rows.
+    grid = {"fast": np.float32([3, 5]), "slow": np.float32([13.0])}
+    recs = []
+    for i, bars in enumerate([150, 200, 97, 130, 180, 160, 140, 110, 125]):
+        recs += synthetic_jobs(1, bars, "sma_crossover", grid, cost=1e-3,
+                               seed=40 + i)
+    specs = _specs(recs)
+    mesh_out = _run(mesh_backends["fused_mesh"], specs)
+    one_out = _run(mesh_backends["fused_one"], specs)
+    _assert_same_payloads(mesh_out, one_out)
+
+
+def test_mesh_generic_group_matches_single_device(mesh_backends):
+    # momentum with a non-integral lookback grid routes generic; the mesh
+    # backend must use the library's sharded_sweep and agree.
+    grid = {"lookback": np.float32([5.5, 10.25])}
+    specs = _specs(synthetic_jobs(9, 160, "momentum", grid, cost=1e-3,
+                                  seed=7))
+    _assert_same_payloads(_run(mesh_backends["generic_mesh"], specs),
+                          _run(mesh_backends["generic_one"], specs))
+
+
+def test_mesh_pairs_fused_and_generic(mesh_backends):
+    grid = {"lookback": np.float32([10, 20]),
+            "z_entry": np.float32([1.0, 2.0])}
+    specs = _specs(synthetic_jobs(9, 160, "pairs", grid, cost=1e-3, seed=9))
+    _assert_same_payloads(_run(mesh_backends["fused_mesh"], specs),
+                          _run(mesh_backends["fused_one"], specs))
+    _assert_same_payloads(_run(mesh_backends["generic_mesh"], specs),
+                          _run(mesh_backends["generic_one"], specs))
+
+
+def test_mesh_backend_end_to_end_worker(devices):
+    """A worker with a mesh backend drains a live dispatcher's queue."""
+    import threading
+    import time
+
+    from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+        Dispatcher, DispatcherServer, JobQueue, PeerRegistry)
+    from distributed_backtesting_exploration_tpu.rpc.worker import Worker
+
+    q = JobQueue()
+    grid = {"fast": np.float32([3, 5]), "slow": np.float32([13.0])}
+    for r in synthetic_jobs(10, 120, "sma_crossover", grid, cost=1e-3,
+                            seed=11):
+        q.enqueue(r)
+    disp = Dispatcher(q, PeerRegistry(prune_window_s=30.0))
+    srv = DispatcherServer(disp, bind="localhost:0",
+                           prune_interval_s=0.5).start()
+    w = Worker(f"localhost:{srv.port}",
+               backend=compute.JaxSweepBackend(use_fused=True, use_mesh=True),
+               poll_interval_s=0.05)
+    t = threading.Thread(target=w.run, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and not q.drained:
+            time.sleep(0.1)
+        assert q.drained, f"queue not drained: {q.stats()}"
+        assert q.stats()["jobs_completed"] == 10
+    finally:
+        w.stop()
+        t.join(timeout=20)
+        srv.stop()
+
+
+def test_mesh_pad_rows_never_reported_for_bad_pairs_jobs(mesh_backends):
+    """A malformed pairs job co-batched with good ones must complete with an
+    EMPTY metric blob on the mesh path too — the mesh pads metric rows to a
+    chip multiple, and a pad row must never masquerade as its result."""
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    grid = {"lookback": np.float32([10.0]), "z_entry": np.float32([1.0])}
+    recs = synthetic_jobs(6, 160, "pairs", grid, cost=1e-3, seed=13)
+    specs = _specs(recs)
+    # Corrupt one job: second leg shorter than the first (validated bad).
+    bad = data.synthetic_ohlcv(1, 90, seed=99)
+    specs[3].ohlcv2 = data.to_wire_bytes(type(bad)(*(f[0] for f in bad)))
+    got = _run(mesh_backends["fused_mesh"], specs)
+    assert got[specs[3].id] == b""
+    for s in specs:
+        if s.id != specs[3].id:
+            assert got[s.id] != b""
+
+
+def test_mesh_generic_param_chunk_composes(devices):
+    """param_chunk (the param-axis memory valve) must stay honored under
+    the mesh: chunked mesh results equal unchunked single-device results."""
+    backend_chunked = compute.JaxSweepBackend(
+        use_fused=False, use_mesh=True, param_chunk=2)
+    backend_plain = compute.JaxSweepBackend(use_fused=False, use_mesh=False)
+    grid = {"lookback": np.float32([5.5, 7.25, 10.5, 12.0])}  # P=4, chunk=2
+    specs = _specs(synthetic_jobs(9, 140, "momentum", grid, cost=1e-3,
+                                  seed=17))
+    _assert_same_payloads(_run(backend_chunked, specs),
+                          _run(backend_plain, specs))
