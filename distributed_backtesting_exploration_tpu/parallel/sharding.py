@@ -48,6 +48,19 @@ def pad_tickers(n_tickers: int, n_shards: int) -> int:
     return -(-n_tickers // n_shards) * n_shards
 
 
+def pad_rows(a: np.ndarray, n_pad: int) -> np.ndarray:
+    """Pad a row-stacked array to ``n_pad`` rows by repeating the last row.
+
+    THE shard-even padding discipline (used by :func:`device_put_sweep` and
+    the worker's mesh dispatch): repeated rows are real, well-formed inputs
+    whose outputs callers drop, so no kernel needs a validity mask."""
+    a = np.asarray(a)
+    n = a.shape[0]
+    if n_pad == n:
+        return a
+    return np.concatenate([a, np.repeat(a[-1:], n_pad - n, axis=0)], axis=0)
+
+
 def device_put_sweep(mesh: Mesh, ohlcv, grid: Mapping[str, jax.Array],
                      bar_mask=None):
     """Place a sweep's inputs: tickers sharded over the mesh, grid replicated.
@@ -58,23 +71,16 @@ def device_put_sweep(mesh: Mesh, ohlcv, grid: Mapping[str, jax.Array],
     callers slice results back to ``[:n_real]``.
     """
     axis = mesh.axis_names[0]
-    n_shards = mesh.devices.size
     n = ohlcv.close.shape[0]
-    n_pad = pad_tickers(n, n_shards)
-
-    def pad(a):
-        a = np.asarray(a)
-        if n_pad == n:
-            return a
-        reps = np.repeat(a[-1:], n_pad - n, axis=0)
-        return np.concatenate([a, reps], axis=0)
+    n_pad = pad_tickers(n, mesh.devices.size)
 
     row = NamedSharding(mesh, P(axis, None))
     rep = NamedSharding(mesh, P())
-    ohlcv = type(ohlcv)(*(jax.device_put(pad(f), row) for f in ohlcv))
+    ohlcv = type(ohlcv)(*(jax.device_put(pad_rows(f, n_pad), row)
+                          for f in ohlcv))
     grid = {k: jax.device_put(jnp.asarray(v), rep) for k, v in grid.items()}
     if bar_mask is not None:
-        bar_mask = jax.device_put(pad(bar_mask), row)
+        bar_mask = jax.device_put(pad_rows(bar_mask, n_pad), row)
     return ohlcv, grid, bar_mask, n
 
 

@@ -291,22 +291,20 @@ class JaxSweepBackend:
 
         mesh = self._mesh
         axis = mesh.axis_names[0]
-        n = row_arrays[0].shape[0]
-        n_pad = sharding_mod.pad_tickers(n, mesh.devices.size)
-
-        def pad(a):
-            if a.shape[0] == n_pad:
-                return a
-            return np.concatenate(
-                [a, np.repeat(a[-1:], n_pad - a.shape[0], axis=0)], axis=0)
+        n_pad = sharding_mod.pad_tickers(row_arrays[0].shape[0],
+                                         mesh.devices.size)
 
         row = NamedSharding(mesh, P(axis, None))
-        args = [self._jax.device_put(pad(np.asarray(a, np.float32)), row)
+        args = [self._jax.device_put(
+                    sharding_mod.pad_rows(np.asarray(a, np.float32), n_pad),
+                    row)
                 for a in row_arrays]
         ragged = t_real is not None
         if ragged:
             args.append(self._jax.device_put(
-                pad(np.asarray(t_real, np.int32).reshape(-1, 1)), row))
+                sharding_mod.pad_rows(
+                    np.asarray(t_real, np.int32).reshape(-1, 1), n_pad),
+                row))
 
         key = key + (ragged,)
         fn = self._mesh_fns.get(key)
@@ -430,6 +428,12 @@ class JaxSweepBackend:
                     m = spec.run(*arrays, grid, cost, ppy, t_real)
             else:
                 batch, _, mask = data_mod.pad_and_stack(series)
+                # One chunk-eligibility rule for both branches: the mesh and
+                # single-device backends must agree on memory bounding.
+                P = sweep_mod.grid_size(grid) if grid else 1
+                chunk = (self.param_chunk
+                         if self.param_chunk and P % self.param_chunk == 0
+                         else None)
                 if self._mesh is not None:
                     # The generic path's multi-chip story already exists in
                     # the library: device_put_sweep + sharded_sweep (tickers
@@ -438,10 +442,6 @@ class JaxSweepBackend:
                     # still bounds the param axis's live set per chip.
                     from ..parallel import sharding as sharding_mod
 
-                    P = sweep_mod.grid_size(grid) if grid else 1
-                    chunk = (self.param_chunk
-                             if self.param_chunk and P % self.param_chunk == 0
-                             else None)
                     sh_panel, sh_grid, sh_mask, _ = (
                         sharding_mod.device_put_sweep(
                             self._mesh, batch,
@@ -456,11 +456,10 @@ class JaxSweepBackend:
                     kwargs = dict(cost=group[0].cost,
                                   bar_mask=jnp.asarray(mask),
                                   periods_per_year=ppy)
-                    P = sweep_mod.grid_size(grid) if grid else 1
-                    if self.param_chunk and P % self.param_chunk == 0:
+                    if chunk:
                         m = sweep_mod.chunked_sweep(
-                            panel, strategy, grid,
-                            param_chunk=self.param_chunk, **kwargs)
+                            panel, strategy, grid, param_chunk=chunk,
+                            **kwargs)
                     else:
                         m = sweep_mod.jit_sweep(panel, strategy, grid,
                                                 **kwargs)
