@@ -20,11 +20,17 @@ kernel in this framework:
   incoming carry. Exact same math as the single-device
   ``lax.associative_scan`` — verified bit-for-bit in tests.
 
-The general hysteresis machine (``backtest_scan``) is *not* associative, so
-it cannot be time-sharded exactly; long histories there use
-:func:`chunked_scan` (sequential over chunks, carry threaded on one chip)
-which bounds peak memory instead. This mirrors SURVEY.md §5's call: blockwise
-scan with carried state, not attention-style ring exchange.
+The band-hysteresis machine — the stateful core of Bollinger/RSI/VWAP/pairs
+— time-shards **exactly** as well: its per-bar update is a map on the
+3-state space {-1, 0, +1}, and map composition is associative
+(``ops.signals.band_transition_maps``), so a block composes into one
+3-vector summary, the block summaries fold across chips like the linear
+scan's carries, and a local fixup applies each block's incoming state
+(:func:`sharded_band_positions`). Only a *general* non-associative state
+machine (arbitrary ``backtest_scan`` bodies) cannot shard; long histories
+there use :func:`chunked_scan` (sequential over chunks, carry threaded on
+one chip), which bounds peak memory instead. This mirrors SURVEY.md §5's
+call: blockwise scan with carried state, not attention-style ring exchange.
 """
 
 from __future__ import annotations
@@ -140,6 +146,143 @@ def chunked_scan(step, init_carry, inputs, *, chunk: int, unroll: int = 8):
         lambda y: y.reshape((T,) + y.shape[2:]), ys)
 
 
+def _from_left(x_blk, k: int, axis_name: str):
+    """Last ``k`` elements of the LEFT neighbor's block (zeros on chip 0)."""
+    n = jax.lax.axis_size(axis_name)
+    perm = [(i, i + 1) for i in range(n - 1)]
+    return jax.lax.ppermute(x_blk[..., -k:], axis_name, perm)
+
+
+def _pnl_metrics_local(pos, r, gidx, T: int, *, cost: float,
+                       periods_per_year: int, axis_name: str,
+                       eps: float = 1e-12):
+    """Blockwise PnL + summary metrics for a time-sharded position path.
+
+    Shared tail of every time-sharded backtest (SMA, Bollinger): one-bar
+    position halo for the lagged exposure, net returns locally, then the
+    moments / running-peak drawdown / final equity as ``psum``/``pmax``
+    reductions with an exclusive cross-chip max for the peak."""
+    from ..ops.metrics import metrics_from_reductions
+
+    n_f = jnp.float32(T)
+    prev_pos = jnp.concatenate(
+        [_from_left(pos, 1, axis_name), pos[..., :-1]], axis=-1)
+    net = prev_pos * r - jnp.float32(cost) * jnp.abs(pos - prev_pos)
+
+    # Moments / downside via global sums.
+    s1 = jax.lax.psum(jnp.sum(net, axis=-1), axis_name)
+    s2 = jax.lax.psum(jnp.sum(net * net, axis=-1), axis_name)
+    down = jnp.minimum(net, 0.0)
+    down_sq = jax.lax.psum(jnp.sum(down * down, axis=-1), axis_name)
+
+    # Equity + running peak across blocks for drawdown.
+    eq = 1.0 + jnp.cumsum(net, axis=-1)
+    eq = eq + _exclusive_block_offset(net.sum(-1), axis_name)[..., None]
+    peak_local = jax.lax.cummax(eq, axis=eq.ndim - 1)
+    left_peak = _exclusive_block_reduce(
+        jnp.max(eq, axis=-1), axis_name, jnp.max, -jnp.inf)
+    peak = jnp.maximum(peak_local, left_peak[..., None])
+    dd = (peak - eq) / jnp.maximum(peak, eps)
+    mdd = jax.lax.pmax(jnp.max(dd, axis=-1), axis_name)
+    eq_final = jax.lax.psum(
+        jnp.sum(jnp.where(gidx == T - 1, eq, 0.0), axis=-1), axis_name)
+
+    active = jnp.abs(prev_pos) > 0
+    wins = (net > 0) & active
+    wins_sum = jax.lax.psum(
+        jnp.sum(wins.astype(jnp.float32), -1), axis_name)
+    active_sum = jax.lax.psum(
+        jnp.sum(active.astype(jnp.float32), -1), axis_name)
+    turnover = jax.lax.psum(
+        jnp.sum(jnp.abs(pos - prev_pos), axis=-1), axis_name)
+    return metrics_from_reductions(
+        s1=s1, s2=s2, downside_sq_sum=down_sq, mdd=mdd,
+        eq_final=eq_final, wins_sum=wins_sum, active_sum=active_sum,
+        turnover=turnover, n=n_f, periods_per_year=periods_per_year,
+        eps=eps)
+
+
+def _block_returns(close_blk, gidx, axis_name: str):
+    """Per-bar simple returns with a one-bar halo (r[0] = 0 globally)."""
+    prev_close = jnp.concatenate(
+        [_from_left(close_blk, 1, axis_name), close_blk[..., :-1]], axis=-1)
+    return jnp.where(gidx == 0, 0.0,
+                     close_blk / jnp.where(gidx == 0, 1.0, prev_close) - 1.0)
+
+
+def _cumsum_ext(series_blk, halo_w: int, axis_name: str):
+    """Global prefix sum of a time-sharded series, plus a ``halo_w``-bar
+    left halo — the lagged-read window every cumsum-difference rolling sum
+    needs. Returns ``(cs, cs_ext)``."""
+    cs = jnp.cumsum(series_blk, axis=-1)
+    cs = cs + _exclusive_block_offset(cs[..., -1], axis_name)[..., None]
+    return cs, jnp.concatenate(
+        [_from_left(cs, halo_w, axis_name), cs], axis=-1)
+
+
+def _windowed_sum_blk(cs, cs_ext, gidx, w: int, halo_w: int):
+    """Trailing-``w`` rolling sum from the extended prefix sum:
+    ``cs[t] - cs[t-w]`` with a zero lagged read in the global warmup
+    (``t < w``) — ``rolling.rolling_sum``'s semantics, blockwise."""
+    Tb = cs.shape[-1]
+    lagged = jax.lax.slice_in_dim(
+        cs_ext, halo_w - w, halo_w - w + Tb, axis=-1)
+    return cs - jnp.where(gidx >= w, lagged, 0.0)
+
+
+def _band_positions_local(z_blk, valid_blk, z_entry, z_exit, axis_name: str):
+    """Band-hysteresis positions for one time block, exact across blocks.
+
+    The machine's per-bar update is a {-1,0,+1} -> {-1,0,+1} map
+    (``ops.signals.band_transition_maps``), so the block's prefix maps come
+    from a local ``associative_scan``, the whole block composes into one
+    3-vector summary, and the state *entering* this block is the exclusive
+    left-fold of block summaries over ICI (same carry pattern as
+    :func:`sharded_linear_scan` — one 3-vector per chip crosses the wire).
+    The fixup routes each bar's prefix map through the incoming state."""
+    from ..ops import signals
+
+    maps = signals.band_transition_maps(z_blk, valid_blk, z_entry, z_exit)
+    pm, p0, pp = jax.lax.associative_scan(
+        lambda a, b: signals._compose_maps(a, b), maps, axis=-1)
+
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    # One latency-bound collective, not three: the block summary is a
+    # stacked (3, ...) map — (next state from -1, from 0, from +1).
+    summary = jnp.stack([pm[..., -1], p0[..., -1], pp[..., -1]])
+    alls = jax.lax.all_gather(summary, axis_name)           # (n, 3, ...)
+    # Exclusive left-fold: start flat, apply each earlier block's map.
+    state = jnp.zeros_like(p0[..., -1])
+    for j in range(n):
+        nxt = jnp.where(state < 0, alls[j, 0],
+                        jnp.where(state > 0, alls[j, 2], alls[j, 1]))
+        state = jnp.where(j < idx, nxt, state)
+    state = state[..., None]
+    return jnp.where(state < 0, pm, jnp.where(state > 0, pp, p0))
+
+
+def sharded_band_positions(mesh: Mesh, z, valid, z_entry, z_exit=0.0, *,
+                           axis_name: str = TIME_AXIS):
+    """Band-hysteresis position path with the TIME axis sharded.
+
+    Exact (bit-level) match to ``ops.signals.band_hysteresis_assoc`` on the
+    unsharded inputs: states are small integers in float32 and every
+    comparison sees the same values, so sharding changes nothing but where
+    the composition happens. ``z``/``valid`` are ``(..., T)`` with T
+    sharded over ``mesh``'s ``axis_name``; ``z_entry``/``z_exit`` are
+    scalars (replicated)."""
+    spec = P(*((None,) * (z.ndim - 1) + (axis_name,)))
+
+    def local(z_blk, valid_blk):
+        return _band_positions_local(z_blk, valid_blk, z_entry, z_exit,
+                                     axis_name)
+
+    return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec),
+                         out_specs=spec, check_vma=False)(
+        z, jnp.broadcast_to(valid, z.shape))
+
+
 def sharded_sma_backtest(mesh: Mesh, close, fast: int, slow: int, *,
                          cost: float = 0.0, periods_per_year: int = 252,
                          axis_name: str = TIME_AXIS):
@@ -161,7 +304,7 @@ def sharded_sma_backtest(mesh: Mesh, close, fast: int, slow: int, *,
     replicated across the mesh. Matches the unsharded
     single-device computation to f32 tolerance.
     """
-    from ..ops.metrics import Metrics, metrics_from_reductions
+    from ..ops.metrics import Metrics
 
     if not (0 < fast < slow):
         raise ValueError(f"need 0 < fast < slow, got {fast}, {slow}")
@@ -175,76 +318,100 @@ def sharded_sma_backtest(mesh: Mesh, close, fast: int, slow: int, *,
             f"slow={slow} exceeds the {T // n_dev}-bar block; the halo "
             "exchange needs the window to fit one neighbor block")
     halo_w = slow
-    eps = 1e-12
     spec = P(*((None,) * (close.ndim - 1) + (axis_name,)))
     rep = P(*((None,) * (close.ndim - 1)))   # metrics drop the time axis
-    n_f = jnp.float32(T)
-
-    def from_left(x_blk, k):
-        """Last ``k`` elements of the LEFT neighbor's block (zeros on chip 0)."""
-        n = jax.lax.axis_size(axis_name)
-        perm = [(i, i + 1) for i in range(n - 1)]
-        return jax.lax.ppermute(x_blk[..., -k:], axis_name, perm)
 
     def local(close_blk):
         Tb = close_blk.shape[-1]
         idx = jax.lax.axis_index(axis_name)
         gidx = jnp.arange(Tb) + idx * Tb                  # global bar index
-
-        # Per-bar simple returns with a one-bar halo (r[0] = 0 globally).
-        prev_close = jnp.concatenate(
-            [from_left(close_blk, 1), close_blk[..., :-1]], axis=-1)
-        r = jnp.where(gidx == 0, 0.0,
-                      close_blk / jnp.where(gidx == 0, 1.0, prev_close) - 1.0)
+        r = _block_returns(close_blk, gidx, axis_name)
 
         # Global prefix sum of closes; lagged reads via a slow-bar halo.
-        cs = jnp.cumsum(close_blk, axis=-1)
-        cs = cs + _exclusive_block_offset(cs[..., -1], axis_name)[..., None]
-        cs_ext = jnp.concatenate([from_left(cs, halo_w), cs], axis=-1)
+        cs, cs_ext = _cumsum_ext(close_blk, halo_w, axis_name)
 
         def sma(w):
-            lagged = jax.lax.slice_in_dim(
-                cs_ext, halo_w - w, halo_w - w + Tb, axis=-1)
-            lagged = jnp.where(gidx >= w, lagged, 0.0)    # cs[t-w], 0 if t<w
-            return (cs - lagged) / jnp.float32(w)
+            return _windowed_sum_blk(cs, cs_ext, gidx, w,
+                                     halo_w) / jnp.float32(w)
 
         valid = gidx >= slow - 1
         pos = jnp.where(valid, jnp.sign(sma(fast) - sma(slow)), 0.0)
-        prev_pos = jnp.concatenate(
-            [from_left(pos, 1), pos[..., :-1]], axis=-1)
-        net = prev_pos * r - jnp.float32(cost) * jnp.abs(pos - prev_pos)
+        return _pnl_metrics_local(pos, r, gidx, T, cost=cost,
+                                  periods_per_year=periods_per_year,
+                                  axis_name=axis_name)
 
-        # Moments / downside via global sums.
-        s1 = jax.lax.psum(jnp.sum(net, axis=-1), axis_name)
-        s2 = jax.lax.psum(jnp.sum(net * net, axis=-1), axis_name)
-        down = jnp.minimum(net, 0.0)
-        down_sq = jax.lax.psum(jnp.sum(down * down, axis=-1), axis_name)
+    out_specs = Metrics(*(rep for _ in Metrics._fields))
+    return jax.shard_map(local, mesh=mesh, in_specs=spec,
+                         out_specs=out_specs, check_vma=False)(close)
 
-        # Equity + running peak across blocks for drawdown.
-        eq = 1.0 + jnp.cumsum(net, axis=-1)
-        eq = eq + _exclusive_block_offset(net.sum(-1), axis_name)[..., None]
-        peak_local = jax.lax.cummax(eq, axis=eq.ndim - 1)
-        left_peak = _exclusive_block_reduce(
-            jnp.max(eq, axis=-1), axis_name, jnp.max, -jnp.inf)
-        peak = jnp.maximum(peak_local, left_peak[..., None])
-        dd = (peak - eq) / jnp.maximum(peak, eps)
-        mdd = jax.lax.pmax(jnp.max(dd, axis=-1), axis_name)
-        eq_final = jax.lax.psum(
-            jnp.sum(jnp.where(gidx == T - 1, eq, 0.0), axis=-1), axis_name)
 
-        active = jnp.abs(prev_pos) > 0
-        wins = (net > 0) & active
-        wins_sum = jax.lax.psum(
-            jnp.sum(wins.astype(jnp.float32), -1), axis_name)
-        active_sum = jax.lax.psum(
-            jnp.sum(active.astype(jnp.float32), -1), axis_name)
-        turnover = jax.lax.psum(
-            jnp.sum(jnp.abs(pos - prev_pos), axis=-1), axis_name)
-        return metrics_from_reductions(
-            s1=s1, s2=s2, downside_sq_sum=down_sq, mdd=mdd,
-            eq_final=eq_final, wins_sum=wins_sum, active_sum=active_sum,
-            turnover=turnover, n=n_f, periods_per_year=periods_per_year,
-            eps=eps)
+def sharded_bollinger_backtest(mesh: Mesh, close, window: int, k: float, *,
+                               z_exit: float = 0.0, cost: float = 0.0,
+                               periods_per_year: int = 252,
+                               axis_name: str = TIME_AXIS):
+    """End-to-end Bollinger mean-reversion backtest, TIME axis sharded.
+
+    The long-context composition for a *stateful* strategy: blockwise
+    rolling z-score (distributed cumsums of the series-centered moments +
+    a ``window``-bar halo, ``rolling.rolling_zscore``'s formula) feeding
+    the exactly-sharded band machine (:func:`_band_positions_local`) and
+    the shared blockwise PnL/metrics tail. One history longer than any
+    single chip's memory runs the full hysteresis strategy without ever
+    materializing the series in one place — the reference has no analogue
+    (its compute slot is a sleep stub, reference
+    ``src/worker/process.rs:21-25``).
+
+    ``window`` is a static int with ``window <= block length`` (halo
+    bound). Returns scalar-per-series :class:`~..ops.metrics.Metrics`,
+    replicated. Matches the single-device computation to f32 tolerance.
+    """
+    from ..ops.metrics import Metrics
+
+    n_dev = mesh.shape[axis_name]
+    T = close.shape[-1]
+    if T % n_dev:
+        raise ValueError(
+            f"T={T} not divisible by the {n_dev}-way {axis_name!r} axis")
+    if window > T // n_dev:
+        raise ValueError(
+            f"window={window} exceeds the {T // n_dev}-bar block; the halo "
+            "exchange needs the window to fit one neighbor block")
+    halo_w = window
+    eps = 1e-12
+    w_f = jnp.float32(window)
+    spec = P(*((None,) * (close.ndim - 1) + (axis_name,)))
+    rep = P(*((None,) * (close.ndim - 1)))
+
+    def local(close_blk):
+        Tb = close_blk.shape[-1]
+        idx = jax.lax.axis_index(axis_name)
+        gidx = jnp.arange(Tb) + idx * Tb
+        r = _block_returns(close_blk, gidx, axis_name)
+
+        # Series mean (psum) -> centered second moments, the same f32
+        # cancellation guard as rolling.rolling_var.
+        mean = (jax.lax.psum(jnp.sum(close_blk, axis=-1), axis_name)
+                / jnp.float32(T))[..., None]
+        xc = close_blk - mean
+
+        def windowed(series_blk):
+            cs, cs_ext = _cumsum_ext(series_blk, halo_w, axis_name)
+            return _windowed_sum_blk(cs, cs_ext, gidx, window, halo_w)
+
+        m = windowed(close_blk) / w_f
+        s1 = windowed(xc)
+        s2 = windowed(xc * xc)
+        var = jnp.maximum((s2 - s1 * s1 / w_f) / w_f, 0.0)
+        z = (close_blk - m) / (jnp.sqrt(var) + eps)
+        valid = gidx >= window - 1
+        z = jnp.where(valid, z, 0.0)
+
+        pos = _band_positions_local(z, jnp.broadcast_to(valid, z.shape),
+                                    jnp.float32(k), jnp.float32(z_exit),
+                                    axis_name)
+        return _pnl_metrics_local(pos, r, gidx, T, cost=cost,
+                                  periods_per_year=periods_per_year,
+                                  axis_name=axis_name)
 
     out_specs = Metrics(*(rep for _ in Metrics._fields))
     return jax.shard_map(local, mesh=mesh, in_specs=spec,

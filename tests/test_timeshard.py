@@ -125,3 +125,62 @@ def test_sharded_sma_backtest_2d_mesh(devices):
     # the check divided by all 8 devices).
     m = timeshard.sharded_sma_backtest(mesh, close, 5, 100, cost=1e-3)
     assert np.isfinite(np.asarray(m.sharpe)).all()
+
+
+def test_sharded_band_positions_bit_exact(devices):
+    """The band-hysteresis machine time-shards EXACTLY: 3-state transition
+    maps compose associatively, so the sharded path must reproduce
+    band_hysteresis_assoc bit for bit."""
+    from distributed_backtesting_exploration_tpu.ops import signals
+    from distributed_backtesting_exploration_tpu.parallel import timeshard
+
+    mesh = Mesh(np.asarray(devices[:8]), (timeshard.TIME_AXIS,))
+    rng = np.random.default_rng(7)
+    z = jnp.asarray(rng.standard_normal((4, 512)) * 1.5, jnp.float32)
+    valid = jnp.arange(512) >= 10
+
+    want = signals.band_hysteresis_assoc(z, valid, 1.0, 0.25)
+    zs = jax.device_put(
+        z, jax.NamedSharding(mesh, P(None, timeshard.TIME_AXIS)))
+    got = timeshard.sharded_band_positions(mesh, zs, valid, 1.0, 0.25)
+    assert (np.asarray(got) == np.asarray(want)).all()
+
+
+def test_sharded_bollinger_backtest_matches_single_device(devices):
+    """The stateful long-context composition: a full Bollinger
+    mean-reversion backtest with the bar axis sharded over 8 chips matches
+    the unsharded computation."""
+    from distributed_backtesting_exploration_tpu.models import base
+    from distributed_backtesting_exploration_tpu.ops import (
+        metrics as metrics_mod, pnl)
+    from distributed_backtesting_exploration_tpu.parallel import timeshard
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    mesh = Mesh(np.asarray(devices[:8]), (timeshard.TIME_AXIS,))
+    ohlcv = data.synthetic_ohlcv(3, 1024, seed=29)
+    close = jnp.asarray(ohlcv.close)
+    window, k = 20, 1.5
+
+    got = timeshard.sharded_bollinger_backtest(mesh, close, window, k,
+                                               cost=1e-3)
+
+    strat = base.get_strategy("bollinger")
+    panel = type(ohlcv)(*(jnp.asarray(f) for f in ohlcv))
+    pos = jax.vmap(lambda o: strat.positions(
+        o, dict(window=jnp.float32(window), k=jnp.float32(k))))(panel)
+    res = pnl.backtest_prefix(close, pos, cost=1e-3)
+    want = metrics_mod.summary_metrics(res.returns, res.equity,
+                                       res.positions)
+    for name in want._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(got, name)), np.asarray(getattr(want, name)),
+            rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_sharded_bollinger_backtest_rejects_oversized_window(devices):
+    from distributed_backtesting_exploration_tpu.parallel import timeshard
+
+    mesh = Mesh(np.asarray(devices[:8]), (timeshard.TIME_AXIS,))
+    with pytest.raises(ValueError, match="halo"):
+        timeshard.sharded_bollinger_backtest(mesh, jnp.ones((1, 256)), 100,
+                                             1.0)
