@@ -89,6 +89,21 @@ def _rets3(close_p):
     return (close_p / prev - 1.0)[..., None]
 
 
+def _shift_t(x, s: int, fill: float):
+    """``y[..., t] = x[..., t-s]`` along the last axis, ``fill`` for t < s
+    (static shift: slice+concat copies, no gather). A shift at or beyond
+    the axis length yields all-fill — the same answer the clipped-gather
+    ``rolling._shifted`` gives, so windows larger than the (padded)
+    history stay graceful instead of producing a wrapped negative slice."""
+    T = x.shape[-1]
+    if s == 0:
+        return x
+    if s >= T:
+        return jnp.full_like(x, fill)
+    pad = jnp.full(x.shape[:-1] + (s,), fill, x.dtype)
+    return jnp.concatenate([pad, x[..., :T - s]], axis=-1)
+
+
 def _shift_down(x, k: int, fill: float):
     """``y[t] = x[t-k]`` along axis 0 with ``fill`` for t < k (static k)."""
     pad = jnp.full((k,) + x.shape[1:], fill, x.dtype)
@@ -921,14 +936,10 @@ def _ema_rows(x, alpha: float):
     A = jnp.broadcast_to(A, x.shape)
     B = jnp.where(t0, x, x * jnp.float32(alpha))
 
-    def shift(v, k, fill):
-        pad = jnp.full(v.shape[:-1] + (k,), fill, v.dtype)
-        return jnp.concatenate([pad, v[..., :-k]], axis=-1)
-
     span = 1
     while span < T:
-        Ae = shift(A, span, 1.0)    # identity element (A=1, B=0)
-        Be = shift(B, span, 0.0)
+        Ae = _shift_t(A, span, 1.0)    # identity element (A=1, B=0)
+        Be = _shift_t(B, span, 0.0)
         A, B = Ae * A, A * Be + B
         span *= 2
     return B
@@ -1052,6 +1063,34 @@ def _fused_mom_call(close, onehot_l, warm, t_real, *, windows: tuple,
         W_pad=W_pad, P_real=P_real, T_real=T_real, interpret=interpret)
 
 
+def _extrema_table(src_p, windows: tuple, mode: str, warm_fill: float):
+    """All distinct-window rolling extrema of padded ``(N, T_pad)`` rows as
+    one ``(N, W, T_pad)`` stack, via a SHARED sparse table.
+
+    Per-window doubling ladders cost O(W · T log W) passes; instead build
+    log2(max window) doubling levels once — ``level[k][t]`` covers
+    ``x[t-2^k+1 .. t]`` — then every window is the max/min of TWO
+    overlapping spans (the classic sparse-table range query). Max/min of
+    raw prices either way: bit-identical to ``rolling.rolling_max``,
+    ~6x fewer elementwise passes at the 125-distinct-window bench grid.
+    Warmup bars (t < w-1) take ``warm_fill``.
+    """
+    op = jnp.maximum if mode == "max" else jnp.minimum
+    neutral = float("-inf") if mode == "max" else float("inf")
+    t_row = jnp.arange(src_p.shape[-1])[None, :]
+    max_k = max((int(w)).bit_length() - 1 for w in windows)
+    levels = [src_p]
+    for k in range(max_k):
+        levels.append(op(levels[k], _shift_t(levels[k], 1 << k, neutral)))
+    rows = []
+    for w in windows:
+        w = int(w)
+        k = w.bit_length() - 1                  # largest 2^k <= w
+        row = op(levels[k], _shift_t(levels[k], w - (1 << k), neutral))
+        rows.append(jnp.where(t_row >= w - 1, row, warm_fill))
+    return jnp.stack(rows, axis=1)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
@@ -1061,10 +1100,10 @@ def _fused_don_call(close, hi_src, lo_src, onehot_w, warm, t_real, *,
                     T_real: int | None, cost: float, ppy: int,
                     interpret: bool):
     """Channel-extrema table prep + pallas call in one jit. Windows are
-    static, so each distinct window's rolling max/min uses the exact
-    O(T log W) doubling ladder (``ops.rolling.rolling_max``); max/min of
-    exact prices is exact, so the channel — and hence every breakout
-    comparison — matches the generic path bit-for-bit.
+    static, so all distinct windows' rolling max/min come from one shared
+    sparse table (:func:`_extrema_table`); max/min of exact prices is
+    exact, so the channel — and hence every breakout comparison — matches
+    the generic path bit-for-bit.
 
     ``hi_src``/``lo_src`` are the columns the channel extrema come from:
     the close itself for the close-only variant, the HIGH/LOW columns for
@@ -1072,17 +1111,11 @@ def _fused_don_call(close, hi_src, lo_src, onehot_w, warm, t_real, *,
     for the generic path's ±inf warmup fill: the one-hot contraction would
     turn inf into NaN via 0*inf, and no finite price ever clears 1e30, so
     every breakout comparison is identical."""
-    from . import rolling as rolling_mod
-
     close_p = _pad_last(close, T_pad)
     hi_p = _pad_last(hi_src, T_pad)
     lo_p = _pad_last(lo_src, T_pad)
-    his, los = [], []
-    for w in windows:
-        his.append(rolling_mod.rolling_max(hi_p, int(w), fill=1e30))
-        los.append(rolling_mod.rolling_min(lo_p, int(w), fill=-1e30))
-    hi_tbl = _pad_w(jnp.stack(his, axis=1), W_pad)               # (N,W,T_pad)
-    lo_tbl = _pad_w(jnp.stack(los, axis=1), W_pad)
+    hi_tbl = _pad_w(_extrema_table(hi_p, windows, "max", 1e30), W_pad)
+    lo_tbl = _pad_w(_extrema_table(lo_p, windows, "min", -1e30), W_pad)
     kernel = functools.partial(_don_kernel, cost=cost, ppy=ppy,
                                T_real=T_real)
     return _single_window_pallas(

@@ -512,3 +512,26 @@ def test_fused_vwap_window_beyond_history():
         "vwap_reversion", _vwap_call,
         dict(window=jnp.asarray([10.0, 150.0], jnp.float32),
              k=jnp.asarray([1.0], jnp.float32)), T=100, seed=23)
+
+
+def test_fused_donchian_window_beyond_history():
+    # A window larger than the (padded) history must not crash the shared
+    # sparse-table prep; such lanes never pass warmup and must match the
+    # generic all-flat result (window still within the generic view bound).
+    ohlcv = data.synthetic_ohlcv(2, 100, seed=31)
+    panel = type(ohlcv)(*(jnp.asarray(f) for f in ohlcv))
+    grid = sweep.product_grid(window=jnp.asarray([10.0, 200.0], jnp.float32))
+    for strategy, call in (
+            ("donchian", lambda: fused.fused_donchian_sweep(
+                panel.close, np.asarray(grid["window"]), cost=1e-3)),
+            ("donchian_hl", lambda: fused.fused_donchian_hl_sweep(
+                panel.close, panel.high, panel.low,
+                np.asarray(grid["window"]), cost=1e-3))):
+        ref = sweep.jit_sweep(panel, get_strategy(strategy), dict(grid),
+                              cost=1e-3)
+        got = call()
+        for name in ref._fields:
+            np.testing.assert_allclose(
+                np.asarray(getattr(got, name)),
+                np.asarray(getattr(ref, name)),
+                rtol=2e-4, atol=2e-5, err_msg=f"{strategy}/{name}")
