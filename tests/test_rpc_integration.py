@@ -493,3 +493,75 @@ def test_empty_queue_returns_empty_reply_not_error():
         channel.close()
     finally:
         srv.stop()
+
+
+def test_every_registered_strategy_travels_the_wire():
+    """Completeness: each strategy in the registry round-trips through the
+    worker backend (decode, grid materialization, routing, metric packing)
+    and matches the direct sweep on the same panels — no family is
+    CLI/RPC-only on paper."""
+    import jax.numpy as jnp
+
+    from distributed_backtesting_exploration_tpu.models import base, pairs
+    from distributed_backtesting_exploration_tpu.parallel import sweep
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    grids = {
+        "sma_crossover": {"fast": np.float32([3, 5]),
+                          "slow": np.float32([13.0])},
+        "momentum": {"lookback": np.float32([5, 10])},
+        "bollinger": {"window": np.float32([10, 20]),   # two multi-valued
+                      "k": np.float32([1.0, 2.0])},     # axes: order matters
+        "bollinger_touch": {"window": np.float32([10.0]),
+                            "k": np.float32([1.0, 2.0])},
+        "donchian": {"window": np.float32([10, 20])},
+        "donchian_hl": {"window": np.float32([10, 20])},
+        "rsi": {"period": np.float32([7.0]), "band": np.float32([20.0])},
+        "macd": {"fast": np.float32([5.0]), "slow": np.float32([13.0]),
+                 "signal": np.float32([4.0])},
+        "vwap_reversion": {"window": np.float32([8.0]),
+                           "k": np.float32([1.0])},
+        "pairs": {"lookback": np.float32([10.0]),
+                  "z_entry": np.float32([1.0])},
+    }
+    # Pairs is the two-legged path (models/pairs.py), not a registry entry.
+    assert set(grids) - {"pairs"} == set(base.available_strategies()), (
+        "registry changed; extend this test's grid table")
+
+    backend = compute.JaxSweepBackend(use_fused=False)
+    for strategy, grid in grids.items():
+        recs = synthetic_jobs(2, 128, strategy, grid, cost=1e-3, seed=3)
+        specs = [pb.JobSpec(id=r.id, strategy=r.strategy, ohlcv=r.ohlcv,
+                            ohlcv2=r.ohlcv2 or b"",
+                            grid=wire.grid_to_proto(r.grid), cost=r.cost)
+                 for r in recs]
+        got = {c.job_id: wire.metrics_from_bytes(c.metrics)
+               for c in backend.process(specs)}
+        assert set(got) == {r.id for r in recs}, strategy
+
+        # Canonical sorted-axis order — the wire contract's DBXM row order
+        # (wire.grid_from_proto) — not dict insertion order.
+        flat = sweep.product_grid(
+            **{k: jnp.asarray(v) for k, v in sorted(grid.items())})
+        if strategy == "pairs":
+            ys = [data.from_wire_bytes(s.ohlcv) for s in specs]
+            xs = [data.from_wire_bytes(s.ohlcv2) for s in specs]
+            want = pairs.run_pairs_sweep(
+                jnp.asarray(np.stack([y.close for y in ys])),
+                jnp.asarray(np.stack([x.close for x in xs])),
+                dict(flat), cost=1e-3)
+        else:
+            series = [data.from_wire_bytes(s.ohlcv) for s in specs]
+            panel = type(series[0])(
+                *(jnp.asarray(np.stack([np.asarray(getattr(s, f))
+                                        for s in series]))
+                  for f in series[0]._fields))
+            want = sweep.jit_sweep(panel, base.get_strategy(strategy),
+                                   dict(flat), cost=1e-3)
+        for i, rec in enumerate(recs):
+            for name in want._fields:
+                np.testing.assert_allclose(
+                    np.asarray(getattr(got[rec.id], name)),
+                    np.asarray(getattr(want, name))[i],
+                    rtol=2e-4, atol=2e-5,
+                    err_msg=f"{strategy}/{name}")
