@@ -123,7 +123,11 @@ class JaxSweepBackend:
 
         self._jax = jax
         self.param_chunk = param_chunk
-        self._devices = jax.devices()
+        # local_devices, not devices: under jax.distributed a process sees
+        # every host's chips in jax.devices(), but a WORKER is one process
+        # on one host — it can only feed (and should only advertise) its
+        # own chips. Cross-host scale-out is the dispatcher's job.
+        self._devices = jax.local_devices()
         # The fused Pallas kernel is compiled-TPU only; its interpret mode
         # is far slower than the generic XLA path on CPU.
         if use_fused is None:

@@ -80,6 +80,16 @@ got_rows = sorted(
 got = np.concatenate([r for _, r in got_rows], axis=0)
 np.testing.assert_allclose(got, np.asarray(want.sharpe), rtol=1e-5,
                            atol=1e-6)
+
+# A worker process on a multi-host slice must advertise and mesh over its
+# OWN chips only (it cannot device_put to another host's devices); the
+# slice-wide scale-out axis is the dispatcher's job-level DP.
+from distributed_backtesting_exploration_tpu.rpc import compute
+backend = compute.JaxSweepBackend(use_fused=False, use_mesh=True)
+assert backend.chips == 4, backend.chips
+assert backend._mesh is not None and backend._mesh.devices.size == 4
+assert all(d.process_index == jax.process_index()
+           for d in backend._mesh.devices.flat)
 print("MULTIHOST_OK", pid, flush=True)
 """
 
