@@ -160,6 +160,22 @@ def main():
             run_boll, n_tickers * sweep.grid_size(bgrid), iters=iters,
             warmup=warmup, name="bollinger_fused")
 
+    if enabled("bollinger_touch_fused"):
+        n_win, n_k = 20, max(min(n_params, 1000) // 20, 1)
+        tgrid = sweep.product_grid(
+            k=jnp.linspace(0.5, 3.0, n_k).astype(jnp.float32),
+            window=jnp.arange(10, 10 + 2 * n_win, 2, dtype=jnp.float32))
+        tw = np.asarray(tgrid["window"])
+        tk = np.asarray(tgrid["k"])
+
+        def run_touch():
+            return fused.fused_bollinger_touch_sweep(panel.close, tw, tk,
+                                                     cost=1e-3)
+
+        rates["bollinger_touch_fused"] = _measure(
+            run_touch, n_tickers * sweep.grid_size(tgrid), iters=iters,
+            warmup=warmup, name="bollinger_touch_fused")
+
     # --- momentum / donchian: the round-3 single-window-axis kernels ------
     if enabled("momentum_fused"):
         mlbs = np.tile(np.arange(5, 130, dtype=np.float32),
@@ -377,9 +393,10 @@ def main():
             name="walkforward")
 
     if not rates:
-        known = ("sma_fused, bollinger_fused, momentum_fused, "
-                 "donchian_fused, donchian_hl_fused, vwap_fused, rsi_fused, "
-                 "macd_fused, pairs, e2e, walkforward")
+        known = ("sma_fused, bollinger_fused, bollinger_touch_fused, "
+                 "momentum_fused, donchian_fused, donchian_hl_fused, "
+                 "vwap_fused, rsi_fused, macd_fused, pairs, e2e, "
+                 "walkforward")
         sys.exit(f"bench: no configs ran — DBX_BENCH_CONFIGS={only} matched "
                  f"nothing (known: {known})")
     # The headline is the north-star config when it ran; otherwise label the
@@ -460,6 +477,15 @@ def verify():
                 lookback=jnp.arange(5, 85, 2, dtype=jnp.float32)),
             lambda g: fused.fused_momentum_sweep(
                 panel.close, np.asarray(g["lookback"]), cost=1e-3),
+        ),
+        "bollinger_touch": strat_case(
+            "bollinger_touch",
+            sweep.product_grid(
+                k=jnp.linspace(0.5, 3.0, 20).astype(jnp.float32),
+                window=jnp.arange(10, 50, 2, dtype=jnp.float32)),
+            lambda g: fused.fused_bollinger_touch_sweep(
+                panel.close, np.asarray(g["window"]), np.asarray(g["k"]),
+                cost=1e-3),
         ),
         "donchian": strat_case(
             "donchian",

@@ -42,6 +42,7 @@ from .signals import band_hysteresis  # noqa: F401
 from .fused import (  # noqa: F401
     fused_sma_sweep,
     fused_bollinger_sweep,
+    fused_bollinger_touch_sweep,
     fused_momentum_sweep,
     fused_donchian_sweep,
     fused_donchian_hl_sweep,

@@ -426,6 +426,36 @@ def _boll_kernel(r_ref, z_ref, ow_ref, k_ref, warm_ref, *refs,
     out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
 
 
+def _touch_kernel(r_ref, z_ref, ow_ref, k_ref, warm_ref, *refs,
+                  cost: float, ppy: int, z_exit: float,
+                  T_real: int | None):
+    """Band-touch cell: the memoryless Bollinger variant — exposure is
+    which band you are currently outside of (``models.bollinger``'s
+    ``bollinger_touch``), so the hysteresis ladder drops out entirely and
+    the cell is one z-selection matmul + a two-select position.
+    ``z_exit`` is unused (the machine has no exit memory); the parameter
+    stays so the kernel is plug-compatible with ``_boll_kernel`` in
+    :func:`_fused_boll_call`."""
+    tr, out_ref = _unpack_tr(refs, T_real)
+    T_pad = r_ref.shape[1]
+    r = r_ref[0]                     # (T_pad, 1)
+    dn = (((0,), (0,)), ((), ()))
+    z = jax.lax.dot_general(z_ref[0], ow_ref[:], dn,
+                            preferred_element_type=jnp.float32,
+                            precision=jax.lax.Precision.HIGHEST)  # (T_pad,128)
+
+    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, _LANES), 0)
+    warm = warm_ref[0, :][None, :]
+    valid = t_idx >= (warm.astype(jnp.int32) - 1)
+    k = k_ref[0, :][None, :]
+    pos = jnp.where(z < -k, 1.0, jnp.where(z > k, -1.0, 0.0))
+    pos = jnp.where(valid, pos, 0.0)
+    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
+
+
+_BAND_KERNELS = {"hysteresis": _boll_kernel, "touch": _touch_kernel}
+
+
 def _pad_w(tbl, W_pad: int):
     """Zero-pad an ``(N, W, T_pad)`` table's window axis up to ``W_pad``."""
     N, W, T_pad = tbl.shape
@@ -518,10 +548,11 @@ def _band_machine_pallas(kernel, close_p, z_table, onehot_w, k_lanes, warm,
 @functools.partial(
     jax.jit,
     static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
-                     "ppy", "z_exit", "interpret"))
+                     "ppy", "z_exit", "machine", "interpret"))
 def _fused_boll_call(close, onehot_w, k_lanes, warm, t_real, *, windows: tuple,
                      T_pad: int, W_pad: int, P_real: int, T_real: int | None,
-                     cost: float, ppy: int, z_exit: float, interpret: bool):
+                     cost: float, ppy: int, z_exit: float, interpret: bool,
+                     machine: str = "hysteresis"):
     """Z-score table prep + pallas call in one jit (same dispatch-economy
     rationale as ``_fused_call``).
 
@@ -545,12 +576,57 @@ def _fused_boll_call(close, onehot_w, k_lanes, warm, t_real, *, windows: tuple,
     z_table = _pad_w(jnp.where((t_row >= w_col - 1)[None], z_table, 0.0),
                      W_pad)
 
-    kernel = functools.partial(_boll_kernel, cost=cost, ppy=ppy,
+    kernel = functools.partial(_BAND_KERNELS[machine], cost=cost, ppy=ppy,
                                z_exit=z_exit, T_real=T_real)
     return _band_machine_pallas(
         kernel, close_p, z_table, onehot_w, k_lanes, warm, t_real,
         T_pad=T_pad, W_pad=W_pad, P_real=P_real, T_real=T_real,
         interpret=interpret)
+
+
+def _bollinger_family_sweep(close, window, k, *, machine: str, z_exit: float,
+                            t_real, cost: float, periods_per_year: int,
+                            interpret: bool | None) -> Metrics:
+    """Shared prep for both Bollinger-family wrappers (one z-table/grid
+    pipeline, the ``machine`` picks the cell)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    close = jnp.asarray(close, jnp.float32)
+    window = np.asarray(window)
+    k = np.asarray(k, np.float32)
+    T = close.shape[1]
+
+    windows, onehot_w, k_lanes, warm = _boll_grid_setup(
+        window.astype(np.float32).tobytes(), k.tobytes())
+    # T_pad is a lane multiple (128): T sits on the table's minor axis AND
+    # on the working tiles' sublane axis.
+    return _fused_boll_call(close, onehot_w, k_lanes, warm,
+                            _t_real_col(t_real, close),
+                            windows=windows,
+                            T_pad=_round_up(T, 128), W_pad=onehot_w.shape[0],
+                            P_real=window.shape[0],
+                            T_real=T if t_real is None else None,
+                            cost=float(cost), ppy=int(periods_per_year),
+                            z_exit=float(z_exit), machine=machine,
+                            interpret=bool(interpret))
+
+
+def fused_bollinger_touch_sweep(close, window, k, *, t_real=None,
+                                cost: float = 0.0,
+                                periods_per_year: int = 252,
+                                interpret: bool | None = None) -> Metrics:
+    """Fused band-touch sweep: the path-free Bollinger variant.
+
+    Same z-table and grid layout as :func:`fused_bollinger_sweep`, but the
+    position is memoryless (long/short while outside the ±k band, flat
+    inside — ``models.bollinger.bollinger_touch``), so the cell skips the
+    hysteresis ladder. Matches ``run_sweep(..., "bollinger_touch")``:
+    bit-level on CPU interpret mode; the usual MXU knife-edge caveat on
+    TPU.
+    """
+    return _bollinger_family_sweep(
+        close, window, k, machine="touch", z_exit=0.0, t_real=t_real,
+        cost=cost, periods_per_year=periods_per_year, interpret=interpret)
 
 
 def fused_bollinger_sweep(close, window, k, *, t_real=None,
@@ -566,26 +642,10 @@ def fused_bollinger_sweep(close, window, k, *, t_real=None,
     TPU the MXU z-selection matmul shares the SMA kernel's knife-edge caveat
     for |z - k| ~ 1e-7 relative. BASELINE.json configs[2] is this workload.
     """
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    close = jnp.asarray(close, jnp.float32)
-    window = np.asarray(window)
-    k = np.asarray(k, np.float32)
-    T = close.shape[1]
-    P = window.shape[0]
-
-    windows, onehot_w, k_lanes, warm = _boll_grid_setup(
-        window.astype(np.float32).tobytes(), k.tobytes())
-    # T_pad is a lane multiple (128): T sits on the table's minor axis AND
-    # on the working tiles' sublane axis.
-    return _fused_boll_call(close, onehot_w, k_lanes, warm,
-                            _t_real_col(t_real, close),
-                            windows=windows,
-                            T_pad=_round_up(T, 128), W_pad=onehot_w.shape[0],
-                            P_real=P, T_real=T if t_real is None else None,
-                            cost=float(cost),
-                            ppy=int(periods_per_year),
-                            z_exit=float(z_exit), interpret=bool(interpret))
+    return _bollinger_family_sweep(
+        close, window, k, machine="hysteresis", z_exit=z_exit,
+        t_real=t_real, cost=cost, periods_per_year=periods_per_year,
+        interpret=interpret)
 
 
 

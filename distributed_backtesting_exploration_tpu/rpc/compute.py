@@ -178,6 +178,13 @@ class JaxSweepBackend:
             t_real=t_real, cost=cost, periods_per_year=ppy)
 
     @staticmethod
+    def _run_fused_bollinger_touch(close, grid, cost, ppy, t_real):
+        from ..ops import fused
+        return fused.fused_bollinger_touch_sweep(
+            close, np.asarray(grid["window"]), np.asarray(grid["k"]),
+            t_real=t_real, cost=cost, periods_per_year=ppy)
+
+    @staticmethod
     def _run_fused_momentum(close, grid, cost, ppy, t_real):
         from ..ops import fused
         return fused.fused_momentum_sweep(
@@ -226,6 +233,8 @@ class JaxSweepBackend:
                                     _run_fused_sma),
         "bollinger": _FusedSpec({"window", "k"}, ("window",),
                                 _run_fused_bollinger),
+        "bollinger_touch": _FusedSpec({"window", "k"}, ("window",),
+                                      _run_fused_bollinger_touch),
         "momentum": _FusedSpec({"lookback"}, ("lookback",),
                                _run_fused_momentum),
         "donchian": _FusedSpec({"window"}, ("window",), _run_fused_donchian),

@@ -535,3 +535,34 @@ def test_fused_donchian_window_beyond_history():
                 np.asarray(getattr(got, name)),
                 np.asarray(getattr(ref, name)),
                 rtol=2e-4, atol=2e-5, err_msg=f"{strategy}/{name}")
+
+
+def _touch_call(panel, grid, lens):
+    return fused.fused_bollinger_touch_sweep(
+        panel.close, np.asarray(grid["window"]), np.asarray(grid["k"]),
+        t_real=lens, cost=1e-3)
+
+
+def test_fused_bollinger_touch_matches_generic():
+    _check_panel_sweep(
+        "bollinger_touch", _touch_call,
+        dict(window=jnp.asarray([10, 20, 30], jnp.float32),
+             k=jnp.asarray([0.5, 1.0, 2.0], jnp.float32)), seed=33)
+
+
+def test_fused_bollinger_touch_unaligned_T():
+    _check_panel_sweep(
+        "bollinger_touch", _touch_call,
+        dict(window=jnp.asarray([8, 16], jnp.float32),
+             k=jnp.asarray([1.0, 1.5], jnp.float32)), T=251, seed=35)
+
+
+def test_fused_bollinger_touch_ragged():
+    _check_ragged(
+        "bollinger_touch",
+        lambda close, g, lens: fused.fused_bollinger_touch_sweep(
+            close, np.asarray(g["window"]), np.asarray(g["k"]),
+            t_real=lens, cost=1e-3),
+        dict(window=jnp.asarray([10, 20], jnp.float32),
+             k=jnp.asarray([1.0, 2.0], jnp.float32)),
+        lengths=[180, 131, 256], seed=37)
