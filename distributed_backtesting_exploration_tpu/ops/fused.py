@@ -401,17 +401,20 @@ def _band_ladder(z, valid, k, z_exit):
     return p0   # start state is flat: the 0-component is the position path
 
 
-def _boll_kernel(r_ref, z_ref, ow_ref, k_ref, warm_ref, *refs,
-                 cost: float, ppy: int, z_exit: float,
-                 T_real: int | None):
-    """Bollinger mean-reversion cell: z-selection matmul + hysteresis ladder."""
+def _band_cell_prologue(r_ref, z_ref, ow_ref, k_ref, warm_ref, refs, T_real):
+    """Shared head of every band-family cell (Bollinger hysteresis, band
+    touch; RSI and VWAP reuse those kernels): ragged/uniform unpack, the
+    z-selection matmul, warmup mask and band lanes.
+
+    The z-table arrives (W_pad, T_pad) — T on lanes, so HBM tiling pads W
+    to a sublane multiple (8) instead of a lane multiple (128); at the
+    baseline grid's ~20 distinct windows the old (T, W)-minor layout
+    inflated every table and prep intermediate 6.4x (same fix as the pairs
+    kernel). Returns ``(tr, out_ref, r, z, t_idx, valid, k)``.
+    """
     tr, out_ref = _unpack_tr(refs, T_real)
     T_pad = r_ref.shape[1]
     r = r_ref[0]                     # (T_pad, 1)
-    # Table arrives (W_pad, T_pad) — T on lanes, so HBM tiling pads W to a
-    # sublane multiple (8) instead of a lane multiple (128); at the baseline
-    # grid's ~20 distinct windows the old (T, W)-minor layout inflated every
-    # table and prep intermediate 6.4x (same fix as the pairs kernel).
     dn = (((0,), (0,)), ((), ()))
     z = jax.lax.dot_general(z_ref[0], ow_ref[:], dn,
                             preferred_element_type=jnp.float32,
@@ -421,7 +424,15 @@ def _boll_kernel(r_ref, z_ref, ow_ref, k_ref, warm_ref, *refs,
     warm = warm_ref[0, :][None, :]
     valid = t_idx >= (warm.astype(jnp.int32) - 1)
     k = k_ref[0, :][None, :]                           # (1, 128) entry band
+    return tr, out_ref, r, z, t_idx, valid, k
 
+
+def _boll_kernel(r_ref, z_ref, ow_ref, k_ref, warm_ref, *refs,
+                 cost: float, ppy: int, z_exit: float,
+                 T_real: int | None):
+    """Bollinger mean-reversion cell: z-selection matmul + hysteresis ladder."""
+    tr, out_ref, r, z, t_idx, valid, k = _band_cell_prologue(
+        r_ref, z_ref, ow_ref, k_ref, warm_ref, refs, T_real)
     pos = _band_ladder(z, valid, k, z_exit)
     out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
 
@@ -436,18 +447,8 @@ def _touch_kernel(r_ref, z_ref, ow_ref, k_ref, warm_ref, *refs,
     ``z_exit`` is unused (the machine has no exit memory); the parameter
     stays so the kernel is plug-compatible with ``_boll_kernel`` in
     :func:`_fused_boll_call`."""
-    tr, out_ref = _unpack_tr(refs, T_real)
-    T_pad = r_ref.shape[1]
-    r = r_ref[0]                     # (T_pad, 1)
-    dn = (((0,), (0,)), ((), ()))
-    z = jax.lax.dot_general(z_ref[0], ow_ref[:], dn,
-                            preferred_element_type=jnp.float32,
-                            precision=jax.lax.Precision.HIGHEST)  # (T_pad,128)
-
-    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, _LANES), 0)
-    warm = warm_ref[0, :][None, :]
-    valid = t_idx >= (warm.astype(jnp.int32) - 1)
-    k = k_ref[0, :][None, :]
+    tr, out_ref, r, z, t_idx, valid, k = _band_cell_prologue(
+        r_ref, z_ref, ow_ref, k_ref, warm_ref, refs, T_real)
     pos = jnp.where(z < -k, 1.0, jnp.where(z > k, -1.0, 0.0))
     pos = jnp.where(valid, pos, 0.0)
     out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)

@@ -375,13 +375,14 @@ class JaxSweepBackend:
         from ..parallel import sweep as sweep_mod
 
         jobs = list(jobs)
-        # Group stackable jobs: same strategy, grid, cost. Mixed history
-        # lengths stack fine — both the fused kernels (per-ticker t_real)
-        # and the generic path (pad_and_stack + bar_mask) handle ragged
-        # groups — but lengths are bucketed by power of two (on the wire
-        # byte length, which is linear in bars) so co-batching never pads a
-        # job more than ~2x, and one oversized job cannot push a whole
-        # group over the fused VMEM cap onto the generic path.
+        # Group stackable jobs: same strategy, grid, cost (and walk-forward
+        # windowing). Mixed history lengths stack fine — both the fused
+        # kernels (per-ticker t_real) and the generic path (pad_and_stack +
+        # bar_mask) handle ragged groups — but lengths are bucketed by
+        # power of two (on the wire byte length, which is linear in bars)
+        # so co-batching never pads a job more than ~2x, and one oversized
+        # job cannot push a whole group over the fused VMEM cap onto the
+        # generic path.
         groups: dict[tuple, list[pb.JobSpec]] = {}
         for job in jobs:
             grid = wire.grid_from_proto(job.grid)
@@ -389,7 +390,8 @@ class JaxSweepBackend:
                    tuple(sorted((k, v.tobytes()) for k, v in grid.items())),
                    len(job.ohlcv).bit_length(),
                    len(job.ohlcv2).bit_length(),   # 0 for single-asset jobs
-                   job.cost, job.periods_per_year)
+                   job.cost, job.periods_per_year,
+                   job.wf_train, job.wf_test, job.wf_metric)
             groups.setdefault(key, []).append(job)
 
         pending = []
@@ -400,6 +402,10 @@ class JaxSweepBackend:
                 continue
             series = [data_mod.from_wire_bytes(j.ohlcv) for j in group]
             lengths = [s.n_bars for s in series]
+            if group[0].wf_train > 0:
+                pending.append(self._submit_walkforward_group(
+                    group, series, lengths, t0))
+                continue
             # JobSpec.grid carries per-parameter AXES; the cartesian product
             # is materialized worker-side (backtesting.proto JobSpec.grid).
             axes = wire.grid_from_proto(group[0].grid)
@@ -480,6 +486,79 @@ class JaxSweepBackend:
                             len(group)))
         return pending
 
+    def _submit_walkforward_group(self, group, series, lengths, t0):
+        """Walk-forward jobs (proto ``JobSpec.wf_*``): per refit window,
+        train-span sweep -> per-ticker argmax by ``wf_metric`` ->
+        out-of-sample repricing on the next ``wf_test`` bars; the DBXM
+        result is ONE stitched OOS metrics row per job, not a per-combo
+        matrix. Jobs too short for a single train+test window complete
+        with an empty block and a loud error. Runs single-device: the
+        per-window selection is a composed ``lax.scan``, not a
+        row-shardable sweep (a mesh-wide variant would shard tickers the
+        same way submit() does — future work, the scan carries are
+        per-ticker)."""
+        import logging
+
+        import jax.numpy as jnp
+
+        from ..models import base as models_base
+        from ..ops.metrics import Metrics
+        from ..parallel import sweep as sweep_mod, walkforward
+
+        log = logging.getLogger("dbx.compute")
+        job0 = group[0]
+        need = job0.wf_train + job0.wf_test
+        metric = job0.wf_metric or "sharpe"
+        if metric not in Metrics._fields:
+            # Validated-bad, like a malformed pairs leg: raising here would
+            # requeue the group through lease expiry forever.
+            log.error("walk-forward jobs %s request unknown selection "
+                      "metric %r (known: %s); completing with empty metrics",
+                      [j.id for j in group], metric,
+                      ", ".join(Metrics._fields))
+            return (list(group), None, t0, 0)
+        good, bad = [], []
+        for j, s, n_bars in zip(group, series, lengths):
+            if job0.wf_test <= 0 or n_bars < need:
+                log.error(
+                    "walk-forward job %s needs wf_test > 0 and >= %d bars "
+                    "(train %d + test %d), has %d; completing with empty "
+                    "metrics", j.id, need, job0.wf_train, job0.wf_test,
+                    n_bars)
+                bad.append(j)
+            else:
+                good.append((j, s))
+        if not good:
+            return (bad, None, t0, 0)
+
+        axes = wire.grid_from_proto(job0.grid)
+        grid = sweep_mod.product_grid(
+            **{k: jnp.asarray(v) for k, v in axes.items()})
+        strategy = models_base.get_strategy(job0.strategy)
+        kwargs = dict(train=job0.wf_train, test=job0.wf_test,
+                      metric=metric, cost=job0.cost,
+                      periods_per_year=job0.periods_per_year or 252)
+        uniform = len({s.n_bars for _, s in good}) == 1
+        if uniform:
+            panel = type(good[0][1])(
+                *(jnp.asarray(np.stack([np.asarray(getattr(s, f))
+                                        for _, s in good]))
+                  for f in good[0][1]._fields))
+            m = walkforward.walk_forward(panel, strategy, dict(grid),
+                                         **kwargs).oos_metrics
+        else:
+            # Window starts are global bar indices: ragged histories can't
+            # share one scan, so they refit per job (grouping buckets
+            # lengths by power of two, keeping this rare and bounded).
+            rows = [walkforward.walk_forward(
+                type(s)(*(jnp.asarray(np.asarray(f))[None, :] for f in s)),
+                strategy, dict(grid), **kwargs).oos_metrics
+                for _, s in good]
+            m = Metrics(*(jnp.concatenate(f, axis=0) for f in zip(*rows)))
+        m = Metrics(*(f[:, None] for f in m))   # one OOS row per job
+        return ([j for j, _ in good] + bad, _start_result_copy(m), t0,
+                len(good))
+
     def _submit_pairs_group(self, group, t0):
         """Two-legged jobs: stack both legs, run the pairs sweep.
 
@@ -503,6 +582,12 @@ class JaxSweepBackend:
         # co-batched group or looping forever through lease requeues.
         good, bad = [], []
         for j in group:
+            if j.wf_train > 0:
+                log.error("pairs job %s requests walk-forward mode, which "
+                          "is single-asset only; completing with empty "
+                          "metrics", j.id)
+                bad.append(j)
+                continue
             if not j.ohlcv2:
                 log.error("pairs job %s has no second leg (ohlcv2); "
                           "completing with empty metrics", j.id)

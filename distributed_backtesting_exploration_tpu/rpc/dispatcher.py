@@ -111,6 +111,12 @@ class JobRecord:
     path: str | None = None       # file-backed source (CSV or DBX1)
     ohlcv: bytes | None = None    # inline source (already-encoded DBX1)
     ohlcv2: bytes | None = None   # second leg for two-legged strategies
+    # Walk-forward mode (proto JobSpec.wf_*): train/test bars per refit
+    # window; 0 train = plain sweep. The DBXM result is then one stitched
+    # out-of-sample metrics row, not a per-combo matrix.
+    wf_train: int = 0
+    wf_test: int = 0
+    wf_metric: str = ""
 
     @property
     def combos(self) -> int:
@@ -131,12 +137,15 @@ class JobRecord:
             rec["ohlcv_b64"] = base64.b64encode(self.ohlcv).decode("ascii")
         if self.ohlcv2 is not None:
             rec["ohlcv2_b64"] = base64.b64encode(self.ohlcv2).decode("ascii")
+        if self.wf_train:
+            rec["wf"] = [self.wf_train, self.wf_test, self.wf_metric]
         return rec
 
     @staticmethod
     def from_journal(rec: dict) -> "JobRecord":
         ohlcv = rec.get("ohlcv_b64")
         ohlcv2 = rec.get("ohlcv2_b64")
+        wf = rec.get("wf") or [0, 0, ""]
         return JobRecord(
             id=rec["id"], strategy=rec["strategy"],
             grid={k: np.asarray(v, np.float32)
@@ -144,7 +153,8 @@ class JobRecord:
             cost=rec.get("cost", 0.0), periods_per_year=rec.get("ppy", 252),
             path=rec.get("path"),
             ohlcv=base64.b64decode(ohlcv) if ohlcv else None,
-            ohlcv2=base64.b64decode(ohlcv2) if ohlcv2 else None)
+            ohlcv2=base64.b64decode(ohlcv2) if ohlcv2 else None,
+            wf_train=int(wf[0]), wf_test=int(wf[1]), wf_metric=str(wf[2]))
 
 
 @dataclasses.dataclass
@@ -490,7 +500,9 @@ class Dispatcher(service.DispatcherServicer):
                 id=rec.id, strategy=rec.strategy, ohlcv=payload,
                 grid=wire.grid_to_proto(rec.grid), cost=rec.cost,
                 periods_per_year=rec.periods_per_year,
-                ohlcv2=rec.ohlcv2 or b""))
+                ohlcv2=rec.ohlcv2 or b"",
+                wf_train=rec.wf_train, wf_test=rec.wf_test,
+                wf_metric=rec.wf_metric))
         if taken:
             log.info("dispatched %d jobs to %s", len(taken), request.worker_id)
         return reply
@@ -637,14 +649,17 @@ def parse_grid(spec: str) -> dict[str, np.ndarray]:
 
 
 def jobs_from_paths(paths, strategy: str, grid, *, cost: float = 0.0,
-                    periods_per_year: int = 252) -> list[JobRecord]:
+                    periods_per_year: int = 252, wf_train: int = 0,
+                    wf_test: int = 0, wf_metric: str = "") -> list[JobRecord]:
     return [JobRecord(id=str(uuid.uuid4()), strategy=strategy, grid=grid,
-                      cost=cost, periods_per_year=periods_per_year, path=p)
+                      cost=cost, periods_per_year=periods_per_year, path=p,
+                      wf_train=wf_train, wf_test=wf_test, wf_metric=wf_metric)
             for p in paths]
 
 
 def synthetic_jobs(n: int, n_bars: int, strategy: str, grid, *,
-                   cost: float = 0.0, seed: int = 0) -> list[JobRecord]:
+                   cost: float = 0.0, seed: int = 0, wf_train: int = 0,
+                   wf_test: int = 0, wf_metric: str = "") -> list[JobRecord]:
     """Inline synthetic-OHLCV jobs (benchmarks / demos without data files).
 
     ``strategy="pairs"`` jobs carry two legs (``ohlcv`` = y, ``ohlcv2`` = x).
@@ -661,7 +676,8 @@ def synthetic_jobs(n: int, n_bars: int, strategy: str, grid, *,
             ohlcv2 = data_mod.to_wire_bytes(leg_x)
         out.append(JobRecord(
             id=str(uuid.uuid4()), strategy=strategy, grid=grid, cost=cost,
-            ohlcv=data_mod.to_wire_bytes(series), ohlcv2=ohlcv2))
+            ohlcv=data_mod.to_wire_bytes(series), ohlcv2=ohlcv2,
+            wf_train=wf_train, wf_test=wf_test, wf_metric=wf_metric))
     return out
 
 
@@ -684,6 +700,13 @@ def make_parser() -> argparse.ArgumentParser:
     ap.add_argument("--lease-s", type=float, default=60.0)
     ap.add_argument("--prune-window-s", type=float, default=10.0)
     ap.add_argument("--jobs-per-chip", type=int, default=1)
+    ap.add_argument("--wf-train", type=int, default=0,
+                    help="walk-forward mode: train bars per refit window "
+                         "(0 = plain sweep)")
+    ap.add_argument("--wf-test", type=int, default=0,
+                    help="walk-forward mode: out-of-sample bars per window")
+    ap.add_argument("--wf-metric", default="sharpe",
+                    help="walk-forward selection metric")
     return ap
 
 
@@ -715,6 +738,17 @@ def build_dispatcher(args) -> Dispatcher:
         log.info("restored %d pending jobs from journal", restored)
 
     grid = parse_grid(args.grid)
+    # Walk-forward fields travel together, gated on --wf-train: a stray
+    # --wf-test without --wf-train must not silently stamp inert fields on
+    # records (they would split worker co-batching across a restart).
+    if args.wf_train:
+        wf_kw = dict(wf_train=args.wf_train, wf_test=args.wf_test,
+                     wf_metric=args.wf_metric)
+    else:
+        if args.wf_test:
+            log.warning("--wf-test %d ignored: walk-forward mode needs "
+                        "--wf-train > 0", args.wf_test)
+        wf_kw = dict(wf_train=0, wf_test=0, wf_metric="")
     if args.data and args.strategy == "pairs":
         raise SystemExit(
             "--data with --strategy pairs is not supported: file-backed "
@@ -728,7 +762,7 @@ def build_dispatcher(args) -> Dispatcher:
             log.info("skipping %d already-journaled paths",
                      len(paths) - len(new_paths))
         for rec in jobs_from_paths(new_paths, args.strategy, grid,
-                                   cost=args.cost):
+                                   cost=args.cost, **wf_kw):
             queue.enqueue(rec)
         log.info("enqueued %d file jobs", len(new_paths))
     if args.synthetic:
@@ -738,7 +772,8 @@ def build_dispatcher(args) -> Dispatcher:
                      args.synthetic)
         else:
             for rec in synthetic_jobs(args.synthetic, args.bars,
-                                      args.strategy, grid, cost=args.cost):
+                                      args.strategy, grid, cost=args.cost,
+                                      **wf_kw):
                 queue.enqueue(rec)
             log.info("enqueued %d synthetic jobs", args.synthetic)
 

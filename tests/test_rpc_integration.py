@@ -565,3 +565,81 @@ def test_every_registered_strategy_travels_the_wire():
                     np.asarray(getattr(want, name))[i],
                     rtol=2e-4, atol=2e-5,
                     err_msg=f"{strategy}/{name}")
+
+
+def test_walkforward_jobs_over_the_wire_match_direct():
+    """Walk-forward mode (JobSpec.wf_*): the worker backend's stitched OOS
+    metrics row per job must equal the direct walk_forward result; a job
+    too short for one train+test window completes with an empty block."""
+    import jax.numpy as jnp
+
+    from distributed_backtesting_exploration_tpu.models import base
+    from distributed_backtesting_exploration_tpu.parallel import (
+        sweep, walkforward)
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    grid = {"fast": np.float32([3, 5]), "slow": np.float32([13.0])}
+    recs = synthetic_jobs(3, 200, "sma_crossover", grid, cost=1e-3, seed=7,
+                          wf_train=80, wf_test=30, wf_metric="sharpe")
+    short = synthetic_jobs(1, 60, "sma_crossover", grid, cost=1e-3, seed=8,
+                           wf_train=80, wf_test=30, wf_metric="sharpe")
+    specs = [pb.JobSpec(id=r.id, strategy=r.strategy, ohlcv=r.ohlcv,
+                        grid=wire.grid_to_proto(r.grid), cost=r.cost,
+                        wf_train=r.wf_train, wf_test=r.wf_test,
+                        wf_metric=r.wf_metric)
+             for r in recs + short]
+    got = {c.job_id: c.metrics
+           for c in compute.JaxSweepBackend(use_fused=False).process(specs)}
+    assert got[short[0].id] == b""   # too short: empty block, still completed
+
+    series = [data.from_wire_bytes(s.ohlcv) for s in specs[:3]]
+    panel = type(series[0])(
+        *(jnp.asarray(np.stack([np.asarray(getattr(s, f)) for s in series]))
+          for f in series[0]._fields))
+    flat = sweep.product_grid(
+        **{k: jnp.asarray(v) for k, v in sorted(grid.items())})
+    want = walkforward.walk_forward(
+        panel, base.get_strategy("sma_crossover"), dict(flat), train=80,
+        test=30, metric="sharpe", cost=1e-3).oos_metrics
+    for i, rec in enumerate(recs):
+        m = wire.metrics_from_bytes(got[rec.id])
+        for name in m._fields:
+            got_v = np.asarray(getattr(m, name))
+            assert got_v.shape == (1,), f"{name}: one OOS row expected"
+            np.testing.assert_allclose(
+                got_v[0], np.asarray(getattr(want, name))[i],
+                rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_walkforward_job_record_journal_roundtrip(tmp_path):
+    from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+        JobRecord)
+
+    rec = JobRecord(id="w1", strategy="sma_crossover",
+                    grid={"fast": np.float32([3.0])}, cost=1e-3,
+                    ohlcv=b"\x01\x02", wf_train=80, wf_test=30,
+                    wf_metric="sortino")
+    back = JobRecord.from_journal(rec.journal_form())
+    assert (back.wf_train, back.wf_test, back.wf_metric) == (80, 30,
+                                                             "sortino")
+    plain = JobRecord.from_journal(
+        JobRecord(id="p1", strategy="sma_crossover",
+                  grid={}, ohlcv=b"\x01").journal_form())
+    assert (plain.wf_train, plain.wf_test, plain.wf_metric) == (0, 0, "")
+
+
+def test_walkforward_unknown_metric_completes_empty():
+    """A typo'd wf_metric must complete the jobs with empty blocks (loud
+    error), never raise — raising would requeue the group through lease
+    expiry forever."""
+    grid = {"fast": np.float32([3.0]), "slow": np.float32([13.0])}
+    recs = synthetic_jobs(2, 200, "sma_crossover", grid, cost=1e-3, seed=9,
+                          wf_train=80, wf_test=30, wf_metric="sharp")
+    specs = [pb.JobSpec(id=r.id, strategy=r.strategy, ohlcv=r.ohlcv,
+                        grid=wire.grid_to_proto(r.grid), cost=r.cost,
+                        wf_train=r.wf_train, wf_test=r.wf_test,
+                        wf_metric=r.wf_metric) for r in recs]
+    got = {c.job_id: c.metrics
+           for c in compute.JaxSweepBackend(use_fused=False).process(specs)}
+    assert set(got) == {r.id for r in recs}
+    assert all(v == b"" for v in got.values())
