@@ -48,8 +48,11 @@ def aggregate(results_dir: str, journal_path: str, *,
 
     Returns ``{"metric", "jobs_aggregated", "jobs_missing", "best"}`` where
     ``best`` is the fleet-wide top-``top`` list of
-    ``{job, strategy, path, value, params}`` rows sorted best-first in the
-    metric's own direction (lower-is-better metrics sort ascending).
+    ``{job, strategy, path, value, mode, params}`` rows sorted best-first
+    in the metric's own direction (lower-is-better metrics sort
+    ascending). ``mode`` is ``"sweep"`` (``params`` = the argmax combo) or
+    ``"walkforward_oos"`` (the block is one stitched out-of-sample row;
+    ``params`` is empty — each refit window chose its own).
     """
     if metric not in Metrics._fields:
         raise ValueError(f"unknown metric {metric!r}; one of "
@@ -65,21 +68,29 @@ def aggregate(results_dir: str, journal_path: str, *,
             continue
         with open(path, "rb") as fh:
             m = wire.metrics_from_bytes(fh.read())
-        axes = {k: np.asarray(v, np.float32)
-                for k, v in sorted(rec.get("grid", {}).items())}
-        grid = _np_product_grid(axes) if axes else {}
         values = np.asarray(getattr(m, metric)).reshape(-1)
         sign_ = metric_sign(metric)
         idx = int(np.argmax(sign_ * values))
-        best = float(values[idx])
-        params = {k: float(v[idx]) for k, v in grid.items()}
-        rows.append({
+        row = {
             "job": jid,
             "strategy": rec.get("strategy"),
             "path": rec.get("path"),
-            "value": float(best),
-            "params": params,
-        })
+            "value": float(values[idx]),
+        }
+        if rec.get("wf"):
+            # Walk-forward block: ONE stitched out-of-sample row, not a
+            # per-combo matrix — there is no single "best param" (each
+            # refit window chose its own); labeling it with grid combo 0
+            # would be wrong. No grid materialization needed either.
+            row["mode"] = "walkforward_oos"
+            row["params"] = {}
+        else:
+            axes = {k: np.asarray(v, np.float32)
+                    for k, v in sorted(rec.get("grid", {}).items())}
+            grid = _np_product_grid(axes) if axes else {}
+            row["mode"] = "sweep"
+            row["params"] = {k: float(v[idx]) for k, v in grid.items()}
+        rows.append(row)
     sign = metric_sign(metric)
     rows.sort(key=lambda r: sign * r["value"], reverse=True)
     return {

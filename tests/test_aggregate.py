@@ -109,3 +109,32 @@ def test_aggregate_cli(tmp_path, capsys):
     out = json.loads(capsys.readouterr().out)
     assert out["jobs_aggregated"] == 2 and len(out["best"]) == 1
     assert set(out["best"][0]["params"]) == {"fast", "slow"}
+
+
+def test_aggregate_walkforward_blocks(tmp_path):
+    """A walk-forward job's stored block is one stitched OOS row: the
+    aggregator must report its value without fabricating 'best params'
+    (each refit window chose its own)."""
+    journal_path = str(tmp_path / "journal.jsonl")
+    results_dir = str(tmp_path / "results")
+    queue = JobQueue(Journal(journal_path))
+    grid = parse_grid("fast=3:5,slow=10:14:2")
+    recs = synthetic_jobs(2, 200, "sma_crossover", grid, cost=1e-3, seed=5,
+                          wf_train=80, wf_test=30, wf_metric="sharpe")
+    for rec in recs:
+        queue.enqueue(rec)
+    disp = Dispatcher(queue, results_dir=results_dir)
+    queue.take(2, "w1")
+    specs = [pb.JobSpec(id=r.id, strategy=r.strategy, ohlcv=r.ohlcv,
+                        grid=wire.grid_to_proto(r.grid), cost=r.cost,
+                        wf_train=r.wf_train, wf_test=r.wf_test,
+                        wf_metric=r.wf_metric) for r in recs]
+    for c in compute.JaxSweepBackend(use_fused=False).process(specs):
+        disp._complete_one(c.job_id, "w1", c.metrics, c.elapsed_s)
+
+    out = aggregate.aggregate(results_dir, journal_path, metric="sharpe")
+    assert out["jobs_aggregated"] == 2
+    for row in out["best"]:
+        assert row["mode"] == "walkforward_oos"
+        assert row["params"] == {}
+        assert np.isfinite(row["value"])
