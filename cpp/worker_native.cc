@@ -52,6 +52,9 @@ bool proto_selftest() {
   spec.set_ohlcv(wire, n);
   spec.set_cost(0.001f);
   spec.set_periods_per_year(252);
+  spec.set_wf_train(504);
+  spec.set_wf_test(63);
+  spec.set_wf_metric("sharpe");
   auto& fast = (*spec.mutable_grid())["fast"];
   fast.add_values(5.0f);
   fast.add_values(10.0f);
@@ -66,7 +69,9 @@ bool proto_selftest() {
             std::memcmp(back.ohlcv().data(), wire, n) == 0 &&
             back.grid().at("fast").values_size() == 2 &&
             back.grid().at("fast").values(1) == 10.0f &&
-            back.periods_per_year() == 252;
+            back.periods_per_year() == 252 &&
+            back.wf_train() == 504 && back.wf_test() == 63 &&
+            back.wf_metric() == "sharpe";
   dbx_bytes_free(wire);
 
   // And the payload decodes back through the native wire decoder.
