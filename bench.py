@@ -5,9 +5,10 @@ SMA-crossover sweep over 5 years of daily bars with a 2,000-point
 (fast, slow) grid — 1,000,000 full backtests (indicators, positions, PnL,
 9 summary metrics) per sweep call, via the fused Pallas kernel. The suite
 also measures configs[2]-[4] and the rest of the fused family: Bollinger
-(500 x 1k (window, k)), momentum, Donchian (close and high/low channels),
-VWAP reversion, RSI, MACD, rolling-OLS pairs (1k pairs x 500 (lookback,
-z_entry)), and walk-forward (12 refit windows x param grid), plus an
+(500 x 1k (window, k), hysteresis and band-touch), momentum, Donchian
+(close and high/low channels), stochastic %K, VWAP reversion, RSI, MACD,
+rolling-OLS pairs (1k pairs x 500 (lookback, z_entry)), and walk-forward
+(12 refit windows x param grid), plus an
 ``e2e`` config that pushes the headline workload
 through a loopback gRPC dispatcher + worker (decode, RPC and metric
 reporting included), printing a per-config line to stderr.
@@ -28,8 +29,8 @@ Prints ONE JSON line to stdout:
      "configs": {name: rate, ...}}
 
 ``--verify`` mode instead runs fused-vs-generic parity for every fused
-kernel (SMA, Bollinger, momentum, Donchian close + high/low, VWAP, RSI,
-MACD, pairs) ON THE CHIP
+kernel (SMA, Bollinger hysteresis + band-touch, momentum, Donchian close +
+high/low, stochastic, VWAP, RSI, MACD, pairs) ON THE CHIP
 and prints one JSON line with max relative error and the argmax/entry flip
 rates (the knife-edge MXU caveat — plus, for pairs, the banded-tree-sum vs
 cumsum-difference caveat — quantified fresh each round).
@@ -228,6 +229,22 @@ def main():
             run_vwap, n_tickers * sweep.grid_size(vgrid), iters=iters,
             warmup=warmup, name="vwap_fused")
 
+    if enabled("stochastic_fused"):
+        sgrid = sweep.product_grid(
+            band=jnp.linspace(10, 40, max(min(n_params, 1000) // 125, 1)
+                              ).astype(jnp.float32),
+            window=jnp.arange(5, 130, dtype=jnp.float32))
+        sw = np.asarray(sgrid["window"])
+        sb = np.asarray(sgrid["band"])
+
+        def run_stoch():
+            return fused.fused_stochastic_sweep(
+                panel.close, panel.high, panel.low, sw, sb, cost=1e-3)
+
+        rates["stochastic_fused"] = _measure(
+            run_stoch, n_tickers * sweep.grid_size(sgrid), iters=iters,
+            warmup=warmup, name="stochastic_fused")
+
     # --- rsi / macd: the EMA-family fused kernels -------------------------
     if enabled("rsi_fused"):
         # 25 distinct periods (not 50): each distinct period unrolls an
@@ -395,8 +412,8 @@ def main():
     if not rates:
         known = ("sma_fused, bollinger_fused, bollinger_touch_fused, "
                  "momentum_fused, donchian_fused, donchian_hl_fused, "
-                 "vwap_fused, rsi_fused, macd_fused, pairs, e2e, "
-                 "walkforward")
+                 "stochastic_fused, vwap_fused, rsi_fused, macd_fused, "
+                 "pairs, e2e, walkforward")
         sys.exit(f"bench: no configs ran — DBX_BENCH_CONFIGS={only} matched "
                  f"nothing (known: {known})")
     # The headline is the north-star config when it ran; otherwise label the
@@ -510,6 +527,15 @@ def verify():
             lambda g: fused.fused_vwap_sweep(
                 panel.close, panel.volume, np.asarray(g["window"]),
                 np.asarray(g["k"]), cost=1e-3),
+        ),
+        "stochastic": strat_case(
+            "stochastic",
+            sweep.product_grid(
+                band=jnp.linspace(10.0, 40.0, 4).astype(jnp.float32),
+                window=jnp.arange(5, 85, 2, dtype=jnp.float32)),
+            lambda g: fused.fused_stochastic_sweep(
+                panel.close, panel.high, panel.low,
+                np.asarray(g["window"]), np.asarray(g["band"]), cost=1e-3),
         ),
         "rsi": strat_case(
             "rsi",

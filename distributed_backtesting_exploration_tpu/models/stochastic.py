@@ -1,0 +1,53 @@
+"""Stochastic-oscillator mean-reversion (stateful): %K with the shared
+band-hysteresis machine.
+
+``%K = 100 * (close - LL_w) / (HH_w - LL_w)`` locates the close inside the
+trailing ``window``-bar high/low channel — the second family (after the
+high/low Donchian) consuming the HIGH/LOW columns, and the classic
+overbought/oversold oscillator. Centering (``%K - 50``) makes the trade
+exactly the band machine shared with Bollinger/RSI/VWAP
+(``ops.signals.band_hysteresis_assoc``): enter long below ``50 - band``
+(oversold), short above ``50 + band``, hold until %K re-crosses 50.
+
+Channel extrema use the traced-window masked-view kernel
+(``rolling.rolling_extrema_traced``) so the sweep engine can vmap over
+``window`` grids; ``MAX_WINDOW`` bounds the static view, as in
+``models.donchian``. A flat channel (HH == LL) yields %K = 50 (neutral).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops import rolling, signals
+from .base import Strategy, register
+
+MAX_WINDOW = 256
+
+
+def stochastic_k(high, low, close, window, *, max_window: int = MAX_WINDOW,
+                 eps: float = 1e-12):
+    """%K in ``[0, 100]``; shapes ``(..., T)`` -> same. ``window`` may be
+    traced (vmap over window grids, bounded by ``max_window``)."""
+    hh = rolling.rolling_extrema_traced(
+        high, window, max_window=max_window, mode="max", fill=jnp.inf)
+    ll = rolling.rolling_extrema_traced(
+        low, window, max_window=max_window, mode="min", fill=-jnp.inf)
+    rng = hh - ll
+    return jnp.where(rng > eps, 100.0 * (close - ll) / (rng + eps), 50.0)
+
+
+def _positions(ohlcv, params):
+    w = params["window"]
+    k_pct = stochastic_k(ohlcv.high, ohlcv.low, ohlcv.close, w)
+    valid = rolling.valid_mask(ohlcv.close.shape[-1], jnp.asarray(w))
+    return signals.band_hysteresis_assoc(
+        jnp.where(valid, k_pct - 50.0, 0.0), valid, params["band"], 0.0)
+
+
+STOCHASTIC = register(Strategy(
+    name="stochastic",
+    param_fields=("window", "band"),
+    positions_fn=_positions,
+    stateful=True,
+))

@@ -48,6 +48,7 @@ from .fused import (  # noqa: F401
     fused_donchian_hl_sweep,
     fused_vwap_sweep,
     fused_rsi_sweep,
+    fused_stochastic_sweep,
     fused_macd_sweep,
     fused_pairs_sweep,
 )

@@ -1263,6 +1263,78 @@ def fused_donchian_hl_sweep(close, high, low, window, *, t_real=None,
                            interpret=bool(interpret))
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
+                     "ppy", "interpret"))
+def _fused_stoch_call(close, high, low, onehot_w, band_lanes, warm, t_real,
+                      *, windows: tuple, T_pad: int, W_pad: int, P_real: int,
+                      T_real: int | None, cost: float, ppy: int,
+                      interpret: bool):
+    """%K table prep + the *Bollinger* kernel: the centered stochastic
+    oscillator is just another z-score feeding the shared band machine
+    (enter beyond ±band, exit at the 50 centerline: z_exit = 0).
+
+    Channel extrema come from the shared sparse table
+    (:func:`_extrema_table`) over the HIGH/LOW columns — exact max/min, so
+    %K sees bit-identical channel values to the generic
+    ``models.stochastic`` path; the %K arithmetic replicates
+    ``stochastic_k``'s float op order (flat channels fall back to the
+    neutral 50)."""
+    close_p = _pad_last(close, T_pad)
+    hi_tbl = _extrema_table(_pad_last(high, T_pad), windows, "max", 1e30)
+    lo_tbl = _extrema_table(_pad_last(low, T_pad), windows, "min", -1e30)
+    rng = hi_tbl - lo_tbl
+    k_tbl = jnp.where(
+        rng > _EPS,
+        100.0 * (close_p[:, None, :] - lo_tbl) / (rng + _EPS),
+        50.0) - 50.0
+    w_col = jnp.asarray(np.asarray(windows, np.int32))[:, None]  # (W,1)
+    t_row = jnp.arange(T_pad)[None, :]
+    z_table = _pad_w(jnp.where((t_row >= w_col - 1)[None], k_tbl, 0.0),
+                     W_pad)
+    kernel = functools.partial(_boll_kernel, cost=cost, ppy=ppy,
+                               z_exit=0.0, T_real=T_real)
+    return _band_machine_pallas(
+        kernel, close_p, z_table, onehot_w, band_lanes, warm, t_real,
+        T_pad=T_pad, W_pad=W_pad, P_real=P_real, T_real=T_real,
+        interpret=interpret)
+
+
+def fused_stochastic_sweep(close, high, low, window, band, *, t_real=None,
+                           cost: float = 0.0, periods_per_year: int = 252,
+                           interpret: bool | None = None) -> Metrics:
+    """Fused stochastic-%K reversion sweep: ``(N, T)`` panels x ``(P,)``.
+
+    ``window``/``band`` are flat per-combo arrays (:func:`product_grid`
+    order); windows must be integral bar counts. Matches
+    ``run_sweep(..., "stochastic")`` (``models.stochastic``): bit-level on
+    CPU interpret mode; the usual MXU knife-edge caveat on TPU. The second
+    fused kernel consuming the high/low columns (after the HL-Donchian).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    close = jnp.asarray(close, jnp.float32)
+    high = jnp.asarray(high, jnp.float32)
+    low = jnp.asarray(low, jnp.float32)
+    window = np.asarray(window)
+    band = np.asarray(band, np.float32)
+    T = close.shape[1]
+
+    # _boll_grid_setup's shapes fit exactly: warm = window, band lanes in
+    # the k slot (padded lanes get band = +inf and never enter).
+    windows, onehot_w, band_lanes, warm = _boll_grid_setup(
+        window.astype(np.float32).tobytes(), band.tobytes())
+    return _fused_stoch_call(close, high, low, onehot_w, band_lanes, warm,
+                             _t_real_col(t_real, close),
+                             windows=windows, T_pad=_round_up(T, 128),
+                             W_pad=onehot_w.shape[0],
+                             P_real=window.shape[0],
+                             T_real=T if t_real is None else None,
+                             cost=float(cost), ppy=int(periods_per_year),
+                             interpret=bool(interpret))
+
+
 @functools.lru_cache(maxsize=8)
 def _single_window_grid_setup(vals_bytes: bytes, warm_offset: float,
                               what: str):

@@ -221,6 +221,14 @@ class JaxSweepBackend:
             cost=cost, periods_per_year=ppy)
 
     @staticmethod
+    def _run_fused_stochastic(close, high, low, grid, cost, ppy, t_real):
+        from ..ops import fused
+        return fused.fused_stochastic_sweep(
+            close, high, low, np.asarray(grid["window"]),
+            np.asarray(grid["band"]), t_real=t_real, cost=cost,
+            periods_per_year=ppy)
+
+    @staticmethod
     def _run_fused_vwap(close, volume, grid, cost, ppy, t_real):
         from ..ops import fused
         return fused.fused_vwap_sweep(
@@ -242,6 +250,9 @@ class JaxSweepBackend:
                                   _run_fused_donchian_hl,
                                   fields=("close", "high", "low")),
         "rsi": _FusedSpec({"period", "band"}, ("period",), _run_fused_rsi),
+        "stochastic": _FusedSpec({"window", "band"}, ("window",),
+                                 _run_fused_stochastic,
+                                 fields=("close", "high", "low")),
         "macd": _FusedSpec({"fast", "slow", "signal"},
                            ("fast", "slow", "signal"), _run_fused_macd,
                            table_axes=("fast", "slow")),
@@ -273,14 +284,17 @@ class JaxSweepBackend:
             [grid[a] for a in (spec.table_axes or spec.window_axes)])
         if np.unique(np.round(tbl)).size > cls._FUSED_MAX_WINDOWS:
             return False
-        if job.strategy in ("donchian", "donchian_hl"):
-            # The generic donchian paths poison windows beyond their static
-            # view bound (models.donchian.MAX_WINDOW) to NaN; the fused
-            # kernels have no such bound, so larger windows would silently
-            # diverge from the semantics-defining path — keep them generic.
+        if job.strategy in ("donchian", "donchian_hl", "stochastic"):
+            # The generic channel paths poison windows beyond their static
+            # view bound (MAX_WINDOW) to NaN; the fused kernels have no
+            # such bound, so larger windows would silently diverge from the
+            # semantics-defining path — keep them generic.
             from ..models import donchian as donchian_mod
+            from ..models import stochastic as stoch_mod
 
-            if float(wins.max()) > donchian_mod.MAX_WINDOW:
+            bound = (stoch_mod.MAX_WINDOW if job.strategy == "stochastic"
+                     else donchian_mod.MAX_WINDOW)
+            if float(wins.max()) > bound:
                 return False
         return int(max(lengths)) <= cls._FUSED_MAX_BARS
 

@@ -566,3 +566,38 @@ def test_fused_bollinger_touch_ragged():
         dict(window=jnp.asarray([10, 20], jnp.float32),
              k=jnp.asarray([1.0, 2.0], jnp.float32)),
         lengths=[180, 131, 256], seed=37)
+
+
+def _stoch_call(panel, grid, lens):
+    return fused.fused_stochastic_sweep(
+        panel.close, panel.high, panel.low, np.asarray(grid["window"]),
+        np.asarray(grid["band"]), t_real=lens, cost=1e-3)
+
+
+def test_fused_stochastic_matches_generic():
+    _check_panel_sweep(
+        "stochastic", _stoch_call,
+        dict(window=jnp.asarray([10, 14, 21], jnp.float32),
+             band=jnp.asarray([20.0, 30.0], jnp.float32)), seed=41)
+
+
+def test_fused_stochastic_unaligned_T():
+    _check_panel_sweep(
+        "stochastic", _stoch_call,
+        dict(window=jnp.asarray([8, 16], jnp.float32),
+             band=jnp.asarray([25.0], jnp.float32)), T=251, seed=43)
+
+
+def test_fused_stochastic_ragged():
+    _check_panel_ragged(
+        "stochastic", _stoch_call,
+        dict(window=jnp.asarray([10.0, 14.0], jnp.float32),
+             band=jnp.asarray([20.0, 30.0], jnp.float32)),
+        lengths=[150, 200, 97], seed=45)
+
+
+def test_fused_stochastic_rejects_non_integer_windows():
+    with pytest.raises(ValueError, match="integral"):
+        fused.fused_stochastic_sweep(
+            jnp.ones((1, 64)), jnp.ones((1, 64)), jnp.ones((1, 64)),
+            np.asarray([10.5]), np.asarray([20.0]))

@@ -517,6 +517,8 @@ def test_every_registered_strategy_travels_the_wire():
         "donchian": {"window": np.float32([10, 20])},
         "donchian_hl": {"window": np.float32([10, 20])},
         "rsi": {"period": np.float32([7.0]), "band": np.float32([20.0])},
+        "stochastic": {"window": np.float32([10.0]),
+                       "band": np.float32([25.0])},
         "macd": {"fast": np.float32([5.0]), "slow": np.float32([13.0]),
                  "signal": np.float32([4.0])},
         "vwap_reversion": {"window": np.float32([8.0]),
