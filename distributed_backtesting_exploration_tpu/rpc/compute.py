@@ -506,11 +506,9 @@ class JaxSweepBackend:
         out-of-sample repricing on the next ``wf_test`` bars; the DBXM
         result is ONE stitched OOS metrics row per job, not a per-combo
         matrix. Jobs too short for a single train+test window complete
-        with an empty block and a loud error. Runs single-device: the
-        per-window selection is a composed ``lax.scan``, not a
-        row-shardable sweep (a mesh-wide variant would shard tickers the
-        same way submit() does — future work, the scan carries are
-        per-ticker)."""
+        with an empty block and a loud error. Uniform groups shard over
+        the chip mesh (the refit scan's carries are per-ticker, so rows
+        are independent); ragged groups refit per job single-device."""
         import logging
 
         import jax.numpy as jnp
@@ -553,11 +551,28 @@ class JaxSweepBackend:
                       metric=metric, cost=job0.cost,
                       periods_per_year=job0.periods_per_year or 252)
         uniform = len({s.n_bars for _, s in good}) == 1
+        panel_cls = type(good[0][1])
         if uniform:
-            panel = type(good[0][1])(
-                *(jnp.asarray(np.stack([np.asarray(getattr(s, f))
-                                        for _, s in good]))
-                  for f in good[0][1]._fields))
+            arrays = [np.stack([np.asarray(getattr(s, f)) for _, s in good])
+                      for f in good[0][1]._fields]
+        if uniform and self._mesh is not None:
+            # The per-window refit is row-parallel (per-ticker scan +
+            # argmax, no cross-row interaction), so walk-forward groups
+            # shard over the chip mesh like any sweep. The runner returns
+            # (rows, 1) metric columns so the row-sharded out_specs fit.
+            def runner(*blks):
+                r = walkforward.walk_forward(
+                    panel_cls(*blks[:-1]), strategy, dict(grid), **kwargs)
+                return Metrics(*(f[:, None] for f in r.oos_metrics))
+
+            m = self._mesh_call(
+                ("wf",) + self._group_key(job0, axes)
+                + (job0.wf_train, job0.wf_test, metric),
+                runner, arrays, None)
+            return ([j for j, _ in good] + bad, _start_result_copy(m), t0,
+                    len(good))
+        if uniform:
+            panel = panel_cls(*(jnp.asarray(a) for a in arrays))
             m = walkforward.walk_forward(panel, strategy, dict(grid),
                                          **kwargs).oos_metrics
         else:

@@ -173,3 +173,18 @@ def test_mesh_generic_param_chunk_composes(devices):
                                   seed=17))
     _assert_same_payloads(_run(backend_chunked, specs),
                           _run(backend_plain, specs))
+
+
+def test_mesh_walkforward_group_matches_single_device(mesh_backends):
+    """Walk-forward groups shard over the mesh (the per-ticker refit scan
+    is row-parallel); the stitched OOS rows must match the single-device
+    path, pad rows never reported."""
+    grid = {"fast": np.float32([3, 5]), "slow": np.float32([13.0])}
+    recs = synthetic_jobs(9, 200, "sma_crossover", grid, cost=1e-3, seed=19,
+                          wf_train=80, wf_test=30, wf_metric="sharpe")
+    specs = [pb.JobSpec(id=r.id, strategy=r.strategy, ohlcv=r.ohlcv,
+                        grid=wire.grid_to_proto(r.grid), cost=r.cost,
+                        wf_train=r.wf_train, wf_test=r.wf_test,
+                        wf_metric=r.wf_metric) for r in recs]
+    _assert_same_payloads(_run(mesh_backends["generic_mesh"], specs),
+                          _run(mesh_backends["generic_one"], specs))

@@ -602,3 +602,19 @@ def test_backend_fused_donchian_hl_big_window_stays_generic():
 
     grid = {"window": np.float32([10, donchian.MAX_WINDOW + 1])}
     assert not compute.JaxSweepBackend._fused_eligible(_Job(), grid, [160])
+
+
+def test_wf_test_without_train_not_stamped(tmp_path):
+    """--wf-test without --wf-train must not stamp inert wf fields on
+    records (they would split worker co-batching across a restart)."""
+    from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+        build_dispatcher, make_parser)
+
+    args = make_parser().parse_args(
+        ["--synthetic", "2", "--bars", "64", "--grid", "fast=3,slow=8",
+         "--wf-test", "30", "--results-dir", str(tmp_path)])
+    disp = build_dispatcher(args)
+    taken = disp.queue.take(2, "w")
+    assert len(taken) == 2
+    for rec, _ in taken:
+        assert (rec.wf_train, rec.wf_test, rec.wf_metric) == (0, 0, "")
