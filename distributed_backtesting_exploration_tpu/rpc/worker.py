@@ -425,7 +425,9 @@ class Worker:
 def make_backend(name: str, **kwargs) -> compute.ComputeBackend:
     if name == "jax":
         return compute.JaxSweepBackend(
-            param_chunk=kwargs.get("param_chunk"))
+            param_chunk=kwargs.get("param_chunk"),
+            use_fused=kwargs.get("use_fused"),
+            use_mesh=kwargs.get("use_mesh"))
     if name == "instant":
         return compute.InstantBackend()
     if name == "sleep":
@@ -441,6 +443,11 @@ def main(argv=None) -> None:
     ap.add_argument("--backend", default="jax",
                     choices=("jax", "instant", "sleep"))
     ap.add_argument("--param-chunk", type=int, default=None)
+    ap.add_argument("--fused", choices=("auto", "on", "off"), default="auto",
+                    help="fused Pallas kernels (auto: on for TPU backends)")
+    ap.add_argument("--mesh", choices=("auto", "on", "off"), default="auto",
+                    help="shard job groups over the local chip mesh "
+                         "(auto: on for multi-chip TPU hosts)")
     ap.add_argument("--poll-s", type=float, default=0.25)
     ap.add_argument("--status-s", type=float, default=1.0)
     ap.add_argument("--jobs-per-chip", type=int, default=1)
@@ -451,7 +458,10 @@ def main(argv=None) -> None:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
-    backend = make_backend(args.backend, param_chunk=args.param_chunk)
+    tristate = {"auto": None, "on": True, "off": False}
+    backend = make_backend(args.backend, param_chunk=args.param_chunk,
+                           use_fused=tristate[args.fused],
+                           use_mesh=tristate[args.mesh])
     worker = Worker(args.connect, backend, worker_id=args.id,
                     poll_interval_s=args.poll_s,
                     status_interval_s=args.status_s,

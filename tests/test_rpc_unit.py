@@ -618,3 +618,14 @@ def test_wf_test_without_train_not_stamped(tmp_path):
     assert len(taken) == 2
     for rec, _ in taken:
         assert (rec.wf_train, rec.wf_test, rec.wf_metric) == (0, 0, "")
+
+
+def test_make_backend_forwards_fused_and_mesh_flags():
+    from distributed_backtesting_exploration_tpu.rpc.worker import (
+        make_backend)
+
+    b = make_backend("jax", use_fused=False, use_mesh=True, param_chunk=4)
+    assert b.use_fused is False and b.param_chunk == 4
+    assert b._mesh is not None          # 8 virtual devices in tests
+    b2 = make_backend("jax", use_fused=None, use_mesh=False)
+    assert b2._mesh is None
