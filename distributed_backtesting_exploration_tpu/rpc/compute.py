@@ -149,7 +149,11 @@ class JaxSweepBackend:
 
     @property
     def chips(self) -> int:
-        return len(self._devices)
+        # Honest capacity advertising: a meshless backend computes every
+        # group on ONE device, so a multi-chip host claiming all of them
+        # would take leases it cannot parallelize; the mesh path advertises
+        # the real fan-out.
+        return len(self._devices) if self._mesh is not None else 1
 
     # Per-cell VMEM budget of the fused kernel: its (T_pad, W_pad) SMA-table
     # block plus ~8 (T_pad, 128) working tiles must fit in ~16 MB.

@@ -188,3 +188,10 @@ def test_mesh_walkforward_group_matches_single_device(mesh_backends):
                         wf_metric=r.wf_metric) for r in recs]
     _assert_same_payloads(_run(mesh_backends["generic_mesh"], specs),
                           _run(mesh_backends["generic_one"], specs))
+
+
+def test_meshless_multidevice_backend_advertises_one_chip(devices):
+    """A meshless backend computes on one device; advertising all visible
+    chips would take dispatcher leases it cannot parallelize."""
+    assert compute.JaxSweepBackend(use_mesh=False).chips == 1
+    assert compute.JaxSweepBackend(use_mesh=True).chips >= 8
