@@ -1027,27 +1027,31 @@ def _mom_kernel(r_ref, c_ref, past_ref, ol_ref, warm_ref, *refs,
     out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
 
 
-def _don_kernel(r_ref, c_ref, hi_ref, lo_ref, ow_ref, warm_ref, *refs,
+def _don_kernel(r_ref, c_ref, sig_ref, ow_ref, warm_ref, *refs,
                 cost: float, ppy: int, T_real: int | None):
-    """Donchian cell: channel selection + the latch machine as a 3-state
-    prefix composition (breakout latches the position until the opposite
-    channel is touched — associative like the band machine, so the same
-    log-depth ladder applies; mirrors ``models.donchian``'s lax.scan)."""
+    """Donchian cell: breakout-sign selection + the latch machine as a
+    3-state prefix composition (breakout latches the position until the
+    opposite channel is touched — associative like the band machine, so
+    the same log-depth ladder applies; mirrors ``models.donchian``'s
+    lax.scan).
+
+    The per-(ticker, window) breakout sign (+1 above the prior channel
+    high, -1 below the prior low, up wins) is precomputed in prep — ONE
+    table and one selection matmul where separate high/low channel tables
+    would need two of each. The one-hot contraction copies exact values
+    in {-1, 0, +1}, so thresholding at ±0.5 recovers the booleans
+    exactly. The close column (``c_ref``) is unused here; it rides the
+    shared momentum/donchian plumbing (:func:`_single_window_pallas`)."""
+    del c_ref
     tr, out_ref = _unpack_tr(refs, T_real)
     T_pad = r_ref.shape[1]
     r = r_ref[0]
-    close = c_ref[0]
     dn = (((0,), (0,)), ((), ()))
-    hp = jax.lax.Precision.HIGHEST
-    hi = jax.lax.dot_general(hi_ref[0], ow_ref[:], dn,
-                             preferred_element_type=jnp.float32, precision=hp)
-    lo = jax.lax.dot_general(lo_ref[0], ow_ref[:], dn,
-                             preferred_element_type=jnp.float32, precision=hp)
-    # Channel known at the close of t-1, applied to bar t.
-    hi_prev = _shift_down(hi, 1, 1e30)
-    lo_prev = _shift_down(lo, 1, -1e30)
-    up = close >= hi_prev
-    down = close <= lo_prev
+    s = jax.lax.dot_general(sig_ref[0], ow_ref[:], dn,
+                            preferred_element_type=jnp.float32,
+                            precision=jax.lax.Precision.HIGHEST)
+    up = s > 0.5
+    down = s < -0.5
 
     t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, _LANES), 0)
     warm = warm_ref[0, :][None, :]     # window + 1
@@ -1168,19 +1172,27 @@ def _fused_don_call(close, hi_src, lo_src, onehot_w, warm, t_real, *,
 
     ``hi_src``/``lo_src`` are the columns the channel extrema come from:
     the close itself for the close-only variant, the HIGH/LOW columns for
-    the classic channel (``models.donchian._positions_hl``). 1e30 stands in
-    for the generic path's ±inf warmup fill: the one-hot contraction would
-    turn inf into NaN via 0*inf, and no finite price ever clears 1e30, so
-    every breakout comparison is identical."""
+    the classic channel (``models.donchian._positions_hl``). ±1e30 stands
+    in for the generic path's ±inf warmup fill; the channel values are
+    consumed only by prep-side comparisons here (the kernel sees the
+    finite sign table), and no finite price ever clears 1e30, so every
+    breakout comparison is identical."""
     close_p = _pad_last(close, T_pad)
-    hi_p = _pad_last(hi_src, T_pad)
-    lo_p = _pad_last(lo_src, T_pad)
-    hi_tbl = _pad_w(_extrema_table(hi_p, windows, "max", 1e30), W_pad)
-    lo_tbl = _pad_w(_extrema_table(lo_p, windows, "min", -1e30), W_pad)
+    hi_tbl = _extrema_table(_pad_last(hi_src, T_pad), windows, "max", 1e30)
+    lo_tbl = _extrema_table(_pad_last(lo_src, T_pad), windows, "min", -1e30)
+    # Channel known at the close of t-1, applied to bar t; collapsing both
+    # channel tables into ONE breakout-sign table (+1 above the prior
+    # high, -1 below the prior low, up wins — the latch's exact
+    # precedence) halves the per-cell table traffic and selection matmuls.
+    hi_prev = _shift_t(hi_tbl, 1, 1e30)
+    lo_prev = _shift_t(lo_tbl, 1, -1e30)
+    c3 = close_p[:, None, :]
+    sig_tbl = _pad_w(jnp.where(c3 >= hi_prev, 1.0,
+                               jnp.where(c3 <= lo_prev, -1.0, 0.0)), W_pad)
     kernel = functools.partial(_don_kernel, cost=cost, ppy=ppy,
                                T_real=T_real)
     return _single_window_pallas(
-        kernel, close_p, [hi_tbl, lo_tbl], onehot_w, warm, t_real,
+        kernel, close_p, [sig_tbl], onehot_w, warm, t_real,
         T_pad=T_pad, W_pad=W_pad, P_real=P_real, T_real=T_real,
         interpret=interpret)
 
