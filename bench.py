@@ -229,6 +229,22 @@ def main():
             run_vwap, n_tickers * sweep.grid_size(vgrid), iters=iters,
             warmup=warmup, name="vwap_fused")
 
+    if enabled("keltner_fused"):
+        kgrid = sweep.product_grid(
+            k=jnp.linspace(1.0, 3.0, max(min(n_params, 1000) // 25, 1)
+                           ).astype(jnp.float32),
+            window=jnp.arange(5, 55, 2, dtype=jnp.float32))
+        kw = np.asarray(kgrid["window"])
+        kk = np.asarray(kgrid["k"])
+
+        def run_kelt():
+            return fused.fused_keltner_sweep(
+                panel.close, panel.high, panel.low, kw, kk, cost=1e-3)
+
+        rates["keltner_fused"] = _measure(
+            run_kelt, n_tickers * sweep.grid_size(kgrid), iters=iters,
+            warmup=warmup, name="keltner_fused")
+
     if enabled("stochastic_fused"):
         sgrid = sweep.product_grid(
             band=jnp.linspace(10, 40, max(min(n_params, 1000) // 125, 1)
@@ -412,8 +428,8 @@ def main():
     if not rates:
         known = ("sma_fused, bollinger_fused, bollinger_touch_fused, "
                  "momentum_fused, donchian_fused, donchian_hl_fused, "
-                 "stochastic_fused, vwap_fused, rsi_fused, macd_fused, "
-                 "pairs, e2e, walkforward")
+                 "keltner_fused, stochastic_fused, vwap_fused, rsi_fused, "
+                 "macd_fused, pairs, e2e, walkforward")
         sys.exit(f"bench: no configs ran — DBX_BENCH_CONFIGS={only} matched "
                  f"nothing (known: {known})")
     # The headline is the north-star config when it ran; otherwise label the
@@ -536,6 +552,15 @@ def verify():
             lambda g: fused.fused_stochastic_sweep(
                 panel.close, panel.high, panel.low,
                 np.asarray(g["window"]), np.asarray(g["band"]), cost=1e-3),
+        ),
+        "keltner": strat_case(
+            "keltner",
+            sweep.product_grid(
+                k=jnp.linspace(1.0, 3.0, 4).astype(jnp.float32),
+                window=jnp.arange(5, 85, 2, dtype=jnp.float32)),
+            lambda g: fused.fused_keltner_sweep(
+                panel.close, panel.high, panel.low,
+                np.asarray(g["window"]), np.asarray(g["k"]), cost=1e-3),
         ),
         "rsi": strat_case(
             "rsi",

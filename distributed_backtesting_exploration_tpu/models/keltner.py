@@ -1,0 +1,60 @@
+"""Keltner-channel mean-reversion (stateful): EMA midline + ATR bands.
+
+The channel midline is an EMA of the close; the band half-width is ``k``
+average true ranges (ATR = rolling mean of the true range, which consumes
+the high/low columns). Normalizing the close's deviation from the midline
+by the ATR gives a z-like score fed to the shared band machine
+(``ops.signals.band_hysteresis_assoc``): enter long when price stretches
+``k`` ATRs below the midline, short above, hold until it re-crosses the
+midline — the volatility-scaled cousin of the Bollinger trade (which
+normalizes by the rolling *standard deviation* instead).
+
+True range per bar: ``max(high - low, |high - prev_close|,
+|low - prev_close|)`` (the first bar has no previous close and uses
+``high - low``). Both the EMA span and the ATR window equal ``window``;
+a zero-ATR window (constant prices) yields deviation 0 (neutral).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops import rolling, signals
+from .base import Strategy, register
+
+
+def true_range(high, low, close):
+    """Per-bar true range; shapes ``(..., T)`` -> same."""
+    prev_close = jnp.concatenate([close[..., :1], close[..., :-1]], axis=-1)
+    return jnp.maximum(high - low,
+                       jnp.maximum(jnp.abs(high - prev_close),
+                                   jnp.abs(low - prev_close)))
+
+
+def keltner_z(high, low, close, window, *, eps: float = 1e-12):
+    """``(close - EMA_w(close)) / ATR_w`` — ATR-normalized deviation.
+
+    ``window`` may be traced (vmap over window grids); zero-ATR windows
+    yield 0 (neutral).
+    """
+    mid = rolling.ema(close, span=window)
+    atr = rolling.rolling_mean(true_range(high, low, close), window,
+                               fill=jnp.nan)
+    dev = close - mid
+    return jnp.where(atr > eps, dev / (atr + eps), 0.0)
+
+
+def _positions(ohlcv, params):
+    w = params["window"]
+    z = keltner_z(ohlcv.high, ohlcv.low, ohlcv.close, w)
+    valid = rolling.valid_mask(ohlcv.close.shape[-1], jnp.asarray(w))
+    return signals.band_hysteresis_assoc(
+        jnp.where(valid, z, 0.0), valid, params["k"], 0.0)
+
+
+KELTNER = register(Strategy(
+    name="keltner",
+    param_fields=("window", "k"),
+    positions_fn=_positions,
+    stateful=True,
+))

@@ -49,6 +49,7 @@ from .fused import (  # noqa: F401
     fused_vwap_sweep,
     fused_rsi_sweep,
     fused_stochastic_sweep,
+    fused_keltner_sweep,
     fused_macd_sweep,
     fused_pairs_sweep,
 )

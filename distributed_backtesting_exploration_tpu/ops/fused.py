@@ -1347,6 +1347,84 @@ def fused_stochastic_sweep(close, high, low, window, band, *, t_real=None,
                              interpret=bool(interpret))
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
+                     "ppy", "interpret"))
+def _fused_keltner_call(close, high, low, onehot_w, k_lanes, warm, t_real,
+                        *, windows: tuple, T_pad: int, W_pad: int,
+                        P_real: int, T_real: int | None, cost: float,
+                        ppy: int, interpret: bool):
+    """Keltner z-table prep + the *Bollinger* kernel: the ATR-normalized
+    deviation from the EMA midline feeds the shared band machine (enter
+    beyond ±k ATRs, exit at the midline re-cross: z_exit = 0).
+
+    Per distinct window: the EMA midline runs as the shift-ladder
+    (``_ema_rows`` — float-order differs from the generic
+    ``associative_scan`` EMA, the RSI/MACD caveat) and the ATR is a
+    cumsum-difference windowed mean of the true range. Warmup rows — where
+    the generic path's NaN-filled rolling mean makes ``atr > eps`` False
+    and the deviation falls back to exactly 0 — are forced to 0, as is the
+    zero-ATR (constant-price) fallback."""
+    close_p = _pad_last(close, T_pad)
+    high_p = _pad_last(high, T_pad)
+    low_p = _pad_last(low, T_pad)
+    w_col, w_f, t_row, windowed_sum, _ = _cumsum_window_tools(windows, T_pad)
+
+    prev_close = jnp.concatenate([close_p[:, :1], close_p[:, :-1]], axis=-1)
+    tr = jnp.maximum(high_p - low_p,
+                     jnp.maximum(jnp.abs(high_p - prev_close),
+                                 jnp.abs(low_p - prev_close)))
+    atr = windowed_sum(tr) / w_f                                 # (N,W,T_pad)
+    mids = jnp.stack(
+        [_ema_rows(close_p, 2.0 / (float(w) + 1.0)) for w in windows],
+        axis=1)
+    dev = close_p[:, None, :] - mids
+    have = (t_row >= (w_col - 1))[None] & (atr > _EPS)
+    z_table = _pad_w(jnp.where(have, dev / (atr + _EPS), 0.0), W_pad)
+
+    kernel = functools.partial(_boll_kernel, cost=cost, ppy=ppy,
+                               z_exit=0.0, T_real=T_real)
+    return _band_machine_pallas(
+        kernel, close_p, z_table, onehot_w, k_lanes, warm, t_real,
+        T_pad=T_pad, W_pad=W_pad, P_real=P_real, T_real=T_real,
+        interpret=interpret)
+
+
+def fused_keltner_sweep(close, high, low, window, k, *, t_real=None,
+                        cost: float = 0.0, periods_per_year: int = 252,
+                        interpret: bool | None = None) -> Metrics:
+    """Fused Keltner-channel reversion sweep: ``(N, T)`` panels x ``(P,)``.
+
+    ``window``/``k`` are flat per-combo arrays (:func:`product_grid`
+    order); windows must be integral bar counts. Matches
+    ``run_sweep(..., "keltner")`` (``models.keltner``) to f32 tolerance
+    (the in-prep EMA ladder rounds differently from the generic
+    ``associative_scan`` — the RSI/MACD caveat — so knife-edge midline
+    crossings can resolve differently; quantified by ``bench.py
+    --verify``).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    close = jnp.asarray(close, jnp.float32)
+    high = jnp.asarray(high, jnp.float32)
+    low = jnp.asarray(low, jnp.float32)
+    window = np.asarray(window)
+    k = np.asarray(k, np.float32)
+    T = close.shape[1]
+
+    windows, onehot_w, k_lanes, warm = _boll_grid_setup(
+        window.astype(np.float32).tobytes(), k.tobytes())
+    return _fused_keltner_call(close, high, low, onehot_w, k_lanes, warm,
+                               _t_real_col(t_real, close),
+                               windows=windows, T_pad=_round_up(T, 128),
+                               W_pad=onehot_w.shape[0],
+                               P_real=window.shape[0],
+                               T_real=T if t_real is None else None,
+                               cost=float(cost), ppy=int(periods_per_year),
+                               interpret=bool(interpret))
+
+
 @functools.lru_cache(maxsize=8)
 def _single_window_grid_setup(vals_bytes: bytes, warm_offset: float,
                               what: str):

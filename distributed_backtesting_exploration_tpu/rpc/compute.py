@@ -233,6 +233,14 @@ class JaxSweepBackend:
             periods_per_year=ppy)
 
     @staticmethod
+    def _run_fused_keltner(close, high, low, grid, cost, ppy, t_real):
+        from ..ops import fused
+        return fused.fused_keltner_sweep(
+            close, high, low, np.asarray(grid["window"]),
+            np.asarray(grid["k"]), t_real=t_real, cost=cost,
+            periods_per_year=ppy)
+
+    @staticmethod
     def _run_fused_vwap(close, volume, grid, cost, ppy, t_real):
         from ..ops import fused
         return fused.fused_vwap_sweep(
@@ -257,6 +265,9 @@ class JaxSweepBackend:
         "stochastic": _FusedSpec({"window", "band"}, ("window",),
                                  _run_fused_stochastic,
                                  fields=("close", "high", "low")),
+        "keltner": _FusedSpec({"window", "k"}, ("window",),
+                              _run_fused_keltner,
+                              fields=("close", "high", "low")),
         "macd": _FusedSpec({"fast", "slow", "signal"},
                            ("fast", "slow", "signal"), _run_fused_macd,
                            table_axes=("fast", "slow")),

@@ -601,3 +601,34 @@ def test_fused_stochastic_rejects_non_integer_windows():
         fused.fused_stochastic_sweep(
             jnp.ones((1, 64)), jnp.ones((1, 64)), jnp.ones((1, 64)),
             np.asarray([10.5]), np.asarray([20.0]))
+
+
+def _keltner_call(panel, grid, lens):
+    return fused.fused_keltner_sweep(
+        panel.close, panel.high, panel.low, np.asarray(grid["window"]),
+        np.asarray(grid["k"]), t_real=lens, cost=1e-3)
+
+
+def test_fused_keltner_matches_generic():
+    # The in-prep EMA ladder rounds differently from the generic
+    # associative_scan (the RSI/MACD caveat); loosened tolerance only.
+    _check_panel_sweep(
+        "keltner", _keltner_call,
+        dict(window=jnp.asarray([10, 14, 21], jnp.float32),
+             k=jnp.asarray([1.5, 2.5], jnp.float32)), seed=47,
+        rtol=2e-3, atol=2e-4)
+
+
+def test_fused_keltner_unaligned_T():
+    _check_panel_sweep(
+        "keltner", _keltner_call,
+        dict(window=jnp.asarray([8, 16], jnp.float32),
+             k=jnp.asarray([2.0], jnp.float32)), T=251, seed=49,
+        rtol=2e-3, atol=2e-4)
+
+
+def test_fused_keltner_rejects_non_integer_windows():
+    with pytest.raises(ValueError, match="integral"):
+        fused.fused_keltner_sweep(
+            jnp.ones((1, 64)), jnp.ones((1, 64)), jnp.ones((1, 64)),
+            np.asarray([10.5]), np.asarray([1.5]))

@@ -519,6 +519,8 @@ def test_every_registered_strategy_travels_the_wire():
         "rsi": {"period": np.float32([7.0]), "band": np.float32([20.0])},
         "stochastic": {"window": np.float32([10.0]),
                        "band": np.float32([25.0])},
+        "keltner": {"window": np.float32([12.0]),
+                    "k": np.float32([1.5])},
         "macd": {"fast": np.float32([5.0]), "slow": np.float32([13.0]),
                  "signal": np.float32([4.0])},
         "vwap_reversion": {"window": np.float32([8.0]),
