@@ -20,7 +20,7 @@ from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
 from distributed_backtesting_exploration_tpu.rpc.worker import Worker
 
 
-def _server(queue, *, lease_s=60.0, prune_window_s=10.0, prune_interval_s=0.1,
+def _server(queue, *, prune_window_s=10.0, prune_interval_s=0.1,
             results_dir=None):
     disp = Dispatcher(queue, PeerRegistry(prune_window_s=prune_window_s),
                       results_dir=results_dir)
@@ -32,10 +32,11 @@ def _server(queue, *, lease_s=60.0, prune_window_s=10.0, prune_interval_s=0.1,
 _LIVE_WORKERS: list = []
 
 
-def _run_worker(target, backend, **kw):
+def _run_worker(target, backend, *, max_idle_polls=10, **kw):
     w = Worker(target, backend, poll_interval_s=0.02,
                status_interval_s=0.05, **kw)
-    t = threading.Thread(target=lambda: w.run(max_idle_polls=10), daemon=True)
+    t = threading.Thread(target=lambda: w.run(max_idle_polls=max_idle_polls),
+                         daemon=True)
     t.start()
     _LIVE_WORKERS.append((w, t))
     return w, t
@@ -647,3 +648,66 @@ def test_walkforward_unknown_metric_completes_empty():
            for c in compute.JaxSweepBackend(use_fused=False).process(specs)}
     assert set(got) == {r.id for r in recs}
     assert all(v == b"" for v in got.values())
+
+
+def test_chaos_soak_exactly_once(tmp_path):
+    """Combined-failure soak: three workers churn a journaled queue while a
+    ghost worker abandons leases and the dispatcher restarts mid-run. Every
+    job must complete EXACTLY once (the journal's completion record is the
+    witness) — none lost, none double-recorded."""
+    from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+        JobRecord)
+    from distributed_backtesting_exploration_tpu.rpc.journal import Journal
+
+    n_jobs = 40
+    jpath = str(tmp_path / "q.jsonl")
+    queue = JobQueue(Journal(jpath), lease_s=1.0)
+    recs = synthetic_jobs(n_jobs, 48, "sma_crossover", GRID, seed=3)
+    for rec in recs:
+        queue.enqueue(rec)
+    disp, srv = _server(queue, prune_window_s=2.0)
+    port = srv.port
+
+    # A ghost takes leases and vanishes: expiry must requeue its jobs.
+    ghost_taken = queue.take(6, "ghost-worker")
+    assert len(ghost_taken) == 6
+
+    # No idle self-exit: a momentarily-empty queue (ghost jobs leased,
+    # everything else dispatched) must not let the fleet die pre-crash.
+    workers = [_run_worker(f"localhost:{port}", compute.InstantBackend(),
+                           max_idle_polls=None)
+               for _ in range(3)]
+    _wait(lambda: queue.stats()["jobs_completed"] >= n_jobs // 3,
+          timeout=60.0, msg="first third completed")
+
+    # Dispatcher crash + restart on the same port, state from the journal.
+    srv.stop()
+    time.sleep(0.3)
+    assert all(t.is_alive() for _, t in workers)
+    state = Journal.replay(jpath)
+    queue2 = JobQueue(lease_s=1.0)
+    for jid in state.pending:
+        # Inline payloads are journaled (ohlcv_b64), so from_journal
+        # restores a fully dispatchable record.
+        queue2.enqueue(JobRecord.from_journal(state.jobs[jid]),
+                       journal=False)
+    already = len(state.completed)
+    disp2 = Dispatcher(queue2, PeerRegistry(prune_window_s=2.0))
+    srv2 = DispatcherServer(disp2, bind=f"localhost:{port}",
+                            prune_interval_s=0.1).start()
+    try:
+        _wait(lambda: queue2.drained, timeout=120.0,
+              msg="post-restart queue drained")
+        for w, t in workers:
+            w.stop()
+        for w, t in workers:
+            t.join(timeout=10)
+    finally:
+        srv2.stop()
+
+    # Exactly once: pre-crash completions + post-crash completions cover
+    # every job id with no overlap and no loss.
+    assert already + queue2.stats()["jobs_completed"] == n_jobs
+    post = set(queue2._completed)
+    assert set(state.completed).isdisjoint(post)
+    assert set(state.completed) | post == {r.id for r in recs}
