@@ -323,7 +323,7 @@ def main():
     # src/worker/process.rs:23). This config measures the same thing
     # honestly for this framework: dispatcher + worker over loopback gRPC,
     # inline DBX1 payloads, decode + RPC + metric pack-and-report included.
-    if enabled("e2e"):
+    def run_e2e(name, *, top_k=0):
         import tempfile
         import threading
 
@@ -341,6 +341,8 @@ def main():
             "slow": np.arange(30, 30 + 2 * max(n_params // 20, 1), 2,
                               dtype=np.float32)}
         combos = int(np.prod([v.size for v in e2e_grid.values()]))
+        topk_kw = (dict(top_k=top_k, rank_metric="sharpe") if top_k
+                   else {})
 
         queue = JobQueue()
         with tempfile.TemporaryDirectory() as results_dir:
@@ -355,12 +357,13 @@ def main():
 
             def drain(seed):
                 for rec in synthetic_jobs(n_jobs, n_bars, "sma_crossover",
-                                          e2e_grid, cost=1e-3, seed=seed):
+                                          e2e_grid, cost=1e-3, seed=seed,
+                                          **topk_kw):
                     queue.enqueue(rec)
                 deadline = time.monotonic() + 600.0
                 while not queue.drained:
                     if time.monotonic() > deadline:
-                        sys.exit("bench[e2e]: drain wedged for 600s — "
+                        sys.exit(f"bench[{name}]: drain wedged for 600s — "
                                  "backend failing every batch? "
                                  f"stats={queue.stats()}")
                     time.sleep(0.002)
@@ -379,11 +382,19 @@ def main():
                 wt.join(timeout=30)
                 srv.stop()
             rate = n_jobs * combos * e2e_iters / elapsed
-            print(f"bench[e2e]: warmup {compile_s:.1f}s, {e2e_iters}x "
+            print(f"bench[{name}]: warmup {compile_s:.1f}s, {e2e_iters}x "
                   f"{n_jobs * combos} backtests through the dispatch loop "
                   f"in {elapsed:.3f}s -> {rate/1e6:.2f}M/s "
                   f"({worker.jobs_completed} jobs)", file=sys.stderr)
-            rates["e2e"] = rate
+            rates[name] = rate
+
+    if enabled("e2e"):
+        run_e2e("e2e")
+    # Same loop with on-device top-k reduction (JobSpec.top_k): workers
+    # ship 16 rows instead of the full per-combo matrix, taking the d2h
+    # result transfer and the completion leg off the critical path.
+    if enabled("e2e_topk"):
+        run_e2e("e2e_topk", top_k=16)
 
     # --- configs[4]: walk-forward (12 refit windows x grid) ---------------
     if enabled("walkforward"):
@@ -429,7 +440,7 @@ def main():
         known = ("sma_fused, bollinger_fused, bollinger_touch_fused, "
                  "momentum_fused, donchian_fused, donchian_hl_fused, "
                  "keltner_fused, stochastic_fused, vwap_fused, rsi_fused, "
-                 "macd_fused, pairs, e2e, walkforward")
+                 "macd_fused, pairs, e2e, e2e_topk, walkforward")
         sys.exit(f"bench: no configs ran — DBX_BENCH_CONFIGS={only} matched "
                  f"nothing (known: {known})")
     # The headline is the north-star config when it ran; otherwise label the

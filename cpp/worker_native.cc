@@ -55,6 +55,8 @@ bool proto_selftest() {
   spec.set_wf_train(504);
   spec.set_wf_test(63);
   spec.set_wf_metric("sharpe");
+  spec.set_top_k(16);
+  spec.set_rank_metric("sortino");
   auto& fast = (*spec.mutable_grid())["fast"];
   fast.add_values(5.0f);
   fast.add_values(10.0f);
@@ -71,7 +73,8 @@ bool proto_selftest() {
             back.grid().at("fast").values(1) == 10.0f &&
             back.periods_per_year() == 252 &&
             back.wf_train() == 504 && back.wf_test() == 63 &&
-            back.wf_metric() == "sharpe";
+            back.wf_metric() == "sharpe" &&
+            back.top_k() == 16 && back.rank_metric() == "sortino";
   dbx_bytes_free(wire);
 
   // And the payload decodes back through the native wire decoder.

@@ -67,7 +67,26 @@ def aggregate(results_dir: str, journal_path: str, *,
                 missing += 1   # completed per journal but block not stored
             continue
         with open(path, "rb") as fh:
-            m = wire.metrics_from_bytes(fh.read())
+            blob = fh.read()
+        kind = wire.result_kind(blob)
+        if kind == "empty":
+            continue   # validated-bad job completed with no result
+        grid_idx = None
+        if kind == "topk":
+            # DBXS block: the worker already reduced on-device; rows are
+            # best-first by the block's own rank metric, and the stored
+            # indices map back into the job's canonical grid order.
+            grid_idx, m, block_metric = wire.topk_from_bytes(blob)
+            if block_metric != metric:
+                # Lossy comparison: only the k best-by-block_metric rows
+                # survived the reduction, so "best by `metric`" below means
+                # best among those — say so once, loudly.
+                log.warning(
+                    "job %s: DBXS block was reduced by %r but aggregation "
+                    "ranks by %r — the reported best is best among the "
+                    "retained top-k rows only", jid, block_metric, metric)
+        else:
+            m = wire.metrics_from_bytes(blob)
         values = np.asarray(getattr(m, metric)).reshape(-1)
         sign_ = metric_sign(metric)
         idx = int(np.argmax(sign_ * values))
@@ -88,8 +107,9 @@ def aggregate(results_dir: str, journal_path: str, *,
             axes = {k: np.asarray(v, np.float32)
                     for k, v in sorted(rec.get("grid", {}).items())}
             grid = _np_product_grid(axes) if axes else {}
-            row["mode"] = "sweep"
-            row["params"] = {k: float(v[idx]) for k, v in grid.items()}
+            row["mode"] = "sweep" if kind == "metrics" else "sweep_topk"
+            combo = int(grid_idx[idx]) if grid_idx is not None else idx
+            row["params"] = {k: float(v[combo]) for k, v in grid.items()}
         rows.append(row)
     sign = metric_sign(metric)
     rows.sort(key=lambda r: sign * r["value"], reverse=True)

@@ -105,6 +105,47 @@ def _stack_metrics(*fields):
     return fn(*fields)
 
 
+_TOPK_FN_CACHE: dict = {}
+
+
+def _topk_reduce(m, metric: str, k: int):
+    """On-device top-k: ``(N, P)`` Metrics -> ``((N, k) idx, (N, k) Metrics)``.
+
+    Rows are ranked by ``metric`` in the metric's own direction
+    (``metric_sign``), NaN rows last. Runs under jit on whatever sharding
+    the sweep produced (the param axis is unsharded in every backend path,
+    so ``top_k``/``take_along_axis`` stay chip-local) — the reduction is
+    the "move scalars, not matrices" half of the north star's per-chip
+    batching story (``JobSpec.top_k``).
+    """
+    import jax
+
+    from ..ops.metrics import Metrics, metric_sign
+
+    key = (metric, int(k))
+    fn = _TOPK_FN_CACHE.get(key)
+    if fn is None:
+        import jax.numpy as jnp
+
+        sign = float(metric_sign(metric))
+        pos = Metrics._fields.index(metric)
+
+        def f(*fields):
+            score = fields[pos] * sign
+            score = jnp.where(jnp.isnan(score), -jnp.inf, score)
+            _, idx = jax.lax.top_k(score, k)
+            return idx, [jnp.take_along_axis(f_, idx, axis=1)
+                         for f_ in fields]
+
+        fn = _TOPK_FN_CACHE[key] = jax.jit(f)
+    idx, sel = fn(*m)
+    try:
+        idx.copy_to_host_async()
+    except AttributeError:
+        pass
+    return idx, Metrics(*sel)
+
+
 class JaxSweepBackend:
     """The real engine: decode OHLCV bytes, run the fused sweep, pack metrics.
 
@@ -387,6 +428,44 @@ class JaxSweepBackend:
                              for k, v in axes.items())),
                 float(job.cost), int(job.periods_per_year or 252))
 
+    @staticmethod
+    def _topk_request_ok(group) -> bool:
+        """Validate a group's ``top_k``/``rank_metric`` request up front.
+
+        An unknown rank metric is validated-bad (complete empty + loud
+        error, no compute); walk-forward jobs ignore ``top_k`` entirely —
+        their payload is already one stitched OOS row (backtesting.proto
+        JobSpec.top_k).
+        """
+        import logging
+
+        from ..ops.metrics import Metrics
+
+        job0 = group[0]
+        if job0.top_k <= 0 or job0.wf_train > 0:
+            return True
+        metric = job0.rank_metric or "sharpe"
+        if metric in Metrics._fields:
+            return True
+        logging.getLogger("dbx.compute").error(
+            "jobs %s request top-k by unknown metric %r (known: %s); "
+            "completing with empty metrics", [j.id for j in group], metric,
+            ", ".join(Metrics._fields))
+        return False
+
+    def _finish_group(self, jobs, m, t0, n_real, job0):
+        """Shared tail of every sweep submit path: optional on-device top-k
+        reduction, then the stacked async result copy."""
+        topk = None
+        if job0.top_k > 0 and job0.wf_train == 0:
+            metric = job0.rank_metric or "sharpe"
+            # Grid size, not m.shape: reading a device array's shape is
+            # free, but np.asarray would sync the pipeline here.
+            P = wire.grid_n_combos(job0.grid)
+            idx, m = _topk_reduce(m, metric, min(int(job0.top_k), P))
+            topk = (idx, metric)
+        return (jobs, _start_result_copy(m), t0, n_real, topk)
+
     def submit(self, jobs) -> list:
         """Dispatch a batch: decode, transfer, launch kernels, start the
         device->host result copy — all without blocking on the device.
@@ -420,12 +499,18 @@ class JaxSweepBackend:
                    len(job.ohlcv).bit_length(),
                    len(job.ohlcv2).bit_length(),   # 0 for single-asset jobs
                    job.cost, job.periods_per_year,
-                   job.wf_train, job.wf_test, job.wf_metric)
+                   job.wf_train, job.wf_test, job.wf_metric,
+                   job.top_k, job.rank_metric)
             groups.setdefault(key, []).append(job)
 
         pending = []
         for group in groups.values():
             t0 = time.perf_counter()
+            if not self._topk_request_ok(group):
+                # Validated-bad, like a malformed pairs leg: complete with
+                # empty blocks instead of requeue-looping through leases.
+                pending.append((list(group), None, t0, 0, None))
+                continue
             if group[0].strategy == "pairs":
                 pending.append(self._submit_pairs_group(group, t0))
                 continue
@@ -511,8 +596,8 @@ class JaxSweepBackend:
                     else:
                         m = sweep_mod.jit_sweep(panel, strategy, grid,
                                                 **kwargs)
-            pending.append((group, _start_result_copy(m), t0,
-                            len(group)))
+            pending.append(self._finish_group(group, m, t0, len(group),
+                                              group[0]))
         return pending
 
     def _submit_walkforward_group(self, group, series, lengths, t0):
@@ -543,7 +628,7 @@ class JaxSweepBackend:
                       "metric %r (known: %s); completing with empty metrics",
                       [j.id for j in group], metric,
                       ", ".join(Metrics._fields))
-            return (list(group), None, t0, 0)
+            return (list(group), None, t0, 0, None)
         good, bad = [], []
         for j, s, n_bars in zip(group, series, lengths):
             if job0.wf_test <= 0 or n_bars < need:
@@ -556,7 +641,7 @@ class JaxSweepBackend:
             else:
                 good.append((j, s))
         if not good:
-            return (bad, None, t0, 0)
+            return (bad, None, t0, 0, None)
 
         axes = wire.grid_from_proto(job0.grid)
         grid = sweep_mod.product_grid(
@@ -585,7 +670,7 @@ class JaxSweepBackend:
                 + (job0.wf_train, job0.wf_test, metric),
                 runner, arrays, None)
             return ([j for j, _ in good] + bad, _start_result_copy(m), t0,
-                    len(good))
+                    len(good), None)
         if uniform:
             panel = panel_cls(*(jnp.asarray(a) for a in arrays))
             m = walkforward.walk_forward(panel, strategy, dict(grid),
@@ -601,7 +686,7 @@ class JaxSweepBackend:
             m = Metrics(*(jnp.concatenate(f, axis=0) for f in zip(*rows)))
         m = Metrics(*(f[:, None] for f in m))   # one OOS row per job
         return ([j for j, _ in good] + bad, _start_result_copy(m), t0,
-                len(good))
+                len(good), None)
 
     def _submit_pairs_group(self, group, t0):
         """Two-legged jobs: stack both legs, run the pairs sweep.
@@ -647,7 +732,7 @@ class JaxSweepBackend:
                 continue
             good.append((j, y, x))
         if not good:
-            return (bad, None, t0, 0)
+            return (bad, None, t0, 0, None)
         group = [j for j, _, _ in good]
         ys = [y for _, y, _ in good]
         xs = [x for _, _, x in good]
@@ -706,16 +791,17 @@ class JaxSweepBackend:
                 for i in range(len(group))]
             m = type(rows[0])(*(jnp.concatenate(f, axis=0)
                                 for f in zip(*rows)))
-        return (list(group) + bad, _start_result_copy(m), t0,
-                len(group))
+        return self._finish_group(list(group) + bad, m, t0, len(group),
+                                  group[0])
 
     def collect(self, pending) -> list[Completion]:
         """Block for a submitted batch's results and pack completions."""
         from ..ops.metrics import Metrics
 
         out: list[Completion] = []
-        for group, stacked, t0, n_real in pending:
+        for group, stacked, t0, n_real, topk in pending:
             host = None if stacked is None else np.asarray(stacked)
+            idx_host = None if topk is None else np.asarray(topk[0])
             elapsed = time.perf_counter() - t0
             per_job = elapsed / max(len(group), 1)
             # n_real (the jobs actually computed), NOT host.shape[1]: the
@@ -725,7 +811,10 @@ class JaxSweepBackend:
             for i, job in enumerate(group):
                 if i < n_rows:
                     row = Metrics(*(host[k, i] for k in range(9)))
-                    blob = wire.metrics_to_bytes(row)
+                    if idx_host is not None:
+                        blob = wire.topk_to_bytes(idx_host[i], row, topk[1])
+                    else:
+                        blob = wire.metrics_to_bytes(row)
                 else:
                     blob = b""   # validated-bad job: complete, no result
                 out.append(Completion(job.id, blob, per_job))

@@ -117,6 +117,11 @@ class JobRecord:
     wf_train: int = 0
     wf_test: int = 0
     wf_metric: str = ""
+    # On-device result reduction (proto JobSpec.top_k): when > 0 the worker
+    # ships only the top-k param rows by rank_metric instead of the full
+    # per-combo matrix — the DCN-diet mode for huge grids.
+    top_k: int = 0
+    rank_metric: str = ""
 
     @property
     def combos(self) -> int:
@@ -139,6 +144,8 @@ class JobRecord:
             rec["ohlcv2_b64"] = base64.b64encode(self.ohlcv2).decode("ascii")
         if self.wf_train:
             rec["wf"] = [self.wf_train, self.wf_test, self.wf_metric]
+        if self.top_k:
+            rec["topk"] = [self.top_k, self.rank_metric]
         return rec
 
     @staticmethod
@@ -146,6 +153,7 @@ class JobRecord:
         ohlcv = rec.get("ohlcv_b64")
         ohlcv2 = rec.get("ohlcv2_b64")
         wf = rec.get("wf") or [0, 0, ""]
+        topk = rec.get("topk") or [0, ""]
         return JobRecord(
             id=rec["id"], strategy=rec["strategy"],
             grid={k: np.asarray(v, np.float32)
@@ -154,7 +162,8 @@ class JobRecord:
             path=rec.get("path"),
             ohlcv=base64.b64decode(ohlcv) if ohlcv else None,
             ohlcv2=base64.b64decode(ohlcv2) if ohlcv2 else None,
-            wf_train=int(wf[0]), wf_test=int(wf[1]), wf_metric=str(wf[2]))
+            wf_train=int(wf[0]), wf_test=int(wf[1]), wf_metric=str(wf[2]),
+            top_k=int(topk[0]), rank_metric=str(topk[1]))
 
 
 @dataclasses.dataclass
@@ -502,7 +511,8 @@ class Dispatcher(service.DispatcherServicer):
                 periods_per_year=rec.periods_per_year,
                 ohlcv2=rec.ohlcv2 or b"",
                 wf_train=rec.wf_train, wf_test=rec.wf_test,
-                wf_metric=rec.wf_metric))
+                wf_metric=rec.wf_metric,
+                top_k=rec.top_k, rank_metric=rec.rank_metric))
         if taken:
             log.info("dispatched %d jobs to %s", len(taken), request.worker_id)
         return reply
@@ -650,16 +660,19 @@ def parse_grid(spec: str) -> dict[str, np.ndarray]:
 
 def jobs_from_paths(paths, strategy: str, grid, *, cost: float = 0.0,
                     periods_per_year: int = 252, wf_train: int = 0,
-                    wf_test: int = 0, wf_metric: str = "") -> list[JobRecord]:
+                    wf_test: int = 0, wf_metric: str = "", top_k: int = 0,
+                    rank_metric: str = "") -> list[JobRecord]:
     return [JobRecord(id=str(uuid.uuid4()), strategy=strategy, grid=grid,
                       cost=cost, periods_per_year=periods_per_year, path=p,
-                      wf_train=wf_train, wf_test=wf_test, wf_metric=wf_metric)
+                      wf_train=wf_train, wf_test=wf_test, wf_metric=wf_metric,
+                      top_k=top_k, rank_metric=rank_metric)
             for p in paths]
 
 
 def synthetic_jobs(n: int, n_bars: int, strategy: str, grid, *,
                    cost: float = 0.0, seed: int = 0, wf_train: int = 0,
-                   wf_test: int = 0, wf_metric: str = "") -> list[JobRecord]:
+                   wf_test: int = 0, wf_metric: str = "", top_k: int = 0,
+                   rank_metric: str = "") -> list[JobRecord]:
     """Inline synthetic-OHLCV jobs (benchmarks / demos without data files).
 
     ``strategy="pairs"`` jobs carry two legs (``ohlcv`` = y, ``ohlcv2`` = x).
@@ -677,7 +690,8 @@ def synthetic_jobs(n: int, n_bars: int, strategy: str, grid, *,
         out.append(JobRecord(
             id=str(uuid.uuid4()), strategy=strategy, grid=grid, cost=cost,
             ohlcv=data_mod.to_wire_bytes(series), ohlcv2=ohlcv2,
-            wf_train=wf_train, wf_test=wf_test, wf_metric=wf_metric))
+            wf_train=wf_train, wf_test=wf_test, wf_metric=wf_metric,
+            top_k=top_k, rank_metric=rank_metric))
     return out
 
 
@@ -707,6 +721,11 @@ def make_parser() -> argparse.ArgumentParser:
                     help="walk-forward mode: out-of-sample bars per window")
     ap.add_argument("--wf-metric", default="sharpe",
                     help="walk-forward selection metric")
+    ap.add_argument("--top-k", type=int, default=0,
+                    help="workers reduce results on-device to the top-k "
+                         "param rows (0 = ship the full per-combo matrix)")
+    ap.add_argument("--rank-metric", default="sharpe",
+                    help="ranking metric for --top-k")
     return ap
 
 
@@ -749,6 +768,21 @@ def build_dispatcher(args) -> Dispatcher:
             log.warning("--wf-test %d ignored: walk-forward mode needs "
                         "--wf-train > 0", args.wf_test)
         wf_kw = dict(wf_train=0, wf_test=0, wf_metric="")
+    if args.top_k:
+        from ..ops.metrics import Metrics
+
+        if args.top_k < 0:
+            raise SystemExit(f"--top-k {args.top_k} must be positive "
+                             "(0 disables the reduction)")
+        if args.wf_train:
+            raise SystemExit("--top-k is a sweep-mode option; walk-forward "
+                             "jobs already complete with one stitched OOS "
+                             "row (drop --top-k or --wf-train)")
+        if args.rank_metric not in Metrics._fields:
+            raise SystemExit(
+                f"--rank-metric {args.rank_metric!r} unknown; one of "
+                f"{', '.join(Metrics._fields)}")
+        wf_kw.update(top_k=args.top_k, rank_metric=args.rank_metric)
     if args.data and args.strategy == "pairs":
         raise SystemExit(
             "--data with --strategy pairs is not supported: file-backed "

@@ -4,6 +4,11 @@ Completions carry the full per-param metric matrix as a compact float32
 block ("DBXM"). The reference's completion payload was a free-text string the
 server never read (reference ``src/server/main.rs:66-78``); here the payload
 is the actual product of the backtest and the dispatcher records it.
+
+Jobs with ``JobSpec.top_k > 0`` instead complete with a "DBXS" (selected)
+block: the top-k param indices into the job's canonical grid order plus
+their metric values — the on-device reduction that keeps a TPU fleet's
+completion leg off the DCN critical path.
 """
 
 from __future__ import annotations
@@ -47,6 +52,66 @@ def metrics_from_bytes(data: bytes) -> Metrics:
         out.append(np.frombuffer(data, dtype="<f4", count=P, offset=off).copy())
         off += 4 * P
     return Metrics(*out)
+
+
+_TOPK_MAGIC = b"DBXS"
+
+
+def topk_to_bytes(indices: "np.ndarray", m: Metrics, rank_metric: str) -> bytes:
+    """Pack a top-k selection: ``(k,)`` grid-row indices + per-field values.
+
+    ``indices`` index the job's canonical cartesian grid order (see
+    :func:`grid_from_proto`), best-first by ``rank_metric`` in the metric's
+    own direction. The metric name travels in the block so a reader needs
+    no out-of-band context to know what "best-first" meant.
+    """
+    idx = np.asarray(indices, dtype="<i4").reshape(-1)
+    fields = [np.asarray(f, dtype="<f4").reshape(-1) for f in m]
+    k = idx.shape[0]
+    if any(f.shape[0] != k for f in fields):
+        raise ValueError("all metric fields must have length k")
+    name = rank_metric.encode("utf-8")
+    if len(name) > 255:
+        raise ValueError("rank_metric name too long")
+    head = _TOPK_MAGIC + struct.pack("<IIB", k, len(fields), len(name)) + name
+    return head + idx.tobytes() + b"".join(f.tobytes() for f in fields)
+
+
+def topk_from_bytes(data: bytes) -> tuple["np.ndarray", Metrics, str]:
+    """Decode a DBXS block -> ``(indices, Metrics of (k,) arrays, metric)``."""
+    if data[:4] != _TOPK_MAGIC:
+        raise ValueError("bad magic; not a DBXS top-k block")
+    k, n_fields, name_len = struct.unpack_from("<IIB", data, 4)
+    if n_fields != len(Metrics._fields):
+        raise ValueError(
+            f"top-k block has {n_fields} fields, expected "
+            f"{len(Metrics._fields)}")
+    off = 13
+    rank_metric = data[off:off + name_len].decode("utf-8")
+    off += name_len
+    need = off + 4 * k + 4 * n_fields * k
+    if len(data) < need:
+        raise ValueError(f"truncated top-k block: {len(data)} < {need}")
+    idx = np.frombuffer(data, dtype="<i4", count=k, offset=off).copy()
+    off += 4 * k
+    out = []
+    for _ in range(n_fields):
+        out.append(np.frombuffer(data, dtype="<f4", count=k,
+                                 offset=off).copy())
+        off += 4 * k
+    return idx, Metrics(*out), rank_metric
+
+
+def result_kind(data: bytes) -> str:
+    """Classify a completion payload: ``"metrics"`` (DBXM), ``"topk"``
+    (DBXS), or ``"empty"``."""
+    if not data:
+        return "empty"
+    if data[:4] == _METRICS_MAGIC:
+        return "metrics"
+    if data[:4] == _TOPK_MAGIC:
+        return "topk"
+    raise ValueError("unknown result block magic")
 
 
 def grid_to_proto(grid: Mapping[str, "np.ndarray"]) -> dict:

@@ -138,3 +138,51 @@ def test_aggregate_walkforward_blocks(tmp_path):
         assert row["mode"] == "walkforward_oos"
         assert row["params"] == {}
         assert np.isfinite(row["value"])
+
+
+def test_aggregate_reads_topk_blocks(tmp_path):
+    """DBXS blocks aggregate like full matrices: the stored indices map
+    back to the canonical grid, mode says the block was pre-reduced."""
+    journal_path = str(tmp_path / "journal.jsonl")
+    results_dir = str(tmp_path / "results")
+    queue = JobQueue(Journal(journal_path))
+    grid = parse_grid("fast=3:5,slow=10:14:2")
+    k = 3
+    recs = synthetic_jobs(3, 96, "sma_crossover", grid, cost=1e-3, seed=3,
+                          top_k=k, rank_metric="sharpe")
+    for rec in recs:
+        queue.enqueue(rec)
+    disp = Dispatcher(queue, results_dir=results_dir)
+    queue.take(len(recs), "w1")
+    specs = [pb.JobSpec(id=r.id, strategy=r.strategy, ohlcv=r.ohlcv,
+                        grid=wire.grid_to_proto(r.grid), cost=r.cost,
+                        periods_per_year=252, top_k=r.top_k,
+                        rank_metric=r.rank_metric) for r in recs]
+    full_specs = [pb.JobSpec(id=r.id, strategy=r.strategy, ohlcv=r.ohlcv,
+                             grid=wire.grid_to_proto(r.grid), cost=r.cost,
+                             periods_per_year=252) for r in recs]
+    backend = compute.JaxSweepBackend()
+    for c in backend.process(specs):
+        disp._complete_one(c.job_id, "w1", c.metrics, c.elapsed_s)
+    full = {c.job_id: wire.metrics_from_bytes(c.metrics)
+            for c in compute.JaxSweepBackend().process(full_specs)}
+
+    out = aggregate.aggregate(results_dir, journal_path, metric="sharpe",
+                              top=10)
+    assert out["jobs_aggregated"] == len(recs)
+    by_job = {r["job"]: r for r in out["best"]}
+    import numpy as np
+
+    from distributed_backtesting_exploration_tpu.parallel import sweep
+    canonical = sweep.product_grid(
+        **{kk: np.asarray(v, np.float32)
+           for kk, v in sorted(recs[0].grid.items())})
+    for rec in recs:
+        row = by_job[rec.id]
+        assert row["mode"] == "sweep_topk"
+        sharpe = np.asarray(full[rec.id].sharpe)
+        best = int(np.argmax(sharpe))
+        assert row["value"] == float(sharpe[best])
+        # Params resolve through the stored grid indices, not row position.
+        for name, vals in canonical.items():
+            assert row["params"][name] == float(np.asarray(vals)[best])

@@ -711,3 +711,117 @@ def test_chaos_soak_exactly_once(tmp_path):
     post = set(queue2._completed)
     assert set(state.completed).isdisjoint(post)
     assert set(state.completed) | post == {r.id for r in recs}
+
+
+def test_topk_jobs_over_the_wire_match_direct_sweep(tmp_path):
+    """JobSpec.top_k: workers reduce on-device and ship DBXS blocks whose
+    rows are the direct sweep's top-k by the rank metric (the reduce-on-
+    chip, move-scalars-over-DCN mode)."""
+    import jax.numpy as jnp
+
+    from distributed_backtesting_exploration_tpu.models import base
+    from distributed_backtesting_exploration_tpu.parallel import sweep
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    grid = parse_grid("fast=3:6,slow=10:16:2")   # P = 9 combos
+    k = 4
+    queue = JobQueue()
+    recs = synthetic_jobs(4, 96, "sma_crossover", grid, cost=1e-3, seed=3,
+                          top_k=k, rank_metric="sharpe")
+    for rec in recs:
+        queue.enqueue(rec)
+    results = tmp_path / "results"
+    disp, srv = _server(queue, results_dir=str(results))
+    try:
+        w, t = _run_worker(f"localhost:{srv.port}",
+                           compute.JaxSweepBackend(use_fused=False))
+        _wait(lambda: queue.drained, msg="queue drained")
+    finally:
+        srv.stop()
+
+    canonical_axes = sweep.product_grid(**dict(sorted(recs[0].grid.items())))
+    for rec in recs:
+        blob = (results / f"{rec.id}.dbxm").read_bytes()
+        assert wire.result_kind(blob) == "topk"
+        idx, got, metric = wire.topk_from_bytes(blob)
+        assert metric == "sharpe" and idx.shape == (k,)
+
+        series = data.from_wire_bytes(rec.ohlcv)
+        panel = type(series)(*(jnp.asarray(f)[None, :] for f in series))
+        want = sweep.jit_sweep(panel, base.get_strategy("sma_crossover"),
+                               canonical_axes, cost=1e-3)
+        sharpe = np.asarray(want.sharpe)[0]
+        order = np.argsort(-sharpe, kind="stable")[:k]
+        np.testing.assert_array_equal(np.sort(idx), np.sort(order))
+        # Rows are best-first and carry the full metric tuple at idx.
+        np.testing.assert_allclose(np.asarray(got.sharpe),
+                                   sharpe[idx], rtol=1e-5, atol=1e-6)
+        for name in want._fields:
+            np.testing.assert_allclose(
+                np.asarray(getattr(got, name)),
+                np.asarray(getattr(want, name))[0][idx],
+                rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_topk_unknown_rank_metric_completes_empty(tmp_path):
+    """A top-k request naming an unknown metric is validated-bad: the jobs
+    complete with EMPTY payloads (no requeue loop, no result files)."""
+    queue = JobQueue()
+    recs = synthetic_jobs(2, 64, "sma_crossover", GRID, top_k=3,
+                          rank_metric="not_a_metric")
+    for rec in recs:
+        queue.enqueue(rec)
+    results = tmp_path / "results"
+    disp, srv = _server(queue, results_dir=str(results))
+    try:
+        w, t = _run_worker(f"localhost:{srv.port}",
+                           compute.JaxSweepBackend(use_fused=False))
+        _wait(lambda: queue.drained, msg="queue drained")
+        s = queue.stats()
+        assert s["jobs_completed"] == 2
+        assert not list(results.glob("*.dbxm"))
+    finally:
+        srv.stop()
+
+
+def test_topk_fused_and_pairs_paths_match_generic():
+    """top_k composes with the fused routing and the two-legged pairs path
+    (backend-level, no server): each completion is a DBXS block matching
+    the corresponding full sweep's top-k rows."""
+    from distributed_backtesting_exploration_tpu.parallel import sweep
+
+    k = 3
+    grid = parse_grid("fast=3:5,slow=10:14:2")
+    sma = synthetic_jobs(2, 96, "sma_crossover", grid, cost=1e-3, seed=6,
+                         top_k=k, rank_metric="total_return")
+    pgrid = parse_grid("lookback=6;10,z_entry=0.5;1.0;1.5")
+    prs = synthetic_jobs(2, 96, "pairs", pgrid, cost=1e-3, seed=7,
+                         top_k=k, rank_metric="sharpe")
+    recs = sma + prs
+    specs = [pb.JobSpec(id=r.id, strategy=r.strategy, ohlcv=r.ohlcv,
+                        ohlcv2=r.ohlcv2 or b"",
+                        grid=wire.grid_to_proto(r.grid), cost=r.cost,
+                        periods_per_year=252, top_k=r.top_k,
+                        rank_metric=r.rank_metric) for r in recs]
+    fused_backend = compute.JaxSweepBackend(use_fused=True)
+    got = {c.job_id: c.metrics for c in fused_backend.process(specs)}
+
+    full_specs = [pb.JobSpec(id=r.id, strategy=r.strategy, ohlcv=r.ohlcv,
+                             ohlcv2=r.ohlcv2 or b"",
+                             grid=wire.grid_to_proto(r.grid), cost=r.cost,
+                             periods_per_year=252) for r in recs]
+    full = {c.job_id: wire.metrics_from_bytes(c.metrics)
+            for c in compute.JaxSweepBackend(use_fused=True)
+            .process(full_specs)}
+
+    from distributed_backtesting_exploration_tpu.ops.metrics import (
+        metric_sign)
+
+    for rec in recs:
+        idx, m, metric = wire.topk_from_bytes(got[rec.id])
+        assert metric == rec.rank_metric
+        ref = np.asarray(getattr(full[rec.id], metric))
+        order = np.argsort(-metric_sign(metric) * ref, kind="stable")[:k]
+        np.testing.assert_array_equal(np.sort(idx), np.sort(order))
+        np.testing.assert_allclose(np.asarray(getattr(m, metric)),
+                                   ref[idx], rtol=1e-5, atol=1e-6)

@@ -629,3 +629,59 @@ def test_make_backend_forwards_fused_and_mesh_flags():
     assert b._mesh is not None          # 8 virtual devices in tests
     b2 = make_backend("jax", use_fused=None, use_mesh=False)
     assert b2._mesh is None
+
+
+def test_topk_wire_roundtrip():
+    """DBXS block: indices + k metric rows + the rank metric's name."""
+    idx = np.asarray([5, 2, 9], np.int32)
+    m = Metrics(*(np.arange(3, dtype=np.float32) + i for i in range(9)))
+    blob = wire.topk_to_bytes(idx, m, "sortino")
+    gi, gm, metric = wire.topk_from_bytes(blob)
+    assert metric == "sortino"
+    np.testing.assert_array_equal(gi, idx)
+    for a, b in zip(gm, m):
+        np.testing.assert_array_equal(a, b)
+    # Kind classification covers all three payload shapes.
+    assert wire.result_kind(blob) == "topk"
+    assert wire.result_kind(wire.metrics_to_bytes(m)) == "metrics"
+    assert wire.result_kind(b"") == "empty"
+    with pytest.raises(ValueError, match="magic"):
+        wire.result_kind(b"????rest")
+    with pytest.raises(ValueError, match="truncated"):
+        wire.topk_from_bytes(blob[:-4])
+    with pytest.raises(ValueError, match="magic"):
+        wire.topk_from_bytes(wire.metrics_to_bytes(m))
+
+
+def test_topk_fields_travel_journal_and_cli(tmp_path):
+    """JobRecord.top_k/rank_metric survive the journal round trip and the
+    CLI stamps them only in sweep mode (walk-forward + --top-k is an
+    error; unknown --rank-metric is an error)."""
+    from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+        build_dispatcher, make_parser)
+
+    rec = JobRecord(id="t", strategy="sma_crossover",
+                    grid={"fast": np.float32([3.0])}, ohlcv=b"x",
+                    top_k=8, rank_metric="cagr")
+    back = JobRecord.from_journal(rec.journal_form())
+    assert (back.top_k, back.rank_metric) == (8, "cagr")
+    # Default stays zero-valued (no "topk" journal key).
+    assert "topk" not in JobRecord(
+        id="u", strategy="s", grid={}, ohlcv=b"x").journal_form()
+
+    args = make_parser().parse_args(
+        ["--synthetic", "2", "--bars", "64", "--grid", "fast=3,slow=8",
+         "--top-k", "4", "--rank-metric", "sortino",
+         "--results-dir", str(tmp_path)])
+    disp = build_dispatcher(args)
+    for rec, _ in disp.queue.take(2, "w"):
+        assert (rec.top_k, rec.rank_metric) == (4, "sortino")
+
+    with pytest.raises(SystemExit, match="rank-metric"):
+        build_dispatcher(make_parser().parse_args(
+            ["--synthetic", "1", "--top-k", "4", "--rank-metric", "nope",
+             "--results-dir", str(tmp_path)]))
+    with pytest.raises(SystemExit, match="walk-forward"):
+        build_dispatcher(make_parser().parse_args(
+            ["--synthetic", "1", "--top-k", "4", "--wf-train", "50",
+             "--wf-test", "20", "--results-dir", str(tmp_path)]))
