@@ -95,29 +95,77 @@ def sharded_linear_scan(mesh: Mesh, a, b, *, axis_name: str = TIME_AXIS):
     """
     spec = P(*((None,) * (a.ndim - 1) + (axis_name,)))
 
+    def local_simple(a_blk, b_blk):
+        return _linear_scan_local(a_blk, b_blk, axis_name)
+
+    return jax.shard_map(local_simple, mesh=mesh, in_specs=(spec, spec),
+                         out_specs=spec, check_vma=False)(a, b)
+
+
+def _linear_scan_local(a_blk, b_blk, axis_name: str):
+    """Blockwise body of :func:`sharded_linear_scan`, composable inside a
+    larger ``shard_map`` (the sharded RSI backtest builds its Wilder EMAs
+    with this in the same SPMD program as the band machine)."""
     def combine(left, right):
         a1, b1 = left
         a2, b2 = right
         return a1 * a2, a2 * b1 + b2
 
-    def local_simple(a_blk, b_blk):
-        prefix_a, y_local = jax.lax.associative_scan(
-            combine, (a_blk, b_blk), axis=-1)
-        A = prefix_a[..., -1]
-        B = y_local[..., -1]
-        n = jax.lax.axis_size(axis_name)
-        idx = jax.lax.axis_index(axis_name)
-        all_A = jax.lax.all_gather(A, axis_name)   # (n, ...)
-        all_B = jax.lax.all_gather(B, axis_name)
-        # Exclusive left-fold of (A, B) maps for blocks < idx, in order.
-        carry = jnp.zeros_like(B)
-        for j in range(n):
-            take = jnp.asarray(j < idx)
-            carry = jnp.where(take, all_A[j] * carry + all_B[j], carry)
-        return y_local + prefix_a * carry[..., None]
+    prefix_a, y_local = jax.lax.associative_scan(
+        combine, (a_blk, b_blk), axis=-1)
+    A = prefix_a[..., -1]
+    B = y_local[..., -1]
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    all_A = jax.lax.all_gather(A, axis_name)   # (n, ...)
+    all_B = jax.lax.all_gather(B, axis_name)
+    # Exclusive left-fold of (A, B) maps for blocks < idx, in order.
+    carry = jnp.zeros_like(B)
+    for j in range(n):
+        take = jnp.asarray(j < idx)
+        carry = jnp.where(take, all_A[j] * carry + all_B[j], carry)
+    return y_local + prefix_a * carry[..., None]
 
-    return jax.shard_map(local_simple, mesh=mesh, in_specs=(spec, spec),
-                         out_specs=spec, check_vma=False)(a, b)
+
+def _ema_local(x_blk, gidx, alpha, axis_name: str):
+    """Blockwise EMA with ``rolling.ema``'s exact seed semantics
+    (``y[0] = x[0]``, encoded as ``a[0] = 0, b[0] = x[0]`` at the *global*
+    first bar)."""
+    t0 = gidx == 0
+    a = jnp.where(t0, 0.0, 1.0 - alpha) * jnp.ones_like(x_blk)
+    b = jnp.where(t0, x_blk, alpha * x_blk)
+    return _linear_scan_local(a, b, axis_name)
+
+
+def sharded_ema(mesh: Mesh, x, *, span=None, alpha=None,
+                axis_name: str = TIME_AXIS):
+    """EMA of a ``(..., T)`` series with the TIME axis sharded over ``mesh``.
+
+    Same recurrence and seed as :func:`~..ops.rolling.ema`
+    (``y[t] = (1-a)*y[t-1] + a*x[t]``, ``y[0] = x[0]``); the cross-block
+    carry is one ``(A, B)`` pair per chip over ICI. An EMA has no window —
+    its state is O(1) — so unlike the rolling-window backtests there is no
+    halo-fits-one-block constraint: any block size works.
+    """
+    if (span is None) == (alpha is None):
+        raise ValueError("pass exactly one of span= or alpha=")
+    if alpha is None:
+        alpha = 2.0 / (float(span) + 1.0)
+    alpha = jnp.float32(alpha)
+    spec = P(*((None,) * (x.ndim - 1) + (axis_name,)))
+    n_dev = mesh.shape[axis_name]
+    T = x.shape[-1]
+    if T % n_dev:
+        raise ValueError(
+            f"T={T} not divisible by the {n_dev}-way {axis_name!r} axis")
+
+    def local(x_blk):
+        Tb = x_blk.shape[-1]
+        gidx = jnp.arange(Tb) + jax.lax.axis_index(axis_name) * Tb
+        return _ema_local(x_blk, gidx, alpha, axis_name)
+
+    return jax.shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec,
+                         check_vma=False)(x)
 
 
 def chunked_scan(step, init_carry, inputs, *, chunk: int, unroll: int = 8):
@@ -409,6 +457,73 @@ def sharded_bollinger_backtest(mesh: Mesh, close, window: int, k: float, *,
         pos = _band_positions_local(z, jnp.broadcast_to(valid, z.shape),
                                     jnp.float32(k), jnp.float32(z_exit),
                                     axis_name)
+        return _pnl_metrics_local(pos, r, gidx, T, cost=cost,
+                                  periods_per_year=periods_per_year,
+                                  axis_name=axis_name)
+
+    out_specs = Metrics(*(rep for _ in Metrics._fields))
+    return jax.shard_map(local, mesh=mesh, in_specs=spec,
+                         out_specs=out_specs, check_vma=False)(close)
+
+
+def sharded_rsi_backtest(mesh: Mesh, close, period: int, band: float, *,
+                         cost: float = 0.0, periods_per_year: int = 252,
+                         axis_name: str = TIME_AXIS):
+    """End-to-end RSI mean-reversion backtest, TIME axis sharded.
+
+    The *EMA-state* long-context composition (Bollinger covers the
+    rolling-window case): Wilder's smoothed gain/loss averages are
+    first-order linear recurrences, so each runs blockwise through
+    :func:`_linear_scan_local` with one ``(A, B)`` carry pair per chip over
+    ICI — no halo at all, since an EMA's state is O(1) rather than a
+    window of bars. The resulting centered RSI feeds the exactly-sharded
+    band machine (:func:`_band_positions_local`, ``models.rsi`` semantics:
+    long below ``50 - band``, short above ``50 + band``, exit at 50) and
+    the shared blockwise PnL/metrics tail. Only the one-bar return/diff
+    halo constrains the block size.
+
+    ``period`` is a static int (the per-chip sweep path vmaps over traced
+    periods; this is the one-long-history path). Returns scalar-per-series
+    :class:`~..ops.metrics.Metrics`, replicated. Matches the unsharded
+    ``rsi`` strategy backtest to f32 tolerance.
+    """
+    from ..ops.metrics import Metrics
+
+    n_dev = mesh.shape[axis_name]
+    T = close.shape[-1]
+    if T % n_dev:
+        raise ValueError(
+            f"T={T} not divisible by the {n_dev}-way {axis_name!r} axis")
+    if period < 1:
+        raise ValueError(f"period must be >= 1, got {period}")
+    alpha = jnp.float32(1.0 / period)   # Wilder's decay (models.rsi)
+    spec = P(*((None,) * (close.ndim - 1) + (axis_name,)))
+    rep = P(*((None,) * (close.ndim - 1)))
+
+    def local(close_blk):
+        Tb = close_blk.shape[-1]
+        idx = jax.lax.axis_index(axis_name)
+        gidx = jnp.arange(Tb) + idx * Tb
+
+        # ONE one-bar halo exchange serves both the returns and the RSI
+        # diff (collectives are latency-bound; XLA is not guaranteed to
+        # CSE two identical ppermutes).
+        prev = jnp.concatenate(
+            [_from_left(close_blk, 1, axis_name), close_blk[..., :-1]],
+            axis=-1)
+        r = jnp.where(gidx == 0, 0.0,
+                      close_blk / jnp.where(gidx == 0, 1.0, prev) - 1.0)
+        # diff[0] = 0 globally (jnp.diff prepend=x0 semantics).
+        diff = jnp.where(gidx == 0, 0.0, close_blk - prev)
+        avg_gain = _ema_local(jnp.maximum(diff, 0.0), gidx, alpha, axis_name)
+        avg_loss = _ema_local(jnp.maximum(-diff, 0.0), gidx, alpha,
+                              axis_name)
+        rsi = 100.0 - 100.0 / (1.0 + avg_gain / (avg_loss + 1e-12))
+
+        valid = gidx >= period   # rolling.valid_mask(T, period + 1)
+        pos = _band_positions_local(
+            rsi - 50.0, jnp.broadcast_to(valid, rsi.shape),
+            jnp.float32(band), jnp.float32(0.0), axis_name)
         return _pnl_metrics_local(pos, r, gidx, T, cost=cost,
                                   periods_per_year=periods_per_year,
                                   axis_name=axis_name)
