@@ -141,7 +141,10 @@ def to_wire_bytes(series: OHLCV) -> bytes:
 
 def from_wire_bytes(data: bytes) -> OHLCV:
     """Decode the binary block produced by :func:`to_wire_bytes`."""
-    if data[:4] != _WIRE_MAGIC:
+    # len check BEFORE unpack: a 4-7 byte block with valid magic must fail
+    # with the contract's ValueError, not struct.error (differential-fuzzed
+    # against the native decoder, which reports these as bad-magic too).
+    if len(data) < 8 or data[:4] != _WIRE_MAGIC:
         raise ValueError("bad magic; not a DBX1 OHLCV block")
     (T,) = struct.unpack_from("<I", data, 4)
     need = 8 + 4 * 5 * T

@@ -199,3 +199,83 @@ def test_native_worker_shell_completes_jobs_end_to_end():
     # The completions carried real metric blocks, recorded dispatcher-side.
     assert len(disp.results) == 2
     assert all(len(block) > 0 for block in disp.results.values())
+
+
+def test_wire_decode_differential_fuzz():
+    """Native and Python DBX1 decoders agree on every input: valid blocks
+    round-trip bit-identically, mutated/truncated/garbage blocks are
+    accepted or rejected IDENTICALLY (a decoder that accepts what its twin
+    rejects is how a fleet gets split-brain payload handling)."""
+    rng = np.random.default_rng(123)
+
+    def both(blob):
+        try:
+            py = data.from_wire_bytes(blob)
+            py = [np.asarray(f) for f in py]
+        except ValueError:
+            py = None
+        try:
+            nat = list(_core.wire_decode(blob))
+        except ValueError:
+            nat = None
+        return py, nat
+
+    for trial in range(60):
+        T = int(rng.integers(0, 40))
+        scale = np.float32(10.0 ** rng.integers(-3, 4))
+        s = data.OHLCV(*(
+            (rng.standard_normal(T) * scale).astype(np.float32)
+            for _ in range(5)))
+        blob = data.to_wire_bytes(s)
+        py, nat = both(blob)
+        assert py is not None and nat is not None, f"trial {trial}: rejected valid block"
+        for a, b in zip(nat, py):
+            np.testing.assert_array_equal(a, b)
+
+        mutations = [
+            blob[:int(rng.integers(0, len(blob) + 1))],     # truncation
+            b"XXXX" + blob[4:],                             # magic corrupt
+            blob[:4] + rng.bytes(4) + blob[8:],             # length corrupt
+            rng.bytes(int(rng.integers(0, 64))),            # garbage
+        ]
+        # Flip one random byte (may or may not keep the block valid).
+        if len(blob) > 8:
+            i = int(rng.integers(0, len(blob)))
+            flipped = bytearray(blob)
+            flipped[i] ^= 0xFF
+            mutations.append(bytes(flipped))
+        for mi, mut in enumerate(mutations):
+            py, nat = both(mut)
+            assert (py is None) == (nat is None), (
+                f"trial {trial} mutation {mi}: python "
+                f"{'accepted' if py is not None else 'rejected'} but native "
+                f"did the opposite (len={len(mut)})")
+            if py is not None:
+                for a, b in zip(nat, py):
+                    np.testing.assert_array_equal(a, b)
+
+    # The length-prefix overflow edge: a huge T must be rejected by both
+    # (size arithmetic must not wrap).
+    import struct as _struct
+    for T_evil in (0xFFFFFFFF, 0x80000000, 0x0FFFFFFF):
+        evil = b"DBX1" + _struct.pack("<I", T_evil) + b"\x00" * 64
+        py, nat = both(evil)
+        assert py is None and nat is None
+
+
+def test_csv_decode_differential_on_valid_inputs():
+    """On well-formed CSVs the native decoder and the pure-Python parser
+    (the semantic reference) agree to f32 round-off."""
+    rng = np.random.default_rng(7)
+    for trial in range(20):
+        T = int(rng.integers(1, 30))
+        s = data.OHLCV(*(
+            (rng.uniform(0.001, 1000.0, T)).astype(np.float32)
+            for _ in range(5)))
+        raw = data.to_csv_bytes(s)
+        nat = _core.csv_decode(raw)
+        # Force the pure-Python path via a non-f32 dtype, then cast.
+        py = data.from_csv_bytes(raw, dtype=np.float64)
+        for a, b in zip(nat, py):
+            np.testing.assert_allclose(a, np.asarray(b, np.float32),
+                                       rtol=1e-6, atol=0)
