@@ -696,34 +696,34 @@ def _boll_grid_setup(window_bytes: bytes, k_bytes: bytes):
             _const(k_lanes), _const(warm))
 
 
-def _pairs_kernel(ry_ref, rx_ref, z_ref, b_ref, ow_ref, k_ref, zx_ref,
+def _pairs_kernel(zh_ref, ow_ref, k_ref, zx_ref,
                   warm_ref, *refs, cost: float, ppy: int,
                   T_real: int | None):
-    """Pairs-trade cell: z/beta selection matmuls + hysteresis + spread PnL.
+    """Pairs-trade cell: one stacked selection matmul + hysteresis + PnL.
 
-    Two MXU contractions pick each lane's lookback column from the per-pair
-    z-score and hedge-ratio tables; the shared band ladder turns z into the
-    position path; the PnL differs from the single-asset tail — spread return
-    ``prev_pos * (r_y - prev_beta * r_x) / max(1 + |prev_beta|, 1)`` (gross-
-    exposure normalized, mirroring ``models.pairs.pair_backtest``) — so this
-    kernel computes its own ``net`` and shares only ``_metrics_pack``.
+    The per-pair z-score and *hedged-return* tables arrive stacked along
+    the lane (T) axis as one ``(W_pad, 2*T_pad)`` block, so ONE MXU
+    contraction selects both per lane — the skinny (K = W_pad) selection
+    matmuls are pass-overhead-bound, and prep already knows the spread
+    return ``(r_y - prev_beta * r_x) / max(1 + |prev_beta|, 1)``
+    (gross-exposure normalized, mirroring ``models.pairs.pair_backtest``;
+    the beta shift is baked in). The shared band ladder turns z into the
+    position path; ``net = prev_pos * hr - cost * |Δpos|`` shares only
+    ``_metrics_pack`` with the single-asset tail.
     """
     tr, out_ref = _unpack_tr(refs, T_real)
-    T_pad = ry_ref.shape[1]
-    ry = ry_ref[0]                   # (T_pad, 1)
-    rx = rx_ref[0]
-    # Tables arrive (W_pad, T_pad) — T on lanes, so the HBM layout pads W up
-    # to a sublane multiple (8) instead of a lane multiple (128); the 12.8x
-    # HBM blow-up of a W-minor table layout dominated the first cut of this
-    # kernel (measured: 601 of 716 ms/sweep in prep). The selection contracts
-    # dim 0 of both operands (tbl^T @ onehot on the MXU).
+    T_pad = zh_ref.shape[2] // 2
+    # The table is (W_pad, 2*T_pad) — T on lanes, so the HBM layout pads W
+    # up to a sublane multiple (8) instead of a lane multiple (128); the
+    # 12.8x HBM blow-up of a W-minor table layout dominated the first cut
+    # of this kernel (measured: 601 of 716 ms/sweep in prep). The selection
+    # contracts dim 0 of both operands (tbl^T @ onehot on the MXU).
     dn = (((0,), (0,)), ((), ()))
-    z = jax.lax.dot_general(z_ref[0], ow_ref[:], dn,
-                            preferred_element_type=jnp.float32,
-                            precision=jax.lax.Precision.HIGHEST)  # (T_pad,128)
-    beta = jax.lax.dot_general(b_ref[0], ow_ref[:], dn,
-                               preferred_element_type=jnp.float32,
-                               precision=jax.lax.Precision.HIGHEST)
+    zh = jax.lax.dot_general(zh_ref[0], ow_ref[:], dn,
+                             preferred_element_type=jnp.float32,
+                             precision=jax.lax.Precision.HIGHEST)
+    z = zh[:T_pad]                                     # (T_pad, 128)
+    hr = zh[T_pad:]                                    # hedged spread return
 
     t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, _LANES), 0)
     warm = warm_ref[0, :][None, :]                     # (1, 128) = 2*lb - 1
@@ -737,10 +737,7 @@ def _pairs_kernel(ry_ref, rx_ref, z_ref, b_ref, ow_ref, k_ref, zx_ref,
     pos_last = _row_at(pos, tr, t_idx, keepdims=True)
     pos = jnp.where(row_ok, pos, pos_last)
     prev = _shift_down(pos, 1, 0.0)
-    prev_beta = _shift_down(beta, 1, 0.0)
-    gross = 1.0 + jnp.abs(prev_beta)
-    spread_ret = prev * (ry - prev_beta * rx) / jnp.maximum(gross, 1.0)
-    net = spread_ret - cost * jnp.abs(pos - prev)
+    net = prev * hr - cost * jnp.abs(pos - prev)
     out_ref[0, 0] = _metrics_pack(pos, prev, net, row_ok, t_idx, tr,
                                   ppy=ppy)
 
@@ -862,10 +859,25 @@ def _fused_pairs_call(y_close, x_close, onehot_w, k_lanes, zx_lanes, warm,
     # also keeps NaN/Inf out of the selection matmul.
     z_tbl = jnp.where((t_row >= 2 * w_col - 2)[None], z, 0.0)
 
+    # Hedged spread return per (pair, window), beta shift baked in: the
+    # kernel's net is just prev_pos * hr - costs, and ONE stacked selection
+    # matmul picks (z, hr) per lane instead of separate z/beta contractions
+    # (the K = W_pad matmul is pass-overhead-bound, so halving the passes
+    # matters more than the FLOPs). Same float op order as the old
+    # in-kernel form — prev_beta, gross, and the division are untouched.
+    ry = _rets3(y_p)[:, :, 0][:, None, :]                        # (N,1,T_pad)
+    rx = _rets3(x_p)[:, :, 0][:, None, :]
+    beta_prev = jnp.concatenate(
+        [jnp.zeros((N, beta_tbl.shape[1], 1), jnp.float32),
+         beta_tbl[:, :, :-1]], axis=2)
+    gross = 1.0 + jnp.abs(beta_prev)
+    hr_tbl = (ry - beta_prev * rx) / jnp.maximum(gross, 1.0)
+
     if W_pad > len(windows):
         zpad = jnp.zeros((N, W_pad - len(windows), T_pad), jnp.float32)
         z_tbl = jnp.concatenate([z_tbl, zpad], axis=1)
-        beta_tbl = jnp.concatenate([beta_tbl, zpad], axis=1)
+        hr_tbl = jnp.concatenate([hr_tbl, zpad], axis=1)
+    zh_tbl = jnp.concatenate([z_tbl, hr_tbl], axis=2)   # (N, W_pad, 2*T_pad)
 
     P_pad = k_lanes.shape[1]
     n_blocks = P_pad // _LANES
@@ -875,13 +887,7 @@ def _fused_pairs_call(y_close, x_close, onehot_w, k_lanes, zx_lanes, warm,
         kernel,
         grid=(N, n_blocks),
         in_specs=[
-            pl.BlockSpec((1, T_pad, 1), lambda i, j: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, T_pad, 1), lambda i, j: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, W_pad, T_pad), lambda i, j: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, W_pad, T_pad), lambda i, j: (i, 0, 0),
+            pl.BlockSpec((1, W_pad, 2 * T_pad), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((W_pad, _LANES), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
@@ -898,7 +904,7 @@ def _fused_pairs_call(y_close, x_close, onehot_w, k_lanes, zx_lanes, warm,
         out_shape=jax.ShapeDtypeStruct(
             (N, n_blocks, _METRIC_ROWS, _LANES), jnp.float32),
         interpret=interpret,
-    )(_rets3(y_p), _rets3(x_p), z_tbl, beta_tbl, onehot_w, k_lanes, zx_lanes,
+    )(zh_tbl, onehot_w, k_lanes, zx_lanes,
       warm, *_tr_args(t_real, T_real))
     return Metrics(*(
         jnp.reshape(out[:, :, k, :], (N, P_pad))[:, :P_real]
