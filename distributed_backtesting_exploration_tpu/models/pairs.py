@@ -54,9 +54,18 @@ def pairs_positions(y: Array, x: Array, params) -> tuple[Array, Array]:
     return pos, beta
 
 
-def pair_backtest(y: Array, x: Array, params, *, cost=0.0,
-                  periods_per_year: int = 252) -> metrics_mod.Metrics:
-    """Full backtest of one pair under one param set (vmap target)."""
+def pair_net_returns(y: Array, x: Array, params, *, cost=0.0):
+    """Positions + per-bar net spread returns + hedged-return factor.
+
+    THE semantics-defining PnL of the pairs trade — the sweep, the fused
+    kernel's parity contract, and the walk-forward engine all price
+    against this one function. Returns ``(pos, net, hr)`` where
+    ``hr[t] = (r_y[t] - beta[t-1]*r_x[t]) / max(1 + |beta[t-1]|, 1)`` is
+    the gross-normalized spread return of holding one unit into bar t and
+    ``net = prev_pos * hr - cost * |Δpos|``. Returns are per unit of gross
+    book, so cost is too: leg notional ``|Δpos|*(1+|beta|)`` over the same
+    gross normalizer reduces to ``|Δpos|``.
+    """
     pos, beta = pairs_positions(y, x, params)
     ry = pnl_mod.simple_returns(y)
     rx = pnl_mod.simple_returns(x)
@@ -65,11 +74,16 @@ def pair_backtest(y: Array, x: Array, params, *, cost=0.0,
     prev_beta = jnp.concatenate(
         [jnp.zeros_like(beta[..., :1]), beta[..., :-1]], axis=-1)
     gross = 1.0 + jnp.abs(prev_beta)
-    spread_ret = prev_pos * (ry - prev_beta * rx) / jnp.maximum(gross, 1.0)
-    # Returns are per unit of gross book, so cost must be too: leg notional
-    # |dpos|*(1+|beta|) over the same gross normalizer reduces to |dpos|.
+    hr = (ry - prev_beta * rx) / jnp.maximum(gross, 1.0)
     turnover = jnp.abs(pos - prev_pos)
-    net = spread_ret - jnp.asarray(cost, y.dtype) * turnover
+    net = prev_pos * hr - jnp.asarray(cost, y.dtype) * turnover
+    return pos, net, hr
+
+
+def pair_backtest(y: Array, x: Array, params, *, cost=0.0,
+                  periods_per_year: int = 252) -> metrics_mod.Metrics:
+    """Full backtest of one pair under one param set (vmap target)."""
+    pos, net, _ = pair_net_returns(y, x, params, cost=cost)
     equity = 1.0 + jnp.cumsum(net, axis=-1)
     return metrics_mod.summary_metrics(
         net, equity, pos, periods_per_year=periods_per_year)

@@ -129,6 +129,82 @@ def walk_forward(
                    periods_per_year=periods_per_year)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("train", "test", "metric", "periods_per_year"))
+def walk_forward_pairs(
+    y_close,
+    x_close,
+    grid: Mapping[str, Array],
+    *,
+    train: int,
+    test: int,
+    metric: str = "sharpe",
+    cost: float = 0.0,
+    periods_per_year: int = 252,
+) -> WalkForwardResult:
+    """Walk-forward optimization for the two-legged pairs strategy.
+
+    Same protocol and scan structure as :func:`walk_forward` over
+    ``(n_pairs, T)`` leg panels: per refit window, sweep the
+    (lookback, z_entry[, z_exit]) grid on the train span (rolling OLS +
+    z-score + band machine recomputed *within* the window — positions at
+    bar t use only bars <= t, so span-slice train metrics equal a
+    train-only run), argmax per pair, realize the winner on the test span.
+    The stitched boundary fix-up replaces the single-asset underlying
+    return with the window's *hedged* spread return factor at its first
+    OOS bar — i.e. the deployed sequence re-hedges each window with the
+    incoming window's chosen beta (positions carry over in spread units;
+    ``models.pairs.pair_backtest`` cost semantics throughout).
+    """
+    from ..models import pairs as pairs_mod
+
+    T = y_close.shape[-1]
+    starts = window_starts(T, train, test)
+    n_pairs = y_close.shape[0]
+    span = train + test
+    sign = metrics_mod.metric_sign(metric)
+
+    def slice_win(a, s0):
+        return jax.lax.dynamic_slice_in_dim(a, s0, span, axis=-1)
+
+    def one_window(carry, s0):
+        ywin = slice_win(y_close, s0)
+        xwin = slice_win(x_close, s0)
+
+        def per_param(y1, x1, params):
+            # The one semantics-defining PnL (shared with run_pairs_sweep
+            # via pair_backtest), so train metrics cannot drift from the
+            # sweep's.
+            pos, net, hr = pairs_mod.pair_net_returns(y1, x1, params,
+                                                      cost=cost)
+            equity_tr = 1.0 + jnp.cumsum(net[..., :train], axis=-1)
+            train_m = getattr(metrics_mod.summary_metrics(
+                net[..., :train], equity_tr, pos[..., :train],
+                periods_per_year=periods_per_year), metric)
+            return (train_m, net[..., train:], pos[..., train:],
+                    pos[..., train - 1], hr[..., train])
+
+        def per_pair(y1, x1):
+            train_m, rets, poss, prevs, hrf = jax.vmap(
+                lambda p: per_param(y1, x1, p))(dict(grid))
+            best = jnp.argmax(sign * train_m)
+            return (train_m[best], best, rets[best], poss[best],
+                    prevs[best], hrf[best])
+
+        best_val, best_idx, oos_r, oos_p, prev_in, hrf = jax.vmap(
+            per_pair)(ywin, xwin)
+        return carry, (best_val, best_idx, oos_r, oos_p, prev_in, hrf)
+
+    _, (train_best, best_idx, oos_r, oos_p, prev_in, hrf) = jax.lax.scan(
+        one_window, 0, starts)
+    chosen = {k: jnp.moveaxis(jnp.take(v, best_idx), 0, 1)
+              for k, v in grid.items()}
+    return _stitch(oos_r, oos_p, prev_in, hrf, train_best, chosen,
+                   n_tickers=n_pairs, cost=cost,
+                   periods_per_year=periods_per_year)
+
+
 def _stitch(oos_r, oos_p, prev_in, rf, train_best, chosen, *, n_tickers,
             cost, periods_per_year) -> WalkForwardResult:
     """Window-major per-window outputs -> stitched WalkForwardResult.

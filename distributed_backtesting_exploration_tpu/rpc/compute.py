@@ -709,14 +709,23 @@ class JaxSweepBackend:
         # fabricate bars the PnL treats as real) is completed with an EMPTY
         # metric block and a loud error rather than poisoning the whole
         # co-batched group or looping forever through lease requeues.
+        job0 = group[0]
+        wf = job0.wf_train > 0
+        if wf:
+            # Validate the walk-forward request once for the group (the
+            # same gates as the single-asset path).
+            metric = job0.wf_metric or "sharpe"
+            from ..ops.metrics import Metrics
+
+            if job0.wf_test <= 0 or metric not in Metrics._fields:
+                log.error(
+                    "pairs walk-forward jobs %s need wf_test > 0 and a "
+                    "known metric (got test=%d, metric=%r); completing "
+                    "with empty metrics", [j.id for j in group],
+                    job0.wf_test, metric)
+                return (list(group), None, t0, 0, None)
         good, bad = [], []
         for j in group:
-            if j.wf_train > 0:
-                log.error("pairs job %s requests walk-forward mode, which "
-                          "is single-asset only; completing with empty "
-                          "metrics", j.id)
-                bad.append(j)
-                continue
             if not j.ohlcv2:
                 log.error("pairs job %s has no second leg (ohlcv2); "
                           "completing with empty metrics", j.id)
@@ -728,6 +737,14 @@ class JaxSweepBackend:
                 log.error("pairs job %s legs differ in length (%d vs %d); "
                           "completing with empty metrics", j.id, y.n_bars,
                           x.n_bars)
+                bad.append(j)
+                continue
+            if wf and y.n_bars < job0.wf_train + job0.wf_test:
+                log.error(
+                    "pairs walk-forward job %s needs >= %d bars (train %d "
+                    "+ test %d), has %d; completing with empty metrics",
+                    j.id, job0.wf_train + job0.wf_test, job0.wf_train,
+                    job0.wf_test, y.n_bars)
                 bad.append(j)
                 continue
             good.append((j, y, x))
@@ -745,6 +762,47 @@ class JaxSweepBackend:
         y_close = _stack_field_ragged(ys, t_max)
         x_close = _stack_field_ragged(xs, t_max)
         uniform = len(set(int(v) for v in lens)) == 1
+        if wf:
+            # Walk-forward pairs (JobSpec.wf_* + strategy "pairs"): one
+            # stitched OOS metrics row per job, like the single-asset path.
+            # Window starts are global bar indices, so ragged groups refit
+            # per job (grouping buckets lengths by power of two — rare).
+            from ..ops.metrics import Metrics
+            from ..parallel import walkforward
+
+            kwargs = dict(train=job0.wf_train, test=job0.wf_test,
+                          metric=job0.wf_metric or "sharpe", cost=cost,
+                          periods_per_year=ppy)
+            if uniform and self._mesh is not None:
+                # Row-parallel exactly like the single-asset wf path: the
+                # per-window refit has no cross-pair interaction, so
+                # uniform groups shard over the chip mesh.
+                def runner(yb, xb, tr):
+                    r = walkforward.walk_forward_pairs(yb, xb, dict(grid),
+                                                       **kwargs)
+                    return Metrics(*(f[:, None] for f in r.oos_metrics))
+
+                m = self._mesh_call(
+                    ("pairs-wf",) + self._group_key(job0, axes)
+                    + (job0.wf_train, job0.wf_test, kwargs["metric"]),
+                    runner, [y_close, x_close], None)
+                return self._finish_group(list(group) + bad, m, t0,
+                                          len(group), job0)
+            if uniform:
+                m = walkforward.walk_forward_pairs(
+                    jnp.asarray(y_close), jnp.asarray(x_close), dict(grid),
+                    **kwargs).oos_metrics
+            else:
+                rows = [walkforward.walk_forward_pairs(
+                    jnp.asarray(y_close[i:i + 1, :int(lens[i])]),
+                    jnp.asarray(x_close[i:i + 1, :int(lens[i])]),
+                    dict(grid), **kwargs).oos_metrics
+                    for i in range(len(group))]
+                m = Metrics(*(jnp.concatenate(f, axis=0)
+                              for f in zip(*rows)))
+            m = Metrics(*(f[:, None] for f in m))   # one OOS row per job
+            return self._finish_group(list(group) + bad, m, t0,
+                                      len(group), job0)
         lb = np.asarray(grid.get("lookback", np.empty(0)))
         fused_ok = (lb.size > 0 and np.allclose(lb, np.round(lb))
                     and np.unique(np.round(lb)).size

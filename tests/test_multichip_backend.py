@@ -195,3 +195,17 @@ def test_meshless_multidevice_backend_advertises_one_chip(devices):
     chips would take dispatcher leases it cannot parallelize."""
     assert compute.JaxSweepBackend(use_mesh=False).chips == 1
     assert compute.JaxSweepBackend(use_mesh=True).chips >= 8
+
+
+def test_mesh_pairs_walkforward_group_matches_single_device(mesh_backends):
+    """Uniform pairs walk-forward groups shard over the mesh like the
+    single-asset wf path (per-window refit is row-parallel per pair)."""
+    grid = {"lookback": np.float32([8, 12]), "z_entry": np.float32([0.8, 1.5])}
+    recs = synthetic_jobs(9, 240, "pairs", grid, cost=1e-3, seed=23,
+                          wf_train=120, wf_test=40, wf_metric="sharpe")
+    specs = [pb.JobSpec(id=r.id, strategy=r.strategy, ohlcv=r.ohlcv,
+                        ohlcv2=r.ohlcv2, grid=wire.grid_to_proto(r.grid),
+                        cost=r.cost, wf_train=r.wf_train, wf_test=r.wf_test,
+                        wf_metric=r.wf_metric) for r in recs]
+    _assert_same_payloads(_run(mesh_backends["generic_mesh"], specs),
+                          _run(mesh_backends["generic_one"], specs))

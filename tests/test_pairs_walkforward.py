@@ -225,3 +225,78 @@ def test_walk_forward_fused_matches_generic():
     np.testing.assert_allclose(np.asarray(got.train_metric),
                                np.asarray(want.train_metric),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_walk_forward_pairs_matches_manual_windows():
+    """walk_forward_pairs == a hand-rolled loop: per window, argmax the
+    train metrics from run_pairs_sweep on the TRAIN slice, reprice the
+    winner over the span with pair_backtest internals, stitch with the
+    re-hedged boundary adjustment."""
+    from distributed_backtesting_exploration_tpu.models import pairs
+    from distributed_backtesting_exploration_tpu.ops import (
+        metrics as metrics_mod, pnl)
+    from distributed_backtesting_exploration_tpu.parallel import (
+        sweep, walkforward)
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    n_pairs, T, train, test = 3, 240, 120, 40
+    cost = 1e-3
+    ohlcv = data.synthetic_ohlcv(2 * n_pairs, T, seed=17)
+    y = jnp.asarray(ohlcv.close[:n_pairs])
+    x = jnp.asarray(ohlcv.close[n_pairs:])
+    grid = sweep.product_grid(
+        lookback=jnp.asarray([8.0, 12.0], jnp.float32),
+        z_entry=jnp.asarray([0.8, 1.5], jnp.float32))
+
+    got = walkforward.walk_forward_pairs(y, x, dict(grid), train=train,
+                                         test=test, cost=cost)
+
+    # Manual reference via independent library paths.
+    starts = np.asarray(walkforward.window_starts(T, train, test))
+    P = sweep.grid_size(grid)
+    all_r, all_p = [], []
+    prev_deployed = np.zeros(n_pairs, np.float32)
+    for s0 in starts:
+        tm = pairs.run_pairs_sweep(y[:, s0:s0 + train], x[:, s0:s0 + train],
+                                   dict(grid), cost=cost)
+        best = np.argmax(np.asarray(tm.sharpe), axis=1)       # (n_pairs,)
+        np.testing.assert_array_equal(
+            np.asarray(got.chosen["lookback"])[:, list(starts).index(s0)],
+            np.asarray(grid["lookback"])[best])
+        for i in range(n_pairs):
+            p1 = {k: jnp.asarray(v)[best[i]] for k, v in grid.items()}
+            y1 = y[i, s0:s0 + train + test]
+            x1 = x[i, s0:s0 + train + test]
+            pos, beta = pairs.pairs_positions(y1, x1, p1)
+            pos, beta = np.asarray(pos), np.asarray(beta)
+            ry = np.asarray(pnl.simple_returns(y1))
+            rx = np.asarray(pnl.simple_returns(x1))
+            prev_pos = np.concatenate([[0.0], pos[:-1]])
+            prev_beta = np.concatenate([[0.0], beta[:-1]])
+            gross = 1.0 + np.abs(prev_beta)
+            hr = (ry - prev_beta * rx) / np.maximum(gross, 1.0)
+            net = (prev_pos * hr
+                   - cost * np.abs(pos - prev_pos)).astype(np.float32)
+            oos = net[train:].copy()
+            # Boundary: swap the window's own prev-in for the deployed one.
+            first, prev_in = pos[train], pos[train - 1]
+            oos[0] += ((prev_deployed[i] - prev_in) * hr[train]
+                       - cost * (abs(first - prev_deployed[i])
+                                 - abs(first - prev_in)))
+            all_r.append((i, oos))
+            all_p.append((i, pos[train:]))
+            prev_deployed[i] = pos[-1]
+    want_r = np.stack([np.concatenate([r for j, r in all_r if j == i])
+                       for i in range(n_pairs)])
+    np.testing.assert_allclose(np.asarray(got.oos_returns), want_r,
+                               rtol=2e-4, atol=2e-5)
+    eq = 1.0 + np.cumsum(want_r, axis=-1)
+    want_p = np.stack([np.concatenate([p for j, p in all_p if j == i])
+                       for i in range(n_pairs)])
+    want_m = metrics_mod.summary_metrics(
+        jnp.asarray(want_r), jnp.asarray(eq), jnp.asarray(want_p))
+    for name in want_m._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(got.oos_metrics, name)),
+            np.asarray(getattr(want_m, name)), rtol=2e-3, atol=2e-4,
+            err_msg=name)

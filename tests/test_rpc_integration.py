@@ -825,3 +825,46 @@ def test_topk_fused_and_pairs_paths_match_generic():
         np.testing.assert_array_equal(np.sort(idx), np.sort(order))
         np.testing.assert_allclose(np.asarray(getattr(m, metric)),
                                    ref[idx], rtol=1e-5, atol=1e-6)
+
+
+def test_pairs_walkforward_jobs_over_the_wire_match_direct():
+    """Walk-forward pairs jobs (JobSpec.wf_* + two legs): the worker's
+    stitched OOS row per job equals walk_forward_pairs directly; a job too
+    short for one train+test window completes with an empty block."""
+    import jax.numpy as jnp
+
+    from distributed_backtesting_exploration_tpu.parallel import (
+        sweep, walkforward)
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    grid = parse_grid("lookback=8;12,z_entry=0.8;1.5")
+    recs = synthetic_jobs(3, 240, "pairs", grid, cost=1e-3, seed=21,
+                          wf_train=120, wf_test=40, wf_metric="sharpe")
+    short = synthetic_jobs(1, 60, "pairs", grid, cost=1e-3, seed=22,
+                           wf_train=120, wf_test=40, wf_metric="sharpe")
+    specs = [pb.JobSpec(id=r.id, strategy=r.strategy, ohlcv=r.ohlcv,
+                        ohlcv2=r.ohlcv2, grid=wire.grid_to_proto(r.grid),
+                        cost=r.cost, wf_train=r.wf_train, wf_test=r.wf_test,
+                        wf_metric=r.wf_metric)
+             for r in recs + short]
+    got = {c.job_id: c.metrics
+           for c in compute.JaxSweepBackend(use_fused=False).process(specs)}
+    assert got[short[0].id] == b""   # too short: empty block, completed
+
+    ys = [data.from_wire_bytes(r.ohlcv) for r in recs]
+    xs = [data.from_wire_bytes(r.ohlcv2) for r in recs]
+    y = jnp.asarray(np.stack([np.asarray(s.close) for s in ys]))
+    x = jnp.asarray(np.stack([np.asarray(s.close) for s in xs]))
+    flat = sweep.product_grid(
+        **{k: jnp.asarray(v) for k, v in sorted(grid.items())})
+    want = walkforward.walk_forward_pairs(
+        y, x, dict(flat), train=120, test=40, metric="sharpe",
+        cost=1e-3).oos_metrics
+    for i, rec in enumerate(recs):
+        m = wire.metrics_from_bytes(got[rec.id])
+        for name in m._fields:
+            got_v = np.asarray(getattr(m, name))
+            assert got_v.shape == (1,), f"{name}: one OOS row expected"
+            np.testing.assert_allclose(
+                got_v[0], np.asarray(getattr(want, name))[i],
+                rtol=2e-4, atol=2e-5, err_msg=name)
