@@ -278,6 +278,32 @@ def _windowed_sum_blk(cs, cs_ext, gidx, w: int, halo_w: int):
     return cs - jnp.where(gidx >= w, lagged, 0.0)
 
 
+def _windowed_zscore_local(series_blk, gidx, window: int, halo_w: int,
+                           T: int, axis_name: str, *, eps: float = 1e-12):
+    """Blockwise rolling z-score with series-mean centering — the shared
+    signal head of the Bollinger and pairs time-sharded backtests
+    (``rolling.rolling_zscore``'s formula: ddof=0, centered second moments
+    against the FULL-history mean as the f32 cancellation guard).
+
+    The three windowed sums (centered, centered², raw) ride ONE stacked
+    ``_cumsum_ext`` — collectives are latency-bound and XLA will not CSE
+    them, so one ``all_gather`` + one ``ppermute`` serve all three (the
+    same one-collective discipline as ``_band_positions_local``).
+    Per-series numerics are identical to separate calls: the stack axis is
+    leading, the scans are per-row.
+    """
+    w_f = jnp.float32(window)
+    mean = (jax.lax.psum(jnp.sum(series_blk, axis=-1), axis_name)
+            / jnp.float32(T))[..., None]
+    sc = series_blk - mean
+    stacked = jnp.stack([sc, sc * sc, series_blk])
+    cs, cs_ext = _cumsum_ext(stacked, halo_w, axis_name)
+    s = _windowed_sum_blk(cs, cs_ext, gidx, window, halo_w)
+    s1, s2, ssum = s[0], s[1], s[2]
+    var = jnp.maximum((s2 - s1 * s1 / w_f) / w_f, 0.0)
+    return (series_blk - ssum / w_f) / (jnp.sqrt(var) + eps)
+
+
 def _band_positions_local(z_blk, valid_blk, z_entry, z_exit, axis_name: str):
     """Band-hysteresis positions for one time block, exact across blocks.
 
@@ -426,7 +452,6 @@ def sharded_bollinger_backtest(mesh: Mesh, close, window: int, k: float, *,
             "exchange needs the window to fit one neighbor block")
     halo_w = window
     eps = 1e-12
-    w_f = jnp.float32(window)
     spec = P(*((None,) * (close.ndim - 1) + (axis_name,)))
     rep = P(*((None,) * (close.ndim - 1)))
 
@@ -436,21 +461,8 @@ def sharded_bollinger_backtest(mesh: Mesh, close, window: int, k: float, *,
         gidx = jnp.arange(Tb) + idx * Tb
         r = _block_returns(close_blk, gidx, axis_name)
 
-        # Series mean (psum) -> centered second moments, the same f32
-        # cancellation guard as rolling.rolling_var.
-        mean = (jax.lax.psum(jnp.sum(close_blk, axis=-1), axis_name)
-                / jnp.float32(T))[..., None]
-        xc = close_blk - mean
-
-        def windowed(series_blk):
-            cs, cs_ext = _cumsum_ext(series_blk, halo_w, axis_name)
-            return _windowed_sum_blk(cs, cs_ext, gidx, window, halo_w)
-
-        m = windowed(close_blk) / w_f
-        s1 = windowed(xc)
-        s2 = windowed(xc * xc)
-        var = jnp.maximum((s2 - s1 * s1 / w_f) / w_f, 0.0)
-        z = (close_blk - m) / (jnp.sqrt(var) + eps)
+        z = _windowed_zscore_local(close_blk, gidx, window, halo_w, T,
+                                   axis_name, eps=eps)
         valid = gidx >= window - 1
         z = jnp.where(valid, z, 0.0)
 
@@ -531,3 +543,103 @@ def sharded_rsi_backtest(mesh: Mesh, close, period: int, band: float, *,
     out_specs = Metrics(*(rep for _ in Metrics._fields))
     return jax.shard_map(local, mesh=mesh, in_specs=spec,
                          out_specs=out_specs, check_vma=False)(close)
+
+
+def sharded_pairs_backtest(mesh: Mesh, y_close, x_close, lookback: int,
+                           z_entry: float, *, z_exit: float = 0.0,
+                           cost: float = 0.0, periods_per_year: int = 252,
+                           axis_name: str = TIME_AXIS):
+    """End-to-end rolling-OLS pairs backtest, TIME axis sharded.
+
+    The two-legged long-context composition — every blockwise piece this
+    module already has, assembled for the hardest single-pair strategy:
+    distributed cumsums of the centered OLS moments (``lookback``-bar
+    halos) give the rolling hedge ratio, the spread z-scores reuse the
+    same windowed-sum primitive, the exactly-sharded band machine turns z
+    into positions, and the shared PnL tail prices the *hedged* spread
+    return ``(r_y - beta[t-1] r_x) / max(1 + |beta[t-1]|, 1)`` — the tail
+    takes any per-bar return factor, so pairs need no new reduction code.
+    Formulas mirror ``models.pairs.pair_backtest`` (series-centered
+    moments, eps=1e-12, warmup spread = y, valid from ``2*lookback - 1``
+    bars). Parity with the single-device computation is f32-tight except
+    at knife-edge band entries: the blockwise cumsum rounds z ~1e-6
+    differently, and a bar where ``|z - z_entry|`` is that small can
+    resolve differently, moving a long history's metrics by ~1e-3
+    relative per flipped bar (the same caveat class as the fused pairs
+    kernel; the parity test bounds both the flip count and the
+    non-flipped error).
+
+    ``lookback`` is a static int with ``lookback <= block length`` (halo
+    bound). Returns scalar-per-pair :class:`~..ops.metrics.Metrics`,
+    replicated.
+    """
+    from ..ops.metrics import Metrics
+
+    n_dev = mesh.shape[axis_name]
+    T = y_close.shape[-1]
+    if T % n_dev:
+        raise ValueError(
+            f"T={T} not divisible by the {n_dev}-way {axis_name!r} axis")
+    if lookback > T // n_dev:
+        raise ValueError(
+            f"lookback={lookback} exceeds the {T // n_dev}-bar block; the "
+            "halo exchange needs the window to fit one neighbor block")
+    halo_w = lookback
+    eps = 1e-12
+    w_f = jnp.float32(lookback)
+    spec = P(*((None,) * (y_close.ndim - 1) + (axis_name,)))
+    rep = P(*((None,) * (y_close.ndim - 1)))
+
+    def local(y_blk, x_blk):
+        Tb = y_blk.shape[-1]
+        idx = jax.lax.axis_index(axis_name)
+        gidx = jnp.arange(Tb) + idx * Tb
+        # Both legs' returns through ONE one-bar halo exchange.
+        r2 = _block_returns(jnp.stack([y_blk, x_blk]), gidx, axis_name)
+        ry, rx = r2[0], r2[1]
+
+        # Series means over the full history (psum), the same f32
+        # cancellation guard as rolling.rolling_ols.
+        my = (jax.lax.psum(jnp.sum(y_blk, axis=-1), axis_name)
+              / jnp.float32(T))[..., None]
+        mx = (jax.lax.psum(jnp.sum(x_blk, axis=-1), axis_name)
+              / jnp.float32(T))[..., None]
+        yc, xc = y_blk - my, x_blk - mx
+
+        # All four OLS moment sums through ONE stacked _cumsum_ext
+        # (collectives are latency-bound; one all_gather + one ppermute
+        # serve the stack — same discipline as _windowed_zscore_local).
+        cs, cs_ext = _cumsum_ext(jnp.stack([xc, yc, xc * xc, xc * yc]),
+                                 halo_w, axis_name)
+        s = _windowed_sum_blk(cs, cs_ext, gidx, lookback, halo_w)
+        sx, sy, sxx, sxy = s[0], s[1], s[2], s[3]
+        cov = sxy - sx * sy / w_f
+        var = jnp.maximum(sxx - sx * sx / w_f, 0.0)
+        beta = cov / (var + eps)
+        alpha = (sy / w_f + my) - beta * (sx / w_f + mx)
+        ok_w = gidx >= lookback - 1
+        beta = jnp.where(ok_w, beta, 0.0)
+        # Warmup spread is exactly y (rolling_ols fill=0.0): those bars
+        # feed the z-score's series mean and early windowed sums.
+        spread = jnp.where(ok_w, y_blk - (alpha + beta * x_blk), y_blk)
+
+        z = _windowed_zscore_local(spread, gidx, lookback, halo_w, T,
+                                   axis_name, eps=eps)
+        valid = gidx >= 2 * lookback - 2
+        z = jnp.where(valid, z, 0.0)
+
+        pos = _band_positions_local(z, jnp.broadcast_to(valid, z.shape),
+                                    jnp.float32(z_entry),
+                                    jnp.float32(z_exit), axis_name)
+        prev_beta = jnp.concatenate(
+            [_from_left(beta, 1, axis_name), beta[..., :-1]], axis=-1)
+        gross = 1.0 + jnp.abs(prev_beta)
+        hr = (ry - prev_beta * rx) / jnp.maximum(gross, 1.0)
+        return _pnl_metrics_local(pos, hr, gidx, T, cost=cost,
+                                  periods_per_year=periods_per_year,
+                                  axis_name=axis_name)
+
+    out_specs = Metrics(*(rep for _ in Metrics._fields))
+    return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec),
+                         out_specs=out_specs, check_vma=False)(
+        y_close, x_close)
