@@ -203,18 +203,21 @@ def _from_left(x_blk, k: int, axis_name: str):
 
 def _pnl_metrics_local(pos, r, gidx, T: int, *, cost: float,
                        periods_per_year: int, axis_name: str,
-                       eps: float = 1e-12):
+                       eps: float = 1e-12, prev_pos=None):
     """Blockwise PnL + summary metrics for a time-sharded position path.
 
     Shared tail of every time-sharded backtest (SMA, Bollinger): one-bar
     position halo for the lagged exposure, net returns locally, then the
     moments / running-peak drawdown / final equity as ``psum``/``pmax``
-    reductions with an exclusive cross-chip max for the peak."""
+    reductions with an exclusive cross-chip max for the peak. A caller
+    that already exchanged a one-bar halo for its own state (pairs stacks
+    beta with pos) passes ``prev_pos`` to keep that single collective."""
     from ..ops.metrics import metrics_from_reductions
 
     n_f = jnp.float32(T)
-    prev_pos = jnp.concatenate(
-        [_from_left(pos, 1, axis_name), pos[..., :-1]], axis=-1)
+    if prev_pos is None:
+        prev_pos = jnp.concatenate(
+            [_from_left(pos, 1, axis_name), pos[..., :-1]], axis=-1)
     net = prev_pos * r - jnp.float32(cost) * jnp.abs(pos - prev_pos)
 
     # Moments / downside via global sums.
@@ -631,13 +634,17 @@ def sharded_pairs_backtest(mesh: Mesh, y_close, x_close, lookback: int,
         pos = _band_positions_local(z, jnp.broadcast_to(valid, z.shape),
                                     jnp.float32(z_entry),
                                     jnp.float32(z_exit), axis_name)
-        prev_beta = jnp.concatenate(
-            [_from_left(beta, 1, axis_name), beta[..., :-1]], axis=-1)
+        # ONE one-bar halo exchange serves both lagged states (pos for the
+        # PnL tail, beta for the hedge) — same discipline as the returns.
+        pb = jnp.stack([pos, beta])
+        prev = jnp.concatenate(
+            [_from_left(pb, 1, axis_name), pb[..., :-1]], axis=-1)
+        prev_pos, prev_beta = prev[0], prev[1]
         gross = 1.0 + jnp.abs(prev_beta)
         hr = (ry - prev_beta * rx) / jnp.maximum(gross, 1.0)
         return _pnl_metrics_local(pos, hr, gidx, T, cost=cost,
                                   periods_per_year=periods_per_year,
-                                  axis_name=axis_name)
+                                  axis_name=axis_name, prev_pos=prev_pos)
 
     out_specs = Metrics(*(rep for _ in Metrics._fields))
     return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec),
