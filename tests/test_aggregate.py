@@ -186,3 +186,39 @@ def test_aggregate_reads_topk_blocks(tmp_path):
         # Params resolve through the stored grid indices, not row position.
         for name, vals in canonical.items():
             assert row["params"][name] == float(np.asarray(vals)[best])
+
+
+def test_aggregate_warns_on_rank_metric_mismatch(tmp_path, caplog):
+    """Re-ranking a top-k block by a DIFFERENT metric is lossy (only the k
+    best-by-block-metric rows survived) — aggregate must say so."""
+    import logging
+
+    journal_path = str(tmp_path / "journal.jsonl")
+    results_dir = str(tmp_path / "results")
+    queue = JobQueue(Journal(journal_path))
+    recs = synthetic_jobs(1, 96, "sma_crossover",
+                          parse_grid("fast=3:5,slow=10:14:2"), cost=1e-3,
+                          top_k=2, rank_metric="sharpe")
+    for rec in recs:
+        queue.enqueue(rec)
+    disp = Dispatcher(queue, results_dir=results_dir)
+    queue.take(1, "w1")
+    spec = pb.JobSpec(id=recs[0].id, strategy=recs[0].strategy,
+                      ohlcv=recs[0].ohlcv,
+                      grid=wire.grid_to_proto(recs[0].grid),
+                      cost=recs[0].cost, periods_per_year=252,
+                      top_k=2, rank_metric="sharpe")
+    for c in compute.JaxSweepBackend().process([spec]):
+        disp._complete_one(c.job_id, "w1", c.metrics, c.elapsed_s)
+
+    with caplog.at_level(logging.WARNING, logger="dbx.aggregate"):
+        out = aggregate.aggregate(results_dir, journal_path,
+                                  metric="total_return", top=3)
+    assert out["jobs_aggregated"] == 1
+    assert any("retained top-k rows only" in r.message for r in caplog.records)
+
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="dbx.aggregate"):
+        aggregate.aggregate(results_dir, journal_path, metric="sharpe")
+    assert not [r for r in caplog.records
+                if "retained top-k" in r.message]   # same metric: no warning
