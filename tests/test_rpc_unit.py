@@ -685,3 +685,36 @@ def test_topk_fields_travel_journal_and_cli(tmp_path):
         build_dispatcher(make_parser().parse_args(
             ["--synthetic", "1", "--top-k", "4", "--wf-train", "50",
              "--wf-test", "20", "--results-dir", str(tmp_path)]))
+
+
+def test_topk_reduce_ranks_nan_last_and_respects_direction():
+    """_topk_reduce: NaN metric cells rank behind every finite one (a
+    zero-variance backtest has NaN sharpe — it must not win top-k by NaN
+    comparison accident), and lower-is-better metrics rank ascending."""
+    import numpy as np
+
+    from distributed_backtesting_exploration_tpu.rpc.compute import (
+        _topk_reduce)
+
+    P = 6
+    fields = {name: np.arange(P, dtype=np.float32)[None, :] + i
+              for i, name in enumerate(Metrics._fields)}
+    sharpe = np.float32([[0.5, np.nan, 2.0, np.nan, 1.0, -3.0]])
+    fields["sharpe"] = sharpe
+    m = Metrics(**fields)
+
+    idx, sel = _topk_reduce(m, "sharpe", 4)
+    np.testing.assert_array_equal(np.asarray(idx)[0], [2, 4, 0, 5])
+    np.testing.assert_array_equal(np.asarray(sel.sharpe)[0],
+                                  sharpe[0][[2, 4, 0, 5]])
+    # Non-ranking fields travel with their row.
+    np.testing.assert_array_equal(
+        np.asarray(sel.turnover)[0],
+        np.asarray(m.turnover)[0][[2, 4, 0, 5]])
+
+    # Lower-is-better direction: max_drawdown picks the smallest values.
+    mdd = np.float32([[0.5, 0.1, np.nan, 0.3, 0.2, 0.9]])
+    fields["max_drawdown"] = mdd
+    m2 = Metrics(**fields)
+    idx2, sel2 = _topk_reduce(m2, "max_drawdown", 3)
+    np.testing.assert_array_equal(np.asarray(idx2)[0], [1, 4, 3])
