@@ -111,6 +111,7 @@ class JobRecord:
     path: str | None = None       # file-backed source (CSV or DBX1)
     ohlcv: bytes | None = None    # inline source (already-encoded DBX1)
     ohlcv2: bytes | None = None   # second leg for two-legged strategies
+    path2: str | None = None      # file-backed second leg (pairs --data2)
     # Walk-forward mode (proto JobSpec.wf_*): train/test bars per refit
     # window; 0 train = plain sweep. The DBXM result is then one stitched
     # out-of-sample metrics row, not a per-combo matrix.
@@ -140,6 +141,8 @@ class JobRecord:
             # Inline payloads must be journaled too, or a restart would
             # restore a job with nothing to dispatch.
             rec["ohlcv_b64"] = base64.b64encode(self.ohlcv).decode("ascii")
+        if self.path2 is not None:
+            rec["path2"] = self.path2
         if self.ohlcv2 is not None:
             rec["ohlcv2_b64"] = base64.b64encode(self.ohlcv2).decode("ascii")
         if self.wf_train:
@@ -159,7 +162,7 @@ class JobRecord:
             grid={k: np.asarray(v, np.float32)
                   for k, v in rec.get("grid", {}).items()},
             cost=rec.get("cost", 0.0), periods_per_year=rec.get("ppy", 252),
-            path=rec.get("path"),
+            path=rec.get("path"), path2=rec.get("path2"),
             ohlcv=base64.b64decode(ohlcv) if ohlcv else None,
             ohlcv2=base64.b64decode(ohlcv2) if ohlcv2 else None,
             wf_train=int(wf[0]), wf_test=int(wf[1]), wf_metric=str(wf[2]),
@@ -261,20 +264,28 @@ class JobQueue:
                     continue
                 rec = self._records[jid]
             payload = rec.ohlcv
-            if payload is None:
-                try:
+            try:
+                if payload is None:
                     if rec.path is None:
                         raise ValueError("job has neither payload nor path")
                     payload = _read_payload(rec.path)
-                except (OSError, ValueError) as e:
-                    with self._lock:
-                        if self._discard_if_completed_locked(jid):
-                            continue
-                        self._failed.add(jid)
-                    log.error("job %s: unreadable %s (%s) -> failed",
-                              jid, rec.path, e)
-                    self._journal.append("fail", id=jid, reason=str(e))
-                    continue
+                if rec.ohlcv2 is None and rec.path2 is not None:
+                    # File-backed second leg (pairs --data2): materialize
+                    # at dispatch time like leg 1, onto a COPY handed to
+                    # the caller — the stored record stays slim, and
+                    # RequestJobs reads rec.ohlcv2 either way.
+                    rec = dataclasses.replace(
+                        rec, ohlcv2=_read_payload(rec.path2))
+            except (OSError, ValueError) as e:
+                with self._lock:
+                    if self._discard_if_completed_locked(jid):
+                        continue
+                    self._failed.add(jid)
+                log.error("job %s: unreadable %s (%s) -> failed",
+                          jid, rec.path2 if payload is not None else rec.path,
+                          e)
+                self._journal.append("fail", id=jid, reason=str(e))
+                continue
             with self._lock:
                 # The id left the FIFO at the top of the loop but is not
                 # leased yet; a completion landing in that unlocked window
@@ -661,12 +672,21 @@ def parse_grid(spec: str) -> dict[str, np.ndarray]:
 def jobs_from_paths(paths, strategy: str, grid, *, cost: float = 0.0,
                     periods_per_year: int = 252, wf_train: int = 0,
                     wf_test: int = 0, wf_metric: str = "", top_k: int = 0,
-                    rank_metric: str = "") -> list[JobRecord]:
+                    rank_metric: str = "",
+                    paths2=None) -> list[JobRecord]:
+    """File-backed jobs; two-legged strategies pass ``paths2`` (leg x
+    files, positionally matched with ``paths``). Payloads are read at
+    dispatch time, so enqueue stays cheap and restarts re-read nothing."""
+    if paths2 is not None and len(paths2) != len(paths):
+        raise ValueError(
+            f"paths/paths2 length mismatch: {len(paths)} vs {len(paths2)}")
+    paths2 = paths2 if paths2 is not None else [None] * len(paths)
     return [JobRecord(id=str(uuid.uuid4()), strategy=strategy, grid=grid,
                       cost=cost, periods_per_year=periods_per_year, path=p,
+                      path2=p2,
                       wf_train=wf_train, wf_test=wf_test, wf_metric=wf_metric,
                       top_k=top_k, rank_metric=rank_metric)
-            for p in paths]
+            for p, p2 in zip(paths, paths2)]
 
 
 def synthetic_jobs(n: int, n_bars: int, strategy: str, grid, *,
@@ -701,6 +721,10 @@ def make_parser() -> argparse.ArgumentParser:
     ap.add_argument("--bind", default="[::]:50051")
     ap.add_argument("--data", default=None,
                     help="glob of OHLCV files (CSV or DBX1) to enqueue")
+    ap.add_argument("--data2", default=None,
+                    help="pairs only: glob of leg-x OHLCV files, matched "
+                         "positionally (both globs sorted) with --data's "
+                         "leg-y files")
     ap.add_argument("--synthetic", type=int, default=0,
                     help="enqueue N synthetic tickers instead of files")
     ap.add_argument("--bars", type=int, default=1260,
@@ -783,20 +807,40 @@ def build_dispatcher(args) -> Dispatcher:
                 f"--rank-metric {args.rank_metric!r} unknown; one of "
                 f"{', '.join(Metrics._fields)}")
         wf_kw.update(top_k=args.top_k, rank_metric=args.rank_metric)
-    if args.data and args.strategy == "pairs":
+    if args.data and args.strategy == "pairs" and not args.data2:
         raise SystemExit(
-            "--data with --strategy pairs is not supported: file-backed "
-            "jobs carry one instrument; pairs jobs need two legs "
-            "(use --synthetic, or enqueue JobRecords with ohlcv/ohlcv2 "
-            "programmatically)")
+            "--strategy pairs with --data needs --data2: file-backed pairs "
+            "jobs take leg-y files from --data and leg-x files from "
+            "--data2, matched positionally (both globs sorted)")
+    if args.data2 and args.strategy != "pairs":
+        raise SystemExit("--data2 is pairs-only (two-legged jobs); "
+                         f"--strategy is {args.strategy!r}")
+    if args.data2 and not args.data:
+        raise SystemExit("--data2 without --data: leg-y files are missing")
     if args.data:
         paths = sorted(glob_mod.glob(args.data))
-        new_paths = [p for p in paths if p not in queue.known_paths]
-        if len(new_paths) < len(paths):
+        paths2 = sorted(glob_mod.glob(args.data2)) if args.data2 else None
+        # Restart dedupe keys on the leg-y path (a pair is identified by
+        # its y file; the positional x match is stable across restarts
+        # because both globs are sorted).
+        keep = [i for i, p in enumerate(paths)
+                if p not in queue.known_paths]
+        if paths2 is not None and keep and len(paths2) != len(paths):
+            # Only fatal when something NEW would be enqueued with an
+            # ambiguous pairing: on a pure crash-restart (every pair
+            # already journaled) a since-vanished leg file must not block
+            # serving the restored queue — restartability first.
+            raise SystemExit(
+                f"--data matched {len(paths)} files but --data2 matched "
+                f"{len(paths2)}; pairs need one leg-x file per leg-y file")
+        if len(keep) < len(paths):
             log.info("skipping %d already-journaled paths",
-                     len(paths) - len(new_paths))
+                     len(paths) - len(keep))
+        new_paths = [paths[i] for i in keep]
+        new_paths2 = [paths2[i] for i in keep] if paths2 else None
         for rec in jobs_from_paths(new_paths, args.strategy, grid,
-                                   cost=args.cost, **wf_kw):
+                                   cost=args.cost, paths2=new_paths2,
+                                   **wf_kw):
             queue.enqueue(rec)
         log.info("enqueued %d file jobs", len(new_paths))
     if args.synthetic:

@@ -718,3 +718,125 @@ def test_topk_reduce_ranks_nan_last_and_respects_direction():
     m2 = Metrics(**fields)
     idx2, sel2 = _topk_reduce(m2, "max_drawdown", 3)
     np.testing.assert_array_equal(np.asarray(idx2)[0], [1, 4, 3])
+
+
+def _write_leg_csvs(tmp_path, n, t=64, prefix=""):
+    from distributed_backtesting_exploration_tpu.utils import data as dmod
+
+    batch = dmod.synthetic_ohlcv(n, t, seed=11)
+    paths = []
+    for i in range(n):
+        one = dmod.OHLCV(*(f[i] for f in batch))
+        p = tmp_path / f"{prefix}{i}.csv"
+        p.write_bytes(dmod.to_csv_bytes(one))
+        paths.append(str(p))
+    return paths
+
+
+def test_file_backed_pairs_jobs(tmp_path):
+    """--data/--data2: pairs jobs take leg y and leg x from matched files,
+    materialized at dispatch time; an unreadable leg-x file marks the job
+    failed (not silently dropped); path2 survives the journal."""
+    from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+        build_dispatcher, make_parser)
+    from distributed_backtesting_exploration_tpu.utils import data as dmod
+
+    ys = _write_leg_csvs(tmp_path, 2, prefix="y")
+    xs = _write_leg_csvs(tmp_path, 2, prefix="x")
+    args = make_parser().parse_args(
+        ["--strategy", "pairs", "--data", str(tmp_path / "y*.csv"),
+         "--data2", str(tmp_path / "x*.csv"),
+         "--grid", "lookback=6;10,z_entry=0.8;1.5",
+         "--results-dir", str(tmp_path / "res"),
+         "--journal", str(tmp_path / "q.jsonl")])
+    disp = build_dispatcher(args)
+    taken = disp.queue.take(2, "w")
+    assert len(taken) == 2
+    for (rec, payload), yp, xp in zip(taken, sorted(ys), sorted(xs)):
+        assert rec.path == yp and rec.path2 == xp
+        y = dmod.from_wire_bytes(payload)
+        x = dmod.from_wire_bytes(rec.ohlcv2)
+        assert y.n_bars == x.n_bars == 64
+
+    # Journal round trip keeps path2.
+    back = JobRecord.from_journal(taken[0][0].journal_form())
+    assert back.path2 == taken[0][0].path2
+
+    # Unreadable leg-x -> failed, journaled, leg y was readable.
+    import os
+    os.unlink(xs[0])
+    args2 = make_parser().parse_args(
+        ["--strategy", "pairs", "--data", str(tmp_path / "y*.csv"),
+         "--data2", str(tmp_path / "x*.csv"),
+         "--grid", "lookback=6",
+         "--results-dir", str(tmp_path / "res2")])
+    import pytest as _pytest
+    with _pytest.raises(SystemExit, match="matched"):
+        build_dispatcher(args2)   # glob count mismatch is loud
+
+    # Same count, one unreadable: job fails at take time.
+    bad = tmp_path / "x0.csv"
+    bad.write_bytes(b"not,a,csv\n1,2\n")
+    disp3 = build_dispatcher(make_parser().parse_args(
+        ["--strategy", "pairs", "--data", str(tmp_path / "y*.csv"),
+         "--data2", str(tmp_path / "x*.csv"), "--grid", "lookback=6",
+         "--results-dir", str(tmp_path / "res3")]))
+    taken3 = disp3.queue.take(2, "w")
+    assert len(taken3) == 1            # the good pair
+    assert disp3.queue.stats()["jobs_failed"] == 1
+
+
+def test_data2_flag_validation(tmp_path):
+    from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+        build_dispatcher, make_parser)
+
+    with pytest.raises(SystemExit, match="data2"):
+        build_dispatcher(make_parser().parse_args(
+            ["--strategy", "pairs", "--data", "nope*.csv",
+             "--results-dir", str(tmp_path)]))
+    with pytest.raises(SystemExit, match="pairs-only"):
+        build_dispatcher(make_parser().parse_args(
+            ["--strategy", "sma_crossover", "--data", "a*.csv",
+             "--data2", "b*.csv", "--results-dir", str(tmp_path)]))
+    with pytest.raises(SystemExit, match="leg-y"):
+        build_dispatcher(make_parser().parse_args(
+            ["--strategy", "pairs", "--data2", "b*.csv",
+             "--results-dir", str(tmp_path)]))
+
+
+def test_inline_leg_y_with_file_leg_x_journal_roundtrip():
+    """A record with an inline leg-y payload and a file-backed leg-x must
+    journal BOTH (regression: the path2 key once swallowed the inline
+    ohlcv_b64 branch, so a restart restored a job with nothing to
+    dispatch)."""
+    rec = JobRecord(id="m", strategy="pairs",
+                    grid={"lookback": np.float32([6.0])},
+                    ohlcv=b"leg-y-bytes", path2="/tmp/x.csv")
+    form = rec.journal_form()
+    assert "ohlcv_b64" in form and form["path2"] == "/tmp/x.csv"
+    back = JobRecord.from_journal(form)
+    assert back.ohlcv == b"leg-y-bytes" and back.path2 == "/tmp/x.csv"
+
+
+def test_pairs_restart_with_vanished_leg_file_still_serves(tmp_path):
+    """Crash-restart discipline: when every pair is already journaled, a
+    since-deleted leg-x file must not SystemExit the dispatcher — the
+    restored queue is the workload and nothing new needs the pairing."""
+    import os
+
+    from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+        build_dispatcher, make_parser)
+
+    ys = _write_leg_csvs(tmp_path, 2, prefix="y")
+    xs = _write_leg_csvs(tmp_path, 2, prefix="x")
+    argv = ["--strategy", "pairs", "--data", str(tmp_path / "y*.csv"),
+            "--data2", str(tmp_path / "x*.csv"), "--grid", "lookback=6",
+            "--journal", str(tmp_path / "q.jsonl"),
+            "--results-dir", str(tmp_path / "res")]
+    disp = build_dispatcher(make_parser().parse_args(argv))
+    assert disp.queue.stats()["jobs_pending"] == 2
+
+    os.unlink(xs[0])   # leg file vanishes between runs
+    disp2 = build_dispatcher(make_parser().parse_args(argv))
+    s = disp2.queue.stats()
+    assert s["jobs_pending"] == 2          # restored, not re-enqueued
