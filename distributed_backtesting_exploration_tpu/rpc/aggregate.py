@@ -89,7 +89,12 @@ def aggregate(results_dir: str, journal_path: str, *,
             m = wire.metrics_from_bytes(blob)
         values = np.asarray(getattr(m, metric)).reshape(-1)
         sign_ = metric_sign(metric)
-        idx = int(np.argmax(sign_ * values))
+        # NaN ranks last (numpy argmax would rank it FIRST — NaN wins every
+        # comparison), matching the worker-side _topk_reduce discipline; a
+        # DBXS block where fewer than k combos have a finite metric must not
+        # report a NaN row as the job's best while finite rows exist.
+        score = np.where(np.isnan(values), -np.inf, sign_ * values)
+        idx = int(np.argmax(score))
         row = {
             "job": jid,
             "strategy": rec.get("strategy"),
@@ -112,7 +117,11 @@ def aggregate(results_dir: str, journal_path: str, *,
             row["params"] = {k: float(v[combo]) for k, v in grid.items()}
         rows.append(row)
     sign = metric_sign(metric)
-    rows.sort(key=lambda r: sign * r["value"], reverse=True)
+    # Same NaN-last discipline fleet-wide: an all-NaN job sorts below every
+    # finite job instead of landing at an arbitrary position (Python sort
+    # with NaN keys is order-dependent).
+    rows.sort(key=lambda r: -np.inf if np.isnan(r["value"])
+              else sign * r["value"], reverse=True)
     return {
         "metric": metric,
         "jobs_aggregated": len(rows),
@@ -135,7 +144,14 @@ def main(argv=None) -> None:
     args = ap.parse_args(argv)
     out = aggregate(args.results_dir, args.journal, metric=args.metric,
                     top=args.top)
-    print(json.dumps(out, indent=2))
+    # All-NaN jobs are retained in `best` (ranked last); json.dumps would
+    # emit non-standard NaN/Infinity tokens for them, breaking strict
+    # parsers downstream — serialize non-finite values as null instead
+    # (allow_nan=False rejects inf too, so isfinite is the right gate).
+    for row in out["best"]:
+        if not np.isfinite(row["value"]):
+            row["value"] = None
+    print(json.dumps(out, indent=2, allow_nan=False))
 
 
 if __name__ == "__main__":

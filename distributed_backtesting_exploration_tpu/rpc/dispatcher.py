@@ -199,6 +199,12 @@ class JobQueue:
         self._requeued = 0
         self._journal = journal or Journal(None)
         self.known_paths: set[str] = set()
+        # Journaled (leg-y path -> leg-x path) pairings for two-legged jobs:
+        # the journal is the authority on which x file a y file was paired
+        # with, so restart intake can keep new pairings disjoint from old
+        # ones instead of trusting sort position (advisor finding: y-glob
+        # churn with equal counts silently re-assigns x legs).
+        self.known_pairings: dict[str, str] = {}
         self.journaled_jobs = 0
         self.lease_s = lease_s
         self._t0 = time.monotonic()
@@ -245,6 +251,9 @@ class JobQueue:
                     self._records[jid] = JobRecord.from_journal(rec)
         self.known_paths |= {rec["path"] for rec in state.jobs.values()
                              if rec.get("path")}
+        self.known_pairings.update(
+            {rec["path"]: rec["path2"] for rec in state.jobs.values()
+             if rec.get("path") and rec.get("path2")})
         self.journaled_jobs += len(state.jobs)
         return n
 
@@ -821,23 +830,52 @@ def build_dispatcher(args) -> Dispatcher:
         paths = sorted(glob_mod.glob(args.data))
         paths2 = sorted(glob_mod.glob(args.data2)) if args.data2 else None
         # Restart dedupe keys on the leg-y path (a pair is identified by
-        # its y file; the positional x match is stable across restarts
-        # because both globs are sorted).
-        keep = [i for i, p in enumerate(paths)
-                if p not in queue.known_paths]
-        if paths2 is not None and keep and len(paths2) != len(paths):
-            # Only fatal when something NEW would be enqueued with an
-            # ambiguous pairing: on a pure crash-restart (every pair
-            # already journaled) a since-vanished leg file must not block
-            # serving the restored queue — restartability first.
-            raise SystemExit(
-                f"--data matched {len(paths)} files but --data2 matched "
-                f"{len(paths2)}; pairs need one leg-x file per leg-y file")
-        if len(keep) < len(paths):
+        # its y file). The journal — not sort position — is the authority
+        # on which x file a journaled y was paired with: if the y-glob set
+        # churns between runs with EQUAL counts (one y deleted, one added),
+        # positional pairing would silently re-assign x legs that belong to
+        # already-journaled pairs (advisor finding). New y files therefore
+        # pair with the x files no journaled pair has claimed.
+        path_set = set(paths)
+        new_paths = [p for p in paths if p not in queue.known_paths]
+        new_paths2 = None
+        if paths2 is not None:
+            gone_ys = {y for y in queue.known_pairings
+                       if y not in path_set}
+            if gone_ys and new_paths:
+                # The churn signature (journaled ys vanished AND new ys
+                # appeared) is exactly when positional pairing would have
+                # silently re-assigned x legs; routine additions (no ys
+                # gone) must not cry wolf.
+                log.warning(
+                    "pairs glob churn: %d journaled leg-y files no longer "
+                    "match --data while %d new leg-y files appeared; "
+                    "journaled pairings are kept and new files pair with "
+                    "unclaimed leg-x files", len(gone_ys), len(new_paths))
+            claimed_x = set(queue.known_pairings.values())
+            unclaimed_x = [x for x in paths2 if x not in claimed_x]
+            if new_paths and len(unclaimed_x) != len(new_paths):
+                # Only fatal when something NEW would be enqueued with an
+                # ambiguous pairing: on a pure crash-restart (every pair
+                # already journaled) a since-vanished leg file must not
+                # block serving the restored queue — restartability first.
+                raise SystemExit(
+                    f"--data matched {len(new_paths)} new leg-y files but "
+                    f"--data2 has {len(unclaimed_x)} unclaimed leg-x files "
+                    f"({len(paths2)} matched, "
+                    f"{len(paths2) - len(unclaimed_x)} already paired in "
+                    "the journal); pairs need one leg-x file per leg-y "
+                    "file")
+            if not new_paths and unclaimed_x:
+                # Pure crash-restart with a stray unclaimed x file: nothing
+                # new needs a pairing, so the restored queue is served and
+                # the stray leg is merely noted (restartability first).
+                log.info("ignoring %d unclaimed --data2 leg-x files: no "
+                         "new leg-y files to pair them with", len(unclaimed_x))
+            new_paths2 = unclaimed_x if new_paths else []
+        if len(new_paths) < len(paths):
             log.info("skipping %d already-journaled paths",
-                     len(paths) - len(keep))
-        new_paths = [paths[i] for i in keep]
-        new_paths2 = [paths2[i] for i in keep] if paths2 else None
+                     len(paths) - len(new_paths))
         for rec in jobs_from_paths(new_paths, args.strategy, grid,
                                    cost=args.cost, paths2=new_paths2,
                                    **wf_kw):

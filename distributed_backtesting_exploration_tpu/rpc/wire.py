@@ -38,6 +38,8 @@ def metrics_from_bytes(data: bytes) -> Metrics:
     """Decode a DBXM block back into a Metrics tuple of ``(P,)`` arrays."""
     if data[:4] != _METRICS_MAGIC:
         raise ValueError("bad magic; not a DBXM metrics block")
+    if len(data) < 12:
+        raise ValueError(f"truncated metrics block: {len(data)} < 12-byte header")
     P, n_fields = struct.unpack_from("<II", data, 4)
     if n_fields != len(Metrics._fields):
         raise ValueError(
@@ -81,12 +83,17 @@ def topk_from_bytes(data: bytes) -> tuple["np.ndarray", Metrics, str]:
     """Decode a DBXS block -> ``(indices, Metrics of (k,) arrays, metric)``."""
     if data[:4] != _TOPK_MAGIC:
         raise ValueError("bad magic; not a DBXS top-k block")
+    if len(data) < 13:
+        raise ValueError(f"truncated top-k block: {len(data)} < 13-byte header")
     k, n_fields, name_len = struct.unpack_from("<IIB", data, 4)
     if n_fields != len(Metrics._fields):
         raise ValueError(
             f"top-k block has {n_fields} fields, expected "
             f"{len(Metrics._fields)}")
     off = 13
+    if len(data) < off + name_len:
+        raise ValueError(
+            f"truncated top-k block: {len(data)} < {off + name_len} (name)")
     rank_metric = data[off:off + name_len].decode("utf-8")
     off += name_len
     need = off + 4 * k + 4 * n_fields * k

@@ -222,3 +222,76 @@ def test_aggregate_warns_on_rank_metric_mismatch(tmp_path, caplog):
         aggregate.aggregate(results_dir, journal_path, metric="sharpe")
     assert not [r for r in caplog.records
                 if "retained top-k" in r.message]   # same metric: no warning
+
+
+def test_aggregate_nan_cells_rank_last(tmp_path):
+    """ADVICE r3: np.argmax(sign * values) ranks NaN FIRST (NaN wins numpy
+    comparisons) — a block with NaN cells must not report a NaN row as the
+    job's best while finite rows exist, and an all-NaN job must sort below
+    every finite job fleet-wide."""
+    journal_path = str(tmp_path / "journal.jsonl")
+    results_dir = str(tmp_path / "results")
+    queue = JobQueue(Journal(journal_path))
+    grid = parse_grid("fast=3:6,slow=10:16:2")   # 3x3 = 9 combos
+    recs = synthetic_jobs(2, 96, "sma_crossover", grid, cost=1e-3, seed=3)
+    for rec in recs:
+        queue.enqueue(rec)
+    import os
+    os.makedirs(results_dir, exist_ok=True)
+
+    def block(sharpe_row):
+        fields = {name: np.full(9, 0.1, np.float32)
+                  for name in aggregate.Metrics._fields}
+        fields["sharpe"] = np.asarray(sharpe_row, np.float32)
+        return wire.metrics_to_bytes(aggregate.Metrics(**fields))
+
+    # Job 0: NaN at the position argmax-without-masking would pick.
+    row0 = np.full(9, 0.5, np.float32)
+    row0[1] = np.nan
+    row0[4] = 2.0            # the true (finite) best
+    with open(f"{results_dir}/{recs[0].id}.dbxm", "wb") as fh:
+        fh.write(block(row0))
+    # Job 1: every cell NaN (e.g. zero-variance returns everywhere).
+    with open(f"{results_dir}/{recs[1].id}.dbxm", "wb") as fh:
+        fh.write(block(np.full(9, np.nan, np.float32)))
+
+    out = aggregate.aggregate(results_dir, journal_path, metric="sharpe",
+                              top=10)
+    assert out["jobs_aggregated"] == 2
+    assert out["best"][0]["job"] == recs[0].id
+    assert out["best"][0]["value"] == 2.0          # finite best, not NaN
+    assert np.isnan(out["best"][1]["value"])       # all-NaN job sorts last
+
+    # Same discipline on a DBXS (top-k) block where < k rows are finite.
+    idx = np.asarray([4, 1, 3], np.int32)          # row 1 carries NaN
+    sel = {name: np.float32([1.0, np.nan, 0.2])
+           for name in aggregate.Metrics._fields}
+    sel["sharpe"] = np.float32([2.0, np.nan, 0.2])
+    blob = wire.topk_to_bytes(idx, aggregate.Metrics(**sel), "sharpe")
+    with open(f"{results_dir}/{recs[1].id}.dbxm", "wb") as fh:
+        fh.write(blob)
+    out2 = aggregate.aggregate(results_dir, journal_path, metric="sharpe",
+                               top=10)
+    row = next(r for r in out2["best"] if r["job"] == recs[1].id)
+    assert row["value"] == 2.0                     # not the NaN row
+
+
+def test_aggregate_cli_emits_valid_json_for_all_nan_job(tmp_path, capsys):
+    """The CLI must serialize an all-NaN job's value as null, not the
+    non-standard `NaN` token that breaks strict JSON parsers."""
+    journal_path = str(tmp_path / "journal.jsonl")
+    results_dir = str(tmp_path / "results")
+    queue = JobQueue(Journal(journal_path))
+    recs = synthetic_jobs(1, 96, "sma_crossover", parse_grid("fast=3,slow=8"),
+                          seed=3)
+    for rec in recs:
+        queue.enqueue(rec)
+    import os
+    os.makedirs(results_dir, exist_ok=True)
+    fields = {name: np.float32([np.nan])
+              for name in aggregate.Metrics._fields}
+    with open(f"{results_dir}/{recs[0].id}.dbxm", "wb") as fh:
+        fh.write(wire.metrics_to_bytes(aggregate.Metrics(**fields)))
+    aggregate.main(["--results-dir", results_dir, "--journal", journal_path])
+    out = json.loads(capsys.readouterr().out)   # strict parse must succeed
+    assert out["best"][0]["value"] is None

@@ -840,3 +840,86 @@ def test_pairs_restart_with_vanished_leg_file_still_serves(tmp_path):
     disp2 = build_dispatcher(make_parser().parse_args(argv))
     s = disp2.queue.stats()
     assert s["jobs_pending"] == 2          # restored, not re-enqueued
+
+
+def test_result_block_short_header_raises_valueerror():
+    """ADVICE r3: a blob with valid magic but a truncated header must raise
+    the contract's ValueError, not leak struct.error into an aggregate run
+    (same gap class the differential fuzz closed in data.from_wire_bytes)."""
+    for n in range(4, 13):
+        with pytest.raises(ValueError, match="truncated"):
+            wire.topk_from_bytes(b"DBXS" + b"\x00" * (n - 4))
+    for n in range(4, 12):
+        with pytest.raises(ValueError, match="truncated"):
+            wire.metrics_from_bytes(b"DBXM" + b"\x00" * (n - 4))
+    # Header intact but the rank-metric name itself is cut off.
+    m = Metrics(*(np.float32([1.0, 2.0]) for _ in range(9)))
+    blob = wire.topk_to_bytes(np.int32([0, 1]), m, "sortino")
+    with pytest.raises(ValueError, match="truncated"):
+        wire.topk_from_bytes(blob[:15])   # 13-byte header + 2 of 7 name bytes
+
+
+def test_pairs_glob_churn_keeps_journaled_x_legs(tmp_path, caplog):
+    """ADVICE r3: y-glob churn between runs with equal counts must not
+    silently re-assign an x leg that a journaled pair already claimed —
+    the journal's (y, x) pairing is authoritative; ambiguity is loud."""
+    import logging
+    import os
+
+    from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+        build_dispatcher, make_parser)
+
+    ys = _write_leg_csvs(tmp_path, 2, prefix="y")
+    xs = _write_leg_csvs(tmp_path, 2, prefix="x")
+    argv = ["--strategy", "pairs", "--data", str(tmp_path / "y*.csv"),
+            "--data2", str(tmp_path / "x*.csv"), "--grid", "lookback=6",
+            "--journal", str(tmp_path / "q.jsonl"),
+            "--results-dir", str(tmp_path / "res")]
+    disp = build_dispatcher(make_parser().parse_args(argv))
+    pairing = {r.path: r.path2 for r, _ in disp.queue.take(2, "w")}
+    assert pairing == dict(zip(sorted(ys), sorted(xs)))
+
+    # Churn: y0 deleted, y2 added; x set unchanged, counts still equal.
+    # Positional pairing would hand y2 the x leg journaled for y1; the
+    # fixed intake refuses instead of silently re-assigning.
+    os.unlink(ys[0])
+    _write_leg_csvs(tmp_path, 3, prefix="y")       # recreates y0,y1 + new y2
+    os.unlink(ys[0])                                # keep y0 deleted
+    with caplog.at_level(logging.WARNING, logger="dbx.dispatcher"), \
+            pytest.raises(SystemExit, match="already paired"):
+        build_dispatcher(make_parser().parse_args(argv))
+    assert any("churn" in r.message for r in caplog.records)
+
+    # Matching churn on BOTH legs: the new y pairs with the one x no
+    # journaled pair has claimed — regardless of sort position.
+    _write_leg_csvs(tmp_path, 3, prefix="x")
+    os.unlink(xs[0])
+    disp3 = build_dispatcher(make_parser().parse_args(argv))
+    taken = disp3.queue.take(10, "w2")
+    new = [r for r, _ in taken if r.path == str(tmp_path / "y2.csv")]
+    assert len(new) == 1
+    assert new[0].path2 == str(tmp_path / "x2.csv")
+    # The restored y1 job keeps its journaled x1 leg.
+    old = [r for r, _ in taken if r.path == str(tmp_path / "y1.csv")]
+    assert old and old[0].path2 == str(tmp_path / "x1.csv")
+
+
+def test_pairs_restart_with_stray_unclaimed_x_still_serves(tmp_path):
+    """Code-review r4: a pure crash-restart with a stray unclaimed leg-x
+    file (user dropped an extra x into the glob) must serve the restored
+    queue, not die on a paths/paths2 length mismatch."""
+    from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+        build_dispatcher, make_parser)
+
+    _write_leg_csvs(tmp_path, 2, prefix="y")
+    _write_leg_csvs(tmp_path, 2, prefix="x")
+    argv = ["--strategy", "pairs", "--data", str(tmp_path / "y*.csv"),
+            "--data2", str(tmp_path / "x*.csv"), "--grid", "lookback=6",
+            "--journal", str(tmp_path / "q.jsonl"),
+            "--results-dir", str(tmp_path / "res")]
+    disp = build_dispatcher(make_parser().parse_args(argv))
+    assert disp.queue.stats()["jobs_pending"] == 2
+
+    _write_leg_csvs(tmp_path, 3, prefix="x")   # stray x2.csv appears
+    disp2 = build_dispatcher(make_parser().parse_args(argv))
+    assert disp2.queue.stats()["jobs_pending"] == 2   # restored + served
