@@ -88,6 +88,13 @@ def aggregate(results_dir: str, journal_path: str, *,
         else:
             m = wire.metrics_from_bytes(blob)
         values = np.asarray(getattr(m, metric)).reshape(-1)
+        if values.size == 0:
+            # A structurally-valid zero-row block (e.g. a job enqueued with
+            # an empty grid axis): nothing to rank; skipping beats aborting
+            # the whole fleet report on np.argmax of an empty array.
+            log.warning("job %s: result block has zero param rows; skipped",
+                        jid)
+            continue
         sign_ = metric_sign(metric)
         # NaN ranks last (numpy argmax would rank it FIRST — NaN wins every
         # comparison), matching the worker-side _topk_reduce discipline; a

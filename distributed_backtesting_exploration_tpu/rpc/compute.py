@@ -10,6 +10,7 @@ the stub's isolation suggested (SURVEY.md §4).
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Callable, Iterable, NamedTuple, Protocol
 
@@ -18,6 +19,8 @@ import numpy as np
 from . import backtesting_pb2 as pb
 from . import wire
 from ..utils import data as data_mod
+
+log = logging.getLogger("dbx.compute")
 
 
 class Completion:
@@ -319,27 +322,42 @@ class JaxSweepBackend:
 
     @classmethod
     def _fused_eligible(cls, job, grid, lengths) -> bool:
-        """Jobs whose strategy has a ``_FUSED_STRATEGIES`` entry, with
-        integral window grids and a VMEM-sized working set, route to
-        Pallas. Mixed history lengths are fine: the kernels take per-ticker
-        real lengths (round 3 — a ragged fleet used to silently drop to the
-        ~6x-slower generic path)."""
+        """True when the job routes to a fused Pallas kernel."""
+        return cls._fused_demotion_reason(job, grid, lengths) is None
+
+    @classmethod
+    def _fused_demotion_reason(cls, job, grid, lengths) -> str | None:
+        """None when the job is fused-eligible; otherwise the cap that
+        demotes it to the ~6x-slower generic path.
+
+        Jobs whose strategy has a ``_FUSED_STRATEGIES`` entry, with integral
+        window grids and a VMEM-sized working set, route to Pallas. Mixed
+        history lengths are fine: the kernels take per-ticker real lengths
+        (round 3 — a ragged fleet used to silently drop to the generic
+        path). A strategy with no fused kernel at all returns a reason too,
+        but submit() only LOGS demotions of fused-capable strategies — the
+        rest are ordinary routing, not a demotion.
+        """
         import numpy as np
 
         spec = cls._FUSED_STRATEGIES.get(job.strategy)
         if spec is None:
-            return False
+            return f"strategy {job.strategy!r} has no fused kernel"
         if set(grid) != spec.axes:
-            return False
+            return (f"grid axes {sorted(grid)} do not match the fused "
+                    f"contract {sorted(spec.axes)}")
         wins = np.concatenate([grid[a] for a in spec.window_axes])
         if wins.size == 0:
-            return False   # empty grid: route to generic, don't crash
+            return "empty window grid"   # route to generic, don't crash
         if not np.allclose(wins, np.round(wins)):
-            return False
+            return ("non-integral window values in axes "
+                    f"{list(spec.window_axes)}")
         tbl = np.concatenate(
             [grid[a] for a in (spec.table_axes or spec.window_axes)])
-        if np.unique(np.round(tbl)).size > cls._FUSED_MAX_WINDOWS:
-            return False
+        n_tbl = int(np.unique(np.round(tbl)).size)
+        if n_tbl > cls._FUSED_MAX_WINDOWS:
+            return (f"{n_tbl} distinct table windows exceed the kernel cap "
+                    f"of {cls._FUSED_MAX_WINDOWS}")
         if job.strategy in ("donchian", "donchian_hl", "stochastic"):
             # The generic channel paths poison windows beyond their static
             # view bound (MAX_WINDOW) to NaN; the fused kernels have no
@@ -351,8 +369,13 @@ class JaxSweepBackend:
             bound = (stoch_mod.MAX_WINDOW if job.strategy == "stochastic"
                      else donchian_mod.MAX_WINDOW)
             if float(wins.max()) > bound:
-                return False
-        return int(max(lengths)) <= cls._FUSED_MAX_BARS
+                return (f"max window {int(wins.max())} exceeds the channel "
+                        f"view bound {bound}")
+        t_max = int(max(lengths))
+        if t_max > cls._FUSED_MAX_BARS:
+            return (f"{t_max} bars exceed the kernel VMEM cap of "
+                    f"{cls._FUSED_MAX_BARS}")
+        return None
 
     def _mesh_call(self, key, runner, row_arrays, t_real):
         """Run ``runner(*blocks, t_real_block)`` with ticker rows sharded
@@ -526,8 +549,9 @@ class JaxSweepBackend:
             grid = sweep_mod.product_grid(**axes)
             strategy = models_base.get_strategy(group[0].strategy)
             ppy = group[0].periods_per_year or 252
-            if self.use_fused and self._fused_eligible(group[0], axes,
-                                                       lengths):
+            demotion = (self._fused_demotion_reason(group[0], axes, lengths)
+                        if self.use_fused else None)
+            if self.use_fused and demotion is None:
                 # Repeat-last padding + per-ticker lengths: the kernels'
                 # padding discipline makes pad bars earn zero return and
                 # hold the final position, and all metric reductions use
@@ -560,6 +584,13 @@ class JaxSweepBackend:
                 else:
                     m = spec.run(*arrays, grid, cost, ppy, t_real)
             else:
+                if (demotion is not None
+                        and group[0].strategy in self._FUSED_STRATEGIES):
+                    # A fleet silently dropping to the ~6x-slower generic
+                    # path is a throughput bug nobody can see; name the cap.
+                    log.warning(
+                        "jobs %s (%s) demoted to the generic path: %s",
+                        [j.id for j in group], group[0].strategy, demotion)
                 batch, _, mask = data_mod.pad_and_stack(series)
                 # One chunk-eligibility rule for both branches: the mesh and
                 # single-device backends must agree on memory bounding.
@@ -804,11 +835,22 @@ class JaxSweepBackend:
             return self._finish_group(list(group) + bad, m, t0,
                                       len(group), job0)
         lb = np.asarray(grid.get("lookback", np.empty(0)))
-        fused_ok = (lb.size > 0 and np.allclose(lb, np.round(lb))
-                    and np.unique(np.round(lb)).size
-                    <= self._FUSED_MAX_WINDOWS
-                    and t_max <= self._FUSED_MAX_BARS)
-        if self.use_fused and fused_ok:
+        n_lb = int(np.unique(np.round(lb)).size)
+        demotion = None
+        if lb.size == 0:
+            demotion = "no 'lookback' axis in grid"
+        elif not np.allclose(lb, np.round(lb)):
+            demotion = "non-integral lookback values"
+        elif n_lb > self._FUSED_MAX_WINDOWS:
+            demotion = (f"{n_lb} distinct lookbacks exceed the kernel cap "
+                        f"of {self._FUSED_MAX_WINDOWS}")
+        elif t_max > self._FUSED_MAX_BARS:
+            demotion = (f"{t_max} bars exceed the kernel VMEM cap of "
+                        f"{self._FUSED_MAX_BARS}")
+        if self.use_fused and demotion is not None:
+            log.warning("jobs %s (pairs) demoted to the generic path: %s",
+                        [j.id for j in group], demotion)
+        if self.use_fused and demotion is None:
             from ..ops import fused
 
             plb = np.asarray(grid["lookback"])

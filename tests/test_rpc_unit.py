@@ -604,6 +604,49 @@ def test_backend_fused_donchian_hl_big_window_stays_generic():
     assert not compute.JaxSweepBackend._fused_eligible(_Job(), grid, [160])
 
 
+def test_fused_demotion_to_generic_path_is_loud(caplog):
+    """A job a VMEM/table cap silently routes off the fused kernel is a
+    throughput bug nobody can see: submit() must log one warning per job
+    group naming the cap that demoted it (round-3 verdict: the >128-window
+    and >8192-bar demotions were silent)."""
+    import logging
+    import numpy as np
+    from distributed_backtesting_exploration_tpu.rpc import compute, wire
+    from distributed_backtesting_exploration_tpu.rpc import backtesting_pb2 as pb
+    from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+        synthetic_jobs)
+
+    backend = compute.JaxSweepBackend(use_fused=True)
+
+    def run(strategy, grid, caplog):
+        recs = synthetic_jobs(1, 96, strategy, grid, seed=3)
+        specs = [pb.JobSpec(id=r.id, strategy=r.strategy, ohlcv=r.ohlcv,
+                            ohlcv2=r.ohlcv2 or b"",
+                            grid=wire.grid_to_proto(r.grid))
+                 for r in recs]
+        with caplog.at_level(logging.WARNING, logger="dbx.compute"):
+            backend.process(specs)
+        return [r.message for r in caplog.records if "demoted" in r.message]
+
+    # 130 distinct windows exceed the 128-entry selection-table cap.
+    wide = {"lookback": np.arange(1, 131, dtype=np.float32)}
+    msgs = run("momentum", wide, caplog)
+    assert msgs and "130 distinct table windows" in msgs[0]
+    assert str(compute.JaxSweepBackend._FUSED_MAX_WINDOWS) in msgs[0]
+
+    # The two-legged path has its own router; it must be loud too.
+    caplog.clear()
+    pair_grid = {"lookback": np.float32([10.5]),
+                 "z_entry": np.float32([1.0])}
+    msgs = run("pairs", pair_grid, caplog)
+    assert msgs and "non-integral lookback" in msgs[0]
+
+    # An eligible job logs nothing (demotion warnings must not cry wolf).
+    caplog.clear()
+    ok = {"lookback": np.float32([5, 10])}
+    assert run("momentum", ok, caplog) == []
+
+
 def test_wf_test_without_train_not_stamped(tmp_path):
     """--wf-test without --wf-train must not stamp inert wf fields on
     records (they would split worker co-batching across a restart)."""
