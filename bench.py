@@ -396,6 +396,76 @@ def main():
     if enabled("e2e_topk"):
         run_e2e("e2e_topk", top_k=16)
 
+    # --- e2e_local: control-plane saturation (no TPU, no tunnel) ----------
+    # `e2e` above is tunnel-bound on remote-proxy chips; this config
+    # measures the DISPATCHER's own ceiling: N workers with an instant
+    # compute backend drain a queue of small inline jobs over loopback
+    # gRPC, so every second is framework control plane — RPC serving under
+    # the GIL, queue state transitions (native core), completion batching.
+    # Reported as JOBS/s per worker count; the 1->2->4 scaling curve (or
+    # its absence) localizes the saturation point (DESIGN.md "Control-plane
+    # ceiling"). The reference's one perf fact is jobs/s through its loop.
+    def run_e2e_local(n_workers, n_jobs):
+        import tempfile
+        import threading
+
+        from distributed_backtesting_exploration_tpu.rpc.compute import (
+            InstantBackend)
+        from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+            Dispatcher, DispatcherServer, JobQueue, PeerRegistry,
+            synthetic_jobs)
+        from distributed_backtesting_exploration_tpu.rpc.worker import Worker
+
+        lgrid = {"fast": np.arange(5.0, 9.0, dtype=np.float32)}
+        queue = JobQueue()
+        with tempfile.TemporaryDirectory() as results_dir:
+            disp = Dispatcher(queue, PeerRegistry(prune_window_s=30.0),
+                              results_dir=results_dir)
+            srv = DispatcherServer(disp, bind="localhost:0",
+                                   prune_interval_s=0.5).start()
+            workers = [Worker(f"localhost:{srv.port}", InstantBackend(),
+                              worker_id=f"local-{i}",
+                              poll_interval_s=0.001, status_interval_s=0.5,
+                              jobs_per_chip=32)
+                       for i in range(n_workers)]
+            threads = [threading.Thread(target=w.run, daemon=True)
+                       for w in workers]
+
+            def drain(n, seed):
+                for rec in synthetic_jobs(n, 32, "sma_crossover", lgrid,
+                                          seed=seed):
+                    queue.enqueue(rec)
+                deadline = time.monotonic() + 300.0
+                while not queue.drained:
+                    if time.monotonic() > deadline:
+                        sys.exit(f"bench[e2e_local]: drain wedged for 300s "
+                                 f"— stats={queue.stats()}")
+                    time.sleep(0.002)
+
+            try:
+                for t in threads:
+                    t.start()
+                drain(max(n_jobs // 4, 64), seed=300)   # channel warm-up
+                t0 = time.perf_counter()
+                drain(n_jobs, seed=301)
+                elapsed = time.perf_counter() - t0
+            finally:
+                for w in workers:
+                    w.stop()
+                for t in threads:
+                    t.join(timeout=30)
+                srv.stop()
+        rate = n_jobs / elapsed
+        print(f"bench[e2e_local_w{n_workers}]: {n_jobs} instant jobs, "
+              f"{n_workers} worker(s), substrate={queue.substrate} -> "
+              f"{rate:.0f} jobs/s", file=sys.stderr)
+        rates[f"e2e_local_w{n_workers}"] = rate
+
+    if enabled("e2e_local"):
+        n_local_jobs = int(os.environ.get("DBX_BENCH_LOCAL_JOBS", 1500))
+        for n_workers in (1, 2, 4):
+            run_e2e_local(n_workers, n_local_jobs)
+
     # --- configs[4]: walk-forward (12 refit windows x grid) ---------------
     if enabled("walkforward"):
         train = n_bars // 2 - 30
@@ -440,7 +510,7 @@ def main():
         known = ("sma_fused, bollinger_fused, bollinger_touch_fused, "
                  "momentum_fused, donchian_fused, donchian_hl_fused, "
                  "keltner_fused, stochastic_fused, vwap_fused, rsi_fused, "
-                 "macd_fused, pairs, e2e, e2e_topk, walkforward")
+                 "macd_fused, pairs, e2e, e2e_topk, e2e_local, walkforward")
         sys.exit(f"bench: no configs ran — DBX_BENCH_CONFIGS={only} matched "
                  f"nothing (known: {known})")
     # The headline is the north-star config when it ran; otherwise label the

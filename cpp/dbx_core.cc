@@ -2,6 +2,7 @@
 
 #include "dbx_core.h"
 
+#include <algorithm>
 #include <cctype>
 #include <chrono>
 #include <condition_variable>
@@ -13,6 +14,8 @@
 #include <mutex>
 #include <string>
 #include <unordered_map>
+#include <unordered_set>
+#include <utility>
 #include <vector>
 
 namespace {
@@ -291,6 +294,187 @@ extern "C" size_t dbx_queue_size(DbxQueue* q) {
 }
 
 extern "C" void dbx_queue_free(DbxQueue* q) { delete q; }
+
+// ---------------------------------------------------------------------------
+// Job-queue state machine
+// ---------------------------------------------------------------------------
+//
+// Mirrors rpc/dispatcher.py's Python fallback exactly; see dbx_core.h for
+// the transition contract and the take_begin/take_commit race model.
+
+struct DbxJobQueue {
+  struct Lease {
+    std::string worker;
+    std::chrono::steady_clock::time_point deadline;
+    uint64_t seq;  // insertion order, so requeue scans match the Python
+                   // fallback's insertion-ordered dict iteration
+  };
+  std::mutex mu;
+  std::deque<std::string> pending;
+  std::unordered_set<std::string> tombstones;
+  std::unordered_map<std::string, double> records;  // id -> combo credit
+  std::unordered_map<std::string, Lease> leases;
+  std::unordered_map<std::string, double> completed;
+  std::unordered_set<std::string> failed;
+  uint64_t lease_seq = 0;
+  int64_t requeued = 0;
+  double combos_done = 0.0;
+};
+
+extern "C" DbxJobQueue* dbx_jobq_new(void) { return new DbxJobQueue(); }
+
+extern "C" void dbx_jobq_free(DbxJobQueue* q) { delete q; }
+
+extern "C" int dbx_jobq_register(DbxJobQueue* q, const char* id,
+                                 double combos) {
+  if (std::strlen(id) > DBX_JOBQ_MAX_ID) return 1;
+  std::lock_guard<std::mutex> lk(q->mu);
+  q->records[id] = combos;
+  return 0;
+}
+
+extern "C" void dbx_jobq_push_pending(DbxJobQueue* q, const char* id) {
+  std::lock_guard<std::mutex> lk(q->mu);
+  q->pending.emplace_back(id);
+}
+
+extern "C" void dbx_jobq_mark_completed(DbxJobQueue* q, const char* id) {
+  std::lock_guard<std::mutex> lk(q->mu);
+  q->completed.emplace(id, 0.0);  // no combos_done credit: prior run's work
+}
+
+extern "C" void dbx_jobq_mark_failed(DbxJobQueue* q, const char* id) {
+  std::lock_guard<std::mutex> lk(q->mu);
+  q->failed.insert(id);
+}
+
+extern "C" int dbx_jobq_take_begin(DbxJobQueue* q, char* out, size_t cap) {
+  std::lock_guard<std::mutex> lk(q->mu);
+  while (!q->pending.empty()) {
+    std::string id = std::move(q->pending.front());
+    q->pending.pop_front();
+    if (q->tombstones.erase(id)) continue;  // completed while pending
+    if (id.size() + 1 > cap) {
+      // Caller's buffer cannot hold the id (register caps ids at
+      // DBX_JOBQ_MAX_ID, so a >=512-byte buffer never hits this). Put the
+      // id back and report the contract violation — silently dropping a
+      // popped job would drain the queue with work unprocessed.
+      q->pending.emplace_front(std::move(id));
+      return -1;
+    }
+    std::memcpy(out, id.c_str(), id.size() + 1);
+    return 1;
+  }
+  return 0;
+}
+
+extern "C" int dbx_jobq_take_commit(DbxJobQueue* q, const char* id,
+                                    const char* worker, int64_t lease_ms) {
+  std::lock_guard<std::mutex> lk(q->mu);
+  if (q->completed.count(id)) {
+    // Completed in the unlocked take window: drop the orphan tombstone the
+    // completion installed, and do not lease.
+    q->tombstones.erase(id);
+    return 1;
+  }
+  q->leases[id] = DbxJobQueue::Lease{
+      worker,
+      std::chrono::steady_clock::now() + std::chrono::milliseconds(lease_ms),
+      q->lease_seq++};
+  return 0;
+}
+
+extern "C" int dbx_jobq_fail(DbxJobQueue* q, const char* id) {
+  std::lock_guard<std::mutex> lk(q->mu);
+  if (q->completed.count(id)) {
+    q->tombstones.erase(id);
+    return 1;
+  }
+  q->failed.insert(id);
+  return 0;
+}
+
+extern "C" int dbx_jobq_complete(DbxJobQueue* q, const char* id) {
+  std::lock_guard<std::mutex> lk(q->mu);
+  auto rec = q->records.find(id);
+  if (rec == q->records.end()) return 2;
+  const bool had_lease = q->leases.erase(id) > 0;
+  if (q->completed.count(id)) return 1;
+  if (!had_lease && !q->failed.count(id) && !q->tombstones.count(id)) {
+    // Completion for a job still sitting in the pending FIFO (late RPC
+    // straddling a lease expiry or restart): no interior removal, so
+    // tombstone the id for take to skip.
+    q->tombstones.insert(id);
+  }
+  q->completed[id] = rec->second;
+  q->combos_done += rec->second;
+  return 0;
+}
+
+namespace {
+
+int requeue_matching(
+    DbxJobQueue* q, DbxPrunedFn fn, void* ctx,
+    const std::function<bool(const DbxJobQueue::Lease&)>& match) {
+  std::vector<std::pair<uint64_t, std::string>> hit;
+  {
+    std::lock_guard<std::mutex> lk(q->mu);
+    for (const auto& [id, lease] : q->leases) {
+      if (match(lease)) hit.emplace_back(lease.seq, id);
+    }
+    // Lease-insertion order, so the front-of-queue result is identical to
+    // the Python fallback's insertion-ordered scan + appendleft loop.
+    std::sort(hit.begin(), hit.end());
+    for (const auto& [seq, id] : hit) {
+      (void)seq;
+      q->leases.erase(id);
+      q->pending.emplace_front(id);
+    }
+    q->requeued += static_cast<int64_t>(hit.size());
+  }
+  if (fn) {
+    for (const auto& [seq, id] : hit) {
+      (void)seq;
+      fn(id.c_str(), ctx);
+    }
+  }
+  return static_cast<int>(hit.size());
+}
+
+}  // namespace
+
+extern "C" int dbx_jobq_requeue_expired(DbxJobQueue* q, DbxPrunedFn fn,
+                                        void* ctx) {
+  const auto now = std::chrono::steady_clock::now();
+  return requeue_matching(
+      q, fn, ctx,
+      [now](const DbxJobQueue::Lease& l) { return l.deadline <= now; });
+}
+
+extern "C" int dbx_jobq_requeue_worker(DbxJobQueue* q, const char* worker,
+                                       DbxPrunedFn fn, void* ctx) {
+  const std::string w = worker;
+  return requeue_matching(
+      q, fn, ctx, [&w](const DbxJobQueue::Lease& l) { return l.worker == w; });
+}
+
+extern "C" void dbx_jobq_stats(DbxJobQueue* q, DbxJobqStats* out) {
+  std::lock_guard<std::mutex> lk(q->mu);
+  out->pending = static_cast<int64_t>(q->pending.size()) -
+                 static_cast<int64_t>(q->tombstones.size());
+  out->leased = static_cast<int64_t>(q->leases.size());
+  out->completed = static_cast<int64_t>(q->completed.size());
+  out->requeued = q->requeued;
+  out->failed = static_cast<int64_t>(q->failed.size());
+  out->combos_done = q->combos_done;
+}
+
+extern "C" int dbx_jobq_drained(DbxJobQueue* q) {
+  std::lock_guard<std::mutex> lk(q->mu);
+  const int64_t live = static_cast<int64_t>(q->pending.size()) -
+                       static_cast<int64_t>(q->tombstones.size());
+  return (live == 0 && q->leases.empty()) ? 1 : 0;
+}
 
 // ---------------------------------------------------------------------------
 // Peer registry

@@ -87,6 +87,78 @@ size_t dbx_queue_size(DbxQueue* q);
 void dbx_queue_free(DbxQueue* q);
 
 // ---------------------------------------------------------------------------
+// Job-queue state machine
+// ---------------------------------------------------------------------------
+//
+// The dispatcher's lease/tombstone/completion transitions (the part of the
+// reference's dispatcher state that is native there — its whole Dispatcher
+// struct lives in Rust, reference src/server/main.rs:20-190). gRPC serving
+// stays in Python (no grpc++ in this environment); this owns the id-state
+// hot path behind it: pending FIFO, tombstone skip, lease table, completion
+// idempotency, expiry/prune requeue. Semantics mirror the Python fallback in
+// rpc/dispatcher.py byte for byte; the mid-take completion race is modeled
+// by the explicit take_begin/take_commit split (payload materialization
+// happens between the two, outside any lock).
+//
+// Job ids are NUL-terminated strings up to DBX_JOBQ_MAX_ID bytes.
+
+#define DBX_JOBQ_MAX_ID 511
+
+// Id/peer callback (also used by the registry's prune below).
+typedef void (*DbxPrunedFn)(const char* peer_id, void* ctx);
+
+typedef struct DbxJobQueue DbxJobQueue;
+
+typedef struct {
+  int64_t pending;      // live FIFO entries (tombstones excluded)
+  int64_t leased;
+  int64_t completed;
+  int64_t requeued;
+  int64_t failed;
+  double combos_done;   // sum of combo credits over first completions
+} DbxJobqStats;
+
+DbxJobQueue* dbx_jobq_new(void);
+void dbx_jobq_free(DbxJobQueue* q);
+// Register a job id with its combo-count credit (recorded on first
+// completion). Idempotent; required before any other call names the id.
+// Returns 0, or 1 if the id exceeds DBX_JOBQ_MAX_ID bytes.
+int dbx_jobq_register(DbxJobQueue* q, const char* id, double combos);
+// Append a registered id to the pending FIFO.
+void dbx_jobq_push_pending(DbxJobQueue* q, const char* id);
+// Journal-restore helpers: mark terminal states without crediting
+// combos_done (a restored completion's work happened in a previous run).
+void dbx_jobq_mark_completed(DbxJobQueue* q, const char* id);
+void dbx_jobq_mark_failed(DbxJobQueue* q, const char* id);
+// Pop the next live pending id (skipping + clearing tombstones) into out.
+// Returns 1 with an id written, 0 when the FIFO is empty, -1 when the next
+// id does not fit in cap bytes (the id is returned to the front of the
+// FIFO; pass a buffer of DBX_JOBQ_MAX_ID + 1 bytes to make this
+// unreachable).
+int dbx_jobq_take_begin(DbxJobQueue* q, char* out, size_t cap);
+// Lease a popped id to worker for lease_ms. Returns 0 leased; 1 when the
+// job completed in the take window (tombstone cleared, not leased).
+int dbx_jobq_take_commit(DbxJobQueue* q, const char* id, const char* worker,
+                         int64_t lease_ms);
+// Mark a popped id failed (unreadable payload). Returns 0 marked; 1 when
+// the job completed in the take window (not marked).
+int dbx_jobq_fail(DbxJobQueue* q, const char* id);
+// Record a completion. Returns 0 new, 1 duplicate, 2 unknown id. Always
+// clears any lease; a completion for an id still in the FIFO installs a
+// tombstone so take skips it.
+int dbx_jobq_complete(DbxJobQueue* q, const char* id);
+// Requeue jobs whose lease deadline passed (front of the FIFO, in lease
+// order — matching the Python fallback's insertion-ordered scan). The
+// callback receives each requeued id. Returns the count.
+int dbx_jobq_requeue_expired(DbxJobQueue* q, DbxPrunedFn fn, void* ctx);
+// Requeue every job leased to worker (front of the FIFO, lease order).
+int dbx_jobq_requeue_worker(DbxJobQueue* q, const char* worker, DbxPrunedFn fn,
+                            void* ctx);
+void dbx_jobq_stats(DbxJobQueue* q, DbxJobqStats* out);
+// 1 when no live pending entries and no leases remain.
+int dbx_jobq_drained(DbxJobQueue* q);
+
+// ---------------------------------------------------------------------------
 // Peer registry
 // ---------------------------------------------------------------------------
 
@@ -95,9 +167,9 @@ typedef struct DbxRegistry DbxRegistry;
 DbxRegistry* dbx_registry_new(int64_t prune_window_ms);
 // Stamp a peer as alive now. Returns 1 if newly registered, 0 if refreshed.
 int dbx_registry_touch(DbxRegistry* r, const char* peer_id);
-// Remove peers silent past the window. For each removed peer the callback is
-// invoked with its id. Returns the number pruned.
-typedef void (*DbxPrunedFn)(const char* peer_id, void* ctx);
+// Remove peers silent past the window. For each removed peer the callback
+// (DbxPrunedFn, declared above) is invoked with its id. Returns the number
+// pruned.
 int dbx_registry_prune(DbxRegistry* r, DbxPrunedFn fn, void* ctx);
 int dbx_registry_alive(DbxRegistry* r);
 void dbx_registry_free(DbxRegistry* r);

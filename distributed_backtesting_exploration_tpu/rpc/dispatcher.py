@@ -48,53 +48,6 @@ from ..utils import data as data_mod
 log = logging.getLogger("dbx.dispatcher")
 
 
-class _PendingIds:
-    """FIFO of pending job ids, backed by the native MPMC queue when the C++
-    core is available (the reference's queue substrate is native; SURVEY.md
-    §2.2 native ledger) and by a deque otherwise. Single lock discipline is
-    owned by JobQueue — these methods are called under its lock.
-    """
-
-    # Far above any realistic pending backlog; push never blocks.
-    _NATIVE_CAPACITY = 1 << 20
-
-    def __init__(self, use_native: bool | None = None):
-        self._nq = None
-        if use_native is None:
-            use_native = native_core.available()
-        if use_native:
-            try:
-                self._nq = native_core.NativeQueue(self._NATIVE_CAPACITY)
-            except RuntimeError:
-                self._nq = None
-        self._dq: collections.deque[str] | None = (
-            None if self._nq is not None else collections.deque())
-        self.backend = "native" if self._nq is not None else "python"
-
-    def append(self, jid: str) -> None:
-        if self._nq is not None:
-            if not self._nq.push(jid.encode(), timeout_ms=0):
-                raise RuntimeError("native pending queue full")
-        else:
-            self._dq.append(jid)
-
-    def appendleft(self, jid: str) -> None:
-        if self._nq is not None:
-            if not self._nq.push_front(jid.encode(), timeout_ms=0):
-                raise RuntimeError("native pending queue full")
-        else:
-            self._dq.appendleft(jid)
-
-    def popleft(self) -> str | None:
-        if self._nq is not None:
-            b = self._nq.pop(timeout_ms=0)
-            return b.decode() if b is not None else None
-        return self._dq.popleft() if self._dq else None
-
-    def __len__(self) -> int:
-        return len(self._nq) if self._nq is not None else len(self._dq)
-
-
 # ---------------------------------------------------------------------------
 # Job records and the leased queue
 # ---------------------------------------------------------------------------
@@ -175,28 +128,160 @@ class Lease:
     deadline: float
 
 
+class _PyQueueState:
+    """Pure-Python fallback of the native job-queue state machine.
+
+    Mirrors ``cpp/dbx_core.h``'s ``DbxJobQueue`` contract exactly (the
+    same contract :class:`runtime._core.NativeJobQueue` binds); the parity
+    tests in ``tests/test_rpc_unit.py`` run both substrates through
+    identical scenarios. Not itself thread-safe — every call arrives under
+    ``JobQueue._lock`` (single-lock discipline, matching how the native
+    side is driven).
+    """
+
+    def __init__(self):
+        self._pending: collections.deque[str] = collections.deque()
+        # Ids completed while still in the pending FIFO (late completions
+        # from a previous lease): the FIFO supports no interior removal, so
+        # take_begin skips tombstoned ids on pop. Invariant: every
+        # tombstone refers to an id currently in the FIFO.
+        self._tombstones: set[str] = set()
+        self._combos: dict[str, float] = {}      # id -> combo credit
+        self._leases: dict[str, Lease] = {}
+        self._completed: dict[str, float] = {}   # id -> combos credited
+        self._failed: set[str] = set()
+        self._requeued = 0
+        self._combos_done = 0.0
+
+    def register(self, jid: str, combos: float) -> None:
+        self._combos[jid] = float(combos)
+
+    def push_pending(self, jid: str) -> None:
+        self._pending.append(jid)
+
+    def mark_completed(self, jid: str) -> None:
+        # Journal-restore path: completed in a prior run, no throughput
+        # credit for this run's combos_done.
+        self._completed.setdefault(jid, 0.0)
+
+    def mark_failed(self, jid: str) -> None:
+        self._failed.add(jid)
+
+    def take_begin(self) -> str | None:
+        while self._pending:
+            jid = self._pending.popleft()
+            if jid in self._tombstones:     # completed while pending
+                self._tombstones.discard(jid)
+                continue
+            return jid
+        return None
+
+    def take_commit(self, jid: str, worker_id: str, lease_s: float) -> bool:
+        """False when the job completed in the take window (not leased)."""
+        if self._discard_if_completed(jid):
+            return False
+        self._leases[jid] = Lease(worker_id, time.monotonic() + lease_s)
+        return True
+
+    def fail(self, jid: str) -> bool:
+        """False when the job completed in the take window (not failed)."""
+        if self._discard_if_completed(jid):
+            return False
+        self._failed.add(jid)
+        return True
+
+    def _discard_if_completed(self, jid: str) -> bool:
+        """True if ``jid`` completed while take() held it outside the lock;
+        clears the orphan tombstone complete() installed."""
+        if jid in self._completed:
+            self._tombstones.discard(jid)
+            return True
+        return False
+
+    def complete(self, jid: str) -> str:
+        if jid not in self._combos:
+            return "unknown"
+        had_lease = self._leases.pop(jid, None) is not None
+        if jid in self._completed:
+            return "dup"
+        if (not had_lease and jid not in self._failed
+                and jid not in self._tombstones):
+            # Rare path: completion for a job sitting in the pending FIFO
+            # (e.g. a completion RPC that straddled a lease expiry or
+            # restart). The FIFO has no interior removal; tombstone the id
+            # so take skips it instead of re-dispatching.
+            self._tombstones.add(jid)
+        combos = self._combos[jid]
+        self._completed[jid] = combos
+        self._combos_done += combos
+        return "new"
+
+    def requeue_expired(self) -> list[str]:
+        now = time.monotonic()
+        expired = [jid for jid, l in self._leases.items()
+                   if l.deadline <= now]
+        for jid in expired:
+            del self._leases[jid]
+            self._pending.appendleft(jid)
+        self._requeued += len(expired)
+        return expired
+
+    def requeue_worker(self, worker_id: str) -> list[str]:
+        held = [jid for jid, l in self._leases.items()
+                if l.worker_id == worker_id]
+        for jid in held:
+            del self._leases[jid]
+            self._pending.appendleft(jid)
+        self._requeued += len(held)
+        return held
+
+    def stats(self) -> dict:
+        return {"pending": len(self._pending) - len(self._tombstones),
+                "leased": len(self._leases),
+                "completed": len(self._completed),
+                "requeued": self._requeued,
+                "failed": len(self._failed),
+                "combos_done": self._combos_done}
+
+    def drained(self) -> bool:
+        live_pending = len(self._pending) - len(self._tombstones)
+        return live_pending == 0 and not self._leases
+
+
 class JobQueue:
     """Thread-safe FIFO of JobRecords with leases and a durable journal.
 
     ``take`` materializes file-backed payloads at dispatch time (so enqueue
     is cheap and restarts don't re-read anything); a job whose file cannot
     be read is marked failed and journaled, never silently dropped.
+
+    The id-state machine (pending FIFO + tombstones + lease table +
+    completion idempotency) runs on the native C++ core when available —
+    the reference's whole dispatcher state is native (reference
+    ``src/server/main.rs:20-190``); gRPC serving stays in Python (no
+    grpc++ in this environment). Full job records (grids, payloads,
+    paths) stay Python-side keyed by the same ids. ``use_native=False``
+    forces the pure-Python fallback, which passes the same parity tests.
     """
 
     def __init__(self, journal: Journal | None = None, *,
-                 lease_s: float = 60.0):
+                 lease_s: float = 60.0, use_native: bool | None = None):
         self._lock = threading.Lock()
-        self._pending = _PendingIds()
-        # Ids completed while still in the pending FIFO (late completions
-        # from a previous lease): the FIFO supports no interior removal, so
-        # take() skips tombstoned ids on pop. Invariant: every tombstone
-        # refers to an id currently in the FIFO.
-        self._tombstones: set[str] = set()
         self._records: dict[str, JobRecord] = {}
-        self._leases: dict[str, Lease] = {}
-        self._completed: dict[str, float] = {}   # id -> combos credited
-        self._failed: set[str] = set()
-        self._requeued = 0
+        state = None
+        if use_native is None:
+            use_native = native_core.available()
+        if use_native:
+            try:
+                state = native_core.NativeJobQueue()
+            except RuntimeError:
+                state = None
+        self.substrate = "native" if state is not None else "python"
+        self._state = state if state is not None else _PyQueueState()
+        # Python-side mirror of completed ids (the native core keeps only
+        # counts): maintained on every "new" completion + restore, read by
+        # observers (chaos tests, operators) via completed_ids().
+        self._completed_ids: set[str] = set()
         self._journal = journal or Journal(None)
         self.known_paths: set[str] = set()
         # Journaled (leg-y path -> leg-x path) pairings for two-legged jobs:
@@ -208,19 +293,27 @@ class JobQueue:
         self.journaled_jobs = 0
         self.lease_s = lease_s
         self._t0 = time.monotonic()
-        self._combos_done = 0.0
+        # Jobs popped by take_begin but not yet committed/failed (payload
+        # materialization runs outside the lock): drained must stay False
+        # through that window or an observer could tear the dispatcher down
+        # with a job mid-dispatch.
+        self._in_take = 0
 
-    @property
-    def substrate(self) -> str:
-        """"native" when the C++ queue core backs the pending FIFO."""
-        return self._pending.backend
+    # Native substrate cap (cpp/dbx_core.h DBX_JOBQ_MAX_ID); enforced at
+    # intake on BOTH substrates so behavior cannot diverge at the edge.
+    MAX_ID_BYTES = 511
 
     # -- intake ------------------------------------------------------------
 
     def enqueue(self, rec: JobRecord, *, journal: bool = True) -> None:
+        if len(rec.id.encode()) > self.MAX_ID_BYTES:
+            raise ValueError(
+                f"job id exceeds {self.MAX_ID_BYTES} bytes (native "
+                f"substrate cap, enforced on both substrates): {rec.id[:64]!r}...")
         with self._lock:
             self._records[rec.id] = rec
-            self._pending.append(rec.id)
+            self._state.register(rec.id, float(rec.combos))
+            self._state.push_pending(rec.id)
         if journal:
             self._journal.append("enqueue", **rec.journal_form())
 
@@ -241,14 +334,18 @@ class JobQueue:
             n += 1
         with self._lock:
             for jid in state.completed:
-                self._completed.setdefault(jid, 0.0)
-            self._failed |= state.failed
+                self._state.mark_completed(jid)
+                self._completed_ids.add(jid)
+            for jid in state.failed:
+                self._state.mark_failed(jid)
             # Register terminal jobs' (slim) records too: a late duplicate
             # completion arriving after a restart must be answered as an
             # idempotent "dup", not "unknown".
             for jid, rec in state.jobs.items():
                 if jid not in self._records:
-                    self._records[jid] = JobRecord.from_journal(rec)
+                    r = JobRecord.from_journal(rec)
+                    self._records[jid] = r
+                    self._state.register(jid, float(r.combos))
         self.known_paths |= {rec["path"] for rec in state.jobs.values()
                              if rec.get("path")}
         self.known_pairings.update(
@@ -262,59 +359,56 @@ class JobQueue:
     def take(self, n: int, worker_id: str) -> list[tuple[JobRecord, bytes]]:
         """Pop up to ``n`` jobs, lease them to ``worker_id``, return payloads."""
         out: list[tuple[JobRecord, bytes]] = []
-        now = time.monotonic()
         while len(out) < n:
             with self._lock:
-                jid = self._pending.popleft()
+                jid = self._state.take_begin()
                 if jid is None:
                     break
-                if jid in self._tombstones:     # completed while pending
-                    self._tombstones.discard(jid)
-                    continue
                 rec = self._records[jid]
-            payload = rec.ohlcv
+                self._in_take += 1
             try:
-                if payload is None:
-                    if rec.path is None:
-                        raise ValueError("job has neither payload nor path")
-                    payload = _read_payload(rec.path)
-                if rec.ohlcv2 is None and rec.path2 is not None:
-                    # File-backed second leg (pairs --data2): materialize
-                    # at dispatch time like leg 1, onto a COPY handed to
-                    # the caller — the stored record stays slim, and
-                    # RequestJobs reads rec.ohlcv2 either way.
-                    rec = dataclasses.replace(
-                        rec, ohlcv2=_read_payload(rec.path2))
-            except (OSError, ValueError) as e:
-                with self._lock:
-                    if self._discard_if_completed_locked(jid):
-                        continue
-                    self._failed.add(jid)
-                log.error("job %s: unreadable %s (%s) -> failed",
-                          jid, rec.path2 if payload is not None else rec.path,
-                          e)
-                self._journal.append("fail", id=jid, reason=str(e))
-                continue
-            with self._lock:
-                # The id left the FIFO at the top of the loop but is not
-                # leased yet; a completion landing in that unlocked window
-                # sees no lease and no FIFO entry and installs a tombstone
-                # for an id that will never be popped again. Re-check here:
-                # a job completed mid-take must be dropped (and its
-                # tombstone discarded), not leased and recomputed.
-                if self._discard_if_completed_locked(jid):
+                payload = rec.ohlcv
+                try:
+                    if payload is None:
+                        if rec.path is None:
+                            raise ValueError(
+                                "job has neither payload nor path")
+                        payload = _read_payload(rec.path)
+                    if rec.ohlcv2 is None and rec.path2 is not None:
+                        # File-backed second leg (pairs --data2):
+                        # materialize at dispatch time like leg 1, onto a
+                        # COPY handed to the caller — the stored record
+                        # stays slim, and RequestJobs reads rec.ohlcv2
+                        # either way.
+                        rec = dataclasses.replace(
+                            rec, ohlcv2=_read_payload(rec.path2))
+                except (OSError, ValueError) as e:
+                    with self._lock:
+                        # A job completed mid-take must count as completed,
+                        # not failed (state.fail re-checks under its lock).
+                        if not self._state.fail(jid):
+                            continue
+                    log.error(
+                        "job %s: unreadable %s (%s) -> failed", jid,
+                        rec.path2 if payload is not None else rec.path, e)
+                    self._journal.append("fail", id=jid, reason=str(e))
                     continue
-                self._leases[jid] = Lease(worker_id, now + self.lease_s)
-            out.append((rec, payload))
+                with self._lock:
+                    # The id left the FIFO at take_begin but is not leased
+                    # yet; a completion landing in that unlocked window
+                    # sees no lease and no FIFO entry and installs a
+                    # tombstone for an id that will never be popped again.
+                    # take_commit re-checks: a job completed mid-take is
+                    # dropped (and its tombstone discarded), not leased and
+                    # recomputed.
+                    if not self._state.take_commit(jid, worker_id,
+                                                   self.lease_s):
+                        continue
+                out.append((rec, payload))
+            finally:
+                with self._lock:
+                    self._in_take -= 1
         return out
-
-    def _discard_if_completed_locked(self, jid: str) -> bool:
-        """Under the lock: True if ``jid`` completed while take() held it
-        outside the lock; clears the orphan tombstone complete() installed."""
-        if jid in self._completed:
-            self._tombstones.discard(jid)
-            return True
-        return False
 
     def complete(self, jid: str, worker_id: str) -> str:
         """Record a completion (idempotent). Returns ``"new"`` for a first
@@ -331,68 +425,51 @@ class JobQueue:
         it is not dispatched again.
         """
         with self._lock:
-            if jid not in self._records:
-                return "unknown"
-            had_lease = self._leases.pop(jid, None) is not None
-            if jid in self._completed:
-                return "dup"
-            if (not had_lease and jid not in self._failed
-                    and jid not in self._tombstones):
-                # Rare path: completion for a job sitting in the pending
-                # FIFO (e.g. a completion RPC that straddled a lease expiry
-                # or restart). The FIFO has no interior removal; tombstone
-                # the id so take() skips it instead of re-dispatching.
-                self._tombstones.add(jid)
-            combos = float(self._records[jid].combos)
-            self._completed[jid] = combos
-            self._combos_done += combos
+            outcome = self._state.complete(jid)
+            if outcome != "new":
+                return outcome
+            self._completed_ids.add(jid)
         self._journal.append("complete", id=jid, worker=worker_id)
         return "new"
+
+    def completed_ids(self) -> set[str]:
+        """Snapshot of completed job ids (restored + this run's)."""
+        with self._lock:
+            return set(self._completed_ids)
 
     # -- recovery ----------------------------------------------------------
 
     def requeue_expired(self) -> list[str]:
         """Re-queue jobs whose lease deadline passed (front of the queue)."""
-        now = time.monotonic()
         with self._lock:
-            expired = [jid for jid, l in self._leases.items()
-                       if l.deadline <= now]
-            for jid in expired:
-                del self._leases[jid]
-                self._pending.appendleft(jid)
-            self._requeued += len(expired)
-        return expired
+            return self._state.requeue_expired()
 
     def requeue_worker(self, worker_id: str) -> list[str]:
         """Re-queue every job leased to a (pruned) worker."""
         with self._lock:
-            held = [jid for jid, l in self._leases.items()
-                    if l.worker_id == worker_id]
-            for jid in held:
-                del self._leases[jid]
-                self._pending.appendleft(jid)
-            self._requeued += len(held)
-        return held
+            return self._state.requeue_worker(worker_id)
 
     # -- observability -----------------------------------------------------
 
     def stats(self) -> dict:
         with self._lock:
+            s = self._state.stats()
             elapsed = max(time.monotonic() - self._t0, 1e-9)
             return {
-                "jobs_pending": len(self._pending) - len(self._tombstones),
-                "jobs_leased": len(self._leases),
-                "jobs_completed": len(self._completed),
-                "jobs_requeued": self._requeued,
-                "jobs_failed": len(self._failed),
-                "backtests_per_sec": self._combos_done / elapsed,
+                "jobs_pending": s["pending"],
+                "jobs_leased": s["leased"],
+                "jobs_completed": s["completed"],
+                "jobs_requeued": s["requeued"],
+                "jobs_failed": s["failed"],
+                "backtests_per_sec": s["combos_done"] / elapsed,
             }
 
     @property
     def drained(self) -> bool:
         with self._lock:
-            live_pending = len(self._pending) - len(self._tombstones)
-            return live_pending == 0 and not self._leases
+            # _in_take covers jobs popped but not yet leased/failed (payload
+            # read in flight): drained must not flicker True in that window.
+            return self._in_take == 0 and self._state.drained()
 
 
 def _read_payload(path: str) -> bytes:
@@ -599,7 +676,8 @@ class Dispatcher(service.DispatcherServicer):
 
     def GetStats(self, request: pb.StatsRequest, context) -> pb.StatsReply:
         s = self.queue.stats()
-        return pb.StatsReply(workers_alive=self.peers.alive(), **{
+        return pb.StatsReply(workers_alive=self.peers.alive(),
+                             substrate=self.queue.substrate, **{
             k: (int(v) if k != "backtests_per_sec" else v)
             for k, v in s.items()})
 

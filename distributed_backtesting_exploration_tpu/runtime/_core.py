@@ -76,6 +76,17 @@ def _build() -> bool:
 _PRUNED_CB = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p)
 
 
+class _JobqStats(ctypes.Structure):
+    _fields_ = [
+        ("pending", ctypes.c_int64),
+        ("leased", ctypes.c_int64),
+        ("completed", ctypes.c_int64),
+        ("requeued", ctypes.c_int64),
+        ("failed", ctypes.c_int64),
+        ("combos_done", ctypes.c_double),
+    ]
+
+
 def _stale() -> bool:
     """True when the .so is missing or older than any cpp/ source file."""
     if not os.path.exists(_LIB_PATH):
@@ -126,6 +137,35 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dbx_queue_size.restype = ctypes.c_size_t
     lib.dbx_queue_size.argtypes = [ctypes.c_void_p]
     lib.dbx_queue_free.argtypes = [ctypes.c_void_p]
+    lib.dbx_jobq_new.restype = ctypes.c_void_p
+    lib.dbx_jobq_new.argtypes = []
+    lib.dbx_jobq_free.argtypes = [ctypes.c_void_p]
+    lib.dbx_jobq_register.restype = ctypes.c_int
+    lib.dbx_jobq_register.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_double]
+    lib.dbx_jobq_push_pending.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.dbx_jobq_mark_completed.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.dbx_jobq_mark_failed.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.dbx_jobq_take_begin.restype = ctypes.c_int
+    lib.dbx_jobq_take_begin.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+    lib.dbx_jobq_take_commit.restype = ctypes.c_int
+    lib.dbx_jobq_take_commit.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64]
+    lib.dbx_jobq_fail.restype = ctypes.c_int
+    lib.dbx_jobq_fail.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.dbx_jobq_complete.restype = ctypes.c_int
+    lib.dbx_jobq_complete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.dbx_jobq_requeue_expired.restype = ctypes.c_int
+    lib.dbx_jobq_requeue_expired.argtypes = [
+        ctypes.c_void_p, _PRUNED_CB, ctypes.c_void_p]
+    lib.dbx_jobq_requeue_worker.restype = ctypes.c_int
+    lib.dbx_jobq_requeue_worker.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, _PRUNED_CB, ctypes.c_void_p]
+    lib.dbx_jobq_stats.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(_JobqStats)]
+    lib.dbx_jobq_drained.restype = ctypes.c_int
+    lib.dbx_jobq_drained.argtypes = [ctypes.c_void_p]
     lib.dbx_registry_new.restype = ctypes.c_void_p
     lib.dbx_registry_new.argtypes = [ctypes.c_int64]
     lib.dbx_registry_touch.restype = ctypes.c_int
@@ -271,6 +311,101 @@ class NativeQueue:
             # responsible for joining consumers before dropping the queue.
             self._lib.dbx_queue_close(h)
             self._lib.dbx_queue_free(h)
+            self._h = None
+
+
+class NativeJobQueue:
+    """The dispatcher's lease/tombstone/completion state machine, native.
+
+    Owns the id-state hot path (pending FIFO, tombstone skip, lease table,
+    completion idempotency, expiry/prune requeue) behind the C ABI in
+    ``cpp/dbx_core.h``; callers keep the full job records (grids, payload
+    paths) in Python keyed by the same ids. Method contracts mirror
+    ``rpc/dispatcher.py``'s pure-Python fallback exactly — the parity tests
+    in ``tests/test_rpc_unit.py`` run both substrates through the same
+    scenarios. (The reference's whole dispatcher state is native, reference
+    ``src/server/main.rs:20-190``; a C++ gRPC *server* is infeasible in this
+    environment, so serving stays in Python and the state machine is the
+    part that goes native.)
+    """
+
+    _ID_BUF = 512   # DBX_JOBQ_MAX_ID + NUL
+
+    def __init__(self):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native core not available")
+        self._lib = lib
+        self._h = lib.dbx_jobq_new()
+
+    def register(self, jid: str, combos: float) -> None:
+        if self._lib.dbx_jobq_register(self._h, jid.encode(),
+                                       float(combos)) != 0:
+            raise ValueError(f"job id exceeds {self._ID_BUF - 1} bytes")
+
+    def push_pending(self, jid: str) -> None:
+        self._lib.dbx_jobq_push_pending(self._h, jid.encode())
+
+    def mark_completed(self, jid: str) -> None:
+        self._lib.dbx_jobq_mark_completed(self._h, jid.encode())
+
+    def mark_failed(self, jid: str) -> None:
+        self._lib.dbx_jobq_mark_failed(self._h, jid.encode())
+
+    def take_begin(self) -> str | None:
+        buf = ctypes.create_string_buffer(self._ID_BUF)
+        rc = self._lib.dbx_jobq_take_begin(self._h, buf, len(buf))
+        if rc == 0:
+            return None
+        if rc < 0:   # unreachable with this buffer; ids cap at register
+            raise RuntimeError("take_begin buffer smaller than next job id")
+        return buf.value.decode()
+
+    def take_commit(self, jid: str, worker_id: str, lease_s: float) -> bool:
+        """False when the job completed in the take window (not leased)."""
+        return self._lib.dbx_jobq_take_commit(
+            self._h, jid.encode(), worker_id.encode(),
+            int(lease_s * 1000)) == 0
+
+    def fail(self, jid: str) -> bool:
+        """False when the job completed in the take window (not failed)."""
+        return self._lib.dbx_jobq_fail(self._h, jid.encode()) == 0
+
+    def complete(self, jid: str) -> str:
+        rc = self._lib.dbx_jobq_complete(self._h, jid.encode())
+        return ("new", "dup", "unknown")[rc]
+
+    def _requeue(self, call, *args) -> list[str]:
+        hit: list[str] = []
+
+        @_PRUNED_CB
+        def collect(jid, _ctx):
+            hit.append(jid.decode())
+
+        call(self._h, *args, collect, None)
+        return hit
+
+    def requeue_expired(self) -> list[str]:
+        return self._requeue(self._lib.dbx_jobq_requeue_expired)
+
+    def requeue_worker(self, worker_id: str) -> list[str]:
+        return self._requeue(self._lib.dbx_jobq_requeue_worker,
+                             worker_id.encode())
+
+    def stats(self) -> dict:
+        s = _JobqStats()
+        self._lib.dbx_jobq_stats(self._h, ctypes.byref(s))
+        return {"pending": int(s.pending), "leased": int(s.leased),
+                "completed": int(s.completed), "requeued": int(s.requeued),
+                "failed": int(s.failed), "combos_done": float(s.combos_done)}
+
+    def drained(self) -> bool:
+        return self._lib.dbx_jobq_drained(self._h) == 1
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.dbx_jobq_free(h)
             self._h = None
 
 

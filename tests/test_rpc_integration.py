@@ -708,7 +708,7 @@ def test_chaos_soak_exactly_once(tmp_path):
     # Exactly once: pre-crash completions + post-crash completions cover
     # every job id with no overlap and no loss.
     assert already + queue2.stats()["jobs_completed"] == n_jobs
-    post = set(queue2._completed)
+    post = queue2.completed_ids()
     assert set(state.completed).isdisjoint(post)
     assert set(state.completed) | post == {r.id for r in recs}
 

@@ -23,9 +23,32 @@ def _mk_jobs(n, **kw):
                       ohlcv=b"payload", **kw) for i in range(n)]
 
 
-def test_take_n_semantics():
+@pytest.fixture(params=["native", "python"])
+def qfactory(request):
+    """JobQueue factory parameterized over the state-machine substrate.
+
+    The native C++ DbxJobQueue and the pure-Python fallback must be
+    behaviorally identical — every queue lifecycle test below runs against
+    BOTH (the contract in cpp/dbx_core.h is "mirrors the Python fallback
+    byte for byte")."""
+    use_native = request.param == "native"
+    if use_native:
+        from distributed_backtesting_exploration_tpu.runtime import _core
+        if not _core.available():
+            pytest.skip("native core not available")
+
+    def make(*args, **kw):
+        kw.setdefault("use_native", use_native)
+        q = JobQueue(*args, **kw)
+        assert q.substrate == request.param
+        return q
+
+    return make
+
+
+def test_take_n_semantics(qfactory):
     """Ask for n, get exactly min(n, len) — the reference handed out len-n."""
-    q = JobQueue()
+    q = qfactory()
     for r in _mk_jobs(5):
         q.enqueue(r)
     got = q.take(3, "w1")
@@ -35,8 +58,8 @@ def test_take_n_semantics():
     assert q.take(1, "w1") == []          # empty -> empty, not an error
 
 
-def test_lease_expiry_requeues_front():
-    q = JobQueue(lease_s=0.0)             # leases expire immediately
+def test_lease_expiry_requeues_front(qfactory):
+    q = qfactory(lease_s=0.0)             # leases expire immediately
     for r in _mk_jobs(2):
         q.enqueue(r)
     q.take(1, "w1")
@@ -45,8 +68,8 @@ def test_lease_expiry_requeues_front():
     assert [r.id for r, _ in got] == ["j0", "j1"]   # requeued at the front
 
 
-def test_requeue_worker_on_prune():
-    q = JobQueue(lease_s=60.0)
+def test_requeue_worker_on_prune(qfactory):
+    q = qfactory(lease_s=60.0)
     for r in _mk_jobs(3):
         q.enqueue(r)
     q.take(2, "w1")
@@ -57,8 +80,8 @@ def test_requeue_worker_on_prune():
     assert s["jobs_requeued"] == 2
 
 
-def test_complete_idempotent_and_unknown():
-    q = JobQueue()
+def test_complete_idempotent_and_unknown(qfactory):
+    q = qfactory()
     for r in _mk_jobs(1):
         q.enqueue(r)
     q.take(1, "w1")
@@ -69,9 +92,9 @@ def test_complete_idempotent_and_unknown():
     assert q.drained
 
 
-def test_unreadable_file_marked_failed(tmp_path):
+def test_unreadable_file_marked_failed(tmp_path, qfactory):
     jpath = str(tmp_path / "journal.jsonl")
-    q = JobQueue(Journal(jpath))
+    q = qfactory(Journal(jpath))
     q.enqueue(JobRecord(id="bad", strategy="s", grid={},
                         path=str(tmp_path / "missing.csv")))
     q.enqueue(_mk_jobs(1)[0])
@@ -82,14 +105,14 @@ def test_unreadable_file_marked_failed(tmp_path):
     assert state.failed == {"bad"}
 
 
-def test_journal_replay_roundtrip(tmp_path):
+def test_journal_replay_roundtrip(tmp_path, qfactory):
     from distributed_backtesting_exploration_tpu.utils import data
     csv_path = tmp_path / "t.csv"
     series = data.synthetic_ohlcv(1, 16, seed=0)
     csv_path.write_bytes(
         data.to_csv_bytes(type(series)(*(f[0] for f in series))))
     jpath = str(tmp_path / "journal.jsonl")
-    q = JobQueue(Journal(jpath))
+    q = qfactory(Journal(jpath))
     for r in _mk_jobs(3, path=None):
         r.ohlcv = None
         r.path = str(csv_path)
@@ -97,7 +120,7 @@ def test_journal_replay_roundtrip(tmp_path):
     q.take(3, "w1")
     q.complete("j1", "w1")
 
-    q2 = JobQueue()
+    q2 = qfactory()
     restored = q2.restore(jpath)
     assert restored == 2                      # j0, j2 pending again
     ids = {r.id for r, _ in q2.take(5, "w2")}
@@ -173,10 +196,10 @@ def test_synthetic_jobs_decode():
     assert series.n_bars == 64
 
 
-def test_late_completion_of_pending_job_removes_it():
+def test_late_completion_of_pending_job_removes_it(qfactory):
     """A completion racing a requeue (dispatcher restart / expired lease)
     must remove the job from pending and clear any fresh lease."""
-    q = JobQueue(lease_s=60.0)
+    q = qfactory(lease_s=60.0)
     for r in _mk_jobs(2):
         q.enqueue(r)
     # j0 completed while still pending (late RPC after a restart replay):
@@ -189,20 +212,20 @@ def test_late_completion_of_pending_job_removes_it():
     assert q.drained
 
 
-def test_inline_job_survives_journal_restart(tmp_path):
+def test_inline_job_survives_journal_restart(tmp_path, qfactory):
     """Synthetic (inline-payload) jobs must be dispatchable after replay."""
     jpath = str(tmp_path / "journal.jsonl")
-    q = JobQueue(Journal(jpath))
+    q = qfactory(Journal(jpath))
     rec = synthetic_jobs(1, 32, "sma_crossover", parse_grid("fast=3:5"))[0]
     q.enqueue(rec)
-    q2 = JobQueue()
+    q2 = qfactory()
     assert q2.restore(jpath) == 1
     got = q2.take(1, "w")
     assert len(got) == 1 and got[0][1] == rec.ohlcv
 
 
-def test_job_with_no_source_fails_cleanly():
-    q = JobQueue()
+def test_job_with_no_source_fails_cleanly(qfactory):
+    q = qfactory()
     q.enqueue(JobRecord(id="x", strategy="s", grid={}))
     assert q.take(1, "w") == []
     assert q.stats()["jobs_failed"] == 1
@@ -260,7 +283,8 @@ def _write_csv(path, n_bars=16, seed=0):
     path.write_bytes(data.to_csv_bytes(type(s)(*(f[0] for f in s))))
 
 
-def test_complete_during_take_window_no_tombstone_leak(tmp_path, monkeypatch):
+def test_complete_during_take_window_no_tombstone_leak(tmp_path, monkeypatch,
+                                                       qfactory):
     """ADVICE r2 (medium): a completion landing between take()'s FIFO pop
     and lease creation installed a permanent tombstone, after which
     jobs_pending under-counted and drained never flipped True."""
@@ -269,7 +293,7 @@ def test_complete_during_take_window_no_tombstone_leak(tmp_path, monkeypatch):
 
     csv_path = tmp_path / "t.csv"
     _write_csv(csv_path)
-    q = disp.JobQueue()
+    q = qfactory()
     q.enqueue(disp.JobRecord(id="j0", strategy="s", grid={},
                              path=str(csv_path)))
     orig = disp._read_payload
@@ -288,13 +312,14 @@ def test_complete_during_take_window_no_tombstone_leak(tmp_path, monkeypatch):
     assert q.drained                      # used to hang at live_pending == -1
 
 
-def test_complete_during_failed_read_not_marked_failed(tmp_path, monkeypatch):
+def test_complete_during_failed_read_not_marked_failed(tmp_path, monkeypatch,
+                                                       qfactory):
     """Same window, but the payload read fails: a job completed mid-take
     must count as completed, not failed."""
     from distributed_backtesting_exploration_tpu.rpc import (
         dispatcher as disp)
 
-    q = disp.JobQueue()
+    q = qfactory()
     q.enqueue(disp.JobRecord(id="j0", strategy="s", grid={},
                              path=str(tmp_path / "gone.csv")))
 
@@ -464,7 +489,7 @@ def test_native_substrate_live_by_default():
     """VERDICT r1: the C++ queue/registry must back the LIVE paths, not just
     tests. Default construction uses the native substrate when available."""
     from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
-        JobQueue, PeerRegistry, _PendingIds)
+        JobQueue, PeerRegistry)
     from distributed_backtesting_exploration_tpu.rpc.worker import Worker
     from distributed_backtesting_exploration_tpu.rpc import compute
     from distributed_backtesting_exploration_tpu.runtime import _core
@@ -476,12 +501,17 @@ def test_native_substrate_live_by_default():
     w = Worker("localhost:1", compute.InstantBackend())
     assert w._in.backend == "native" and w._out.backend == "native"
 
-    # Both _PendingIds backends behave identically (FIFO + front-requeue).
-    for backend in (True, False):
-        p = _PendingIds(use_native=backend)
-        p.append("a"); p.append("b"); p.appendleft("front")
-        assert [p.popleft(), p.popleft(), p.popleft()] == ["front", "a", "b"]
-        assert p.popleft() is None and len(p) == 0
+
+def test_oversized_job_id_rejected_at_intake(qfactory):
+    """Ids beyond the native substrate's 511-byte cap are rejected at
+    enqueue on BOTH substrates — behavior must not diverge at the edge
+    (and a half-registered record must not strand in _records)."""
+    q = qfactory()
+    big = JobRecord(id="x" * 600, strategy="s", grid={}, ohlcv=b"p")
+    with pytest.raises(ValueError, match="511 bytes"):
+        q.enqueue(big)
+    assert q.stats()["jobs_pending"] == 0
+    assert q.complete(big.id, "w") == "unknown"   # nothing half-registered
 
 
 def test_journal_compaction_preserves_live_state(tmp_path):
