@@ -26,11 +26,23 @@ The band-hysteresis machine — the stateful core of Bollinger/RSI/VWAP/pairs
 (``ops.signals.band_transition_maps``), so a block composes into one
 3-vector summary, the block summaries fold across chips like the linear
 scan's carries, and a local fixup applies each block's incoming state
-(:func:`sharded_band_positions`). Only a *general* non-associative state
-machine (arbitrary ``backtest_scan`` bodies) cannot shard; long histories
-there use :func:`chunked_scan` (sequential over chunks, carry threaded on
-one chip), which bounds peak memory instead. This mirrors SURVEY.md §5's
-call: blockwise scan with carried state, not attention-style ring exchange.
+(:func:`sharded_band_positions`). The Donchian breakout latch is the same
+shape of machine, so it shards through the identical fold
+(:func:`_transition_positions_local`).
+
+Rolling-extrema state (Donchian channels, stochastic %K) is the fourth
+and last state shape: rolling max/min have no cumsum form, but the
+reduction never spans more than ``window`` bars, so a bounded halo
+(``ppermute`` of the left neighbor's last ``window`` bars) plus a local
+sliding ``reduce_window`` reproduces the trailing extrema exactly — no
+carry fixup at all (:func:`sharded_donchian_backtest`,
+:func:`sharded_stochastic_backtest`).
+
+Only a *general* non-associative state machine (arbitrary
+``backtest_scan`` bodies) cannot shard; long histories there use
+:func:`chunked_scan` (sequential over chunks, carry threaded on one chip),
+which bounds peak memory instead. This mirrors SURVEY.md §5's call:
+blockwise scan with carried state, not attention-style ring exchange.
 """
 
 from __future__ import annotations
@@ -307,19 +319,21 @@ def _windowed_zscore_local(series_blk, gidx, window: int, halo_w: int,
     return (series_blk - ssum / w_f) / (jnp.sqrt(var) + eps)
 
 
-def _band_positions_local(z_blk, valid_blk, z_entry, z_exit, axis_name: str):
-    """Band-hysteresis positions for one time block, exact across blocks.
+def _transition_positions_local(maps, axis_name: str):
+    """Position path of ANY {-1,0,+1} transition-map machine, one time
+    block, exact across blocks.
 
-    The machine's per-bar update is a {-1,0,+1} -> {-1,0,+1} map
-    (``ops.signals.band_transition_maps``), so the block's prefix maps come
-    from a local ``associative_scan``, the whole block composes into one
-    3-vector summary, and the state *entering* this block is the exclusive
-    left-fold of block summaries over ICI (same carry pattern as
-    :func:`sharded_linear_scan` — one 3-vector per chip crosses the wire).
-    The fixup routes each bar's prefix map through the incoming state."""
+    The block's prefix maps come from a local ``associative_scan``, the
+    whole block composes into one 3-vector summary, and the state
+    *entering* this block is the exclusive left-fold of block summaries
+    over ICI (same carry pattern as :func:`sharded_linear_scan` — one
+    3-vector per chip crosses the wire). The fixup routes each bar's
+    prefix map through the incoming state. Shared by the band-hysteresis
+    machine (Bollinger/RSI/pairs/stochastic) and the Donchian breakout
+    latch — any stateful strategy whose per-bar update is a map on the
+    3-state space shards through here."""
     from ..ops import signals
 
-    maps = signals.band_transition_maps(z_blk, valid_blk, z_entry, z_exit)
     pm, p0, pp = jax.lax.associative_scan(
         lambda a, b: signals._compose_maps(a, b), maps, axis=-1)
 
@@ -337,6 +351,42 @@ def _band_positions_local(z_blk, valid_blk, z_entry, z_exit, axis_name: str):
         state = jnp.where(j < idx, nxt, state)
     state = state[..., None]
     return jnp.where(state < 0, pm, jnp.where(state > 0, pp, p0))
+
+
+def _band_positions_local(z_blk, valid_blk, z_entry, z_exit, axis_name: str):
+    """Band-hysteresis positions for one time block, exact across blocks
+    (``ops.signals.band_transition_maps`` composed through
+    :func:`_transition_positions_local`)."""
+    from ..ops import signals
+
+    maps = signals.band_transition_maps(z_blk, valid_blk, z_entry, z_exit)
+    return _transition_positions_local(maps, axis_name)
+
+
+def _latch_maps(up, down, valid):
+    """Per-bar transition maps of the Donchian breakout latch
+    (``models.donchian._latch``'s step): break above the prior channel
+    high -> +1 from any state, below the prior low -> -1, else hold;
+    invalid bars force flat. ``up`` wins over ``down`` (a bar clearing
+    both channels goes long), exactly as the scan's nested ``where``."""
+    def nxt_from(prev):
+        return jnp.where(up, 1.0, jnp.where(down, -1.0, prev))
+
+    one = jnp.ones(up.shape, jnp.float32)
+    zero = jnp.zeros_like(one)
+    v = jnp.broadcast_to(valid, up.shape)
+    return (jnp.where(v, nxt_from(-one), zero),
+            jnp.where(v, nxt_from(zero), zero),
+            jnp.where(v, nxt_from(one), zero))
+
+
+def _reduce_window_last(x, w: int, mode: str):
+    """Sliding extrema over the last axis: ``out[..., j] = mode(x[..., j:j+w])``
+    (VALID — output length ``x.shape[-1] - w + 1``)."""
+    init = -jnp.inf if mode == "max" else jnp.inf
+    comp = jax.lax.max if mode == "max" else jax.lax.min
+    dims = (1,) * (x.ndim - 1) + (w,)
+    return jax.lax.reduce_window(x, init, comp, dims, (1,) * x.ndim, "VALID")
 
 
 def sharded_band_positions(mesh: Mesh, z, valid, z_entry, z_exit=0.0, *,
@@ -650,3 +700,205 @@ def sharded_pairs_backtest(mesh: Mesh, y_close, x_close, lookback: int,
     return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec),
                          out_specs=out_specs, check_vma=False)(
         y_close, x_close)
+
+
+def _check_time_axis(T: int, n_dev: int, window: int, axis_name: str,
+                     what: str):
+    if T % n_dev:
+        raise ValueError(
+            f"T={T} not divisible by the {n_dev}-way {axis_name!r} axis")
+    if window > T // n_dev:
+        raise ValueError(
+            f"{what}={window} exceeds the {T // n_dev}-bar block; the halo "
+            "exchange needs the window to fit one neighbor block")
+
+
+def _donchian_metrics_local(latch_src, hi_src, lo_src, gidx, window: int,
+                            T: int, *, cost: float, periods_per_year: int,
+                            axis_name: str):
+    """Shared blockwise body of both Donchian variants: ONE stacked
+    ``window``-bar halo exchange serves the returns' lagged close and both
+    prior-channel extrema (collectives are latency-bound — same
+    one-collective discipline as the z-score/pairs paths). The prior
+    channel at bar t reduces bars ``t-window .. t-1``; the breakout latch
+    is a 3-state transition-map machine, so it composes across chips
+    exactly like the band machine."""
+    w = window
+    stacked = (latch_src if hi_src is latch_src
+               else jnp.stack([latch_src, hi_src, lo_src]))
+    ext = jnp.concatenate([_from_left(stacked, w, axis_name), stacked],
+                          axis=-1)
+    if hi_src is latch_src:
+        close_ext, hi_ext, lo_ext = ext, ext, ext
+        close_blk = latch_src
+    else:
+        close_ext, hi_ext, lo_ext = ext[0], ext[1], ext[2]
+        close_blk = latch_src
+    Tb = close_blk.shape[-1]
+
+    prev_close = jax.lax.slice_in_dim(close_ext, w - 1, w - 1 + Tb, axis=-1)
+    r = jnp.where(gidx == 0, 0.0,
+                  close_blk / jnp.where(gidx == 0, 1.0, prev_close) - 1.0)
+
+    # hi_prev[t] = max(src[t-w .. t-1]): the w-window starting at local i
+    # of the w-halo'd series. Warmup values are garbage on chip 0 (zero
+    # halo) — masked by `valid` below, exactly like the unsharded fill.
+    hi_prev = jax.lax.slice_in_dim(
+        _reduce_window_last(hi_ext, w, "max"), 0, Tb, axis=-1)
+    lo_prev = jax.lax.slice_in_dim(
+        _reduce_window_last(lo_ext, w, "min"), 0, Tb, axis=-1)
+
+    valid = gidx >= w            # rolling.valid_mask(T, w + 1)
+    up = close_blk >= hi_prev
+    down = close_blk <= lo_prev
+    pos = _transition_positions_local(_latch_maps(up, down, valid),
+                                      axis_name)
+    return _pnl_metrics_local(pos, r, gidx, T, cost=cost,
+                              periods_per_year=periods_per_year,
+                              axis_name=axis_name)
+
+
+def sharded_donchian_backtest(mesh: Mesh, close, window: int, *,
+                              cost: float = 0.0, periods_per_year: int = 252,
+                              axis_name: str = TIME_AXIS):
+    """End-to-end Donchian-channel breakout backtest, TIME axis sharded.
+
+    The *rolling-extrema-state* long-context composition — the fourth and
+    last state shape (after windowed-sum, EMA, and band-machine states):
+    rolling max/min have no cumsum form, so the channel extrema come from
+    a bounded halo instead of a distributed prefix sum — each bar's
+    ``window``-bar channel reaches at most ``window`` bars into the left
+    neighbor's block, so ONE stacked ``ppermute`` plus a local sliding
+    ``reduce_window`` reproduces the trailing extrema exactly (extrema
+    need no carry fixup at all: unlike a cumsum the reduction never spans
+    more than ``window`` bars). The breakout latch (hold until the
+    opposite channel is touched) is a {-1,0,+1} transition-map machine —
+    ``models.donchian._latch``'s scan — so it composes across chips
+    through the same 3-vector summary fold as the band machine
+    (:func:`_transition_positions_local`). Semantics match
+    ``models.donchian`` (channel at bar t uses bars ``t-window..t-1``,
+    ties break long, warmup flat, valid from ``window`` bars).
+
+    ``window`` is a static int with ``window <= block length`` (halo
+    bound). Returns scalar-per-series :class:`~..ops.metrics.Metrics`,
+    replicated. Matches the single-device computation to f32 tolerance.
+    """
+    from ..ops.metrics import Metrics
+
+    n_dev = mesh.shape[axis_name]
+    T = close.shape[-1]
+    _check_time_axis(T, n_dev, window, axis_name, "window")
+    spec = P(*((None,) * (close.ndim - 1) + (axis_name,)))
+    rep = P(*((None,) * (close.ndim - 1)))
+
+    def local(close_blk):
+        Tb = close_blk.shape[-1]
+        gidx = jnp.arange(Tb) + jax.lax.axis_index(axis_name) * Tb
+        return _donchian_metrics_local(
+            close_blk, close_blk, close_blk, gidx, window, T, cost=cost,
+            periods_per_year=periods_per_year, axis_name=axis_name)
+
+    out_specs = Metrics(*(rep for _ in Metrics._fields))
+    return jax.shard_map(local, mesh=mesh, in_specs=spec,
+                         out_specs=out_specs, check_vma=False)(close)
+
+
+def sharded_donchian_hl_backtest(mesh: Mesh, close, high, low, window: int,
+                                 *, cost: float = 0.0,
+                                 periods_per_year: int = 252,
+                                 axis_name: str = TIME_AXIS):
+    """Classic high/low-channel Donchian breakout, TIME axis sharded.
+
+    Same composition as :func:`sharded_donchian_backtest` with the
+    channels built from the HIGH/LOW columns (``models.donchian``'s
+    ``donchian_hl``); the three series share ONE stacked halo exchange.
+    """
+    from ..ops.metrics import Metrics
+
+    n_dev = mesh.shape[axis_name]
+    T = close.shape[-1]
+    _check_time_axis(T, n_dev, window, axis_name, "window")
+    spec = P(*((None,) * (close.ndim - 1) + (axis_name,)))
+    rep = P(*((None,) * (close.ndim - 1)))
+
+    def local(close_blk, high_blk, low_blk):
+        Tb = close_blk.shape[-1]
+        gidx = jnp.arange(Tb) + jax.lax.axis_index(axis_name) * Tb
+        return _donchian_metrics_local(
+            close_blk, high_blk, low_blk, gidx, window, T, cost=cost,
+            periods_per_year=periods_per_year, axis_name=axis_name)
+
+    out_specs = Metrics(*(rep for _ in Metrics._fields))
+    return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=out_specs, check_vma=False)(
+        close, high, low)
+
+
+def sharded_stochastic_backtest(mesh: Mesh, close, high, low, window: int,
+                                band: float, *, cost: float = 0.0,
+                                periods_per_year: int = 252,
+                                axis_name: str = TIME_AXIS):
+    """End-to-end stochastic-%K mean-reversion backtest, TIME axis sharded.
+
+    Rolling-extrema state feeding the band machine: the trailing
+    ``window``-bar high/low channel comes from the bounded-halo sliding
+    ``reduce_window`` (window ends AT bar t here — lag 0, vs the Donchian
+    channel's lag 1), %K centers it, and the exactly-sharded band machine
+    plus the shared PnL tail finish the composition. Semantics match
+    ``models.stochastic`` (flat channel -> neutral 50, valid from
+    ``window - 1`` bars, enter long below ``50 - band``, exit at 50).
+
+    ``window`` is a static int with ``window <= block length``. Returns
+    scalar-per-series :class:`~..ops.metrics.Metrics`, replicated.
+    """
+    from ..ops.metrics import Metrics
+
+    eps = 1e-12
+    n_dev = mesh.shape[axis_name]
+    T = close.shape[-1]
+    _check_time_axis(T, n_dev, window, axis_name, "window")
+    halo = max(window - 1, 1)    # extrema need w-1 left bars; returns need 1
+    spec = P(*((None,) * (close.ndim - 1) + (axis_name,)))
+    rep = P(*((None,) * (close.ndim - 1)))
+
+    def local(close_blk, high_blk, low_blk):
+        Tb = close_blk.shape[-1]
+        gidx = jnp.arange(Tb) + jax.lax.axis_index(axis_name) * Tb
+
+        # ONE stacked halo exchange serves the lagged close and both
+        # channel extrema.
+        stacked = jnp.stack([close_blk, high_blk, low_blk])
+        ext = jnp.concatenate([_from_left(stacked, halo, axis_name),
+                               stacked], axis=-1)
+        prev_close = jax.lax.slice_in_dim(ext[0], halo - 1, halo - 1 + Tb,
+                                          axis=-1)
+        r = jnp.where(gidx == 0, 0.0,
+                      close_blk / jnp.where(gidx == 0, 1.0, prev_close)
+                      - 1.0)
+
+        # hh[t] = max(high[t-w+1 .. t]): w-window ending at local i, i.e.
+        # starting at ext index i + halo - w + 1.
+        start = halo - window + 1
+        hh = jax.lax.slice_in_dim(
+            _reduce_window_last(ext[1], window, "max"), start, start + Tb,
+            axis=-1)
+        ll = jax.lax.slice_in_dim(
+            _reduce_window_last(ext[2], window, "min"), start, start + Tb,
+            axis=-1)
+        rng = hh - ll
+        k_pct = jnp.where(rng > eps, 100.0 * (close_blk - ll) / (rng + eps),
+                          50.0)
+
+        valid = gidx >= window - 1   # rolling.valid_mask(T, window)
+        pos = _band_positions_local(
+            jnp.where(valid, k_pct - 50.0, 0.0),
+            jnp.broadcast_to(valid, k_pct.shape), jnp.float32(band),
+            jnp.float32(0.0), axis_name)
+        return _pnl_metrics_local(pos, r, gidx, T, cost=cost,
+                                  periods_per_year=periods_per_year,
+                                  axis_name=axis_name)
+
+    out_specs = Metrics(*(rep for _ in Metrics._fields))
+    return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=out_specs, check_vma=False)(
+        close, high, low)

@@ -275,6 +275,94 @@ def test_sharded_pairs_backtest_matches_single_device(devices):
                                    err_msg=name)
 
 
+def _single_device_strategy_metrics(ohlcv, strat_name, params, *, cost=1e-3):
+    from distributed_backtesting_exploration_tpu.models import base
+    from distributed_backtesting_exploration_tpu.ops import (
+        metrics as metrics_mod, pnl)
+
+    strat = base.get_strategy(strat_name)
+    panel = type(ohlcv)(*(jnp.asarray(f) for f in ohlcv))
+    pos = jax.vmap(lambda o: strat.positions(
+        o, {k: jnp.float32(v) for k, v in params.items()}))(panel)
+    res = pnl.backtest_prefix(jnp.asarray(ohlcv.close), pos, cost=cost)
+    return metrics_mod.summary_metrics(res.returns, res.equity,
+                                       res.positions)
+
+
+def test_sharded_donchian_backtest_matches_single_device(devices):
+    """The rolling-extrema long-context composition (fourth state shape):
+    a full Donchian breakout backtest with the bar axis sharded over 8
+    chips matches the unsharded computation — channel extrema via bounded
+    halo + sliding reduce_window, the breakout latch via the 3-state
+    transition-map fold."""
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    mesh = Mesh(np.asarray(devices[:8]), (timeshard.TIME_AXIS,))
+    ohlcv = data.synthetic_ohlcv(3, 1024, seed=41)
+    close = jnp.asarray(ohlcv.close)
+    window = 20
+
+    got = timeshard.sharded_donchian_backtest(mesh, close, window, cost=1e-3)
+    want = _single_device_strategy_metrics(ohlcv, "donchian",
+                                           dict(window=window))
+    for name in want._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(got, name)), np.asarray(getattr(want, name)),
+            rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_sharded_donchian_hl_backtest_matches_single_device(devices):
+    """High/low-channel variant: the three OHLCV columns ride one stacked
+    halo exchange and must reproduce models.donchian_hl exactly."""
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    mesh = Mesh(np.asarray(devices[:8]), (timeshard.TIME_AXIS,))
+    ohlcv = data.synthetic_ohlcv(3, 1024, seed=43)
+    window = 24
+
+    got = timeshard.sharded_donchian_hl_backtest(
+        mesh, jnp.asarray(ohlcv.close), jnp.asarray(ohlcv.high),
+        jnp.asarray(ohlcv.low), window, cost=1e-3)
+    want = _single_device_strategy_metrics(ohlcv, "donchian_hl",
+                                           dict(window=window))
+    for name in want._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(got, name)), np.asarray(getattr(want, name)),
+            rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_sharded_stochastic_backtest_matches_single_device(devices):
+    """Rolling-extrema state feeding the band machine: the sharded %K
+    backtest matches models.stochastic on the unsharded path."""
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    mesh = Mesh(np.asarray(devices[:8]), (timeshard.TIME_AXIS,))
+    ohlcv = data.synthetic_ohlcv(3, 1024, seed=47)
+    window, band = 14, 20.0
+
+    got = timeshard.sharded_stochastic_backtest(
+        mesh, jnp.asarray(ohlcv.close), jnp.asarray(ohlcv.high),
+        jnp.asarray(ohlcv.low), window, band, cost=1e-3)
+    want = _single_device_strategy_metrics(
+        ohlcv, "stochastic", dict(window=window, band=band))
+    for name in want._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(got, name)), np.asarray(getattr(want, name)),
+            rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_sharded_extrema_backtests_reject_oversized_window(devices):
+    from distributed_backtesting_exploration_tpu.parallel import timeshard
+
+    mesh = Mesh(np.asarray(devices[:8]), (timeshard.TIME_AXIS,))
+    ones = jnp.ones((1, 256))
+    with pytest.raises(ValueError, match="halo"):
+        timeshard.sharded_donchian_backtest(mesh, ones, 100)
+    with pytest.raises(ValueError, match="halo"):
+        timeshard.sharded_stochastic_backtest(mesh, ones, ones, ones, 100,
+                                              20.0)
+
+
 def test_sharded_pairs_backtest_rejects_oversized_lookback(devices):
     from jax.sharding import Mesh
 
