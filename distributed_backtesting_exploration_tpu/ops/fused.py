@@ -755,74 +755,32 @@ def _fused_pairs_call(y_close, x_close, onehot_w, k_lanes, zx_lanes, warm,
 
     The tables follow ``rolling.rolling_ols`` / ``rolling.rolling_zscore``'s
     formulas (series-centered moments, eps=1e-12, warmup fill 0 so the warmup
-    spread is exactly ``y`` — ``models.pairs.pair_signals`` semantics). The
-    OLS moment sums ride the same cumsum-differencing closure as the generic
-    path; the z-score's per-(pair, window) sums are block-banded MXU tree
-    sums instead, so z matches the generic path to f32 tolerance, not
-    bit-level (see :func:`fused_pairs_sweep`).
+    spread is exactly ``y`` — ``models.pairs.pair_signals`` semantics). Both
+    the OLS moment sums and the z-score's per-(pair, window) sums ride the
+    same cumsum-differencing closures as the generic path
+    (:func:`_cumsum_window_tools`), so the whole signal prep rounds like the
+    reference algebra (see :func:`fused_pairs_sweep`).
     """
     N, T = y_close.shape
     y_p, x_p = _pad_last(y_close, T_pad), _pad_last(x_close, T_pad)
 
     # Tables are built (N, W, T_pad) — T on the minor axis — so HBM tiling
     # pads W to a sublane multiple (8) rather than a lane multiple (128).
-    w_col, w_f, t_row, windowed_sum, _ = _cumsum_window_tools(windows, T_pad)
-
-    # Windowed sums, two regimes — neither is cumsum-on-the-(N,W,T)-minor-
-    # axis (XLA lowers that to a serial scan, measured ~114 ms at the
-    # 1k-pair baseline) and neither is a gather (the VWAP kernel measured
-    # take_along_axis at 15x the cost of the sums it was reading):
-    #
-    # * Per-PAIR series (the OLS moments): ``_cumsum_window_tools``'s 2-D
-    #   closure — one cumsum over (N, T_pad) (XLA handles the 2-D
-    #   minor-axis case well; the SMA table prep rides the same op) plus a
-    #   clipped shifted read, replicating ``rolling.rolling_sum``'s exact
-    #   float op order, so beta/alpha track the generic path's rounding.
-    #
-    # * Per-(pair, window) series (the spread z-score, where each window
-    #   row is a different series): block-banded MXU tree sums. The full
-    #   (T, T) band matmul costs O(T^2) per row; but a window w <= 128
-    #   only ever couples bar u to tiles ⌈u/128⌉ and its predecessor, so
-    #   two (128, 128) per-window band blocks (in-tile D, cross-tile S)
-    #   replace it: out[.., t, :] = x[.., t, :] @ D + x[.., t-1, :] @ S —
-    #   a 5x FLOP cut at the baseline shape (measured 17.8 -> ~5 ms prep,
-    #   pairs 6.9 -> ~10 M backtests/s). Wider windows (> 128 bars) fall
-    #   back to the full band einsum.
-    n_tiles = T_pad // _LANES
-    max_w = int(max(windows))
-    if max_w <= _LANES:
-        s_ax = np.arange(_LANES)[None, :, None]              # source bar
-        u_ax = np.arange(_LANES)[None, None, :]              # output bar
-        w_np = np.asarray(windows, np.int64)[:, None, None]
-        D = jnp.asarray(((s_ax <= u_ax) & (s_ax > u_ax - w_np))
-                        .astype(np.float32))                 # (W,128,128)
-        S = jnp.asarray((s_ax > u_ax + _LANES - w_np)
-                        .astype(np.float32))                 # (W,128,128)
-
-        def windowed_sum3(series):                  # (N,W,T_pad) -> same
-            x = series.reshape(N, len(windows), n_tiles, _LANES)
-            prev = jnp.concatenate(
-                [jnp.zeros_like(x[:, :, :1]), x[:, :, :-1]], axis=2)
-            out = (jnp.einsum("nwts,wsu->nwtu", x, D,
-                              precision=jax.lax.Precision.HIGHEST,
-                              preferred_element_type=jnp.float32)
-                   + jnp.einsum("nwts,wsu->nwtu", prev, S,
-                                precision=jax.lax.Precision.HIGHEST,
-                                preferred_element_type=jnp.float32))
-            return out.reshape(N, len(windows), T_pad)
-    else:
-        # Built on-device: at long-history shapes a host-literal (W, T, T)
-        # band would bloat every retrace and the executable image.
-        s_full = jnp.arange(T_pad)[None, :, None]
-        u_full = jnp.arange(T_pad)[None, None, :]
-        w_dev = jnp.asarray(np.asarray(windows, np.int32))[:, None, None]
-        B = ((s_full > u_full - w_dev) & (s_full <= u_full)).astype(
-            jnp.float32)                                     # (W,T,T)
-
-        def windowed_sum3(series):                  # (N,W,T_pad) -> same
-            return jnp.einsum("nws,wsu->nwu", series, B,
-                              precision=jax.lax.Precision.HIGHEST,
-                              preferred_element_type=jnp.float32)
+    # BOTH the per-pair OLS moments and the per-(pair, window) z-score sums
+    # ride cumsum-difference closures that replicate
+    # ``rolling.rolling_sum``'s exact float op order (inclusive prefix sum
+    # minus a static shifted read). Selection-stability is why (round 4):
+    # the previous block-banded MXU tree sums evaluated the z windowed sums
+    # in a different summation order than the generic path's cumsum
+    # difference, and that rounding gap was the fleet's worst entry-flip
+    # rate (0.77% of cells, the only unstable best-param argmax in
+    # VERIFY_r03). Matching the op order collapses the disagreement to the
+    # same class as the other kernels; the 3-D minor-axis cumsum with
+    # static per-row shifts costs about the same as the two band einsums it
+    # replaces (A/B'd as full entry-point timings on the chip, see
+    # DESIGN.md).
+    w_col, w_f, t_row, windowed_sum, windowed_sum3 = _cumsum_window_tools(
+        windows, T_pad)
 
     # Rolling OLS of y on x per distinct lookback (closed form from windowed
     # moments; centering with the real-bar means kills f32 cancellation —
@@ -920,18 +878,16 @@ def fused_pairs_sweep(y_close, x_close, lookback, z_entry, *, t_real=None,
     ``lookback``/``z_entry`` are flat per-combo arrays (:func:`product_grid`
     order); ``z_exit`` may be a scalar or a per-combo array. Lookbacks are bar
     counts and must be integral. Matches :func:`~..models.pairs.run_pairs_sweep`
-    (BASELINE.json configs[3]) to f32 tolerance — NOT bit-level (unlike the
-    SMA/Bollinger kernels): the OLS moments are cumsum-differenced exactly
-    like the generic path, but the spread z-score's windowed sums are
-    block-banded *tree* sums while the generic path differences a cumsum,
-    so z differs near the band and a knife-edge entry can flip, diverging
-    that cell's position path. On-chip this is <1% of cells at the verify
-    scale (0.77% — down 6x from the all-tree-sum prep, since beta/alpha now
-    round like the reference algebra; the cumsum-difference reference loses
-    ~1e-4 absolute z precision to cancellation over long histories — the
-    tree sums are the *tighter* evaluation), and best-param decisions are
-    stable to ~1 ticker in 50 at knife edges; ``bench.py --verify``
-    re-quantifies both every round.
+    (BASELINE.json configs[3]) to f32 tolerance: every windowed sum in the
+    prep — the OLS moments AND the spread z-score's — is cumsum-differenced
+    in ``rolling.rolling_sum``'s exact float op order, so beta/alpha/z all
+    round like the generic path and only MXU-selection knife edges remain.
+    (Round 4: the z sums were previously block-banded MXU tree sums, whose
+    different summation order made pairs the fleet's worst entry-flip rate
+    — 0.77% of cells and the only unstable best-param argmax; matching the
+    op order measured 7/20000 cells = 0.035% flips, best-param flips 0, and
+    8.33 vs 7.96 M/s. ``bench.py --verify`` re-quantifies and BUDGETS both
+    every round.)
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
