@@ -65,7 +65,33 @@ def _setup_jax():
     return jax
 
 
-def _measure(run, n_backtests: int, *, iters: int, warmup: int, name: str):
+# Approximate TPU v5e (v5 lite) peaks for the roofline model below. MXU
+# f32 = the 197 bf16 TFLOP/s spec divided by the 6-pass HIGHEST-precision
+# schedule every selection matmul here uses. The VPU figure is an estimate
+# (1024 lanes x ~2.6 f32 ops/cycle effective); these are for RELATIVE bound
+# attribution — "which resource caps this kernel" — not absolute gospel.
+V5E_PEAKS = {"vpu": 4.0e12, "mxu": 3.3e13, "hbm": 8.1e11}
+ROOFLINE: dict = {}
+
+
+def _roofline_note(name, rate: float, n_bars: int, model: dict | None):
+    """Per-kernel utilization string from a (vpu ops, mxu flops, hbm bytes)
+    per-cell-bar model; records the figures for the bench JSON."""
+    if not model:
+        return ""
+    cell_bars = rate * n_bars
+    util = {res: cell_bars * per / V5E_PEAKS[res]
+            for res, per in model.items()}
+    bound = max(util, key=util.get)
+    ROOFLINE[name] = {**{f"{r}_util": round(u, 3) for r, u in util.items()},
+                      "bound": bound,
+                      "vpu_ops_per_cell_bar": model.get("vpu", 0)}
+    parts = ", ".join(f"{r.upper()} {100 * u:.0f}%" for r, u in util.items())
+    return f" | {parts} of v5e peak -> {bound.upper()}-bound"
+
+
+def _measure(run, n_backtests: int, *, iters: int, warmup: int, name: str,
+             n_bars: int = 0, model: dict | None = None):
     """Compile + warm the dispatch pipeline, then time ``iters`` chained runs."""
     import jax.numpy as jnp
     import numpy as np
@@ -90,7 +116,8 @@ def _measure(run, n_backtests: int, *, iters: int, warmup: int, name: str):
     assert np.isfinite(acc_val), f"{name}: non-finite accumulator"
     rate = n_backtests * iters / elapsed
     print(f"bench[{name}]: compile {compile_s:.1f}s, {iters}x {n_backtests} "
-          f"backtests in {elapsed:.3f}s -> {rate/1e6:.2f}M/s", file=sys.stderr)
+          f"backtests in {elapsed:.3f}s -> {rate/1e6:.2f}M/s"
+          f"{_roofline_note(name, rate, n_bars, model)}", file=sys.stderr)
     return rate
 
 
@@ -124,6 +151,32 @@ def main():
     def enabled(name):
         return only is None or name in only
 
+    # --- Roofline models: per-(cell, bar) resource counts read off the
+    # kernel structure in ops/fused.py. Every in-kernel recurrence is a
+    # log2(T_pad)-round shift ladder, so op counts scale with `rounds`:
+    #   metrics tail  = ~26 reduction/PnL ops + 2 ladders x 2 ops/round
+    #   3-state prefix compose (band/latch machines) = 9 ops/round
+    #   in-kernel EMA ladder (MACD signal line)      = 5 ops/round
+    # MXU = 2 FLOP x W_pad contraction per selection matmul per cell-bar
+    # (HIGHEST precision — the peak constant already folds the 6-pass
+    # schedule). HBM = the (W_pad x T_pad) table stream amortized over
+    # P_pad lanes, times (1 + prep passes over table-shaped intermediates).
+    # The models explain the kernel-family spread: sign kernels
+    # (SMA/momentum, ~100 ops) vs state-machine kernels (Donchian/band
+    # family, ~210 ops) differ ~2.1x in work per cell-bar — matching their
+    # measured M/s ratio at roughly equal VPU utilization.
+    rounds = max(int(np.ceil(np.log2(max(n_bars, 2)))), 1)
+    TAIL = 26 + 4 * rounds          # shared metrics tail
+    LADDER3 = 9 * rounds            # band/latch 3-state compose
+
+    def _model(vpu, n_distinct_w, p, *, w_align=8, selections=1,
+               prep_passes=3):
+        w_pad = -(-max(n_distinct_w, 1) // w_align) * w_align
+        p_pad = -(-max(p, 1) // 128) * 128
+        return {"vpu": float(vpu),
+                "mxu": 2.0 * selections * w_pad,
+                "hbm": 4.0 * w_pad * (1 + prep_passes) / p_pad}
+
     # --- configs[1] headline: fused SMA-crossover sweep -------------------
     if enabled("sma_fused"):
         n_fast = 20
@@ -145,7 +198,9 @@ def main():
 
         rates["sma_fused"] = _measure(
             run_sma, n_tickers * sweep.grid_size(grid), iters=iters,
-            warmup=warmup, name="sma_fused")
+            warmup=warmup, name="sma_fused", n_bars=n_bars,
+            model=_model(TAIL + 4, np.unique(np.r_[fa, sl]).size,
+                         fa.size, w_align=128))
 
     # --- configs[2]: fused Bollinger (window, k) --------------------------
     if enabled("bollinger_fused"):
@@ -161,7 +216,8 @@ def main():
 
         rates["bollinger_fused"] = _measure(
             run_boll, n_tickers * sweep.grid_size(bgrid), iters=iters,
-            warmup=warmup, name="bollinger_fused")
+            warmup=warmup, name="bollinger_fused", n_bars=n_bars,
+            model=_model(TAIL + LADDER3 + 10, np.unique(bw).size, bw.size))
 
     if enabled("bollinger_touch_fused"):
         n_win, n_k = 20, max(min(n_params, 1000) // 20, 1)
@@ -177,7 +233,8 @@ def main():
 
         rates["bollinger_touch_fused"] = _measure(
             run_touch, n_tickers * sweep.grid_size(tgrid), iters=iters,
-            warmup=warmup, name="bollinger_touch_fused")
+            warmup=warmup, name="bollinger_touch_fused", n_bars=n_bars,
+            model=_model(TAIL + 8, np.unique(tw).size, tw.size))
 
     # --- momentum / donchian: the round-3 single-window-axis kernels ------
     if enabled("momentum_fused"):
@@ -189,7 +246,9 @@ def main():
 
         rates["momentum_fused"] = _measure(
             run_mom, n_tickers * len(mlbs), iters=iters, warmup=warmup,
-            name="momentum_fused")
+            name="momentum_fused", n_bars=n_bars,
+            model=_model(TAIL + 4, np.unique(mlbs).size, mlbs.size,
+                         prep_passes=2))
 
     if enabled("donchian_fused"):
         dwins = np.tile(np.arange(10, 135, dtype=np.float32),
@@ -200,7 +259,9 @@ def main():
 
         rates["donchian_fused"] = _measure(
             run_don, n_tickers * len(dwins), iters=iters, warmup=warmup,
-            name="donchian_fused")
+            name="donchian_fused", n_bars=n_bars,
+            model=_model(TAIL + LADDER3 + 10, np.unique(dwins).size,
+                         dwins.size))
 
     if enabled("donchian_hl_fused"):
         hwins = np.tile(np.arange(10, 135, dtype=np.float32),
@@ -212,7 +273,9 @@ def main():
 
         rates["donchian_hl_fused"] = _measure(
             run_don_hl, n_tickers * len(hwins), iters=iters, warmup=warmup,
-            name="donchian_hl_fused")
+            name="donchian_hl_fused", n_bars=n_bars,
+            model=_model(TAIL + LADDER3 + 10, np.unique(hwins).size,
+                         hwins.size, prep_passes=4))
 
     # --- vwap: the volume-consuming band-machine kernel -------------------
     if enabled("vwap_fused"):
@@ -229,7 +292,9 @@ def main():
 
         rates["vwap_fused"] = _measure(
             run_vwap, n_tickers * sweep.grid_size(vgrid), iters=iters,
-            warmup=warmup, name="vwap_fused")
+            warmup=warmup, name="vwap_fused", n_bars=n_bars,
+            model=_model(TAIL + LADDER3 + 10, np.unique(vw).size, vw.size,
+                         prep_passes=4))
 
     if enabled("keltner_fused"):
         kgrid = sweep.product_grid(
@@ -245,7 +310,9 @@ def main():
 
         rates["keltner_fused"] = _measure(
             run_kelt, n_tickers * sweep.grid_size(kgrid), iters=iters,
-            warmup=warmup, name="keltner_fused")
+            warmup=warmup, name="keltner_fused", n_bars=n_bars,
+            model=_model(TAIL + LADDER3 + 10, np.unique(kw).size, kw.size,
+                         prep_passes=4))
 
     if enabled("stochastic_fused"):
         sgrid = sweep.product_grid(
@@ -261,7 +328,9 @@ def main():
 
         rates["stochastic_fused"] = _measure(
             run_stoch, n_tickers * sweep.grid_size(sgrid), iters=iters,
-            warmup=warmup, name="stochastic_fused")
+            warmup=warmup, name="stochastic_fused", n_bars=n_bars,
+            model=_model(TAIL + LADDER3 + 12, np.unique(sw).size, sw.size,
+                         prep_passes=4))
 
     # --- rsi / macd: the EMA-family fused kernels -------------------------
     if enabled("rsi_fused"):
@@ -278,7 +347,9 @@ def main():
 
         rates["rsi_fused"] = _measure(
             run_rsi, n_tickers * len(rp), iters=iters, warmup=warmup,
-            name="rsi_fused")
+            name="rsi_fused", n_bars=n_bars,
+            model=_model(TAIL + LADDER3 + 10, np.unique(rp).size, rp.size,
+                         prep_passes=4))
 
     if enabled("macd_fused"):
         mf = np.repeat(np.arange(5, 15, dtype=np.float32), 100)
@@ -291,7 +362,10 @@ def main():
 
         rates["macd_fused"] = _measure(
             run_macd, n_tickers * len(mf), iters=iters, warmup=warmup,
-            name="macd_fused")
+            name="macd_fused", n_bars=n_bars,
+            model=_model(TAIL + 5 * rounds + 5,
+                         np.unique(np.r_[mf, ms]).size, mf.size,
+                         prep_passes=4))
 
     # --- configs[3]: rolling-OLS pairs (lookback, z_entry) ----------------
     if enabled("pairs"):
@@ -317,7 +391,9 @@ def main():
         rates["pairs"] = _measure(
             run_pairs, n_pairs * sweep.grid_size(pgrid),
             iters=max(iters // 2, 3), warmup=max(warmup // 3, 2),
-            name="pairs")
+            name="pairs", n_bars=n_bars,
+            model=_model(TAIL + LADDER3 + 15, np.unique(plb).size,
+                         plb.size, selections=2, prep_passes=8))
 
     # --- e2e: backtests/sec THROUGH the gRPC dispatch loop ----------------
     # The reference's one perf fact is jobs/sec through its full loop
@@ -533,6 +609,9 @@ def main():
         # reference worker: 1 backtest/sec
         "vs_baseline": round(rates[headline_name], 1),
         "configs": {k: round(v, 1) for k, v in rates.items()},
+        # Per-kernel utilization model (% of approximate v5e peaks +
+        # binding resource); see the roofline comment in main().
+        "roofline": ROOFLINE,
     }))
 
 

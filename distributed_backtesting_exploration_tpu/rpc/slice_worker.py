@@ -1,0 +1,318 @@
+"""Slice-level worker: one multi-host JAX slice serving the dispatcher.
+
+The default scale-out is job-level — each host runs an independent
+:class:`~.worker.Worker` (``parallel/multihost.py`` layer 1, the
+reference's machines-polling-a-queue model, reference ``README.md:6-7``).
+This module is layer 2 joined with the RPC plane: when a single sweep
+must span more chips than one host owns, the hosts form one
+``jax.distributed`` slice and serve the SAME dispatcher contract as one
+logical worker.
+
+Architecture (SPMD discipline: every process of a slice must execute the
+same jitted programs in the same order, so control flow is leader-driven):
+
+- **Leader** (process 0) owns the gRPC side entirely: it polls
+  RequestJobs, decodes job payloads, reports batched completions. The
+  dispatcher sees ONE worker advertising the whole slice's chip count.
+- Each round the leader **broadcasts** a small control message (run /
+  idle / stop) plus the decoded job group to every process
+  (``jax.experimental.multihost_utils.broadcast_one_to_all`` — gloo on
+  CPU slices, ICI/DCN collectives on TPU pods).
+- All processes then run the identical ticker-sharded sweep over the
+  GLOBAL mesh (:func:`~..parallel.sharding.sharded_sweep` — the same
+  code path as the single-host mesh backend) and replicate the metrics
+  with an in-program all-gather (``jit`` with replicated
+  ``out_shardings``), so the leader can pack DBXM blocks host-side.
+
+The broadcast ships the full OHLCV group to every host — the simplest
+correct data plane, fine for control-plane-scale payloads (a 5y-daily
+ticker is ~25 KB); a production pod would stage payloads on shared
+storage and broadcast only paths. Jobs in one poll batch are grouped by
+(strategy, grid, cost, ppy, bars) exactly like the single-host backend;
+mixed batches run as successive groups.
+
+Tested end-to-end in ``tests/test_multihost.py``: two OS processes with
+4 virtual CPU devices each form an 8-device slice, drain a LIVE
+dispatcher, and every job's stored DBXM block matches the direct
+single-device sweep.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import uuid
+
+import numpy as np
+
+log = logging.getLogger("dbx.slice_worker")
+
+_STOP = {"op": "stop"}
+_IDLE = {"op": "idle"}
+
+
+def _bcast_msg(msg: dict | None, arrays: list[np.ndarray] | None = None):
+    """Broadcast a JSON header + f32 array block from the leader.
+
+    Followers pass ``None`` and receive the leader's message. Two
+    collectives: a fixed-shape length header, then one payload buffer
+    (every process must present identical shapes to the collective).
+    """
+    from jax.experimental import multihost_utils as mhu
+
+    if msg is not None:
+        header = json.dumps(msg).encode()
+        blob = b"".join(np.ascontiguousarray(a, np.float32).tobytes()
+                        for a in (arrays or []))
+        lens = np.asarray([len(header), len(blob)], np.int64)
+    else:
+        header = b""
+        blob = b""
+        lens = np.zeros(2, np.int64)
+    lens = np.asarray(mhu.broadcast_one_to_all(lens))
+    n_h, n_b = int(lens[0]), int(lens[1])
+    buf = np.zeros(n_h + n_b, np.uint8)
+    if msg is not None:
+        buf[:n_h] = np.frombuffer(header, np.uint8)
+        buf[n_h:] = np.frombuffer(blob, np.uint8)
+    buf = np.asarray(mhu.broadcast_one_to_all(buf))
+    out = json.loads(bytes(buf[:n_h]))
+    payload = np.frombuffer(bytes(buf[n_h:]), np.float32)
+    return out, payload
+
+
+class SliceWorker:
+    """A whole multi-host slice polling the dispatcher as one worker.
+
+    Construct AFTER :func:`~..parallel.multihost.initialize`; every
+    process of the slice constructs one and calls :meth:`run` — the
+    leader drives, followers follow the broadcast control stream.
+    """
+
+    def __init__(self, connect: str, *, worker_id: str | None = None,
+                 jobs_per_chip: int = 1, poll_interval_s: float = 0.25):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel import sharding as sharding_mod
+
+        self._jax = jax
+        self.is_leader = jax.process_index() == 0
+        self.mesh = sharding_mod.make_mesh()        # the GLOBAL slice mesh
+        axis = self.mesh.axis_names[0]
+        self._row = NamedSharding(self.mesh, P(axis, None))
+        self._rep = NamedSharding(self.mesh, P())
+        # One jitted identity per worker: out_shardings=replicated makes it
+        # the in-program all-gather, and a per-call lambda would retrace
+        # (and recompile) the reshard program on every job group.
+        self._gather = jax.jit(lambda x: x, out_shardings=self._rep)
+        self.chips = jax.device_count()
+        self.jobs_completed = 0
+        self._poll_interval_s = poll_interval_s
+        self._jobs_per_chip = jobs_per_chip
+        self._stub = None
+        if self.is_leader:
+            import grpc
+
+            from . import service
+
+            self.worker_id = worker_id or f"slice-{uuid.uuid4().hex[:8]}"
+            self._channel = grpc.insecure_channel(
+                connect, options=service.default_channel_options())
+            self._stub = service.DispatcherStub(self._channel)
+            log.info("slice worker %s: leader of %d processes, %d chips",
+                     self.worker_id, jax.process_count(), self.chips)
+
+    # -- leader side -------------------------------------------------------
+
+    def _poll(self) -> list:
+        from . import backtesting_pb2 as pb
+
+        reply = self._stub.RequestJobs(pb.JobsRequest(
+            worker_id=self.worker_id, chips=self.chips,
+            jobs_per_chip=self._jobs_per_chip), timeout=10.0)
+        return list(reply.jobs)
+
+    def _group_jobs(self, jobs):
+        """Group a poll batch like the single-host backend: same strategy,
+        grid, cost, ppy and bar count stack into one sharded sweep.
+
+        Returns ``(groups, decoded, bad)``. This worker runs plain
+        single-asset sweeps over the global mesh; job kinds it does not
+        implement — two-legged pairs, walk-forward, on-device top-k —
+        land in ``bad`` and are completed with EMPTY metric blocks plus a
+        loud error (the validated-bad discipline of the single-host
+        backend): silently running a walk-forward job as a plain sweep
+        would store WRONG results as a valid completion, and leaving the
+        jobs leased would requeue-loop them through the slice forever.
+        Route such jobs to single-host workers (``rpc/worker.py``), which
+        implement all three."""
+        from . import wire
+        from ..utils import data as data_mod
+
+        groups: dict[tuple, list] = {}
+        decoded: dict[str, tuple] = {}
+        bad: list = []
+        for job in jobs:
+            unsupported = (
+                "pairs (two-legged)" if (job.strategy == "pairs"
+                                         or job.ohlcv2) else
+                "walk-forward" if job.wf_train > 0 else
+                "top-k reduction" if job.top_k > 0 else None)
+            if unsupported:
+                log.error(
+                    "slice worker: job %s needs %s, which the slice-level "
+                    "worker does not implement; completing with empty "
+                    "metrics (route it to a single-host worker)",
+                    job.id, unsupported)
+                bad.append(job)
+                continue
+            series = data_mod.from_wire_bytes(job.ohlcv)
+            key = (job.strategy,
+                   tuple(sorted((k, v.tobytes()) for k, v in
+                                wire.grid_from_proto(job.grid).items())),
+                   job.cost, job.periods_per_year, series.n_bars)
+            groups.setdefault(key, []).append(job)
+            decoded[job.id] = series
+        return groups, decoded, bad
+
+    def _complete(self, items) -> None:
+        from . import backtesting_pb2 as pb
+
+        batch = pb.CompleteBatch(worker_id=self.worker_id, items=items)
+        self._stub.CompleteJobs(batch, timeout=10.0)
+        self.jobs_completed += len(items)
+
+    # -- the SPMD round ----------------------------------------------------
+
+    def _run_group(self, msg: dict | None, flat: np.ndarray):
+        """Execute one broadcast job group on the global mesh (every
+        process). Returns host-resident replicated Metrics."""
+        import jax.numpy as jnp
+
+        from ..models import base as models_base
+        from ..ops.metrics import Metrics
+        from ..parallel import sharding as sharding_mod
+        from ..parallel import sweep as sweep_mod
+        from ..utils import data as data_mod
+
+        hdr, payload = _bcast_msg(msg, [flat] if flat is not None else [])
+        if hdr["op"] != "run":
+            return hdr, None
+        n_pad, T = hdr["n_pad"], hdr["bars"]
+        panel_np = payload.reshape(5, n_pad, T)
+        row, rep = self._row, self._rep
+
+        jax = self._jax
+        # Every host holds the full broadcast rows; contribute this
+        # process's contiguous block (the 1-D mesh orders shards by
+        # jax.devices(), which lists each process's devices contiguously —
+        # the same layout parallel.multihost.host_shard relies on).
+        n_local = n_pad * jax.local_device_count() // jax.device_count()
+        start = jax.process_index() * n_local
+
+        def globalize(a):
+            return jax.make_array_from_process_local_data(
+                row, np.ascontiguousarray(a[start:start + n_local]),
+                global_shape=a.shape)
+
+        panel = data_mod.OHLCV(*(globalize(panel_np[i]) for i in range(5)))
+        grid = {k: self._jax.device_put(
+                    jnp.asarray(np.asarray(v, np.float32)), rep)
+                for k, v in hdr["grid"].items()}
+        strategy = models_base.get_strategy(hdr["strategy"])
+        flat_grid = sweep_mod.product_grid(**grid)
+        m = sharding_mod.sharded_sweep(
+            self.mesh, panel, strategy, flat_grid, cost=hdr["cost"],
+            periods_per_year=hdr["ppy"] or 252)
+        # In-program all-gather: replicate the row-sharded metrics so the
+        # leader can read them host-side.
+        m = Metrics(*(np.asarray(self._gather(f)) for f in m))
+        return hdr, m
+
+    # -- the loop ----------------------------------------------------------
+
+    def run(self, *, max_idle_polls: int | None = None) -> None:
+        """Drive the slice until ``max_idle_polls`` consecutive empty polls
+        (None = forever; followers always follow the leader's stream)."""
+        from . import wire
+        from . import backtesting_pb2 as pb
+        from ..ops.metrics import Metrics
+
+        if self.is_leader:
+            try:
+                self._leader_loop(max_idle_polls)
+            except BaseException:
+                # Followers are (or will be) parked inside the broadcast
+                # collective waiting for the next control message; dying
+                # without a stop would deadlock every other process of the
+                # slice. Best effort — if the collective itself is broken
+                # the broadcast raises too and processes exit.
+                try:
+                    _bcast_msg(_STOP)
+                except Exception:
+                    pass
+                raise
+        else:
+            while True:
+                hdr, _ = self._run_group(None, None)
+                if hdr["op"] == "stop":
+                    return
+                if hdr["op"] == "idle":
+                    time.sleep(self._poll_interval_s)
+
+    def _leader_loop(self, max_idle_polls: int | None) -> None:
+        from . import wire
+        from . import backtesting_pb2 as pb
+        from ..ops.metrics import Metrics
+        from ..parallel import sharding as sharding_mod
+
+        idle = 0
+        while True:
+            jobs = self._poll()
+            if not jobs:
+                idle += 1
+                if max_idle_polls is not None and idle >= max_idle_polls:
+                    _bcast_msg(_STOP)
+                    log.info("slice worker %s: idle for %d polls; "
+                             "stopping (%d jobs completed)",
+                             self.worker_id, idle, self.jobs_completed)
+                    return
+                _bcast_msg(_IDLE)
+                time.sleep(self._poll_interval_s)
+                continue
+            idle = 0
+            groups, decoded, bad = self._group_jobs(jobs)
+            if bad:
+                # Validated-bad kinds: complete with empty blocks (see
+                # _group_jobs) — no broadcast round needed.
+                self._complete([pb.CompleteItem(id=j.id, metrics=b"",
+                                                elapsed_s=0.0)
+                                for j in bad])
+            # One broadcast round per group; followers need no counts in
+            # advance — they simply process the control stream.
+            for (strat, grid_b, cost, ppy, bars), group in groups.items():
+                rows = np.stack(
+                    [np.stack([np.asarray(getattr(decoded[j.id], f))
+                               for j in group])
+                     for f in ("open", "high", "low", "close", "volume")])
+                n_pad = sharding_mod.pad_tickers(
+                    len(group), self.mesh.devices.size)
+                rows = np.stack([sharding_mod.pad_rows(r, n_pad)
+                                 for r in rows])
+                msg = {"op": "run", "strategy": strat,
+                       "grid": {k: np.frombuffer(v, np.float32).tolist()
+                                for k, v in grid_b},
+                       "cost": cost, "ppy": ppy, "bars": bars,
+                       "n_pad": n_pad}
+                t0 = time.perf_counter()
+                _, m = self._run_group(msg, rows.reshape(-1))
+                per_job = (time.perf_counter() - t0) / len(group)
+                items = []
+                for i, job in enumerate(group):
+                    blob = wire.metrics_to_bytes(
+                        Metrics(*(np.asarray(f)[i] for f in m)))
+                    items.append(pb.CompleteItem(
+                        id=job.id, metrics=blob, elapsed_s=per_job))
+                self._complete(items)

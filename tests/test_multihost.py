@@ -94,6 +94,115 @@ print("MULTIHOST_OK", pid, flush=True)
 """
 
 
+_SLICE_CHILD = r"""
+import os, sys
+pid = int(sys.argv[1]); coord = sys.argv[2]; dispatcher = sys.argv[4]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+sys.path.insert(0, sys.argv[3])
+import jax
+jax.config.update("jax_platforms", "cpu")
+from distributed_backtesting_exploration_tpu.parallel import multihost
+from distributed_backtesting_exploration_tpu.rpc.slice_worker import (
+    SliceWorker)
+
+n = multihost.initialize(coord, num_processes=2, process_id=pid)
+assert n == 2 and jax.device_count() == 8
+w = SliceWorker(dispatcher, worker_id="slice-under-test",
+                poll_interval_s=0.1, jobs_per_chip=1)
+assert w.chips == 8
+w.run(max_idle_polls=20)
+print("SLICE_OK", pid, w.jobs_completed, flush=True)
+"""
+
+
+def test_slice_worker_drains_live_dispatcher(tmp_path):
+    """VERDICT r3 #8 — the two proven halves joined: a 2-process
+    jax.distributed worker (4+4 virtual devices, ONE 8-device mesh)
+    serves a LIVE dispatcher as one logical worker. The slice drains the
+    queue and every job's stored DBXM block matches the direct
+    single-device sweep."""
+    import numpy as np
+
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from distributed_backtesting_exploration_tpu.models import base
+    from distributed_backtesting_exploration_tpu.parallel import sweep
+    from distributed_backtesting_exploration_tpu.rpc import wire
+    from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+        Dispatcher, DispatcherServer, JobQueue, PeerRegistry,
+        synthetic_jobs)
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    grid = {"fast": np.float32([3.0, 5.0]), "slow": np.float32([10.0, 20.0])}
+    queue = JobQueue()
+    recs = synthetic_jobs(6, 64, "sma_crossover", grid, cost=1e-3, seed=13)
+    for rec in recs:
+        queue.enqueue(rec)
+    # A two-legged job the slice worker does NOT implement: it must be
+    # completed empty with a loud error, not crash the slice or
+    # requeue-loop forever.
+    pair_rec = synthetic_jobs(
+        1, 64, "pairs", {"lookback": np.float32([8.0]),
+                         "z_entry": np.float32([1.0])}, seed=14)[0]
+    queue.enqueue(pair_rec)
+    results = tmp_path / "results"
+    disp = Dispatcher(queue, PeerRegistry(prune_window_s=120.0),
+                      results_dir=str(results))
+    srv = DispatcherServer(disp, bind="localhost:0").start()
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        coord = f"localhost:{s.getsockname()[1]}"
+    script = tmp_path / "slice_child.py"
+    script.write_text(_SLICE_CHILD)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(pid), coord, _REPO_ROOT,
+             f"localhost:{srv.port}"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        for pid in (0, 1)
+    ]
+    try:
+        outs = [p.communicate(timeout=280) for p in procs]
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        srv.stop()
+        pytest.fail("slice worker children timed out")
+    srv.stop()
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, f"child failed:\n{err[-3000:]}"
+    assert "SLICE_OK 0 7" in outs[0][0]       # 6 sweeps + 1 empty pairs
+    assert "SLICE_OK 1" in outs[1][0]
+    assert queue.drained
+    s = queue.stats()
+    assert s["jobs_completed"] == 7 and s["jobs_failed"] == 0
+    # The unsupported pairs job completed with an EMPTY block (which the
+    # dispatcher does not persist — no stored result, but no requeue loop).
+    assert not (results / f"{pair_rec.id}.dbxm").exists()
+
+    # Per-job parity: each stored DBXM block equals the direct sweep.
+    flat = sweep.product_grid(
+        **{k: jnp.asarray(v) for k, v in grid.items()})
+    strat = base.get_strategy("sma_crossover")
+    for rec in recs:
+        blob = (results / f"{rec.id}.dbxm").read_bytes()
+        got = wire.metrics_from_bytes(blob)
+        series = data.from_wire_bytes(rec.ohlcv)
+        panel = type(series)(*(jnp.asarray(np.asarray(f))[None, :]
+                               for f in series))
+        want = sweep.jit_sweep(panel, strat, dict(flat), cost=1e-3)
+        for name in want._fields:
+            np.testing.assert_allclose(
+                np.asarray(getattr(got, name)),
+                np.asarray(getattr(want, name))[0],
+                rtol=1e-4, atol=1e-5, err_msg=name)
+
+
 def test_two_process_distributed_sharded_sweep(tmp_path):
     with socket.socket() as s:
         s.bind(("localhost", 0))
