@@ -24,6 +24,19 @@ dispatches pay full round-trip latency before pipelining engages — measured
 device-side accumulator so every sweep executes, synchronized once at the end.
 A persistent compilation cache under .jax_cache cuts fresh-process compiles.
 
+CROSS-RUN variance caveat (r4, measured): the same config can move +-35%
+between bench invocations on this environment's tunneled chip (keltner
+measured 7.25 M/s inside one full-suite run and 11.35 M/s isolated
+minutes later, identical code). Only BACK-TO-BACK A/B runs in one sitting
+are trustworthy for optimization decisions; a single full-suite run's
+per-config spread is bounded-reliable for the big picture (kernel-family
+ratios, bound attribution) but not for ~20% deltas. An r4 experiment that
+"fixed" keltner's apparent 41% utilization by fusing its 25 per-window
+EMA prep ladders into one stacked ladder measured FASTER against the bad
+baseline and 16-19% SLOWER in a controlled A/B (per-window loop wins for
+keltner/rsi/macd prep); the loop stays, and the roofline's per-run
+utilization figures should be read with that error bar.
+
 Prints ONE JSON line to stdout:
     {"metric": ..., "value": N, "unit": "backtests/sec", "vs_baseline": N,
      "configs": {name: rate, ...}}
