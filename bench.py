@@ -768,16 +768,12 @@ def verify():
         ),
     }
     # Per-kernel error budgets, asserted below: flip_rate caps with ~4x
-    # headroom over the measured rates (r4: every kernel <= 0.05% except
-    # MACD), so numeric regressions FAIL the verify run loudly instead of
-    # drifting across rounds. MACD's higher budget is a documented
-    # irreducible-at-f32 gap: its signal-line EMA runs as an in-kernel
-    # doubling ladder whose rounding differs from XLA's associative_scan
-    # (Blelloch recursion) — bit-matching would mean reproducing that
-    # recursion under Pallas layout constraints for a 1e-7-boundary
-    # disagreement with a STABLE best-param argmax (0 flips every round).
-    # See DESIGN.md "Fused-kernel error budgets".
-    FLIP_BUDGET = {"macd": 0.006, "pairs": 0.002}
+    # headroom over the measured rates (r4: every kernel <= 0.05%, MACD
+    # included after its generic path became the fused ladder's rounding
+    # twin — demeaned close + ema_ladder, 26 -> 2 flips), so numeric
+    # regressions FAIL the verify run loudly instead of drifting across
+    # rounds. See DESIGN.md "Fused-kernel error budgets".
+    FLIP_BUDGET = {"pairs": 0.002}
     FLIP_BUDGET_DEFAULT = 0.002
     ARGMAX_BUDGET = {"pairs": 1}      # knife-edge band entries, ~1 in 50
     ARGMAX_BUDGET_DEFAULT = 0

@@ -981,27 +981,17 @@ def _ema_rows(x, alpha: float):
     """EMA along the last axis with a scalar decay, as a shift-based
     doubling ladder (the prep-side twin of the in-kernel ``_ema_ladder``).
 
-    Same recurrence as ``rolling.ema`` — ``y[0] = x[0]``,
-    ``y[t] = (1-a) y[t-1] + a x[t]`` — but built from ~log2(T) elementwise
-    passes instead of ``associative_scan``: XLA compiles the scan's deep
-    slice graph ~30x slower (measured ~4 s/scan at the bench shape, and the
-    remote-proxy backend cannot persistently cache compiles), while the
-    runtime difference is noise. Rounding differs from associative_scan by
-    float-order only.
+    Delegates to ``rolling.ema_ladder`` — the SAME function the generic
+    models (MACD, TRIX) evaluate their EMAs with, which is what makes the
+    fused and generic paths rounding twins (the parity fix that took MACD
+    from 26/6400 verify flips to 2). Keep this a delegation, not a copy:
+    a drifting twin silently reintroduces that flip class. (The ladder is
+    also what makes compile time tractable: XLA compiles associative_scan's
+    deep slice graph ~30x slower at the bench shape, and the remote-proxy
+    backend cannot persistently cache compiles.)
     """
-    T = x.shape[-1]
-    t0 = jnp.arange(T) == 0
-    A = jnp.where(t0, 0.0, jnp.float32(1.0 - alpha))
-    A = jnp.broadcast_to(A, x.shape)
-    B = jnp.where(t0, x, x * jnp.float32(alpha))
-
-    span = 1
-    while span < T:
-        Ae = _shift_t(A, span, 1.0)    # identity element (A=1, B=0)
-        Be = _shift_t(B, span, 0.0)
-        A, B = Ae * A, A * Be + B
-        span *= 2
-    return B
+    from . import rolling
+    return rolling.ema_ladder(x, alpha=jnp.float32(alpha))
 
 
 def _mom_kernel(r_ref, c_ref, past_ref, ol_ref, warm_ref, *refs,
@@ -1580,10 +1570,17 @@ def _fused_macd_call(close, onehot_f, onehot_s, a_sig, warm, t_real, *,
                      spans: tuple, T_pad: int, W_pad: int, P_real: int,
                      T_real: int | None, cost: float, ppy: int,
                      interpret: bool):
-    """Distinct-span EMA table prep + pallas call in one jit."""
+    """Distinct-span EMA table prep + pallas call in one jit.
+
+    The EMA table is built from the *demeaned* close — ``macd`` is
+    shift-invariant (``models.macd``, which demeans identically), and the
+    demeaned series keeps the f32 error proportional to price deviations
+    rather than price level. Returns still come from the raw series.
+    """
     close_p = _pad_last(close, T_pad)
     N = close.shape[0]
-    rows = [_ema_rows(close_p, 2.0 / (float(s) + 1.0)) for s in spans]
+    close_dm = close_p - close_p[..., :1]
+    rows = [_ema_rows(close_dm, 2.0 / (float(s) + 1.0)) for s in spans]
     ema_tbl = jnp.stack(rows, axis=1)                            # (N,W,T_pad)
     if W_pad > len(spans):
         ema_tbl = jnp.concatenate(
@@ -1631,11 +1628,11 @@ def fused_macd_sweep(close, fast, slow, signal, *, t_real=None,
 
     ``fast``/``slow``/``signal`` are flat per-combo span arrays
     (:func:`product_grid` order); spans must be integral. Matches
-    ``run_sweep(..., "macd")`` (``models.macd``) to f32 tolerance: the
-    signal-line EMA runs as an in-kernel associative ladder whose rounding
-    differs slightly from XLA's associative_scan, so a knife-edge
-    macd/signal crossing can resolve differently (rare; same caveat class
-    as the MXU selection matmuls).
+    ``run_sweep(..., "macd")`` (``models.macd``) to f32 tolerance — both
+    paths demean the close and evaluate every EMA with the same
+    shift-doubling ladder (``rolling.ema_ladder`` generically, ``_ema_rows``
+    / ``_ema_ladder`` here), so they are rounding twins; the only residual
+    divergence class is the MXU selection matmul for the macd line.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -1678,6 +1675,7 @@ def _macd_grid_setup(fast_bytes: bytes, slow_bytes: bytes,
     warm[0, :P] = slow + signal - 1.0
     return (tuple(int(s) for s in spans), _const(oh_f),
             _const(oh_s), _const(a_sig), _const(warm))
+
 
 @functools.partial(
     jax.jit,
