@@ -7,7 +7,8 @@ SMA-crossover sweep over 5 years of daily bars with a 2,000-point
 also measures configs[2]-[4] and the rest of the fused family: Bollinger
 (500 x 1k (window, k), hysteresis and band-touch), momentum, Donchian
 (close and high/low channels), stochastic %K, VWAP reversion, RSI, MACD,
-rolling-OLS pairs (1k pairs x 500 (lookback, z_entry)), and walk-forward
+TRIX, OBV trend, rolling-OLS pairs (1k pairs x 500 (lookback, z_entry)),
+and walk-forward
 (12 refit windows x param grid), plus an
 ``e2e`` config that pushes the headline workload
 through a loopback gRPC dispatcher + worker (decode, RPC and metric
@@ -43,7 +44,7 @@ Prints ONE JSON line to stdout:
 
 ``--verify`` mode instead runs fused-vs-generic parity for every fused
 kernel (SMA, Bollinger hysteresis + band-touch, momentum, Donchian close +
-high/low, stochastic, VWAP, RSI, MACD, pairs) ON THE CHIP
+high/low, stochastic, VWAP, RSI, MACD, TRIX, OBV, pairs) ON THE CHIP
 and prints one JSON line with max relative error and the argmax/entry flip
 rates (the knife-edge MXU caveat, plus MACD's in-kernel-ladder vs
 associative_scan caveat — quantified fresh each round and asserted
@@ -380,6 +381,36 @@ def main():
                          np.unique(np.r_[mf, ms]).size, mf.size,
                          prep_passes=4))
 
+    if enabled("trix_fused"):
+        # 10 distinct spans x 100 signal lanes; each distinct span chains
+        # THREE EMA ladders in the prep (triple smoothing), hence the
+        # heavier prep_passes.
+        tsp = np.repeat(np.arange(5, 15, dtype=np.float32), 100)
+        tsg = np.tile(np.arange(3, 13, dtype=np.float32), 100)
+
+        def run_trix():
+            return fused.fused_trix_sweep(panel.close, tsp, tsg, cost=1e-3)
+
+        rates["trix_fused"] = _measure(
+            run_trix, n_tickers * len(tsp), iters=iters, warmup=warmup,
+            name="trix_fused", n_bars=n_bars,
+            model=_model(TAIL + 5 * rounds + 7, np.unique(tsp).size,
+                         tsp.size, prep_passes=10))
+
+    if enabled("obv_fused"):
+        ow = np.tile(np.arange(5, 130, dtype=np.float32),
+                     max(n_params // 125, 1))
+
+        def run_obv():
+            return fused.fused_obv_sweep(panel.close, panel.volume, ow,
+                                         cost=1e-3)
+
+        rates["obv_fused"] = _measure(
+            run_obv, n_tickers * len(ow), iters=iters, warmup=warmup,
+            name="obv_fused", n_bars=n_bars,
+            model=_model(TAIL + 8, np.unique(ow).size, ow.size,
+                         prep_passes=5))
+
     # --- configs[3]: rolling-OLS pairs (lookback, z_entry) ----------------
     if enabled("pairs"):
         n_pairs = min(2 * n_tickers, 1000)
@@ -601,7 +632,8 @@ def main():
         known = ("sma_fused, bollinger_fused, bollinger_touch_fused, "
                  "momentum_fused, donchian_fused, donchian_hl_fused, "
                  "keltner_fused, stochastic_fused, vwap_fused, rsi_fused, "
-                 "macd_fused, pairs, e2e, e2e_topk, e2e_local, walkforward")
+                 "macd_fused, trix_fused, obv_fused, pairs, e2e, e2e_topk, "
+                 "e2e_local, walkforward")
         sys.exit(f"bench: no configs ran — DBX_BENCH_CONFIGS={only} matched "
                  f"nothing (known: {known})")
     # The headline is the north-star config when it ran; otherwise label the
@@ -755,6 +787,23 @@ def verify():
             lambda g: fused.fused_macd_sweep(
                 panel.close, np.asarray(g["fast"]), np.asarray(g["slow"]),
                 np.asarray(g["signal"]), cost=1e-3),
+        ),
+        "trix": strat_case(
+            "trix",
+            sweep.product_grid(
+                span=jnp.arange(5, 45, 2, dtype=jnp.float32),
+                signal=jnp.asarray([4.0, 9.0], jnp.float32)),
+            lambda g: fused.fused_trix_sweep(
+                panel.close, np.asarray(g["span"]), np.asarray(g["signal"]),
+                cost=1e-3),
+        ),
+        "obv": strat_case(
+            "obv_trend",
+            sweep.product_grid(
+                window=jnp.arange(5, 85, 2, dtype=jnp.float32)),
+            lambda g: fused.fused_obv_sweep(
+                panel.close, panel.volume, np.asarray(g["window"]),
+                cost=1e-3),
         ),
         "pairs": (
             # Chunked generic reference: the unchunked vmap materializes the

@@ -7,5 +7,5 @@ the Strategy API and the registry.
 
 from .base import Strategy, register, get_strategy, available_strategies  # noqa: F401
 from . import (  # noqa: F401
-    bollinger, donchian, keltner, macd, momentum, pairs, rsi,
-    sma_crossover, stochastic, vwap)
+    bollinger, donchian, keltner, macd, momentum, obv, pairs, rsi,
+    sma_crossover, stochastic, trix, vwap)

@@ -1,0 +1,51 @@
+"""On-balance-volume trend (path-free): OBV vs its own rolling mean.
+
+``obv[t] = sum_{s<=t} sign(close[s] - close[s-1]) * v[s]`` — the classic
+volume-flow accumulator — traded as ``sign(obv - sma_w(obv))``: long while
+volume flow runs above its ``window``-bar average, short below. This is
+the framework's first *volume-led* trend family (VWAP reversion consumes
+volume too, but as a price anchor; here volume IS the signal).
+
+Numerics: ``v = volume / volume[..., :1]`` — the traded quantity
+``sign(obv - sma)`` is invariant under positive scaling of volume (both
+terms are linear in ``v``), and normalizing by the first bar keeps the
+double accumulation (cumsum for OBV, cumsum-difference for its SMA) at
+O(1) magnitudes instead of raw-volume ~1e6 scale, so the f32 error budget
+tracks the signal. The first bar is always real, even in ragged panels
+(padding is appended), so the normalizer never reads a padded value.
+
+The padding discipline holds for free: appended pad bars repeat the last
+close, so ``diff = 0`` and the OBV step is exactly zero — OBV is flat over
+padding and trailing windows never look forward.
+
+Warmup: positions are masked flat for ``t < window - 1`` (the SMA's rule).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops import rolling
+from .base import Strategy, register
+
+
+#: Shared with the fused kernel prep (``ops.fused._fused_obv_call``) so the
+#: generic and fused paths evaluate ONE definition — see ``rolling.obv_series``.
+obv_series = rolling.obv_series
+
+
+def _positions(ohlcv, params):
+    close = ohlcv.close
+    w = params["window"]
+    obv = obv_series(close, ohlcv.volume)
+    sma = rolling.rolling_mean(obv, w)
+    valid = rolling.valid_mask(close.shape[-1], w)
+    return jnp.where(valid, jnp.sign(obv - sma), 0.0)
+
+
+OBV_TREND = register(Strategy(
+    name="obv_trend",
+    param_fields=("window",),
+    positions_fn=_positions,
+    stateful=False,
+))

@@ -1,0 +1,55 @@
+"""TRIX: triple-EMA rate-of-change with a signal-line crossover (path-free).
+
+``trix = roc(ema(ema(ema(close, span), span), span))`` — one-bar rate of
+change of a triple-smoothed close — traded as ``sign(trix - ema(trix,
+signal))``, the same crossover shape as MACD but on a triple-filtered
+oscillator, giving the sweep engine a third path-free trend family with a
+*different* noise/lag trade-off (three cascaded poles vs MACD's two spans).
+
+Every EMA evaluates as a Hillis–Steele shift-doubling ladder
+(``ops.rolling.ema_ladder`` — ~log2(T) fused VPU passes), the exact
+rounding twin of the fused kernel's in-kernel ladder, so the generic and
+fused paths resolve the same knife edges (the MACD family's round-4
+lesson). No demeaning is needed here: the rate of change is a *ratio*, so
+the price level cancels instead of inflating the f32 error budget.
+
+Warmup: each EMA stage is seed-dominated for ~span bars; positions are
+masked flat for ``t < 3*span + signal - 3`` (three cascaded spans plus the
+signal span, the MACD rule extended to the triple cascade, plus one bar
+for the rate-of-change difference).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops import rolling
+from .base import Strategy, register
+
+
+def trix_lines(close, span, signal):
+    """``(trix, signal_line)`` for spans ``span``/``signal`` (traced scalars
+    allowed; shapes ``(..., T)``). ``trix[0] = 0`` (the one-bar rate of
+    change has no history at bar 0)."""
+    e3 = rolling.ema_ladder(
+        rolling.ema_ladder(
+            rolling.ema_ladder(close, span=span), span=span), span=span)
+    prev = jnp.concatenate([e3[..., :1], e3[..., :-1]], axis=-1)
+    trix = e3 / prev - 1.0
+    return trix, rolling.ema_ladder(trix, span=signal)
+
+
+def _positions(ohlcv, params):
+    close = ohlcv.close
+    trix, sig = trix_lines(close, params["span"], params["signal"])
+    warm = 3.0 * jnp.asarray(params["span"]) + jnp.asarray(params["signal"]) - 2.0
+    valid = rolling.valid_mask(close.shape[-1], warm)
+    return jnp.where(valid, jnp.sign(trix - sig), 0.0)
+
+
+TRIX = register(Strategy(
+    name="trix",
+    param_fields=("span", "signal"),
+    positions_fn=_positions,
+    stateful=False,
+))

@@ -1677,6 +1677,273 @@ def _macd_grid_setup(fast_bytes: bytes, slow_bytes: bytes,
             _const(oh_s), _const(a_sig), _const(warm))
 
 
+def _obv_kernel(r_ref, obv_ref, sma_ref, oh_ref, warm_ref, *refs,
+                cost: float, ppy: int, T_real: int | None):
+    """OBV-trend cell: one window-table selection gives the OBV rolling
+    mean; position = sign(obv - sma). The selection one-hot has a single
+    nonzero per lane, so the MXU contraction is an exact copy — the only
+    rounding in the cell is the subtraction itself."""
+    tr, out_ref = _unpack_tr(refs, T_real)
+    T_pad = r_ref.shape[1]
+    r = r_ref[0]
+    obv = obv_ref[0]                 # (T_pad, 1) -> broadcasts over lanes
+    sma = jnp.dot(sma_ref[0], oh_ref[:],      # (T_pad, W) x (W, 128)
+                  preferred_element_type=jnp.float32,
+                  precision=jax.lax.Precision.HIGHEST)
+    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, _LANES), 0)
+    warm = warm_ref[0, :][None, :]               # (1, 128) = window
+    valid = t_idx >= (warm.astype(jnp.int32) - 1)
+    pos = jnp.where(valid, jnp.sign(obv - sma), 0.0)
+    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
+                     "ppy", "interpret"))
+def _fused_obv_call(close, volume, onehot_w, warm, t_real, *,
+                    windows: tuple, T_pad: int, W_pad: int, P_real: int,
+                    T_real: int | None, cost: float, ppy: int,
+                    interpret: bool):
+    """OBV series + distinct-window SMA table prep + pallas call in one jit.
+
+    The OBV accumulator is the SHARED ``rolling.obv_series`` (the same
+    function ``models.obv`` evaluates), and the windowed mean follows the
+    generic ``rolling.rolling_mean``'s cumsum-difference op order, so the
+    paths are rounding twins by construction (see the SMA table comment in
+    ``_fused_call`` for the gather layout rationale).
+    """
+    from . import rolling
+
+    N, T = close.shape
+    close_p = _pad_last(close, T_pad)
+    vol_p = _pad_last(volume, T_pad)
+    obv = rolling.obv_series(close_p, vol_p)                   # (N, T_pad)
+
+    cs = jnp.cumsum(obv, axis=1)
+    w_vec = jnp.asarray(np.asarray(windows, np.int32))         # (W,)
+    t_idx = jnp.arange(T_pad)[:, None]                         # (T_pad, 1)
+    gather_idx = jnp.clip(t_idx - w_vec[None, :], 0, T_pad - 1)
+    shifted = jnp.take(cs, gather_idx, axis=1)                 # (N,T_pad,W)
+    shifted = jnp.where((t_idx >= w_vec[None, :])[None], shifted, 0.0)
+    sma_table = (cs[:, :, None] - shifted) / w_vec[None, None, :].astype(
+        jnp.float32)
+    sma_table = jnp.where(
+        (t_idx >= w_vec[None, :] - 1)[None], sma_table, 0.0)
+    if W_pad > len(windows):
+        sma_table = jnp.concatenate(
+            [sma_table,
+             jnp.zeros((N, T_pad, W_pad - len(windows)), jnp.float32)],
+            axis=-1)
+
+    P_pad = onehot_w.shape[1]
+    n_blocks = P_pad // _LANES
+    kernel = functools.partial(_obv_kernel, cost=cost, ppy=ppy,
+                               T_real=T_real)
+    out = pl.pallas_call(
+        kernel,
+        grid=(N, n_blocks),
+        in_specs=[
+            pl.BlockSpec((1, T_pad, 1), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T_pad, 1), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T_pad, W_pad), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((W_pad, _LANES), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ] + _tr_specs(T_real),
+        out_specs=pl.BlockSpec(
+            (1, 1, _METRIC_ROWS, _LANES), lambda i, j: (i, j, 0, 0),
+            memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(
+            (N, n_blocks, _METRIC_ROWS, _LANES), jnp.float32),
+        interpret=interpret,
+    )(_rets3(close_p), obv[:, :, None], sma_table, onehot_w, warm,
+      *_tr_args(t_real, T_real))
+    return Metrics(*(
+        jnp.reshape(out[:, :, k, :], (N, P_pad))[:, :P_real]
+        for k in range(9)))
+
+
+def fused_obv_sweep(close, volume, window, *, t_real=None, cost: float = 0.0,
+                    periods_per_year: int = 252,
+                    interpret: bool | None = None) -> Metrics:
+    """Fused OBV-trend sweep: ``(N, T)`` closes+volumes x ``(P,)`` windows.
+
+    ``window`` is a flat per-combo window array (:func:`product_grid`
+    order); windows must be integral bar counts. Matches
+    ``run_sweep(..., "obv_trend")`` (``models.obv``) to f32 tolerance —
+    the OBV accumulation, first-bar volume normalization, and windowed
+    mean follow the generic path's exact op order, and the selection
+    contraction is an exact one-hot copy.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    close = jnp.asarray(close, jnp.float32)
+    volume = jnp.asarray(volume, jnp.float32)
+    window = np.asarray(window)
+    T = close.shape[1]
+    windows, onehot_w, warm = _obv_grid_setup(
+        window.astype(np.float32).tobytes())
+    return _fused_obv_call(close, volume, onehot_w, warm,
+                           _t_real_col(t_real, close),
+                           windows=windows, T_pad=_round_up(T, 128),
+                           W_pad=onehot_w.shape[0], P_real=window.shape[0],
+                           T_real=T if t_real is None else None,
+                           cost=float(cost), ppy=int(periods_per_year),
+                           interpret=bool(interpret))
+
+
+@functools.lru_cache(maxsize=4)
+def _obv_grid_setup(window_bytes: bytes):
+    """Distinct windows + selector and warmup (= window) lanes."""
+    window = np.frombuffer(window_bytes, np.float32)
+    P = window.shape[0]
+    windows = _distinct_windows(window, "windows")
+    W_pad = _round_up(max(windows.shape[0], 1), 8)
+    P_pad = _round_up(max(P, 1), _LANES)
+    oh = _window_onehot(windows, window, W_pad, P_pad)
+    warm = np.ones((1, P_pad), np.float32)
+    warm[0, :P] = window
+    return (tuple(int(w) for w in windows), _const(oh), _const(warm))
+
+
+def _trix_kernel(r_ref, ema_ref, oh_ref, asig_ref, warm_ref, *refs,
+                 cost: float, ppy: int, T_real: int | None):
+    """TRIX cell: one span-table selection gives the triple-smoothed close;
+    the one-bar rate of change is computed in-kernel (a ratio, so the price
+    level cancels); the signal line is a per-lane EMA ladder; position =
+    sign(trix - signal)."""
+    tr, out_ref = _unpack_tr(refs, T_real)
+    T_pad = r_ref.shape[1]
+    r = r_ref[0]
+    dn = (((0,), (0,)), ((), ()))
+    e3 = jax.lax.dot_general(ema_ref[0], oh_ref[:], dn,
+                             preferred_element_type=jnp.float32,
+                             precision=jax.lax.Precision.HIGHEST)
+    prev = _shift_down(e3, 1, 1.0)
+    # Padded lanes select all-zero table rows (0/0): guard the denominator
+    # so they stay finite; real lanes have positive price-level EMAs.
+    denom = jnp.where(prev == 0.0, 1.0, prev)
+    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, _LANES), 0)
+    # trix[0] = 0 exactly, matching models.trix (prev seeds with e3[0]).
+    trix = jnp.where(t_idx == 0, 0.0, e3 / denom - 1.0)
+    a_sig = asig_ref[0, :][None, :]                  # (1, 128)
+    sig = _ema_ladder(trix, a_sig)
+
+    warm = warm_ref[0, :][None, :]                   # 3*span + signal - 2
+    valid = t_idx >= (warm.astype(jnp.int32) - 1)
+    pos = jnp.where(valid, jnp.sign(trix - sig), 0.0)
+    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("spans", "T_pad", "W_pad", "P_real", "T_real", "cost",
+                     "ppy", "interpret"))
+def _fused_trix_call(close, onehot, a_sig, warm, t_real, *,
+                     spans: tuple, T_pad: int, W_pad: int, P_real: int,
+                     T_real: int | None, cost: float, ppy: int,
+                     interpret: bool):
+    """Distinct-span triple-EMA table prep + pallas call in one jit."""
+    close_p = _pad_last(close, T_pad)
+    N = close.shape[0]
+    rows = []
+    for s in spans:
+        a = 2.0 / (float(s) + 1.0)
+        rows.append(_ema_rows(_ema_rows(_ema_rows(close_p, a), a), a))
+    e3_tbl = jnp.stack(rows, axis=1)                             # (N,W,T_pad)
+    if W_pad > len(spans):
+        e3_tbl = jnp.concatenate(
+            [e3_tbl, jnp.zeros((N, W_pad - len(spans), T_pad),
+                               jnp.float32)], axis=1)
+
+    P_pad = a_sig.shape[1]
+    n_blocks = P_pad // _LANES
+    kernel = functools.partial(_trix_kernel, cost=cost, ppy=ppy,
+                               T_real=T_real)
+    out = pl.pallas_call(
+        kernel,
+        grid=(N, n_blocks),
+        in_specs=[
+            pl.BlockSpec((1, T_pad, 1), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, W_pad, T_pad), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((W_pad, _LANES), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ] + _tr_specs(T_real),
+        out_specs=pl.BlockSpec(
+            (1, 1, _METRIC_ROWS, _LANES), lambda i, j: (i, j, 0, 0),
+            memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(
+            (N, n_blocks, _METRIC_ROWS, _LANES), jnp.float32),
+        interpret=interpret,
+    )(_rets3(close_p), e3_tbl, onehot, a_sig, warm,
+      *_tr_args(t_real, T_real))
+    return Metrics(*(
+        jnp.reshape(out[:, :, k, :], (N, P_pad))[:, :P_real]
+        for k in range(9)))
+
+
+def fused_trix_sweep(close, span, signal, *, t_real=None, cost: float = 0.0,
+                     periods_per_year: int = 252,
+                     interpret: bool | None = None) -> Metrics:
+    """Fused TRIX signal-line crossover sweep: ``(N, T)`` x ``(P,)`` lanes.
+
+    ``span``/``signal`` are flat per-combo span arrays (:func:`product_grid`
+    order); spans must be integral. Matches ``run_sweep(..., "trix")``
+    (``models.trix``) to f32 tolerance — both paths evaluate every EMA with
+    the same shift-doubling ladder (``rolling.ema_ladder`` generically,
+    ``_ema_rows`` / ``_ema_ladder`` here) and the rate-of-change ratio
+    cancels the price level, so the only residual divergence class is the
+    MXU selection matmul for the triple-smoothed close.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    close = jnp.asarray(close, jnp.float32)
+    span = np.asarray(span)
+    signal = np.asarray(signal)
+    T = close.shape[1]
+    spans, onehot, a_sig, warm = _trix_grid_setup(
+        span.astype(np.float32).tobytes(),
+        signal.astype(np.float32).tobytes())
+    return _fused_trix_call(close, onehot, a_sig, warm,
+                            _t_real_col(t_real, close),
+                            spans=spans, T_pad=_round_up(T, 128),
+                            W_pad=onehot.shape[0], P_real=span.shape[0],
+                            T_real=T if t_real is None else None,
+                            cost=float(cost), ppy=int(periods_per_year),
+                            interpret=bool(interpret))
+
+
+@functools.lru_cache(maxsize=4)
+def _trix_grid_setup(span_bytes: bytes, signal_bytes: bytes):
+    """Distinct spans + selector, per-lane signal decay and warmup
+    (= 3*span + signal - 2, ``models.trix``'s rule)."""
+    span = np.frombuffer(span_bytes, np.float32)
+    signal = np.frombuffer(signal_bytes, np.float32)
+    P = span.shape[0]
+    spans = _distinct_windows(span, "spans")
+    _distinct_windows(signal, "signal spans")   # validate integrality only
+    W_pad = _round_up(max(spans.shape[0], 1), 8)
+    P_pad = _round_up(max(P, 1), _LANES)
+    oh = _window_onehot(spans, span, W_pad, P_pad)
+    a_sig = np.zeros((1, P_pad), np.float32)
+    a_sig[0, :P] = 2.0 / (signal + 1.0)
+    warm = np.ones((1, P_pad), np.float32)
+    warm[0, :P] = 3.0 * span + signal - 2.0
+    return (tuple(int(s) for s in spans), _const(oh),
+            _const(a_sig), _const(warm))
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",

@@ -206,6 +206,24 @@ def ema_ladder(x: Array, *, span=None, alpha=None) -> Array:
     return B
 
 
+def obv_series(close, volume):
+    """Normalized on-balance volume, shape ``(..., T)``; ``obv[0] = 0``.
+
+    ``obv[t] = sum_{s<=t} sign(close[s] - close[s-1]) * v[s]`` with
+    ``v = volume / volume[..., :1]`` (zero-guarded). The first-bar
+    normalization keeps the double accumulation (this cumsum, then a
+    windowed mean of it) at O(1) magnitudes instead of raw-volume ~1e6
+    scale; the traded quantity ``sign(obv - sma)`` is invariant under the
+    scaling. This is the ONE definition both the generic model
+    (``models.obv``) and the fused kernel prep evaluate — shared so the
+    two paths stay rounding twins by construction.
+    """
+    v0 = volume[..., :1]
+    v = volume / jnp.where(v0 == 0.0, 1.0, v0)
+    step = jnp.sign(jnp.diff(close, axis=-1, prepend=close[..., :1])) * v
+    return jnp.cumsum(step, axis=-1)
+
+
 def _static_window(window, name: str) -> int:
     if not isinstance(window, (int,)):
         raise TypeError(

@@ -262,6 +262,13 @@ class JaxSweepBackend:
             periods_per_year=ppy)
 
     @staticmethod
+    def _run_fused_trix(close, grid, cost, ppy, t_real):
+        from ..ops import fused
+        return fused.fused_trix_sweep(
+            close, np.asarray(grid["span"]), np.asarray(grid["signal"]),
+            t_real=t_real, cost=cost, periods_per_year=ppy)
+
+    @staticmethod
     def _run_fused_donchian_hl(close, high, low, grid, cost, ppy, t_real):
         from ..ops import fused
         return fused.fused_donchian_hl_sweep(
@@ -283,6 +290,13 @@ class JaxSweepBackend:
             close, high, low, np.asarray(grid["window"]),
             np.asarray(grid["k"]), t_real=t_real, cost=cost,
             periods_per_year=ppy)
+
+    @staticmethod
+    def _run_fused_obv(close, volume, grid, cost, ppy, t_real):
+        from ..ops import fused
+        return fused.fused_obv_sweep(
+            close, volume, np.asarray(grid["window"]), t_real=t_real,
+            cost=cost, periods_per_year=ppy)
 
     @staticmethod
     def _run_fused_vwap(close, volume, grid, cost, ppy, t_real):
@@ -315,9 +329,13 @@ class JaxSweepBackend:
         "macd": _FusedSpec({"fast", "slow", "signal"},
                            ("fast", "slow", "signal"), _run_fused_macd,
                            table_axes=("fast", "slow")),
+        "trix": _FusedSpec({"span", "signal"}, ("span", "signal"),
+                           _run_fused_trix, table_axes=("span",)),
         "vwap_reversion": _FusedSpec({"window", "k"}, ("window",),
                                      _run_fused_vwap,
                                      fields=("close", "volume")),
+        "obv_trend": _FusedSpec({"window"}, ("window",), _run_fused_obv,
+                                fields=("close", "volume")),
     }
 
     @classmethod

@@ -411,6 +411,44 @@ def test_fused_macd_ragged():
         np.asarray(grid["signal"]), t_real=lens, cost=1e-3)
     _macd_flip_aware_check(got, ref)
 
+
+def test_fused_trix_matches_generic():
+    ohlcv = data.synthetic_ohlcv(3, 200, seed=23)
+    panel = type(ohlcv)(*(jnp.asarray(f) for f in ohlcv))
+    grid = sweep.product_grid(
+        span=jnp.asarray([5.0, 9.0, 15.0], jnp.float32),
+        signal=jnp.asarray([4.0, 9.0], jnp.float32))
+    ref = sweep.jit_sweep(panel, get_strategy("trix"), dict(grid), cost=1e-3)
+    got = fused.fused_trix_sweep(
+        panel.close, np.asarray(grid["span"]), np.asarray(grid["signal"]),
+        cost=1e-3)
+    _macd_flip_aware_check(got, ref)
+
+
+def test_fused_trix_ragged():
+    series = []
+    for i, T in enumerate([150, 200, 97]):
+        one = data.synthetic_ohlcv(1, T, seed=60 + i)
+        series.append(type(one)(*(f[0] for f in one)))
+    batch, lens, mask = data.pad_and_stack(series)
+    panel = type(batch)(*(jnp.asarray(f) for f in batch))
+    grid = sweep.product_grid(
+        span=jnp.asarray([5.0, 9.0], jnp.float32),
+        signal=jnp.asarray([4.0], jnp.float32))
+    ref = sweep.jit_sweep(panel, get_strategy("trix"), dict(grid), cost=1e-3,
+                          bar_mask=jnp.asarray(mask))
+    got = fused.fused_trix_sweep(
+        batch.close, np.asarray(grid["span"]), np.asarray(grid["signal"]),
+        t_real=lens, cost=1e-3)
+    _macd_flip_aware_check(got, ref)
+
+
+def test_fused_trix_rejects_non_integer_spans():
+    with pytest.raises(ValueError, match="integral"):
+        fused.fused_trix_sweep(
+            jnp.ones((1, 64)), np.asarray([10.5]), np.asarray([4.0]))
+
+
 def _check_panel_sweep(strategy, fused_call, grid_axes, n_tickers=3, T=200,
                        cost=1e-3, seed=0, rtol=2e-4, atol=2e-5):
     """Generic-vs-fused parity for strategies consuming non-close columns:
@@ -496,6 +534,37 @@ def test_fused_vwap_ragged():
         dict(window=jnp.asarray([10.0, 20.0], jnp.float32),
              k=jnp.asarray([1.0, 2.0], jnp.float32)),
         lengths=[180, 131, 256], seed=60)
+
+
+def _obv_call(panel, grid, lens):
+    return fused.fused_obv_sweep(
+        panel.close, panel.volume, np.asarray(grid["window"]),
+        t_real=lens, cost=1e-3)
+
+
+def test_fused_obv_matches_generic():
+    _check_panel_sweep(
+        "obv_trend", _obv_call,
+        dict(window=jnp.asarray([8, 15, 30], jnp.float32)), seed=17)
+
+
+def test_fused_obv_unaligned_T():
+    _check_panel_sweep(
+        "obv_trend", _obv_call,
+        dict(window=jnp.asarray([10, 21], jnp.float32)), T=251, seed=19)
+
+
+def test_fused_obv_ragged():
+    _check_panel_ragged(
+        "obv_trend", _obv_call,
+        dict(window=jnp.asarray([8.0, 20.0], jnp.float32)),
+        lengths=[180, 131, 256], seed=70)
+
+
+def test_fused_obv_rejects_non_integer_windows():
+    with pytest.raises(ValueError, match="integral"):
+        fused.fused_obv_sweep(jnp.ones((1, 64)), jnp.ones((1, 64)),
+                              np.asarray([10.5]))
 
 
 def test_fused_vwap_rejects_non_integer_windows():

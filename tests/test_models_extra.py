@@ -8,7 +8,7 @@ naive per-bar Python/NumPy loops — trivially auditable semantics.
 import jax.numpy as jnp
 import numpy as np
 
-from distributed_backtesting_exploration_tpu.models import base, macd, rsi
+from distributed_backtesting_exploration_tpu.models import base, macd, rsi, trix
 from distributed_backtesting_exploration_tpu.parallel import sweep
 from distributed_backtesting_exploration_tpu.utils import data
 
@@ -85,6 +85,37 @@ def test_macd_lines_match_numpy():
                                rtol=1e-4, atol=1e-4)
     np.testing.assert_allclose(np.asarray(got_sig), want_sig,
                                rtol=1e-4, atol=1e-4)
+
+
+def test_trix_lines_match_numpy():
+    close = _one_close(seed=9)
+    got_trix, got_sig = trix.trix_lines(
+        jnp.asarray(close, jnp.float32), 9.0, 4.0)
+    ema = lambda x, span: _np_ema(x, 2.0 / (span + 1.0))
+    e3 = ema(ema(ema(close, 9.0), 9.0), 9.0)
+    prev = np.concatenate([e3[:1], e3[:-1]])
+    want_trix = e3 / prev - 1.0
+    want_sig = ema(want_trix, 4.0)
+    np.testing.assert_allclose(np.asarray(got_trix), want_trix,
+                               rtol=1e-3, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got_sig), want_sig,
+                               rtol=1e-3, atol=1e-6)
+
+
+def test_obv_series_matches_numpy():
+    s = data.synthetic_ohlcv(1, 220, seed=11)
+    close = np.asarray(s.close[0], np.float64)
+    volume = np.asarray(s.volume[0], np.float64)
+    from distributed_backtesting_exploration_tpu.models import obv as obv_mod
+
+    got = np.asarray(obv_mod.obv_series(
+        jnp.asarray(close[None], jnp.float32),
+        jnp.asarray(volume[None], jnp.float32))[0])
+    v = volume / volume[0]
+    step = np.sign(np.diff(close, prepend=close[:1])) * v
+    want = np.cumsum(step)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    assert got[0] == 0.0     # first bar: sign(0) * v0 = 0
 
 
 def test_rsi_macd_sweep_end_to_end():

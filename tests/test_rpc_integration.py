@@ -524,6 +524,9 @@ def test_every_registered_strategy_travels_the_wire():
                     "k": np.float32([1.5])},
         "macd": {"fast": np.float32([5.0]), "slow": np.float32([13.0]),
                  "signal": np.float32([4.0])},
+        "trix": {"span": np.float32([6.0, 9.0]),
+                 "signal": np.float32([4.0])},
+        "obv_trend": {"window": np.float32([8.0, 15.0])},
         "vwap_reversion": {"window": np.float32([8.0]),
                            "k": np.float32([1.0])},
         "pairs": {"lookback": np.float32([10.0]),
