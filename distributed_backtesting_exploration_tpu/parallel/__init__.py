@@ -8,6 +8,9 @@
   scans (the sequence-parallelism analogue for backtests).
 - :mod:`.walkforward` — walk-forward optimization: ``lax.scan`` over refit
   windows with the sweep kernel nested inside.
+- :mod:`.portfolio` — portfolio-level composition: per-ticker param
+  selection, weighted book aggregation (one ``psum`` across a sharded
+  ticker axis), correlation diagnostics.
 """
 
-from . import sweep, sharding, timeshard, walkforward  # noqa: F401
+from . import portfolio, sweep, sharding, timeshard, walkforward  # noqa: F401
