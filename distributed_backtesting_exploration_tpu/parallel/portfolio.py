@@ -50,6 +50,21 @@ def equal_weights(n: int) -> Array:
     return jnp.full((n,), 1.0 / float(n), jnp.float32)
 
 
+def _normalize_weights(weights, n: int) -> Array:
+    """Normalize to unit GROSS exposure: ``w / sum(|w|)``.
+
+    Abs-sum (not plain sum) normalization keeps long-short books sane: a
+    dollar-neutral ``[1, -1]`` normalizes to ``[0.5, -0.5]`` instead of
+    dividing by zero, and a net-short vector keeps its sign instead of
+    silently trading inverted. For all-long weights this is the usual
+    sum-to-1 normalization.
+    """
+    if weights is None:
+        return equal_weights(n)
+    w = jnp.asarray(weights, jnp.float32)
+    return w / jnp.maximum(jnp.sum(jnp.abs(w)), 1e-12)
+
+
 def inverse_vol_weights(close, *, eps: float = 1e-12) -> Array:
     """Full-sample inverse-volatility weights from a ``(N, T)`` close panel.
 
@@ -77,15 +92,13 @@ def portfolio_returns(close, positions, *, weights=None,
 
     Each ticker's post-cost net returns come from
     :func:`~..ops.pnl.backtest_prefix`; the portfolio nets them with
-    ``weights`` (normalized; default equal). Returns ``(portfolio_net (T,),
+    ``weights`` (normalized to unit gross exposure, see
+    :func:`_normalize_weights`; default equal). Returns ``(portfolio_net (T,),
     portfolio_equity (T,), net_exposure (T,))`` — net exposure is the
     weighted sum of per-ticker positions, the book's directional tilt.
     """
     close = jnp.asarray(close, jnp.float32)
-    n = close.shape[0]
-    w = (equal_weights(n) if weights is None
-         else jnp.asarray(weights, jnp.float32))
-    w = w / jnp.sum(w)
+    w = _normalize_weights(weights, close.shape[0])
     res = pnl_mod.backtest_prefix(close, positions, cost=cost)
     port_net = jnp.einsum("n,nt->t", w, res.returns)
     port_equity = 1.0 + jnp.cumsum(port_net, axis=-1)
@@ -117,16 +130,13 @@ def select_best_params(metric_values: Array, grid: Mapping[str, Array], *,
 
     Returns ``(best_values (N,), {field: (N,) best params})`` — the
     direction-aware, NaN-last selection (NaN cells lose to any finite
-    cell, matching the worker-side top-k discipline). The params dict
-    plugs straight into :func:`portfolio_backtest`.
+    cell, matching the worker-side top-k discipline). Delegates to
+    :func:`~.sweep.best_params` — ONE selection implementation serves the
+    walk-forward refits, the aggregate read path, and this book
+    composition. The params dict plugs straight into
+    :func:`portfolio_backtest`.
     """
-    sign = metrics_mod.metric_sign(metric) if metric is not None else 1.0
-    score = jnp.where(jnp.isnan(metric_values), -jnp.inf,
-                      sign * metric_values)
-    idx = jnp.argmax(score, axis=-1)
-    best = jnp.take_along_axis(metric_values, idx[:, None], axis=-1)[:, 0]
-    chosen = {name: jnp.take(vals, idx) for name, vals in grid.items()}
-    return best, chosen
+    return sweep_mod.best_params(metric_values, grid, metric=metric)
 
 
 @functools.partial(
@@ -184,9 +194,12 @@ def sharded_portfolio_returns(mesh, close, positions, *, weights=None,
     close = jnp.asarray(close, jnp.float32)
     n = close.shape[0]
     ax = axis or mesh.axis_names[0]
-    w = (equal_weights(n) if weights is None
-         else jnp.asarray(weights, jnp.float32))
-    w = w / jnp.sum(w)
+    n_dev = mesh.shape[ax]
+    if n % n_dev:
+        raise ValueError(
+            f"N={n} tickers not divisible by the {n_dev}-way {ax!r} axis; "
+            "pad the book with zero-weight tickers")
+    w = _normalize_weights(weights, n)
 
     def local(close_blk, pos_blk, w_blk):
         res = pnl_mod.backtest_prefix(close_blk, pos_blk, cost=cost)

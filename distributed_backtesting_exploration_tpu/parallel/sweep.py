@@ -159,9 +159,15 @@ def best_params(metric_values: Array, grid: Mapping[str, Array], *, axis=-1,
     dispatcher-side result aggregation. Pass ``metric`` (the
     :class:`~..ops.metrics.Metrics` field name) so lower-is-better metrics
     (max_drawdown, volatility, turnover) select the minimum.
+
+    NaN cells rank LAST (``jnp.argmax`` alone would rank them first —
+    NaN wins float comparisons), matching the worker-side top-k and
+    aggregate-side disciplines; an all-NaN row still returns a NaN best.
     """
     sign = metrics_mod.metric_sign(metric) if metric is not None else 1.0
-    idx = jnp.argmax(sign * metric_values, axis=axis)
+    score = jnp.where(jnp.isnan(metric_values), -jnp.inf,
+                      sign * metric_values)
+    idx = jnp.argmax(score, axis=axis)
     best = jnp.take_along_axis(
         metric_values, jnp.expand_dims(idx, axis), axis=axis).squeeze(axis)
     chosen = {n: jnp.take(v, idx) for n, v in grid.items()}

@@ -902,3 +902,142 @@ def sharded_stochastic_backtest(mesh: Mesh, close, high, low, window: int,
     return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=out_specs, check_vma=False)(
         close, high, low)
+
+
+def sharded_trix_backtest(mesh: Mesh, close, span: int, signal: int, *,
+                          cost: float = 0.0, periods_per_year: int = 252,
+                          axis_name: str = TIME_AXIS):
+    """End-to-end TRIX signal-line backtest, TIME axis sharded.
+
+    Pure EMA-state composition (``models.trix`` semantics): the triple
+    smoothing is three chained blockwise linear scans
+    (:func:`_ema_local` — one ``(A, B)`` carry pair per chip each, no
+    halo), the one-bar rate of change reuses the return halo exchange,
+    and the signal line is a fourth blockwise EMA over the trix series.
+    Like the sharded RSI path, only the one-bar halo constrains the block
+    size — EMA state is O(1), so histories of any length shard.
+
+    ``span``/``signal`` are static ints. Returns scalar-per-series
+    :class:`~..ops.metrics.Metrics`, replicated. Matches the unsharded
+    ``trix`` strategy backtest to f32 tolerance.
+    """
+    from ..ops.metrics import Metrics
+
+    n_dev = mesh.shape[axis_name]
+    T = close.shape[-1]
+    if T % n_dev:
+        raise ValueError(
+            f"T={T} not divisible by the {n_dev}-way {axis_name!r} axis")
+    if span < 1 or signal < 1:
+        raise ValueError(f"spans must be >= 1, got {span}, {signal}")
+    a_span = jnp.float32(2.0 / (span + 1.0))
+    a_sig = jnp.float32(2.0 / (signal + 1.0))
+    spec = P(*((None,) * (close.ndim - 1) + (axis_name,)))
+    rep = P(*((None,) * (close.ndim - 1)))
+
+    def local(close_blk):
+        Tb = close_blk.shape[-1]
+        gidx = jnp.arange(Tb) + jax.lax.axis_index(axis_name) * Tb
+        r = _block_returns(close_blk, gidx, axis_name)
+
+        e3 = _ema_local(
+            _ema_local(
+                _ema_local(close_blk, gidx, a_span, axis_name),
+                gidx, a_span, axis_name),
+            gidx, a_span, axis_name)
+        # One-bar rate of change: trix[0] = 0 globally (models.trix seeds
+        # the lagged read with e3[0]).
+        e3_prev = jnp.concatenate(
+            [_from_left(e3, 1, axis_name), e3[..., :-1]], axis=-1)
+        trix = jnp.where(gidx == 0, 0.0,
+                         e3 / jnp.where(gidx == 0, 1.0, e3_prev) - 1.0)
+        sig = _ema_local(trix, gidx, a_sig, axis_name)
+
+        warm = 3 * span + signal - 2
+        valid = gidx >= warm - 1   # rolling.valid_mask(T, warm)
+        pos = jnp.where(valid, jnp.sign(trix - sig), 0.0)
+        return _pnl_metrics_local(pos, r, gidx, T, cost=cost,
+                                  periods_per_year=periods_per_year,
+                                  axis_name=axis_name)
+
+    out_specs = Metrics(*(rep for _ in Metrics._fields))
+    return jax.shard_map(local, mesh=mesh, in_specs=spec,
+                         out_specs=out_specs, check_vma=False)(close)
+
+
+def sharded_obv_backtest(mesh: Mesh, close, volume, window: int, *,
+                         cost: float = 0.0, periods_per_year: int = 252,
+                         axis_name: str = TIME_AXIS):
+    """End-to-end OBV-trend backtest, TIME axis sharded.
+
+    A *double-accumulation* composition (``models.obv`` semantics): the
+    OBV series is a distributed cumsum of the signed volume steps (one
+    block-offset ``all_gather``), and its rolling mean is a second
+    distributed cumsum over the OBV values with a ``window``-bar halo for
+    the lagged read (:func:`_cumsum_ext` + :func:`_windowed_sum_blk` —
+    the SMA machinery applied to a derived series). The first-bar volume
+    normalizer is one ``psum`` of the chip-0 contribution.
+
+    ``window`` is a static int with ``window <= block length``. Returns
+    scalar-per-series :class:`~..ops.metrics.Metrics`, replicated.
+    Matches the unsharded ``obv_trend`` strategy backtest to f32
+    tolerance.
+    """
+    from ..ops.metrics import Metrics
+
+    n_dev = mesh.shape[axis_name]
+    T = close.shape[-1]
+    if T % n_dev:
+        raise ValueError(
+            f"T={T} not divisible by the {n_dev}-way {axis_name!r} axis")
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    if window > T // n_dev:
+        raise ValueError(
+            f"window={window} exceeds the {T // n_dev}-bar block; the halo "
+            "exchange needs the window to fit one neighbor block")
+    halo_w = window
+    spec = P(*((None,) * (close.ndim - 1) + (axis_name,)))
+    rep = P(*((None,) * (close.ndim - 1)))
+
+    def local(close_blk, vol_blk):
+        Tb = close_blk.shape[-1]
+        gidx = jnp.arange(Tb) + jax.lax.axis_index(axis_name) * Tb
+
+        # ONE one-bar halo exchange serves both the returns and the OBV
+        # sign step (collectives are latency-bound; XLA is not guaranteed
+        # to CSE two identical ppermutes — the sharded-RSI discipline).
+        prev_close = jnp.concatenate(
+            [_from_left(close_blk, 1, axis_name), close_blk[..., :-1]],
+            axis=-1)
+        r = jnp.where(gidx == 0, 0.0,
+                      close_blk / jnp.where(gidx == 0, 1.0, prev_close)
+                      - 1.0)
+
+        # First-bar volume normalizer, broadcast from the global bar 0.
+        v0 = jax.lax.psum(
+            jnp.sum(jnp.where(gidx == 0, vol_blk, 0.0), axis=-1),
+            axis_name)[..., None]
+        v = vol_blk / jnp.where(v0 == 0.0, 1.0, v0)
+        # diff[0] = 0 globally (sign(0) = 0).
+        step = jnp.where(gidx == 0, 0.0,
+                         jnp.sign(close_blk - prev_close)) * v
+
+        # OBV = distributed cumsum of steps; its rolling mean = a second
+        # distributed cumsum with a window halo (the double accumulation).
+        obv = jnp.cumsum(step, axis=-1)
+        obv = obv + _exclusive_block_offset(obv[..., -1],
+                                            axis_name)[..., None]
+        cs, cs_ext = _cumsum_ext(obv, halo_w, axis_name)
+        sma = _windowed_sum_blk(cs, cs_ext, gidx, window,
+                                halo_w) / jnp.float32(window)
+
+        valid = gidx >= window - 1   # rolling.valid_mask(T, window)
+        pos = jnp.where(valid, jnp.sign(obv - sma), 0.0)
+        return _pnl_metrics_local(pos, r, gidx, T, cost=cost,
+                                  periods_per_year=periods_per_year,
+                                  axis_name=axis_name)
+
+    out_specs = Metrics(*(rep for _ in Metrics._fields))
+    return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec),
+                         out_specs=out_specs, check_vma=False)(close, volume)

@@ -150,3 +150,26 @@ def test_portfolio_turnover_uses_net_exposure():
     net, equity, expo = portfolio.portfolio_returns(two.close, pos, cost=0.0)
     np.testing.assert_allclose(np.asarray(expo), 0.0, atol=1e-7)
     np.testing.assert_allclose(np.asarray(net), 0.0, atol=1e-7)
+
+
+def test_long_short_weights_normalize_by_gross():
+    """Dollar-neutral weights must not divide by zero or flip sign: with
+    w = [1, -1] on two identical tickers the book is flat (net 0), and a
+    net-short book keeps its direction."""
+    one = _panel(n=1, seed=9)
+    two = type(one)(*(jnp.repeat(f, 2, axis=0) for f in one))
+    strat = base.get_strategy("momentum")
+    pos = portfolio.per_ticker_positions(
+        two, strat, {"lookback": jnp.full((2,), 10.0)})
+    net, equity, expo = portfolio.portfolio_returns(
+        two.close, pos, weights=np.float32([1.0, -1.0]), cost=0.0)
+    assert np.isfinite(np.asarray(net)).all()
+    np.testing.assert_allclose(np.asarray(net), 0.0, atol=1e-7)
+    # Net-short [1, -2] on identical tickers == -1/3 of the single book.
+    net_s, _, _ = portfolio.portfolio_returns(
+        two.close, pos, weights=np.float32([1.0, -2.0]), cost=0.0)
+    net_1, _, _ = portfolio.portfolio_returns(
+        two.close[:1], pos[:1], cost=0.0)
+    np.testing.assert_allclose(np.asarray(net_s),
+                               -np.asarray(net_1) / 3.0,
+                               rtol=1e-5, atol=1e-7)

@@ -138,3 +138,23 @@ def test_chunked_sweep_matches_jit_sweep():
         np.testing.assert_allclose(
             np.asarray(getattr(got, name)), np.asarray(getattr(ref, name)),
             rtol=1e-6, atol=1e-7, err_msg=name)
+
+
+def test_best_params_ranks_nan_last():
+    """NaN metric cells must lose to any finite cell (jnp.argmax alone
+    would rank NaN first); an all-NaN row still reports NaN. Direction
+    awareness: lower-is-better metrics select the minimum."""
+    import jax.numpy as jnp
+    from distributed_backtesting_exploration_tpu.parallel import sweep as sw
+
+    vals = jnp.asarray([[0.5, jnp.nan, 2.0],
+                        [jnp.nan, jnp.nan, jnp.nan],
+                        [3.0, 1.0, -1.0]])
+    grid = {"window": jnp.asarray([10.0, 20.0, 30.0])}
+    best, chosen = sw.best_params(vals, grid, metric="sharpe")
+    assert np.asarray(chosen["window"]).tolist() == [30.0, 10.0, 10.0]
+    assert float(best[0]) == 2.0 and float(best[2]) == 3.0
+    assert np.isnan(float(best[1]))
+    _, chosen_dd = sw.best_params(
+        jnp.asarray([[0.3, 0.1, jnp.nan]]), grid, metric="max_drawdown")
+    assert float(chosen_dd["window"][0]) == 20.0

@@ -370,3 +370,71 @@ def test_sharded_pairs_backtest_rejects_oversized_lookback(devices):
     with pytest.raises(ValueError, match="halo"):
         timeshard.sharded_pairs_backtest(mesh, jnp.ones((1, 256)),
                                          jnp.ones((1, 256)), 100, 1.0)
+
+
+def test_sharded_trix_backtest_matches_single_device(devices):
+    """The round-4 EMA-state composition: a full TRIX signal-line backtest
+    with the bar axis sharded over 8 chips matches the unsharded
+    computation — four chained blockwise EMAs, O(1) carry each.
+
+    Flip-aware, like the pairs test: sign(trix - sig) is a razor edge and
+    the blockwise associative_scan rounds ~1e-7 differently from the
+    generic path's ema_ladder, so a knife-edge crossing can diverge one
+    series' whole path — such series must stay rare and every non-flipped
+    series must match tightly."""
+    from distributed_backtesting_exploration_tpu.utils import data
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(devices[:8]), (timeshard.TIME_AXIS,))
+    ohlcv = data.synthetic_ohlcv(8, 1024, seed=41)
+    close = jnp.asarray(ohlcv.close)
+    span, signal = 9, 4
+
+    got = timeshard.sharded_trix_backtest(mesh, close, span, signal,
+                                          cost=1e-3)
+    want = _single_device_strategy_metrics(
+        ohlcv, "trix", dict(span=span, signal=signal))
+
+    flipped = np.zeros(8, dtype=bool)
+    for name in want._fields:
+        a = np.asarray(getattr(got, name))
+        b = np.asarray(getattr(want, name))
+        flipped |= np.abs(a - b) > (0.01 + 0.01 * np.abs(b))
+    assert int(flipped.sum()) <= 2, f"{int(flipped.sum())}/8 flips"
+    for name in want._fields:
+        a = np.asarray(getattr(got, name))[~flipped]
+        b = np.asarray(getattr(want, name))[~flipped]
+        np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-4,
+                                   err_msg=name)
+
+
+def test_sharded_obv_backtest_matches_single_device(devices):
+    """The double-accumulation composition: OBV (distributed cumsum of
+    signed volume) vs its rolling mean (second distributed cumsum + halo)
+    matches the unsharded obv_trend backtest."""
+    from distributed_backtesting_exploration_tpu.utils import data
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(devices[:8]), (timeshard.TIME_AXIS,))
+    ohlcv = data.synthetic_ohlcv(3, 1024, seed=43)
+    close = jnp.asarray(ohlcv.close)
+    volume = jnp.asarray(ohlcv.volume)
+    window = 20
+
+    got = timeshard.sharded_obv_backtest(mesh, close, volume, window,
+                                         cost=1e-3)
+    want = _single_device_strategy_metrics(
+        ohlcv, "obv_trend", dict(window=window))
+    for name in want._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(got, name)), np.asarray(getattr(want, name)),
+            rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_sharded_obv_window_must_fit_block(devices):
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(devices[:8]), (timeshard.TIME_AXIS,))
+    ones = jnp.ones((1, 256))
+    with pytest.raises(ValueError, match="exceeds"):
+        timeshard.sharded_obv_backtest(mesh, ones, ones, 100)
