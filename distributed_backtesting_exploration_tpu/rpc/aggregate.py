@@ -85,6 +85,19 @@ def aggregate(results_dir: str, journal_path: str, *,
                     "job %s: DBXS block was reduced by %r but aggregation "
                     "ranks by %r — the reported best is best among the "
                     "retained top-k rows only", jid, block_metric, metric)
+        elif kind == "returns":
+            # DBXP block: one best row (k=1 by the block's own rank
+            # metric) + the return series, which this ranking path does
+            # not need (`--portfolio` is the series read path).
+            gi, m_row, _ret, block_metric = wire.best_returns_from_bytes(
+                blob)
+            grid_idx = np.asarray([gi])
+            m = Metrics(*(np.asarray([v], np.float32) for v in m_row))
+            if block_metric != metric:
+                log.warning(
+                    "job %s: DBXP block kept only the best-by-%r combo; "
+                    "ranking by %r compares those single survivors",
+                    jid, block_metric, metric)
         else:
             m = wire.metrics_from_bytes(blob)
         values = np.asarray(getattr(m, metric)).reshape(-1)
@@ -119,7 +132,8 @@ def aggregate(results_dir: str, journal_path: str, *,
             axes = {k: np.asarray(v, np.float32)
                     for k, v in sorted(rec.get("grid", {}).items())}
             grid = _np_product_grid(axes) if axes else {}
-            row["mode"] = "sweep" if kind == "metrics" else "sweep_topk"
+            row["mode"] = {"metrics": "sweep", "topk": "sweep_topk",
+                           "returns": "sweep_best_returns"}[kind]
             combo = int(grid_idx[idx]) if grid_idx is not None else idx
             row["params"] = {k: float(v[combo]) for k, v in grid.items()}
         rows.append(row)
@@ -137,6 +151,125 @@ def aggregate(results_dir: str, journal_path: str, *,
     }
 
 
+def _np_portfolio_metrics(returns: np.ndarray,
+                          periods_per_year: int = 252) -> dict:
+    """NumPy twin of the returns/equity subset of
+    ``ops.metrics.summary_metrics`` for ONE return series (same formulas:
+    population moments, additive equity ``1 + cumsum``, peak-relative
+    drawdown). Golden-tested against the jax version. The position-derived
+    fields (hit_rate, n_trades, turnover) need per-leg exposures that DBXP
+    blocks deliberately do not carry, so they are absent here."""
+    r = np.asarray(returns, np.float64)
+    n = max(r.shape[-1], 1)
+    eps = 1e-12
+    mean = r.sum() / n
+    std = np.sqrt(max(np.square(r).sum() / n - mean * mean, 0.0))
+    downside = np.minimum(r, 0.0)
+    dstd = np.sqrt(np.square(downside).sum() / n)
+    ann = np.sqrt(periods_per_year)
+    equity = 1.0 + np.cumsum(r)
+    peak = np.maximum.accumulate(equity)
+    mdd = float(np.max((peak - equity) / np.maximum(peak, eps)))
+    years = max(n / periods_per_year, eps)
+    final = max(equity[-1], eps)
+    return {
+        "sharpe": float(mean / (std + eps) * ann),
+        "sortino": float(mean / (dstd + eps) * ann),
+        "max_drawdown": mdd,
+        "total_return": float(equity[-1] - 1.0),
+        "cagr": float(final ** (1.0 / years) - 1.0),
+        "volatility": float(std * ann),
+    }
+
+
+def portfolio(results_dir: str, journal_path: str, *,
+              weights: str = "equal",
+              periods_per_year: int = 252, top: int = 10) -> dict:
+    """Compose stored DBXP best-return series into the true fleet book.
+
+    This is the read-path half of ``JobSpec.best_returns``: each job shipped
+    its winning combo's per-bar net returns, so the fleet-level portfolio —
+    which per-job metric ROWS cannot produce (cross-ticker correlations are
+    lost in a scalar) — is a weighted sum of stored series. ``weights`` is
+    ``"equal"`` or ``"inverse_vol"`` (per-leg 1/std of its net returns),
+    normalized to unit gross exposure like
+    ``parallel.portfolio._normalize_weights``. All legs must share one bar
+    count (compose over a uniform fleet; ragged legs error loudly with the
+    offending lengths). Runs dispatcher-side on NumPy only — no jax.
+    """
+    if weights not in ("equal", "inverse_vol"):
+        raise ValueError(f"unknown weights scheme {weights!r}; "
+                         "one of: equal, inverse_vol")
+    state = Journal.replay(journal_path)
+    legs = []
+    for jid, rec in state.jobs.items():
+        path = os.path.join(results_dir, f"{jid}.dbxm")
+        if not os.path.exists(path):
+            continue
+        with open(path, "rb") as fh:
+            blob = fh.read()
+        if wire.result_kind(blob) != "returns":
+            continue
+        grid_idx, m_row, ret, rank_metric = wire.best_returns_from_bytes(blob)
+        axes = {k: np.asarray(v, np.float32)
+                for k, v in sorted(rec.get("grid", {}).items())}
+        grid = _np_product_grid(axes) if axes else {}
+        legs.append({
+            "job": jid,
+            "strategy": rec.get("strategy"),
+            "path": rec.get("path"),
+            "rank_metric": rank_metric,
+            "value": float(getattr(m_row, rank_metric))
+            if rank_metric in Metrics._fields else None,
+            "params": {k: float(v[grid_idx]) for k, v in grid.items()},
+            "returns": ret,
+        })
+    if not legs:
+        raise ValueError(
+            f"no DBXP best-returns blocks found under {results_dir!r} — "
+            "was the fleet run with --best-returns?")
+    lengths = {leg["returns"].shape[0] for leg in legs}
+    if len(lengths) > 1:
+        raise ValueError(
+            "cannot compose ragged legs into one book: bar counts "
+            f"{sorted(lengths)} differ across jobs")
+    R = np.stack([leg["returns"] for leg in legs]).astype(np.float64)
+    live = R.std(axis=-1) > 0
+    if weights == "inverse_vol":
+        # A never-traded leg (flat series, std = 0) must not receive
+        # 1/eps ~ 1e12 weight and collapse the book to zero — dead legs
+        # get weight 0 (all-dead falls back to equal).
+        if live.any():
+            w = np.where(live, 1.0 / (R.std(axis=-1) + 1e-12), 0.0)
+        else:
+            w = np.ones(R.shape[0])
+    else:
+        w = np.ones(R.shape[0])
+    w = w / max(np.abs(w).sum(), 1e-12)
+    port = w @ R
+    # Diversification scalar: mean off-diagonal correlation. Zero-variance
+    # legs produce NaN rows in corrcoef; exclude them rather than
+    # poisoning the mean.
+    if int(live.sum()) >= 2:
+        corr = np.corrcoef(R[live])
+        k = corr.shape[0]
+        avg_corr = float((corr.sum() - np.trace(corr)) / (k * (k - 1)))
+    else:
+        avg_corr = None
+    for leg, wi in zip(legs, w):
+        leg["weight"] = float(wi)
+        del leg["returns"]
+    legs.sort(key=lambda r: (r["value"] is None, -(r["value"] or 0.0)))
+    return {
+        "weights": weights,
+        "legs_composed": len(legs),
+        "bars": int(R.shape[1]),
+        "avg_pairwise_correlation": avg_corr,
+        "portfolio": _np_portfolio_metrics(port, periods_per_year),
+        "legs": legs[:top],
+    }
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(
         description="dbx aggregate: best params per job from stored results")
@@ -148,7 +281,29 @@ def main(argv=None) -> None:
     ap.add_argument("--metric", default="sharpe",
                     choices=list(Metrics._fields))
     ap.add_argument("--top", type=int, default=10)
+    ap.add_argument("--portfolio", nargs="?", const="equal", default=None,
+                    choices=["equal", "inverse_vol"],
+                    help="compose stored DBXP best-return series (jobs run "
+                         "with --best-returns) into the fleet book with "
+                         "this weighting; prints portfolio metrics + the "
+                         "diversification scalar instead of the ranking")
     args = ap.parse_args(argv)
+    if args.portfolio:
+        out = portfolio(args.results_dir, args.journal,
+                        weights=args.portfolio, top=args.top)
+        # Same non-finite discipline as the ranking path: a NaN bar in any
+        # stored series (NaN source prices) NaNs every composed metric, and
+        # json.dumps(allow_nan=False) would raise instead of reporting.
+        for leg in out["legs"]:
+            if leg["value"] is not None and not np.isfinite(leg["value"]):
+                leg["value"] = None
+        out["portfolio"] = {k: (v if np.isfinite(v) else None)
+                            for k, v in out["portfolio"].items()}
+        ac = out["avg_pairwise_correlation"]
+        if ac is not None and not np.isfinite(ac):
+            out["avg_pairwise_correlation"] = None
+        print(json.dumps(out, indent=2, allow_nan=False))
+        return
     out = aggregate(args.results_dir, args.journal, metric=args.metric,
                     top=args.top)
     # All-NaN jobs are retained in `best` (ranked last); json.dumps would

@@ -541,7 +541,7 @@ class JaxSweepBackend:
                    len(job.ohlcv2).bit_length(),   # 0 for single-asset jobs
                    job.cost, job.periods_per_year,
                    job.wf_train, job.wf_test, job.wf_metric,
-                   job.top_k, job.rank_metric)
+                   job.top_k, job.rank_metric, job.best_returns)
             groups.setdefault(key, []).append(job)
 
         pending = []
@@ -552,6 +552,18 @@ class JaxSweepBackend:
                 # empty blocks instead of requeue-looping through leases.
                 pending.append((list(group), None, t0, 0, None))
                 continue
+            if group[0].best_returns and (group[0].strategy == "pairs"
+                                          or group[0].wf_train > 0):
+                # Validated-bad, like a bad top-k request: the DBXP contract
+                # is single-asset full-history sweeps (the dispatcher CLI
+                # enforces this; a hand-built spec gets a loud empty).
+                log.error(
+                    "jobs %s: best_returns is not supported for %s jobs; "
+                    "completing empty", [j.id for j in group],
+                    "pairs" if group[0].strategy == "pairs"
+                    else "walk-forward")
+                pending.append((list(group), None, t0, 0, None))
+                continue
             if group[0].strategy == "pairs":
                 pending.append(self._submit_pairs_group(group, t0))
                 continue
@@ -559,6 +571,10 @@ class JaxSweepBackend:
             lengths = [s.n_bars for s in series]
             if group[0].wf_train > 0:
                 pending.append(self._submit_walkforward_group(
+                    group, series, lengths, t0))
+                continue
+            if group[0].best_returns:
+                pending.append(self._submit_best_returns_group(
                     group, series, lengths, t0))
                 continue
             # JobSpec.grid carries per-parameter AXES; the cartesian product
@@ -648,6 +664,104 @@ class JaxSweepBackend:
             pending.append(self._finish_group(group, m, t0, len(group),
                                               group[0]))
         return pending
+
+    def _submit_best_returns_group(self, group, series, lengths, t0):
+        """Fleet-portfolio jobs (proto ``JobSpec.best_returns``): sweep the
+        grid, pick each job's best combo by ``rank_metric`` (NaN-last,
+        direction-aware — ``sweep.best_params``'s discipline), re-price the
+        winner, and ship a DBXP block: grid index + 9 metric values + the
+        per-bar net-return series. Sweep -> selection -> repricing run in
+        ONE jitted trace per group (the ``sweep_and_compose`` discipline:
+        the (n, P) intermediates never leave the device); the three result
+        arrays start async d2h copies so the next batch overlaps.
+
+        Uses the generic sweep path (the repricing needs positions, which
+        the fused kernels do not materialize); selection is identical
+        either way.
+        """
+        import jax.numpy as jnp
+
+        from ..ops.metrics import Metrics
+
+        job0 = group[0]
+        axes = wire.grid_from_proto(job0.grid)
+        metric = job0.rank_metric or "sharpe"
+        if metric not in Metrics._fields:
+            # Validated-bad, the _topk_request_ok discipline: a hand-built
+            # spec naming an unknown metric completes empty with a loud
+            # error instead of crashing the worker inside the trace.
+            log.error("jobs %s: unknown best_returns rank metric %r; "
+                      "completing empty", [j.id for j in group], metric)
+            return (list(group), None, t0, 0, None)
+        batch, _, mask = data_mod.pad_and_stack(series)
+        panel_arrays = [np.asarray(f) for f in batch]
+        fn = self._best_returns_fn(job0, axes, metric)
+        m_best, idx, returns = fn(
+            type(batch)(*(jnp.asarray(a) for a in panel_arrays)),
+            jnp.asarray(mask))
+        stacked = _start_result_copy(m_best)
+        for arr in (idx, returns):
+            try:
+                arr.copy_to_host_async()
+            except AttributeError:
+                pass
+        return (list(group), stacked, t0, len(group),
+                {"kind": "returns", "idx": idx, "returns": returns,
+                 "metric": metric, "lens": lengths})
+
+    def _best_returns_fn(self, job0, axes, metric: str):
+        """Build (and cache) the one-trace sweep->select->reprice function
+        for a (strategy, grid, cost, ppy, metric) signature."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..models import base as models_base
+        from ..ops import pnl as pnl_mod
+        from ..ops.metrics import Metrics, metric_sign
+        from ..parallel import sweep as sweep_mod
+
+        key = (("best_returns",) + self._group_key(job0, axes) + (metric,))
+        fn = self._mesh_fns.get(key)   # shared FIFO-evicted compile cache
+        if fn is not None:
+            return fn
+
+        strategy = models_base.get_strategy(job0.strategy)
+        cost = job0.cost
+        ppy = job0.periods_per_year or 252
+        grid = {k: jnp.asarray(v)
+                for k, v in sweep_mod.product_grid(**axes).items()}
+        sign = metric_sign(metric)
+
+        @jax.jit
+        def f(panel, bar_mask):
+            m = sweep_mod.run_sweep(panel, strategy, grid, cost=cost,
+                                    bar_mask=bar_mask,
+                                    periods_per_year=ppy)
+            vals = getattr(m, metric)
+            score = jnp.where(jnp.isnan(vals), -jnp.inf, sign * vals)
+            idx = jnp.argmax(score, axis=-1).astype(jnp.int32)   # (n,)
+            chosen = {k: jnp.take(v, idx) for k, v in grid.items()}
+
+            def per_ticker(o1, mask1, p1):
+                pos = strategy.positions(o1, p1)
+                # run_sweep's padding discipline: HOLD the last valid
+                # position through padded bars (zero return, zero
+                # turnover on repeat-last closes).
+                last_idx = jnp.maximum(
+                    jnp.sum(mask1.astype(jnp.int32), axis=-1) - 1, 0)
+                pos_last = jnp.take(pos, last_idx, axis=-1)
+                return jnp.where(mask1, pos, pos_last)
+
+            pos = jax.vmap(per_ticker)(panel, bar_mask, chosen)
+            res = pnl_mod.backtest_prefix(panel.close, pos, cost=cost)
+            m_best = Metrics(*(jnp.take_along_axis(f_, idx[:, None], axis=1)
+                               for f_ in m))                     # (n, 1)
+            return m_best, idx, res.returns
+
+        if len(self._mesh_fns) >= self._MESH_FN_CAP:
+            self._mesh_fns.pop(next(iter(self._mesh_fns)))
+        self._mesh_fns[key] = f
+        return f
 
     def _submit_walkforward_group(self, group, series, lengths, t0):
         """Walk-forward jobs (proto ``JobSpec.wf_*``): per refit window,
@@ -917,9 +1031,18 @@ class JaxSweepBackend:
         from ..ops.metrics import Metrics
 
         out: list[Completion] = []
-        for group, stacked, t0, n_real, topk in pending:
+        for group, stacked, t0, n_real, extra in pending:
             host = None if stacked is None else np.asarray(stacked)
-            idx_host = None if topk is None else np.asarray(topk[0])
+            idx_host = ret_host = lens = None
+            mode = None
+            if isinstance(extra, dict):          # best_returns (DBXP) group
+                mode = extra["kind"]
+                idx_host = np.asarray(extra["idx"])
+                ret_host = np.asarray(extra["returns"])
+                lens = extra["lens"]
+            elif extra is not None:              # top-k (DBXS) group
+                mode = "topk"
+                idx_host = np.asarray(extra[0])
             elapsed = time.perf_counter() - t0
             per_job = elapsed / max(len(group), 1)
             # n_real (the jobs actually computed), NOT host.shape[1]: the
@@ -929,8 +1052,15 @@ class JaxSweepBackend:
             for i, job in enumerate(group):
                 if i < n_rows:
                     row = Metrics(*(host[k, i] for k in range(9)))
-                    if idx_host is not None:
-                        blob = wire.topk_to_bytes(idx_host[i], row, topk[1])
+                    if mode == "topk":
+                        blob = wire.topk_to_bytes(idx_host[i], row, extra[1])
+                    elif mode == "returns":
+                        # Trim to the job's real history: padded bars earn
+                        # exactly zero (repeat-last close + held position)
+                        # but belong to the group, not the job.
+                        blob = wire.best_returns_to_bytes(
+                            int(idx_host[i]), row,
+                            ret_host[i, :int(lens[i])], extra["metric"])
                     else:
                         blob = wire.metrics_to_bytes(row)
                 else:

@@ -76,6 +76,10 @@ class JobRecord:
     # per-combo matrix — the DCN-diet mode for huge grids.
     top_k: int = 0
     rank_metric: str = ""
+    # Fleet-portfolio mode (proto JobSpec.best_returns): the worker ships a
+    # DBXP block — best combo by rank_metric + its net-return series — so
+    # `aggregate --portfolio` can compose the true fleet book.
+    best_returns: bool = False
 
     @property
     def combos(self) -> int:
@@ -102,6 +106,8 @@ class JobRecord:
             rec["wf"] = [self.wf_train, self.wf_test, self.wf_metric]
         if self.top_k:
             rec["topk"] = [self.top_k, self.rank_metric]
+        if self.best_returns:
+            rec["ret"] = [True, self.rank_metric]
         return rec
 
     @staticmethod
@@ -119,7 +125,9 @@ class JobRecord:
             ohlcv=base64.b64decode(ohlcv) if ohlcv else None,
             ohlcv2=base64.b64decode(ohlcv2) if ohlcv2 else None,
             wf_train=int(wf[0]), wf_test=int(wf[1]), wf_metric=str(wf[2]),
-            top_k=int(topk[0]), rank_metric=str(topk[1]))
+            top_k=int(topk[0]),
+            rank_metric=str(topk[1]) or str((rec.get("ret") or [0, ""])[1]),
+            best_returns=bool((rec.get("ret") or [False])[0]))
 
 
 @dataclasses.dataclass
@@ -609,7 +617,8 @@ class Dispatcher(service.DispatcherServicer):
                 ohlcv2=rec.ohlcv2 or b"",
                 wf_train=rec.wf_train, wf_test=rec.wf_test,
                 wf_metric=rec.wf_metric,
-                top_k=rec.top_k, rank_metric=rec.rank_metric))
+                top_k=rec.top_k, rank_metric=rec.rank_metric,
+                best_returns=rec.best_returns))
         if taken:
             log.info("dispatched %d jobs to %s", len(taken), request.worker_id)
         return reply
@@ -759,7 +768,7 @@ def parse_grid(spec: str) -> dict[str, np.ndarray]:
 def jobs_from_paths(paths, strategy: str, grid, *, cost: float = 0.0,
                     periods_per_year: int = 252, wf_train: int = 0,
                     wf_test: int = 0, wf_metric: str = "", top_k: int = 0,
-                    rank_metric: str = "",
+                    rank_metric: str = "", best_returns: bool = False,
                     paths2=None) -> list[JobRecord]:
     """File-backed jobs; two-legged strategies pass ``paths2`` (leg x
     files, positionally matched with ``paths``). Payloads are read at
@@ -772,14 +781,16 @@ def jobs_from_paths(paths, strategy: str, grid, *, cost: float = 0.0,
                       cost=cost, periods_per_year=periods_per_year, path=p,
                       path2=p2,
                       wf_train=wf_train, wf_test=wf_test, wf_metric=wf_metric,
-                      top_k=top_k, rank_metric=rank_metric)
+                      top_k=top_k, rank_metric=rank_metric,
+                      best_returns=best_returns)
             for p, p2 in zip(paths, paths2)]
 
 
 def synthetic_jobs(n: int, n_bars: int, strategy: str, grid, *,
                    cost: float = 0.0, seed: int = 0, wf_train: int = 0,
                    wf_test: int = 0, wf_metric: str = "", top_k: int = 0,
-                   rank_metric: str = "") -> list[JobRecord]:
+                   rank_metric: str = "",
+                   best_returns: bool = False) -> list[JobRecord]:
     """Inline synthetic-OHLCV jobs (benchmarks / demos without data files).
 
     ``strategy="pairs"`` jobs carry two legs (``ohlcv`` = y, ``ohlcv2`` = x).
@@ -798,7 +809,8 @@ def synthetic_jobs(n: int, n_bars: int, strategy: str, grid, *,
             id=str(uuid.uuid4()), strategy=strategy, grid=grid, cost=cost,
             ohlcv=data_mod.to_wire_bytes(series), ohlcv2=ohlcv2,
             wf_train=wf_train, wf_test=wf_test, wf_metric=wf_metric,
-            top_k=top_k, rank_metric=rank_metric))
+            top_k=top_k, rank_metric=rank_metric,
+            best_returns=best_returns))
     return out
 
 
@@ -836,7 +848,12 @@ def make_parser() -> argparse.ArgumentParser:
                     help="workers reduce results on-device to the top-k "
                          "param rows (0 = ship the full per-combo matrix)")
     ap.add_argument("--rank-metric", default="sharpe",
-                    help="ranking metric for --top-k")
+                    help="ranking metric for --top-k / --best-returns")
+    ap.add_argument("--best-returns", action="store_true",
+                    help="fleet-portfolio mode: workers ship each job's "
+                         "best combo (by --rank-metric) plus its net-return "
+                         "series (DBXP block); compose the book afterwards "
+                         "with `aggregate --portfolio`")
     return ap
 
 
@@ -879,9 +896,14 @@ def build_dispatcher(args) -> Dispatcher:
             log.warning("--wf-test %d ignored: walk-forward mode needs "
                         "--wf-train > 0", args.wf_test)
         wf_kw = dict(wf_train=0, wf_test=0, wf_metric="")
-    if args.top_k:
+    if args.top_k or args.best_returns:
         from ..ops.metrics import Metrics
 
+        if args.rank_metric not in Metrics._fields:
+            raise SystemExit(
+                f"--rank-metric {args.rank_metric!r} unknown; one of "
+                f"{', '.join(Metrics._fields)}")
+    if args.top_k:
         if args.top_k < 0:
             raise SystemExit(f"--top-k {args.top_k} must be positive "
                              "(0 disables the reduction)")
@@ -889,11 +911,20 @@ def build_dispatcher(args) -> Dispatcher:
             raise SystemExit("--top-k is a sweep-mode option; walk-forward "
                              "jobs already complete with one stitched OOS "
                              "row (drop --top-k or --wf-train)")
-        if args.rank_metric not in Metrics._fields:
-            raise SystemExit(
-                f"--rank-metric {args.rank_metric!r} unknown; one of "
-                f"{', '.join(Metrics._fields)}")
         wf_kw.update(top_k=args.top_k, rank_metric=args.rank_metric)
+    if args.best_returns:
+        if args.wf_train:
+            raise SystemExit("--best-returns is a sweep-mode option; "
+                             "walk-forward jobs have no single best combo "
+                             "(drop --best-returns or --wf-train)")
+        if args.top_k:
+            raise SystemExit("--best-returns and --top-k are mutually "
+                             "exclusive completion payloads (DBXP vs DBXS)")
+        if args.strategy == "pairs":
+            raise SystemExit("--best-returns supports single-asset "
+                             "strategies only (the spread book needs both "
+                             "legs' series)")
+        wf_kw.update(best_returns=True, rank_metric=args.rank_metric)
     if args.data and args.strategy == "pairs" and not args.data2:
         raise SystemExit(
             "--strategy pairs with --data needs --data2: file-backed pairs "

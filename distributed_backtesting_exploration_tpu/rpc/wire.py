@@ -109,15 +109,78 @@ def topk_from_bytes(data: bytes) -> tuple["np.ndarray", Metrics, str]:
     return idx, Metrics(*out), rank_metric
 
 
+_RETURNS_MAGIC = b"DBXP"
+
+
+def best_returns_to_bytes(grid_idx: int, m_row: Metrics,
+                          returns: "np.ndarray", rank_metric: str) -> bytes:
+    """Pack a best-param result WITH its net-return series (a "DBXP"
+    portfolio block): the winning grid-row index, its 9 metric values, and
+    the per-bar net strategy returns under that parameter set.
+
+    This is what makes FLEET-level portfolio composition possible without
+    re-running compute: per-job metric rows cannot be combined into a
+    portfolio Sharpe (cross-ticker correlations are lost), but return
+    series can — ``aggregate --portfolio`` composes the stored series into
+    the true book. ~4 bytes/bar per job (~5 KB for 5y daily), the same
+    order as the DBXS block and ~100x smaller than a full DBXM matrix at
+    bench scale.
+    """
+    vals = np.asarray([float(np.asarray(f).reshape(-1)[0]) for f in m_row],
+                      dtype="<f4")
+    ret = np.asarray(returns, dtype="<f4").reshape(-1)
+    name = rank_metric.encode("utf-8")
+    if len(name) > 255:
+        raise ValueError("rank_metric name too long")
+    head = _RETURNS_MAGIC + struct.pack(
+        "<IIIB", int(grid_idx), ret.shape[0], vals.shape[0],
+        len(name)) + name
+    return head + vals.tobytes() + ret.tobytes()
+
+
+def best_returns_from_bytes(
+        data: bytes) -> tuple[int, Metrics, "np.ndarray", str]:
+    """Decode a DBXP block -> ``(grid_idx, Metrics of scalars, returns,
+    rank_metric)``."""
+    if data[:4] != _RETURNS_MAGIC:
+        raise ValueError("bad magic; not a DBXP best-returns block")
+    if len(data) < 17:
+        raise ValueError(
+            f"truncated best-returns block: {len(data)} < 17-byte header")
+    grid_idx, T, n_fields, name_len = struct.unpack_from("<IIIB", data, 4)
+    if n_fields != len(Metrics._fields):
+        raise ValueError(
+            f"best-returns block has {n_fields} fields, expected "
+            f"{len(Metrics._fields)}")
+    off = 17
+    if len(data) < off + name_len:
+        raise ValueError(
+            f"truncated best-returns block: {len(data)} < "
+            f"{off + name_len} (name)")
+    rank_metric = data[off:off + name_len].decode("utf-8")
+    off += name_len
+    need = off + 4 * n_fields + 4 * T
+    if len(data) < need:
+        raise ValueError(
+            f"truncated best-returns block: {len(data)} < {need}")
+    vals = np.frombuffer(data, dtype="<f4", count=n_fields, offset=off)
+    off += 4 * n_fields
+    ret = np.frombuffer(data, dtype="<f4", count=T, offset=off).copy()
+    return int(grid_idx), Metrics(*(np.float32(v) for v in vals)), ret, \
+        rank_metric
+
+
 def result_kind(data: bytes) -> str:
     """Classify a completion payload: ``"metrics"`` (DBXM), ``"topk"``
-    (DBXS), or ``"empty"``."""
+    (DBXS), ``"returns"`` (DBXP), or ``"empty"``."""
     if not data:
         return "empty"
     if data[:4] == _METRICS_MAGIC:
         return "metrics"
     if data[:4] == _TOPK_MAGIC:
         return "topk"
+    if data[:4] == _RETURNS_MAGIC:
+        return "returns"
     raise ValueError("unknown result block magic")
 
 

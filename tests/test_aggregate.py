@@ -8,6 +8,7 @@ dispatcher-side result memory and turn stored blocks into decisions.
 import json
 
 import numpy as np
+import pytest
 
 from distributed_backtesting_exploration_tpu.ops.metrics import metric_sign
 from distributed_backtesting_exploration_tpu.rpc import aggregate, compute
@@ -295,3 +296,126 @@ def test_aggregate_cli_emits_valid_json_for_all_nan_job(tmp_path, capsys):
     aggregate.main(["--results-dir", results_dir, "--journal", journal_path])
     out = json.loads(capsys.readouterr().out)   # strict parse must succeed
     assert out["best"][0]["value"] is None
+
+
+def _best_returns_run(tmp_path, n_jobs=4, n_bars=96, weights="equal"):
+    """Fleet run in --best-returns mode: DBXP blocks land in results_dir."""
+    journal_path = str(tmp_path / "journal.jsonl")
+    results_dir = str(tmp_path / "results")
+    queue = JobQueue(Journal(journal_path))
+    grid = parse_grid("fast=3:5,slow=10:14:2")
+    recs = synthetic_jobs(n_jobs, n_bars, "sma_crossover", grid, cost=1e-3,
+                          seed=5, best_returns=True, rank_metric="sharpe")
+    for rec in recs:
+        queue.enqueue(rec)
+    disp = Dispatcher(queue, results_dir=results_dir)
+    queue.take(n_jobs, "w1")
+    specs = [pb.JobSpec(id=r.id, strategy=r.strategy, ohlcv=r.ohlcv,
+                        grid=wire.grid_to_proto(r.grid), cost=r.cost,
+                        periods_per_year=252, best_returns=True,
+                        rank_metric="sharpe") for r in recs]
+    backend = compute.JaxSweepBackend(use_fused=False)
+    for c in backend.process(specs):
+        disp._complete_one(c.job_id, "w1", c.metrics, c.elapsed_s)
+    return journal_path, results_dir, recs
+
+
+def test_best_returns_blocks_match_direct_composition(tmp_path):
+    """The DBXP flow end to end: worker-shipped best-return series, composed
+    by aggregate.portfolio(), must equal the direct library composition
+    (sweep -> per-ticker best -> weighted book) on the same panels."""
+    import jax.numpy as jnp
+
+    from distributed_backtesting_exploration_tpu.models import base
+    from distributed_backtesting_exploration_tpu.ops import (
+        metrics as metrics_mod, pnl)
+    from distributed_backtesting_exploration_tpu.parallel import (
+        portfolio as portfolio_mod, sweep)
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    journal_path, results_dir, recs = _best_returns_run(tmp_path)
+    out = aggregate.portfolio(results_dir, journal_path, weights="equal")
+    assert out["legs_composed"] == len(recs)
+
+    # Direct composition: stack the jobs' tickers into one panel.
+    series = [data.from_wire_bytes(r.ohlcv) for r in recs]
+    panel = type(series[0])(*(jnp.stack([np.asarray(getattr(s, f))
+                                         for s in series])
+                              for f in series[0]._fields))
+    canonical = sweep.product_grid(**dict(sorted(recs[0].grid.items())))
+    pm, chosen = portfolio_mod.sweep_and_compose(
+        panel, base.get_strategy("sma_crossover"), canonical, cost=1e-3)
+    # Portfolio sharpe from the composed book matches the DBXP composition.
+    assert out["portfolio"]["sharpe"] == pytest.approx(
+        float(pm.sharpe), rel=2e-4, abs=2e-5)
+    # Per-leg params match the per-ticker winners.
+    by_job = {leg["job"]: leg for leg in out["legs"]}
+    for i, rec in enumerate(recs):
+        for k in canonical:
+            assert by_job[rec.id]["params"][k] == float(chosen[k][i])
+
+
+def test_portfolio_inverse_vol_and_ranking_path(tmp_path):
+    journal_path, results_dir, recs = _best_returns_run(tmp_path)
+    out = aggregate.portfolio(results_dir, journal_path,
+                              weights="inverse_vol")
+    ws = [leg["weight"] for leg in out["legs"]]
+    assert pytest.approx(sum(abs(w) for w in ws), abs=1e-6) == 1.0
+    assert all(w > 0 for w in ws)
+    assert np.isfinite(out["portfolio"]["sharpe"])
+    if out["avg_pairwise_correlation"] is not None:
+        assert -1.0 <= out["avg_pairwise_correlation"] <= 1.0
+    # The plain ranking path reads DBXP blocks too (one row per job).
+    ranked = aggregate.aggregate(results_dir, journal_path, metric="sharpe")
+    assert ranked["jobs_aggregated"] == len(recs)
+    assert all(r["mode"] == "sweep_best_returns" for r in ranked["best"])
+    assert all(r["params"] for r in ranked["best"])
+
+
+def test_np_portfolio_metrics_matches_jax():
+    """The aggregate-side NumPy metrics twin must match ops.metrics on the
+    returns/equity subset (same population moments, additive equity,
+    peak-relative drawdown)."""
+    import jax.numpy as jnp
+
+    from distributed_backtesting_exploration_tpu.ops import metrics as mm
+
+    rng = np.random.default_rng(7)
+    r = rng.normal(0.0005, 0.01, 512).astype(np.float32)
+    got = aggregate._np_portfolio_metrics(r, 252)
+    rj = jnp.asarray(r)
+    eq = 1.0 + jnp.cumsum(rj)
+    want = {
+        "sharpe": float(mm.sharpe(rj)),
+        "sortino": float(mm.sortino(rj)),
+        "max_drawdown": float(mm.max_drawdown(eq)),
+        "total_return": float(mm.total_return(eq)),
+        "cagr": float(mm.cagr(eq)),
+        "volatility": float(np.std(r) * np.sqrt(252.0)),
+    }
+    for k, v in want.items():
+        assert got[k] == pytest.approx(v, rel=2e-4, abs=1e-6), k
+
+
+def test_portfolio_requires_dbxp_blocks(tmp_path):
+    journal_path, results_dir, _ = _completed_run(tmp_path)   # plain DBXM
+    with pytest.raises(ValueError, match="best-returns"):
+        aggregate.portfolio(results_dir, journal_path)
+
+
+def test_portfolio_inverse_vol_excludes_dead_legs(tmp_path):
+    """A never-traded leg (flat return series) must get weight 0 under
+    inverse_vol — not 1/eps, which would collapse the book to zero."""
+    journal_path, results_dir, recs = _best_returns_run(tmp_path, n_jobs=3)
+    jid = recs[0].id
+    with open(f"{results_dir}/{jid}.dbxm", "rb") as fh:
+        gi, row, ret, metric = wire.best_returns_from_bytes(fh.read())
+    with open(f"{results_dir}/{jid}.dbxm", "wb") as fh:
+        fh.write(wire.best_returns_to_bytes(
+            gi, row, np.zeros_like(ret), metric))
+    out = aggregate.portfolio(results_dir, journal_path,
+                              weights="inverse_vol")
+    w_by_job = {leg["job"]: leg["weight"] for leg in out["legs"]}
+    assert w_by_job[jid] == 0.0
+    assert sum(w_by_job.values()) == pytest.approx(1.0, abs=1e-6)
+    assert np.isfinite(out["portfolio"]["sharpe"])

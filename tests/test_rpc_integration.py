@@ -871,3 +871,52 @@ def test_pairs_walkforward_jobs_over_the_wire_match_direct():
             np.testing.assert_allclose(
                 got_v[0], np.asarray(getattr(want, name))[i],
                 rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_best_returns_jobs_over_the_wire_match_direct_composition(tmp_path):
+    """JobSpec.best_returns end to end over real gRPC: workers ship DBXP
+    blocks (best combo + net-return series) and `aggregate --portfolio`
+    composes them into the book the direct library composition produces."""
+    import jax.numpy as jnp
+
+    from distributed_backtesting_exploration_tpu.models import base
+    from distributed_backtesting_exploration_tpu.parallel import (
+        portfolio as portfolio_mod, sweep)
+    from distributed_backtesting_exploration_tpu.rpc import aggregate
+    from distributed_backtesting_exploration_tpu.rpc.journal import Journal
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    journal_path = str(tmp_path / "journal.jsonl")
+    results = tmp_path / "results"
+    queue = JobQueue(Journal(journal_path))
+    grid = parse_grid("fast=3:6,slow=10:16:2")
+    recs = synthetic_jobs(4, 96, "sma_crossover", grid, cost=1e-3, seed=9,
+                          best_returns=True, rank_metric="sharpe")
+    for rec in recs:
+        queue.enqueue(rec)
+    disp, srv = _server(queue, results_dir=str(results))
+    try:
+        _run_worker(f"localhost:{srv.port}",
+                    compute.JaxSweepBackend(use_fused=False))
+        _wait(lambda: queue.drained, msg="queue drained")
+    finally:
+        srv.stop()
+
+    for rec in recs:
+        blob = (results / f"{rec.id}.dbxm").read_bytes()
+        assert wire.result_kind(blob) == "returns"
+        _, _, ret, metric = wire.best_returns_from_bytes(blob)
+        assert metric == "sharpe" and ret.shape == (96,)
+
+    out = aggregate.portfolio(str(results), journal_path, weights="equal")
+    assert out["legs_composed"] == 4 and out["bars"] == 96
+
+    series = [data.from_wire_bytes(rec.ohlcv) for rec in recs]
+    panel = type(series[0])(*(jnp.stack([np.asarray(getattr(s, f))
+                                         for s in series])
+                              for f in series[0]._fields))
+    canonical = sweep.product_grid(**dict(sorted(recs[0].grid.items())))
+    pm, _ = portfolio_mod.sweep_and_compose(
+        panel, base.get_strategy("sma_crossover"), canonical, cost=1e-3)
+    assert out["portfolio"]["sharpe"] == pytest.approx(
+        float(pm.sharpe), rel=2e-4, abs=2e-5)

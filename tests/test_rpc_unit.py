@@ -996,3 +996,97 @@ def test_pairs_restart_with_stray_unclaimed_x_still_serves(tmp_path):
     _write_leg_csvs(tmp_path, 3, prefix="x")   # stray x2.csv appears
     disp2 = build_dispatcher(make_parser().parse_args(argv))
     assert disp2.queue.stats()["jobs_pending"] == 2   # restored + served
+
+
+def test_best_returns_wire_roundtrip():
+    """DBXP block: grid index + one metric row + the net-return series."""
+    row = Metrics(*(np.float32(i + 0.5) for i in range(9)))
+    ret = np.linspace(-0.01, 0.01, 37).astype(np.float32)
+    blob = wire.best_returns_to_bytes(7, row, ret, "sharpe")
+    gi, gm, gr, metric = wire.best_returns_from_bytes(blob)
+    assert gi == 7 and metric == "sharpe"
+    for a, b in zip(gm, row):
+        assert float(a) == float(b)
+    np.testing.assert_array_equal(gr, ret)
+    assert wire.result_kind(blob) == "returns"
+    # Truncation at every boundary raises the contract's ValueError, never
+    # struct.error (the DBX1/DBXS decoder discipline).
+    for cut in (4, 10, 16, 18, 22, len(blob) - 1):
+        with pytest.raises(ValueError, match="truncated|magic"):
+            wire.best_returns_from_bytes(blob[:cut])
+    with pytest.raises(ValueError, match="magic"):
+        wire.best_returns_from_bytes(wire.metrics_to_bytes(
+            Metrics(*(np.zeros(1, np.float32) for _ in range(9)))))
+
+
+def test_best_returns_travels_journal_and_cli(tmp_path):
+    """JobRecord.best_returns survives the journal round trip; the CLI
+    rejects the incompatible mode combinations."""
+    from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+        build_dispatcher, make_parser)
+
+    rec = JobRecord(id="p", strategy="sma_crossover",
+                    grid={"fast": np.float32([3.0])}, ohlcv=b"x",
+                    best_returns=True, rank_metric="cagr")
+    back = JobRecord.from_journal(rec.journal_form())
+    assert back.best_returns is True and back.rank_metric == "cagr"
+    # Plain records stay untouched.
+    plain = JobRecord.from_journal(JobRecord(
+        id="q", strategy="s", grid={}, ohlcv=b"x").journal_form())
+    assert plain.best_returns is False
+
+    parser = make_parser()
+    base_args = ["--synthetic", "1", "--grid", "fast=3,slow=8"]
+    for bad in (["--best-returns", "--top-k", "4"],
+                ["--best-returns", "--wf-train", "50", "--wf-test", "10"],
+                ["--best-returns", "--strategy", "pairs"],
+                ["--best-returns", "--rank-metric", "nope"]):
+        with pytest.raises(SystemExit):
+            build_dispatcher(parser.parse_args(base_args + bad))
+    args = parser.parse_args(base_args + ["--best-returns",
+                                          "--journal",
+                                          str(tmp_path / "j.jsonl")])
+    disp = build_dispatcher(args)
+    taken = disp.queue.take(1, "w")
+    assert taken and taken[0][0].best_returns is True
+
+
+def test_best_returns_rejected_for_pairs_and_walkforward():
+    """A hand-built best_returns spec on pairs or walk-forward jobs is
+    validated-bad: complete-with-empty, loudly, no requeue loop."""
+    from distributed_backtesting_exploration_tpu.rpc import (
+        backtesting_pb2 as pb, compute)
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    backend = compute.JaxSweepBackend(use_fused=False)
+    one = data.synthetic_ohlcv(1, 48, seed=2)
+    ohlcv = data.to_wire_bytes(type(one)(*(f[0] for f in one)))
+    specs = [
+        pb.JobSpec(id="p1", strategy="pairs", ohlcv=ohlcv, ohlcv2=ohlcv,
+                   grid=wire.grid_to_proto(
+                       {"lookback": np.float32([8.0]),
+                        "z_entry": np.float32([1.0])}),
+                   best_returns=True),
+        pb.JobSpec(id="w1", strategy="sma_crossover", ohlcv=ohlcv,
+                   grid=wire.grid_to_proto({"fast": np.float32([3.0]),
+                                            "slow": np.float32([8.0])}),
+                   wf_train=24, wf_test=8, best_returns=True),
+    ]
+    comps = backend.process(specs)
+    assert sorted(c.job_id for c in comps) == ["p1", "w1"]
+    assert all(c.metrics == b"" for c in comps)
+
+
+def test_best_returns_unknown_metric_completes_empty():
+    from distributed_backtesting_exploration_tpu.rpc import (
+        backtesting_pb2 as pb, compute)
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    one = data.synthetic_ohlcv(1, 48, seed=3)
+    ohlcv = data.to_wire_bytes(type(one)(*(f[0] for f in one)))
+    spec = pb.JobSpec(id="m1", strategy="sma_crossover", ohlcv=ohlcv,
+                      grid=wire.grid_to_proto({"fast": np.float32([3.0]),
+                                               "slow": np.float32([8.0])}),
+                      best_returns=True, rank_metric="not_a_metric")
+    comps = compute.JaxSweepBackend(use_fused=False).process([spec])
+    assert len(comps) == 1 and comps[0].metrics == b""
