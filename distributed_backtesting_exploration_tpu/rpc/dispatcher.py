@@ -481,11 +481,15 @@ class JobQueue:
 
 
 def _read_payload(path: str) -> bytes:
-    """Read a job's OHLCV payload; CSV files are transcoded to DBX1 binary."""
+    """Read a job's OHLCV payload; CSV and Parquet files are transcoded to
+    DBX1 binary (format sniffed by magic: ``PAR1`` = Parquet, ``DBX1`` =
+    already wire-ready, anything else = CSV)."""
     t0 = time.perf_counter()
     with open(path, "rb") as fh:
         raw = fh.read()
-    if raw[:4] != b"DBX1":
+    if raw[:4] == b"PAR1":
+        raw = data_mod.to_wire_bytes(data_mod.from_parquet_bytes(raw))
+    elif raw[:4] != b"DBX1":
         series = data_mod.from_csv_bytes(raw)
         raw = data_mod.to_wire_bytes(series)
     log.info("read %s (%d bytes) in %.1fms",

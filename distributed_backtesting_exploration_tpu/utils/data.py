@@ -123,6 +123,46 @@ def from_csv_bytes(data: bytes, *, dtype=np.float32) -> OHLCV:
     return OHLCV(**out)
 
 
+def to_parquet_bytes(series: OHLCV) -> bytes:
+    """Encode a single ticker as a Parquet file (pyarrow).
+
+    The columnar twin of :func:`to_csv_bytes` — same five named columns,
+    f64 values — for fleets whose market data lives in Parquet lakes
+    rather than CSV dumps.
+    """
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    if series.close.ndim != 1:
+        raise ValueError(
+            "to_parquet_bytes takes a single ticker, fields shaped (T,)")
+    table = pa.table({f: np.asarray(getattr(series, f), np.float64)
+                      for f in _FIELDS})
+    sink = io.BytesIO()
+    pq.write_table(table, sink)
+    return sink.getvalue()
+
+
+def from_parquet_bytes(data: bytes, *, dtype=np.float32) -> OHLCV:
+    """Decode a Parquet file's OHLCV columns (name-matched, case-insensitive;
+    extra columns such as a date index are tolerated, like the CSV
+    decoder)."""
+    import pyarrow.parquet as pq
+
+    try:
+        table = pq.read_table(io.BytesIO(data))
+    except Exception as e:
+        raise ValueError(f"not a readable Parquet file: {e}") from e
+    by_name = {name.strip().lower(): i
+               for i, name in enumerate(table.column_names)}
+    missing = [f for f in _FIELDS if f not in by_name]
+    if missing:
+        raise ValueError(f"Parquet missing columns: {missing}; "
+                         f"columns={table.column_names}")
+    return OHLCV(*(np.asarray(table.column(by_name[f]).to_numpy(),
+                              dtype=dtype) for f in _FIELDS))
+
+
 # ---------------------------------------------------------------------------
 # Binary wire codec (replaces CSV-text-over-gzip on the job data plane)
 # ---------------------------------------------------------------------------

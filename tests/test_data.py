@@ -60,3 +60,54 @@ def test_pad_and_stack():
     assert mask[0, :100].all() and not mask[0, 100:].any()
     # padding repeats the final bar -> zero returns in the padded tail
     np.testing.assert_array_equal(batch.close[0, 100:], batch.close[0, 99])
+
+
+def test_parquet_roundtrip():
+    s = one_ticker(64)
+    back = data_mod.from_parquet_bytes(data_mod.to_parquet_bytes(s))
+    for f in ("open", "high", "low", "close", "volume"):
+        np.testing.assert_allclose(getattr(back, f), getattr(s, f),
+                                   rtol=1e-6)
+
+
+def test_parquet_extra_columns_and_case():
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    import io as io_mod
+
+    table = pa.table({"Date": ["a", "b"], "Close": [10.0, 11.0],
+                      "open": [9.0, 10.0], "LOW": [8.0, 9.0],
+                      "High": [11.0, 12.0], "volume": [100.0, 110.0]})
+    sink = io_mod.BytesIO()
+    pq.write_table(table, sink)
+    s = data_mod.from_parquet_bytes(sink.getvalue())
+    np.testing.assert_allclose(s.close, [10, 11])
+    np.testing.assert_allclose(s.high, [11, 12])
+
+
+def test_parquet_missing_column_and_garbage():
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    import io as io_mod
+
+    table = pa.table({"close": [1.0]})
+    sink = io_mod.BytesIO()
+    pq.write_table(table, sink)
+    with pytest.raises(ValueError, match="missing columns"):
+        data_mod.from_parquet_bytes(sink.getvalue())
+    with pytest.raises(ValueError, match="Parquet"):
+        data_mod.from_parquet_bytes(b"PAR1 definitely not parquet")
+
+
+def test_dispatcher_reads_parquet_payload(tmp_path):
+    """File-backed Parquet jobs transcode to DBX1 at dispatch, like CSV."""
+    from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+        _read_payload)
+
+    s = one_ticker(32)
+    p = tmp_path / "t.parquet"
+    p.write_bytes(data_mod.to_parquet_bytes(s))
+    blob = _read_payload(str(p))
+    back = data_mod.from_wire_bytes(blob)
+    np.testing.assert_allclose(back.close, np.asarray(s.close, np.float32),
+                               rtol=1e-6)
