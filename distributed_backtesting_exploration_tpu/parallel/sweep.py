@@ -151,14 +151,20 @@ def chunked_sweep(ohlcv, strategy, grid, *, param_chunk: int, cost=0.0,
 
 
 def best_params(metric_values: Array, grid: Mapping[str, Array], *, axis=-1,
-                metric: str | None = None):
+                metric: str | None = None, return_index: bool = False):
     """Select the best point of a ``(..., P)`` metric over the param axis.
 
     Returns ``(best_value, {name: best_param})`` with the leading shape of
-    ``metric_values`` minus the param axis. Used by walk-forward refits and by
+    ``metric_values`` minus the param axis — plus the flat-grid argmax
+    indices as a third element when ``return_index`` is true. Used by
+    walk-forward refits, the worker's best-returns (DBXP) path, and
     dispatcher-side result aggregation. Pass ``metric`` (the
     :class:`~..ops.metrics.Metrics` field name) so lower-is-better metrics
     (max_drawdown, volatility, turnover) select the minimum.
+
+    This is THE selection implementation: every path that picks a winning
+    combo routes through here so the NaN/direction discipline cannot drift
+    between the worker, walk-forward, and portfolio surfaces.
 
     NaN cells rank LAST (``jnp.argmax`` alone would rank them first —
     NaN wins float comparisons), matching the worker-side top-k and
@@ -171,4 +177,6 @@ def best_params(metric_values: Array, grid: Mapping[str, Array], *, axis=-1,
     best = jnp.take_along_axis(
         metric_values, jnp.expand_dims(idx, axis), axis=axis).squeeze(axis)
     chosen = {n: jnp.take(v, idx) for n, v in grid.items()}
+    if return_index:
+        return best, chosen, idx
     return best, chosen

@@ -202,28 +202,52 @@ def portfolio(results_dir: str, journal_path: str, *,
                          "one of: equal, inverse_vol")
     state = Journal.replay(journal_path)
     legs = []
+    skipped: dict[str, list] = {}
     for jid, rec in state.jobs.items():
         path = os.path.join(results_dir, f"{jid}.dbxm")
         if not os.path.exists(path):
             continue
         with open(path, "rb") as fh:
             blob = fh.read()
-        if wire.result_kind(blob) != "returns":
+        kind = wire.result_kind(blob)
+        if kind != "returns":
+            # A completed job whose stored block is not DBXP cannot
+            # contribute a leg. This is NOT routine: a fleet run with
+            # --best-returns should produce only DBXP blocks, so a DBXM/
+            # DBXS/empty block here means some worker ran the job as the
+            # wrong kind (e.g. a slice worker that predates the
+            # best-returns triage) — a book quietly missing legs is the
+            # exact silent failure this accounting exists to surface.
+            skipped.setdefault(kind, []).append(jid)
             continue
         grid_idx, m_row, ret, rank_metric = wire.best_returns_from_bytes(blob)
         axes = {k: np.asarray(v, np.float32)
                 for k, v in sorted(rec.get("grid", {}).items())}
         grid = _np_product_grid(axes) if axes else {}
+        value = (float(getattr(m_row, rank_metric))
+                 if rank_metric in Metrics._fields else None)
+        if value is not None and not np.isfinite(value):
+            # Sanitize BEFORE the sort below: a NaN sort key makes leg
+            # ordering nondeterministic (NaN is truthy, so `value or 0.0`
+            # stays NaN), and library callers should never see the
+            # unsanitized dict either.
+            value = None
         legs.append({
             "job": jid,
             "strategy": rec.get("strategy"),
             "path": rec.get("path"),
             "rank_metric": rank_metric,
-            "value": float(getattr(m_row, rank_metric))
-            if rank_metric in Metrics._fields else None,
+            "value": value,
             "params": {k: float(v[grid_idx]) for k, v in grid.items()},
             "returns": ret,
         })
+    for kind, jids in sorted(skipped.items()):
+        log.warning(
+            "portfolio: skipped %d stored block(s) of kind %r (not DBXP) — "
+            "the composed book is missing these jobs: %s. Re-run them on a "
+            "worker that implements --best-returns (single-host "
+            "rpc/worker.py does; check for slice workers completing the "
+            "wrong kind)", len(jids), kind, ", ".join(sorted(jids)))
     if not legs:
         raise ValueError(
             f"no DBXP best-returns blocks found under {results_dir!r} — "
@@ -263,6 +287,7 @@ def portfolio(results_dir: str, journal_path: str, *,
     return {
         "weights": weights,
         "legs_composed": len(legs),
+        "blocks_skipped": sum(len(v) for v in skipped.values()),
         "bars": int(R.shape[1]),
         "avg_pairwise_correlation": avg_corr,
         "portfolio": _np_portfolio_metrics(port, periods_per_year),

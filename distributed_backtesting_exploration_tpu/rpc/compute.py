@@ -717,7 +717,7 @@ class JaxSweepBackend:
 
         from ..models import base as models_base
         from ..ops import pnl as pnl_mod
-        from ..ops.metrics import Metrics, metric_sign
+        from ..ops.metrics import Metrics
         from ..parallel import sweep as sweep_mod
 
         key = (("best_returns",) + self._group_key(job0, axes) + (metric,))
@@ -730,17 +730,18 @@ class JaxSweepBackend:
         ppy = job0.periods_per_year or 252
         grid = {k: jnp.asarray(v)
                 for k, v in sweep_mod.product_grid(**axes).items()}
-        sign = metric_sign(metric)
 
         @jax.jit
         def f(panel, bar_mask):
             m = sweep_mod.run_sweep(panel, strategy, grid, cost=cost,
                                     bar_mask=bar_mask,
                                     periods_per_year=ppy)
-            vals = getattr(m, metric)
-            score = jnp.where(jnp.isnan(vals), -jnp.inf, sign * vals)
-            idx = jnp.argmax(score, axis=-1).astype(jnp.int32)   # (n,)
-            chosen = {k: jnp.take(v, idx) for k, v in grid.items()}
+            # Selection delegates to THE shared implementation
+            # (sweep.best_params: NaN-last, direction-aware) so this path
+            # can never drift from walk-forward/portfolio selection.
+            _, chosen, idx = sweep_mod.best_params(
+                getattr(m, metric), grid, metric=metric, return_index=True)
+            idx = idx.astype(jnp.int32)                          # (n,)
 
             def per_ticker(o1, mask1, p1):
                 pos = strategy.positions(o1, p1)

@@ -140,14 +140,15 @@ class SliceWorker:
 
         Returns ``(groups, decoded, bad)``. This worker runs plain
         single-asset sweeps over the global mesh; job kinds it does not
-        implement — two-legged pairs, walk-forward, on-device top-k —
-        land in ``bad`` and are completed with EMPTY metric blocks plus a
+        implement — two-legged pairs, walk-forward, on-device top-k,
+        best-returns (DBXP) — land in ``bad`` and are completed with EMPTY
+        metric blocks plus a
         loud error (the validated-bad discipline of the single-host
         backend): silently running a walk-forward job as a plain sweep
         would store WRONG results as a valid completion, and leaving the
         jobs leased would requeue-loop them through the slice forever.
         Route such jobs to single-host workers (``rpc/worker.py``), which
-        implement all three."""
+        implement all four."""
         from . import wire
         from ..utils import data as data_mod
 
@@ -159,7 +160,13 @@ class SliceWorker:
                 "pairs (two-legged)" if (job.strategy == "pairs"
                                          or job.ohlcv2) else
                 "walk-forward" if job.wf_train > 0 else
-                "top-k reduction" if job.top_k > 0 else None)
+                "top-k reduction" if job.top_k > 0 else
+                # best_returns must be triaged too: running it as a plain
+                # sweep would complete with a full DBXM block, which
+                # `aggregate --portfolio` cannot compose — a mixed fleet
+                # would quietly lose this leg from the book.
+                "best-returns (DBXP) reduction" if job.best_returns
+                else None)
             if unsupported:
                 log.error(
                     "slice worker: job %s needs %s, which the slice-level "

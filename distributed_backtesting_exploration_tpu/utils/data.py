@@ -147,7 +147,17 @@ def from_parquet_bytes(data: bytes, *, dtype=np.float32) -> OHLCV:
     """Decode a Parquet file's OHLCV columns (name-matched, case-insensitive;
     extra columns such as a date index are tolerated, like the CSV
     decoder)."""
-    import pyarrow.parquet as pq
+    try:
+        import pyarrow.parquet as pq
+    except ImportError as e:
+        # pyarrow is an optional dependency (only Parquet payloads need it);
+        # a raw ModuleNotFoundError here would read as a framework bug and —
+        # worse — escape the dispatcher's (OSError, ValueError) bad-payload
+        # triage and crash the intake thread instead of failing the one job.
+        raise ValueError(
+            "pyarrow is required to decode Parquet payloads but is not "
+            "installed on this host; install pyarrow or feed CSV/DBX1 "
+            f"files instead ({e})") from e
 
     try:
         table = pq.read_table(io.BytesIO(data))
