@@ -704,6 +704,11 @@ def sharded_pairs_backtest(mesh: Mesh, y_close, x_close, lookback: int,
 
 def _check_time_axis(T: int, n_dev: int, window: int, axis_name: str,
                      what: str):
+    if window < 1:
+        # A non-positive window would not crash: the windowed sums divide
+        # by w and the halo slice x[..., -0:] takes the FULL block, so the
+        # call would return silent NaN/garbage metrics instead of failing.
+        raise ValueError(f"{what} must be >= 1, got {window}")
     if T % n_dev:
         raise ValueError(
             f"T={T} not divisible by the {n_dev}-way {axis_name!r} axis")
@@ -956,6 +961,293 @@ def sharded_trix_backtest(mesh: Mesh, close, span: int, signal: int, *,
         warm = 3 * span + signal - 2
         valid = gidx >= warm - 1   # rolling.valid_mask(T, warm)
         pos = jnp.where(valid, jnp.sign(trix - sig), 0.0)
+        return _pnl_metrics_local(pos, r, gidx, T, cost=cost,
+                                  periods_per_year=periods_per_year,
+                                  axis_name=axis_name)
+
+    out_specs = Metrics(*(rep for _ in Metrics._fields))
+    return jax.shard_map(local, mesh=mesh, in_specs=spec,
+                         out_specs=out_specs, check_vma=False)(close)
+
+
+def sharded_momentum_backtest(mesh: Mesh, close, lookback: int, *,
+                              cost: float = 0.0, periods_per_year: int = 252,
+                              axis_name: str = TIME_AXIS):
+    """End-to-end time-series momentum backtest, TIME axis sharded.
+
+    The simplest windowed composition (``models.momentum`` semantics:
+    ``sign(close[t] - close[t-lookback])``, valid from ``lookback`` bars):
+    the lagged read is a pure bounded-halo exchange — no cumsum, no carry —
+    so ONE stacked ``ppermute`` of the left neighbor's last ``lookback``
+    bars serves both the one-bar return lag and the momentum lag.
+
+    ``lookback`` is a static int with ``lookback <= block length`` (halo
+    bound). Returns scalar-per-series :class:`~..ops.metrics.Metrics`,
+    replicated. Matches the single-device computation to f32 tolerance.
+    """
+    from ..ops.metrics import Metrics
+
+    n_dev = mesh.shape[axis_name]
+    T = close.shape[-1]
+    _check_time_axis(T, n_dev, lookback, axis_name, "lookback")
+    halo = lookback
+    spec = P(*((None,) * (close.ndim - 1) + (axis_name,)))
+    rep = P(*((None,) * (close.ndim - 1)))
+
+    def local(close_blk):
+        Tb = close_blk.shape[-1]
+        gidx = jnp.arange(Tb) + jax.lax.axis_index(axis_name) * Tb
+        ext = jnp.concatenate([_from_left(close_blk, halo, axis_name),
+                               close_blk], axis=-1)
+        prev_close = jax.lax.slice_in_dim(ext, halo - 1, halo - 1 + Tb,
+                                          axis=-1)
+        r = jnp.where(gidx == 0, 0.0,
+                      close_blk / jnp.where(gidx == 0, 1.0, prev_close)
+                      - 1.0)
+        # past[t] = close[t - lookback]; chip 0's zero halo is garbage in
+        # the warmup region, masked by `valid` exactly like the unsharded
+        # clipped-gather fill.
+        past = jax.lax.slice_in_dim(ext, 0, Tb, axis=-1)
+        valid = gidx >= lookback      # rolling.valid_mask(T, lookback + 1)
+        pos = jnp.where(valid, jnp.sign(close_blk - past), 0.0)
+        return _pnl_metrics_local(pos, r, gidx, T, cost=cost,
+                                  periods_per_year=periods_per_year,
+                                  axis_name=axis_name)
+
+    out_specs = Metrics(*(rep for _ in Metrics._fields))
+    return jax.shard_map(local, mesh=mesh, in_specs=spec,
+                         out_specs=out_specs, check_vma=False)(close)
+
+
+def sharded_bollinger_touch_backtest(mesh: Mesh, close, window: int,
+                                     k: float, *, cost: float = 0.0,
+                                     periods_per_year: int = 252,
+                                     axis_name: str = TIME_AXIS):
+    """Path-free Bollinger band-touch backtest, TIME axis sharded.
+
+    Same blockwise rolling z-score as :func:`sharded_bollinger_backtest`
+    (distributed centered cumsums + ``window``-bar halo), but the exposure
+    is memoryless — ``+1`` below the lower band, ``-1`` above the upper,
+    flat inside (``models.bollinger._touch_positions``) — so no state
+    machine composes across chips at all: the position is a local map of
+    the z block.
+
+    ``window`` is a static int with ``window <= block length``. Returns
+    scalar-per-series :class:`~..ops.metrics.Metrics`, replicated.
+    Matches the single-device computation to f32 tolerance.
+    """
+    from ..ops.metrics import Metrics
+
+    n_dev = mesh.shape[axis_name]
+    T = close.shape[-1]
+    _check_time_axis(T, n_dev, window, axis_name, "window")
+    halo_w = window
+    eps = 1e-12
+    k_f = jnp.float32(k)
+    spec = P(*((None,) * (close.ndim - 1) + (axis_name,)))
+    rep = P(*((None,) * (close.ndim - 1)))
+
+    def local(close_blk):
+        Tb = close_blk.shape[-1]
+        gidx = jnp.arange(Tb) + jax.lax.axis_index(axis_name) * Tb
+        r = _block_returns(close_blk, gidx, axis_name)
+        z = _windowed_zscore_local(close_blk, gidx, window, halo_w, T,
+                                   axis_name, eps=eps)
+        valid = gidx >= window - 1
+        z = jnp.where(valid, z, 0.0)
+        pos = jnp.where(z < -k_f, 1.0, jnp.where(z > k_f, -1.0, 0.0))
+        pos = jnp.where(valid, pos, 0.0)
+        return _pnl_metrics_local(pos, r, gidx, T, cost=cost,
+                                  periods_per_year=periods_per_year,
+                                  axis_name=axis_name)
+
+    out_specs = Metrics(*(rep for _ in Metrics._fields))
+    return jax.shard_map(local, mesh=mesh, in_specs=spec,
+                         out_specs=out_specs, check_vma=False)(close)
+
+
+def sharded_keltner_backtest(mesh: Mesh, close, high, low, window: int,
+                             k: float, *, cost: float = 0.0,
+                             periods_per_year: int = 252,
+                             axis_name: str = TIME_AXIS):
+    """End-to-end Keltner-channel mean-reversion backtest, TIME axis sharded.
+
+    A *mixed-state* composition (``models.keltner`` semantics): the EMA
+    midline is a blockwise linear scan (one ``(A, B)`` carry pair per
+    chip), the ATR is a windowed mean of the true range (distributed
+    cumsum + ``window``-bar halo), and the ATR-normalized deviation feeds
+    the exactly-sharded band machine. The true range's lagged close rides
+    a one-bar halo (first global bar uses ``high - low`` via a
+    ``close``-valued pad, matching the unsharded ``true_range``).
+
+    ``window`` is a static int with ``window <= block length``. Returns
+    scalar-per-series :class:`~..ops.metrics.Metrics`, replicated.
+    Matches the single-device computation to f32 tolerance.
+    """
+    from ..ops.metrics import Metrics
+
+    n_dev = mesh.shape[axis_name]
+    T = close.shape[-1]
+    _check_time_axis(T, n_dev, window, axis_name, "window")
+    alpha = jnp.float32(2.0 / (window + 1.0))
+    eps = 1e-12
+    k_f = jnp.float32(k)
+    spec = P(*((None,) * (close.ndim - 1) + (axis_name,)))
+    rep = P(*((None,) * (close.ndim - 1)))
+
+    def local(close_blk, high_blk, low_blk):
+        Tb = close_blk.shape[-1]
+        gidx = jnp.arange(Tb) + jax.lax.axis_index(axis_name) * Tb
+        # ONE one-bar halo exchange serves the returns and the true
+        # range's lagged close (the sharded-RSI discipline).
+        prev_raw = jnp.concatenate(
+            [_from_left(close_blk, 1, axis_name), close_blk[..., :-1]],
+            axis=-1)
+        r = jnp.where(gidx == 0, 0.0,
+                      close_blk / jnp.where(gidx == 0, 1.0, prev_raw) - 1.0)
+        # models.keltner.true_range pads the first bar's lagged close with
+        # close[0] itself (|high - close[0]| etc. still <= high - low
+        # bounds the max correctly only when close[0] is inside the bar —
+        # we reproduce the reference formula, not a re-derivation).
+        prev_c = jnp.where(gidx == 0, close_blk, prev_raw)
+        tr = jnp.maximum(high_blk - low_blk,
+                         jnp.maximum(jnp.abs(high_blk - prev_c),
+                                     jnp.abs(low_blk - prev_c)))
+        mid = _ema_local(close_blk, gidx, alpha, axis_name)
+        cs, cs_ext = _cumsum_ext(tr, window, axis_name)
+        atr = _windowed_sum_blk(cs, cs_ext, gidx, window,
+                                window) / jnp.float32(window)
+        dev = close_blk - mid
+        valid = gidx >= window - 1    # rolling.valid_mask(T, window)
+        # keltner_z: zero-ATR (or warmup-NaN in the unsharded path) -> 0.
+        z = jnp.where(valid & (atr > eps), dev / (atr + eps), 0.0)
+        pos = _band_positions_local(z, jnp.broadcast_to(valid, z.shape),
+                                    k_f, jnp.float32(0.0), axis_name)
+        return _pnl_metrics_local(pos, r, gidx, T, cost=cost,
+                                  periods_per_year=periods_per_year,
+                                  axis_name=axis_name)
+
+    out_specs = Metrics(*(rep for _ in Metrics._fields))
+    return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=out_specs, check_vma=False)(
+        close, high, low)
+
+
+def sharded_vwap_backtest(mesh: Mesh, close, volume, window: int, k: float,
+                          *, cost: float = 0.0, periods_per_year: int = 252,
+                          axis_name: str = TIME_AXIS):
+    """End-to-end VWAP-deviation mean-reversion backtest, TIME axis sharded.
+
+    The volume-weighted composition (``models.vwap`` semantics): rolling
+    VWAP is two windowed sums (price x volume and volume) riding ONE
+    stacked distributed cumsum + halo, the close's deviation from it is
+    z-scored with the same windowed machinery
+    (:func:`_windowed_zscore_local` on the derived series), and the band
+    machine + PnL tail finish as in Bollinger. Warmup and zero-volume
+    windows fall back to ``vwap = close`` (deviation 0), exactly like the
+    unsharded NaN-guarded path.
+
+    ``window`` is a static int with ``window <= block length``. Returns
+    scalar-per-series :class:`~..ops.metrics.Metrics`, replicated.
+    Matches the single-device computation to f32 tolerance.
+    """
+    from ..ops.metrics import Metrics
+
+    n_dev = mesh.shape[axis_name]
+    T = close.shape[-1]
+    _check_time_axis(T, n_dev, window, axis_name, "window")
+    halo_w = window
+    eps = 1e-12
+    k_f = jnp.float32(k)
+    spec = P(*((None,) * (close.ndim - 1) + (axis_name,)))
+    rep = P(*((None,) * (close.ndim - 1)))
+
+    def local(close_blk, vol_blk):
+        Tb = close_blk.shape[-1]
+        gidx = jnp.arange(Tb) + jax.lax.axis_index(axis_name) * Tb
+        r = _block_returns(close_blk, gidx, axis_name)
+
+        # Both VWAP sums through ONE stacked _cumsum_ext.
+        cs, cs_ext = _cumsum_ext(
+            jnp.stack([close_blk * vol_blk, vol_blk]), halo_w, axis_name)
+        s = _windowed_sum_blk(cs, cs_ext, gidx, window, halo_w)
+        pv, v = s[0], s[1]
+        valid_w = gidx >= window - 1
+        vwap = jnp.where(valid_w & (v > eps), pv / (v + eps), close_blk)
+        dev = close_blk - vwap        # 0 through warmup, like the
+                                      # unsharded NaN-window fallback
+        z = _windowed_zscore_local(dev, gidx, window, halo_w, T,
+                                   axis_name, eps=eps)
+        valid = gidx >= 2 * window - 2   # rolling.valid_mask(T, 2w - 1)
+        z = jnp.where(valid, z, 0.0)
+        pos = _band_positions_local(z, jnp.broadcast_to(valid, z.shape),
+                                    k_f, jnp.float32(0.0), axis_name)
+        return _pnl_metrics_local(pos, r, gidx, T, cost=cost,
+                                  periods_per_year=periods_per_year,
+                                  axis_name=axis_name)
+
+    out_specs = Metrics(*(rep for _ in Metrics._fields))
+    return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec),
+                         out_specs=out_specs, check_vma=False)(close, volume)
+
+
+def sharded_macd_backtest(mesh: Mesh, close, fast: int, slow: int,
+                          signal: int, *, cost: float = 0.0,
+                          periods_per_year: int = 252,
+                          axis_name: str = TIME_AXIS):
+    """End-to-end MACD signal-line backtest, TIME axis sharded.
+
+    Pure EMA-chain composition (``models.macd`` semantics): the close is
+    demeaned by its GLOBAL first bar (one ``psum`` broadcast — the f32
+    error-budget trick of the unsharded model), the fast/slow EMAs and
+    the signal-line EMA are three blockwise linear scans with one
+    ``(A, B)`` carry pair per chip each, and the trade is
+    ``sign(macd - signal_line)`` masked for the ``slow + signal - 1``
+    warmup. EMA state is O(1), so only the one-bar return halo constrains
+    the block size.
+
+    ``fast``/``slow``/``signal`` are static ints. Returns
+    scalar-per-series :class:`~..ops.metrics.Metrics`, replicated.
+    Parity with the single-device model is flip-aware: the unsharded path
+    evaluates its EMAs with the shift-doubling ladder while the blockwise
+    path uses ``associative_scan`` + carry fixup, which rounds ~1e-7
+    differently — enough to flip a knife-edge ``sign(macd - sig)``
+    crossing (the TRIX caveat class; the parity test bounds flips).
+    """
+    from ..ops.metrics import Metrics
+
+    n_dev = mesh.shape[axis_name]
+    T = close.shape[-1]
+    if T % n_dev:
+        raise ValueError(
+            f"T={T} not divisible by the {n_dev}-way {axis_name!r} axis")
+    if fast < 1 or slow < 1 or signal < 1:
+        raise ValueError(
+            f"spans must be >= 1, got {fast}, {slow}, {signal}")
+    a_fast = jnp.float32(2.0 / (fast + 1.0))
+    a_slow = jnp.float32(2.0 / (slow + 1.0))
+    a_sig = jnp.float32(2.0 / (signal + 1.0))
+    spec = P(*((None,) * (close.ndim - 1) + (axis_name,)))
+    rep = P(*((None,) * (close.ndim - 1)))
+
+    def local(close_blk):
+        Tb = close_blk.shape[-1]
+        gidx = jnp.arange(Tb) + jax.lax.axis_index(axis_name) * Tb
+        r = _block_returns(close_blk, gidx, axis_name)
+
+        # Demean by the global first bar (models.macd: x = close - close[0];
+        # shift-invariant in exact arithmetic, ~100x less f32 rounding).
+        c0 = jax.lax.psum(
+            jnp.sum(jnp.where(gidx == 0, close_blk, 0.0), axis=-1),
+            axis_name)[..., None]
+        x = close_blk - c0
+        macd = (_ema_local(x, gidx, a_fast, axis_name)
+                - _ema_local(x, gidx, a_slow, axis_name))
+        sig = _ema_local(macd, gidx, a_sig, axis_name)
+
+        warm = slow + signal - 1
+        valid = gidx >= warm - 1      # rolling.valid_mask(T, warm)
+        pos = jnp.where(valid, jnp.sign(macd - sig), 0.0)
         return _pnl_metrics_local(pos, r, gidx, T, cost=cost,
                                   periods_per_year=periods_per_year,
                                   axis_name=axis_name)

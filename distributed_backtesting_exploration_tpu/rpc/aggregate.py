@@ -206,6 +206,12 @@ def portfolio(results_dir: str, journal_path: str, *,
     for jid, rec in state.jobs.items():
         path = os.path.join(results_dir, f"{jid}.dbxm")
         if not os.path.exists(path):
+            # Pending jobs have no block yet — routine. A job the journal
+            # says COMPLETED with no stored block is a missing leg, the
+            # same quietly-thinner-book failure as a wrong-kind block
+            # (aggregate()'s jobs_missing discipline).
+            if jid in state.completed:
+                skipped.setdefault("missing", []).append(jid)
             continue
         with open(path, "rb") as fh:
             blob = fh.read()
@@ -242,12 +248,20 @@ def portfolio(results_dir: str, journal_path: str, *,
             "returns": ret,
         })
     for kind, jids in sorted(skipped.items()):
-        log.warning(
-            "portfolio: skipped %d stored block(s) of kind %r (not DBXP) — "
-            "the composed book is missing these jobs: %s. Re-run them on a "
-            "worker that implements --best-returns (single-host "
-            "rpc/worker.py does; check for slice workers completing the "
-            "wrong kind)", len(jids), kind, ", ".join(sorted(jids)))
+        if kind == "missing":
+            log.warning(
+                "portfolio: %d job(s) completed per the journal but have no "
+                "stored block — the composed book is missing these jobs: "
+                "%s. Was the dispatcher run without --results-dir, or were "
+                "blocks deleted?", len(jids), ", ".join(sorted(jids)))
+        else:
+            log.warning(
+                "portfolio: skipped %d stored block(s) of kind %r (not "
+                "DBXP) — the composed book is missing these jobs: %s. "
+                "Re-run them on a worker that implements --best-returns "
+                "(single-host rpc/worker.py does; check for slice workers "
+                "completing the wrong kind)", len(jids), kind,
+                ", ".join(sorted(jids)))
     if not legs:
         raise ValueError(
             f"no DBXP best-returns blocks found under {results_dir!r} — "

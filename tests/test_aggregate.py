@@ -424,6 +424,24 @@ def test_portfolio_counts_and_warns_on_non_dbxp_blocks(tmp_path, caplog):
     assert warn and recs[0].id in warn[0].message
 
 
+def test_portfolio_counts_completed_jobs_with_missing_blocks(tmp_path,
+                                                             caplog):
+    """A job the journal says COMPLETED whose block file vanished is a
+    missing leg too — same loud accounting as a wrong-kind block."""
+    import logging
+    import os
+
+    journal_path, results_dir, recs = _best_returns_run(tmp_path, n_jobs=3)
+    os.remove(f"{results_dir}/{recs[0].id}.dbxm")
+    with caplog.at_level(logging.WARNING, logger="dbx.aggregate"):
+        out = aggregate.portfolio(results_dir, journal_path)
+    assert out["legs_composed"] == 2
+    assert out["blocks_skipped"] == 1
+    warn = [r for r in caplog.records if "no "
+            "stored block" in r.message]
+    assert warn and recs[0].id in warn[0].message
+
+
 def test_portfolio_sanitizes_nonfinite_leg_values(tmp_path):
     """ADVICE r4: a NaN rank-metric value must be nulled BEFORE the sort
     (NaN is truthy, so `-(value or 0.0)` is NaN and ordering goes

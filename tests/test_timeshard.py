@@ -438,3 +438,114 @@ def test_sharded_obv_window_must_fit_block(devices):
     ones = jnp.ones((1, 256))
     with pytest.raises(ValueError, match="exceeds"):
         timeshard.sharded_obv_backtest(mesh, ones, ones, 100)
+
+
+def test_sharded_momentum_backtest_matches_single_device(devices):
+    """Pure bounded-halo lag: the time-sharded momentum backtest matches
+    models.momentum on the unsharded path (14/14 family completion)."""
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    mesh = Mesh(np.asarray(devices[:8]), (timeshard.TIME_AXIS,))
+    ohlcv = data.synthetic_ohlcv(3, 1024, seed=51)
+    got = timeshard.sharded_momentum_backtest(
+        mesh, jnp.asarray(ohlcv.close), 21, cost=1e-3)
+    want = _single_device_strategy_metrics(ohlcv, "momentum",
+                                           dict(lookback=21))
+    for name in want._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(got, name)), np.asarray(getattr(want, name)),
+            rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_sharded_bollinger_touch_backtest_matches_single_device(devices):
+    """Path-free band touch: same sharded z-score as the hysteresis
+    Bollinger, memoryless exposure — no cross-chip state at all."""
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    mesh = Mesh(np.asarray(devices[:8]), (timeshard.TIME_AXIS,))
+    ohlcv = data.synthetic_ohlcv(3, 1024, seed=53)
+    got = timeshard.sharded_bollinger_touch_backtest(
+        mesh, jnp.asarray(ohlcv.close), 20, 1.5, cost=1e-3)
+    want = _single_device_strategy_metrics(
+        ohlcv, "bollinger_touch", dict(window=20, k=1.5))
+    for name in want._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(got, name)), np.asarray(getattr(want, name)),
+            rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_sharded_keltner_backtest_matches_single_device(devices):
+    """Mixed EMA-midline + windowed-ATR state feeding the band machine:
+    the sharded Keltner backtest matches models.keltner unsharded."""
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    mesh = Mesh(np.asarray(devices[:8]), (timeshard.TIME_AXIS,))
+    ohlcv = data.synthetic_ohlcv(3, 1024, seed=57)
+    got = timeshard.sharded_keltner_backtest(
+        mesh, jnp.asarray(ohlcv.close), jnp.asarray(ohlcv.high),
+        jnp.asarray(ohlcv.low), 20, 1.5, cost=1e-3)
+    want = _single_device_strategy_metrics(
+        ohlcv, "keltner", dict(window=20, k=1.5))
+    for name in want._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(got, name)), np.asarray(getattr(want, name)),
+            rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_sharded_vwap_backtest_matches_single_device(devices):
+    """The volume-weighted composition: sharded rolling VWAP + deviation
+    z-score + band machine matches models.vwap_reversion unsharded."""
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    mesh = Mesh(np.asarray(devices[:8]), (timeshard.TIME_AXIS,))
+    ohlcv = data.synthetic_ohlcv(3, 1024, seed=59)
+    got = timeshard.sharded_vwap_backtest(
+        mesh, jnp.asarray(ohlcv.close), jnp.asarray(ohlcv.volume), 20, 1.5,
+        cost=1e-3)
+    want = _single_device_strategy_metrics(
+        ohlcv, "vwap_reversion", dict(window=20, k=1.5))
+    for name in want._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(got, name)), np.asarray(getattr(want, name)),
+            rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_sharded_macd_backtest_matches_single_device(devices):
+    """EMA-chain composition with the global-first-bar demean. Flip-aware
+    like TRIX: the model's ema_ladder and the blockwise associative scan
+    round ~1e-7 apart, enough to flip a knife-edge sign crossing."""
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    mesh = Mesh(np.asarray(devices[:8]), (timeshard.TIME_AXIS,))
+    ohlcv = data.synthetic_ohlcv(8, 1024, seed=61)
+    got = timeshard.sharded_macd_backtest(
+        mesh, jnp.asarray(ohlcv.close), 12, 26, 9, cost=1e-3)
+    want = _single_device_strategy_metrics(
+        ohlcv, "macd", dict(fast=12, slow=26, signal=9))
+
+    flipped = np.zeros(8, dtype=bool)
+    for name in want._fields:
+        a = np.asarray(getattr(got, name))
+        b = np.asarray(getattr(want, name))
+        flipped |= np.abs(a - b) > (0.01 + 0.01 * np.abs(b))
+    assert int(flipped.sum()) <= 2, f"{int(flipped.sum())}/8 flips"
+    for name in want._fields:
+        a = np.asarray(getattr(got, name))[~flipped]
+        b = np.asarray(getattr(want, name))[~flipped]
+        np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-4,
+                                   err_msg=name)
+
+
+def test_new_sharded_families_reject_bad_windows(devices):
+    mesh = Mesh(np.asarray(devices[:8]), (timeshard.TIME_AXIS,))
+    ones = jnp.ones((1, 256))
+    with pytest.raises(ValueError, match="halo"):
+        timeshard.sharded_momentum_backtest(mesh, ones, 100)
+    with pytest.raises(ValueError, match="halo"):
+        timeshard.sharded_keltner_backtest(mesh, ones, ones, ones, 100, 1.0)
+    with pytest.raises(ValueError, match="halo"):
+        timeshard.sharded_vwap_backtest(mesh, ones, ones, 100, 1.0)
+    with pytest.raises(ValueError, match="halo"):
+        timeshard.sharded_bollinger_touch_backtest(mesh, ones, 100, 1.0)
+    with pytest.raises(ValueError, match=">= 1"):
+        timeshard.sharded_macd_backtest(mesh, ones, 0, 26, 9)
