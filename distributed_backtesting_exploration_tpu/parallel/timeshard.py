@@ -223,14 +223,24 @@ def _pnl_metrics_local(pos, r, gidx, T: int, *, cost: float,
     moments / running-peak drawdown / final equity as ``psum``/``pmax``
     reductions with an exclusive cross-chip max for the peak. A caller
     that already exchanged a one-bar halo for its own state (pairs stacks
-    beta with pos) passes ``prev_pos`` to keep that single collective."""
+    beta with pos) passes ``prev_pos`` to keep that single collective.
+
+    ``T`` is the SEMANTIC history length: bars with ``gidx >= T`` (the
+    right padding a caller adds to make the panel divisible by the mesh)
+    are dead — they contribute zero net return, turnover, and activity,
+    the equity curve stays flat through them, and every denominator uses
+    ``T``. With repeat-last padding this makes the padded computation
+    bit-equal in semantics to the unpadded one (the ``t_real`` contract
+    of the ``sharded_*_backtest`` family)."""
     from ..ops.metrics import metrics_from_reductions
 
     n_f = jnp.float32(T)
+    live = gidx < T
     if prev_pos is None:
         prev_pos = jnp.concatenate(
             [_from_left(pos, 1, axis_name), pos[..., :-1]], axis=-1)
     net = prev_pos * r - jnp.float32(cost) * jnp.abs(pos - prev_pos)
+    net = jnp.where(live, net, 0.0)
 
     # Moments / downside via global sums.
     s1 = jax.lax.psum(jnp.sum(net, axis=-1), axis_name)
@@ -250,14 +260,15 @@ def _pnl_metrics_local(pos, r, gidx, T: int, *, cost: float,
     eq_final = jax.lax.psum(
         jnp.sum(jnp.where(gidx == T - 1, eq, 0.0), axis=-1), axis_name)
 
-    active = jnp.abs(prev_pos) > 0
+    active = (jnp.abs(prev_pos) > 0) & live
     wins = (net > 0) & active
     wins_sum = jax.lax.psum(
         jnp.sum(wins.astype(jnp.float32), -1), axis_name)
     active_sum = jax.lax.psum(
         jnp.sum(active.astype(jnp.float32), -1), axis_name)
     turnover = jax.lax.psum(
-        jnp.sum(jnp.abs(pos - prev_pos), axis=-1), axis_name)
+        jnp.sum(jnp.where(live, jnp.abs(pos - prev_pos), 0.0), axis=-1),
+        axis_name)
     return metrics_from_reductions(
         s1=s1, s2=s2, downside_sq_sum=down_sq, mdd=mdd,
         eq_final=eq_final, wins_sum=wins_sum, active_sum=active_sum,
@@ -308,7 +319,10 @@ def _windowed_zscore_local(series_blk, gidx, window: int, halo_w: int,
     leading, the scans are per-row.
     """
     w_f = jnp.float32(window)
-    mean = (jax.lax.psum(jnp.sum(series_blk, axis=-1), axis_name)
+    # Mean over the LIVE history only (gidx < T): with a right-padded
+    # panel the pad bars must not shift the full-history centering.
+    mean = (jax.lax.psum(
+        jnp.sum(jnp.where(gidx < T, series_blk, 0.0), axis=-1), axis_name)
             / jnp.float32(T))[..., None]
     sc = series_blk - mean
     stacked = jnp.stack([sc, sc * sc, series_blk])
@@ -412,7 +426,8 @@ def sharded_band_positions(mesh: Mesh, z, valid, z_entry, z_exit=0.0, *,
 
 def sharded_sma_backtest(mesh: Mesh, close, fast: int, slow: int, *,
                          cost: float = 0.0, periods_per_year: int = 252,
-                         axis_name: str = TIME_AXIS):
+                         axis_name: str = TIME_AXIS,
+                         t_real: int | None = None):
     """End-to-end SMA-crossover backtest with the TIME axis sharded.
 
     The composed long-context path: for a ``(..., T)`` close panel whose
@@ -436,14 +451,15 @@ def sharded_sma_backtest(mesh: Mesh, close, fast: int, slow: int, *,
     if not (0 < fast < slow):
         raise ValueError(f"need 0 < fast < slow, got {fast}, {slow}")
     n_dev = mesh.shape[axis_name]   # the TIME axis size, not total devices
-    T = close.shape[-1]
-    if T % n_dev:
+    T_pad = close.shape[-1]
+    if T_pad % n_dev:
         raise ValueError(
-            f"T={T} not divisible by the {n_dev}-way {axis_name!r} axis")
-    if slow > T // n_dev:
+            f"T={T_pad} not divisible by the {n_dev}-way {axis_name!r} axis")
+    if slow > T_pad // n_dev:
         raise ValueError(
-            f"slow={slow} exceeds the {T // n_dev}-bar block; the halo "
+            f"slow={slow} exceeds the {T_pad // n_dev}-bar block; the halo "
             "exchange needs the window to fit one neighbor block")
+    T = _resolve_t_real(T_pad, t_real)
     halo_w = slow
     spec = P(*((None,) * (close.ndim - 1) + (axis_name,)))
     rep = P(*((None,) * (close.ndim - 1)))   # metrics drop the time axis
@@ -475,7 +491,8 @@ def sharded_sma_backtest(mesh: Mesh, close, fast: int, slow: int, *,
 def sharded_bollinger_backtest(mesh: Mesh, close, window: int, k: float, *,
                                z_exit: float = 0.0, cost: float = 0.0,
                                periods_per_year: int = 252,
-                               axis_name: str = TIME_AXIS):
+                               axis_name: str = TIME_AXIS,
+                               t_real: int | None = None):
     """End-to-end Bollinger mean-reversion backtest, TIME axis sharded.
 
     The long-context composition for a *stateful* strategy: blockwise
@@ -495,14 +512,15 @@ def sharded_bollinger_backtest(mesh: Mesh, close, window: int, k: float, *,
     from ..ops.metrics import Metrics
 
     n_dev = mesh.shape[axis_name]
-    T = close.shape[-1]
-    if T % n_dev:
+    T_pad = close.shape[-1]
+    if T_pad % n_dev:
         raise ValueError(
-            f"T={T} not divisible by the {n_dev}-way {axis_name!r} axis")
-    if window > T // n_dev:
+            f"T={T_pad} not divisible by the {n_dev}-way {axis_name!r} axis")
+    if window > T_pad // n_dev:
         raise ValueError(
-            f"window={window} exceeds the {T // n_dev}-bar block; the halo "
-            "exchange needs the window to fit one neighbor block")
+            f"window={window} exceeds the {T_pad // n_dev}-bar block; the "
+            "halo exchange needs the window to fit one neighbor block")
+    T = _resolve_t_real(T_pad, t_real)
     halo_w = window
     eps = 1e-12
     spec = P(*((None,) * (close.ndim - 1) + (axis_name,)))
@@ -533,7 +551,8 @@ def sharded_bollinger_backtest(mesh: Mesh, close, window: int, k: float, *,
 
 def sharded_rsi_backtest(mesh: Mesh, close, period: int, band: float, *,
                          cost: float = 0.0, periods_per_year: int = 252,
-                         axis_name: str = TIME_AXIS):
+                         axis_name: str = TIME_AXIS,
+                         t_real: int | None = None):
     """End-to-end RSI mean-reversion backtest, TIME axis sharded.
 
     The *EMA-state* long-context composition (Bollinger covers the
@@ -555,12 +574,13 @@ def sharded_rsi_backtest(mesh: Mesh, close, period: int, band: float, *,
     from ..ops.metrics import Metrics
 
     n_dev = mesh.shape[axis_name]
-    T = close.shape[-1]
-    if T % n_dev:
+    T_pad = close.shape[-1]
+    if T_pad % n_dev:
         raise ValueError(
-            f"T={T} not divisible by the {n_dev}-way {axis_name!r} axis")
+            f"T={T_pad} not divisible by the {n_dev}-way {axis_name!r} axis")
     if period < 1:
         raise ValueError(f"period must be >= 1, got {period}")
+    T = _resolve_t_real(T_pad, t_real)
     alpha = jnp.float32(1.0 / period)   # Wilder's decay (models.rsi)
     spec = P(*((None,) * (close.ndim - 1) + (axis_name,)))
     rep = P(*((None,) * (close.ndim - 1)))
@@ -601,7 +621,8 @@ def sharded_rsi_backtest(mesh: Mesh, close, period: int, band: float, *,
 def sharded_pairs_backtest(mesh: Mesh, y_close, x_close, lookback: int,
                            z_entry: float, *, z_exit: float = 0.0,
                            cost: float = 0.0, periods_per_year: int = 252,
-                           axis_name: str = TIME_AXIS):
+                           axis_name: str = TIME_AXIS,
+                           t_real: int | None = None):
     """End-to-end rolling-OLS pairs backtest, TIME axis sharded.
 
     The two-legged long-context composition — every blockwise piece this
@@ -629,14 +650,15 @@ def sharded_pairs_backtest(mesh: Mesh, y_close, x_close, lookback: int,
     from ..ops.metrics import Metrics
 
     n_dev = mesh.shape[axis_name]
-    T = y_close.shape[-1]
-    if T % n_dev:
+    T_pad = y_close.shape[-1]
+    if T_pad % n_dev:
         raise ValueError(
-            f"T={T} not divisible by the {n_dev}-way {axis_name!r} axis")
-    if lookback > T // n_dev:
+            f"T={T_pad} not divisible by the {n_dev}-way {axis_name!r} axis")
+    if lookback > T_pad // n_dev:
         raise ValueError(
-            f"lookback={lookback} exceeds the {T // n_dev}-bar block; the "
-            "halo exchange needs the window to fit one neighbor block")
+            f"lookback={lookback} exceeds the {T_pad // n_dev}-bar block; "
+            "the halo exchange needs the window to fit one neighbor block")
+    T = _resolve_t_real(T_pad, t_real)
     halo_w = lookback
     eps = 1e-12
     w_f = jnp.float32(lookback)
@@ -651,11 +673,14 @@ def sharded_pairs_backtest(mesh: Mesh, y_close, x_close, lookback: int,
         r2 = _block_returns(jnp.stack([y_blk, x_blk]), gidx, axis_name)
         ry, rx = r2[0], r2[1]
 
-        # Series means over the full history (psum), the same f32
-        # cancellation guard as rolling.rolling_ols.
-        my = (jax.lax.psum(jnp.sum(y_blk, axis=-1), axis_name)
+        # Series means over the LIVE history (psum, gidx < T so right
+        # padding can't shift them), the same f32 cancellation guard as
+        # rolling.rolling_ols.
+        my = (jax.lax.psum(
+            jnp.sum(jnp.where(gidx < T, y_blk, 0.0), axis=-1), axis_name)
               / jnp.float32(T))[..., None]
-        mx = (jax.lax.psum(jnp.sum(x_blk, axis=-1), axis_name)
+        mx = (jax.lax.psum(
+            jnp.sum(jnp.where(gidx < T, x_blk, 0.0), axis=-1), axis_name)
               / jnp.float32(T))[..., None]
         yc, xc = y_blk - my, x_blk - mx
 
@@ -700,6 +725,26 @@ def sharded_pairs_backtest(mesh: Mesh, y_close, x_close, lookback: int,
     return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec),
                          out_specs=out_specs, check_vma=False)(
         y_close, x_close)
+
+
+def _resolve_t_real(T_pad: int, t_real) -> int:
+    """Semantic history length of a right-padded panel.
+
+    The ``t_real`` contract shared by every ``sharded_*_backtest``: a
+    caller whose history is not divisible by the mesh pads the time axis
+    on the RIGHT with repeat-last values up to ``T_pad`` and passes the
+    real length here. Pad bars then earn zero return, zero turnover, and
+    zero weight in every mean/metric denominator (see
+    :func:`_pnl_metrics_local`), so the padded result equals the
+    unpadded one exactly — the same discipline as the fused kernels'
+    per-ticker ``t_real`` (``ops.fused``)."""
+    if t_real is None:
+        return T_pad
+    t = int(t_real)
+    if not 0 < t <= T_pad:
+        raise ValueError(
+            f"t_real={t} must be in (0, {T_pad}] (the padded length)")
+    return t
 
 
 def _check_time_axis(T: int, n_dev: int, window: int, axis_name: str,
@@ -765,7 +810,8 @@ def _donchian_metrics_local(latch_src, hi_src, lo_src, gidx, window: int,
 
 def sharded_donchian_backtest(mesh: Mesh, close, window: int, *,
                               cost: float = 0.0, periods_per_year: int = 252,
-                              axis_name: str = TIME_AXIS):
+                              axis_name: str = TIME_AXIS,
+                              t_real: int | None = None):
     """End-to-end Donchian-channel breakout backtest, TIME axis sharded.
 
     The *rolling-extrema-state* long-context composition — the fourth and
@@ -791,8 +837,9 @@ def sharded_donchian_backtest(mesh: Mesh, close, window: int, *,
     from ..ops.metrics import Metrics
 
     n_dev = mesh.shape[axis_name]
-    T = close.shape[-1]
-    _check_time_axis(T, n_dev, window, axis_name, "window")
+    T_pad = close.shape[-1]
+    _check_time_axis(T_pad, n_dev, window, axis_name, "window")
+    T = _resolve_t_real(T_pad, t_real)
     spec = P(*((None,) * (close.ndim - 1) + (axis_name,)))
     rep = P(*((None,) * (close.ndim - 1)))
 
@@ -811,7 +858,8 @@ def sharded_donchian_backtest(mesh: Mesh, close, window: int, *,
 def sharded_donchian_hl_backtest(mesh: Mesh, close, high, low, window: int,
                                  *, cost: float = 0.0,
                                  periods_per_year: int = 252,
-                                 axis_name: str = TIME_AXIS):
+                                 axis_name: str = TIME_AXIS,
+                                 t_real: int | None = None):
     """Classic high/low-channel Donchian breakout, TIME axis sharded.
 
     Same composition as :func:`sharded_donchian_backtest` with the
@@ -821,8 +869,9 @@ def sharded_donchian_hl_backtest(mesh: Mesh, close, high, low, window: int,
     from ..ops.metrics import Metrics
 
     n_dev = mesh.shape[axis_name]
-    T = close.shape[-1]
-    _check_time_axis(T, n_dev, window, axis_name, "window")
+    T_pad = close.shape[-1]
+    _check_time_axis(T_pad, n_dev, window, axis_name, "window")
+    T = _resolve_t_real(T_pad, t_real)
     spec = P(*((None,) * (close.ndim - 1) + (axis_name,)))
     rep = P(*((None,) * (close.ndim - 1)))
 
@@ -842,7 +891,8 @@ def sharded_donchian_hl_backtest(mesh: Mesh, close, high, low, window: int,
 def sharded_stochastic_backtest(mesh: Mesh, close, high, low, window: int,
                                 band: float, *, cost: float = 0.0,
                                 periods_per_year: int = 252,
-                                axis_name: str = TIME_AXIS):
+                                axis_name: str = TIME_AXIS,
+                                t_real: int | None = None):
     """End-to-end stochastic-%K mean-reversion backtest, TIME axis sharded.
 
     Rolling-extrema state feeding the band machine: the trailing
@@ -860,8 +910,9 @@ def sharded_stochastic_backtest(mesh: Mesh, close, high, low, window: int,
 
     eps = 1e-12
     n_dev = mesh.shape[axis_name]
-    T = close.shape[-1]
-    _check_time_axis(T, n_dev, window, axis_name, "window")
+    T_pad = close.shape[-1]
+    _check_time_axis(T_pad, n_dev, window, axis_name, "window")
+    T = _resolve_t_real(T_pad, t_real)
     halo = max(window - 1, 1)    # extrema need w-1 left bars; returns need 1
     spec = P(*((None,) * (close.ndim - 1) + (axis_name,)))
     rep = P(*((None,) * (close.ndim - 1)))
@@ -911,7 +962,8 @@ def sharded_stochastic_backtest(mesh: Mesh, close, high, low, window: int,
 
 def sharded_trix_backtest(mesh: Mesh, close, span: int, signal: int, *,
                           cost: float = 0.0, periods_per_year: int = 252,
-                          axis_name: str = TIME_AXIS):
+                          axis_name: str = TIME_AXIS,
+                          t_real: int | None = None):
     """End-to-end TRIX signal-line backtest, TIME axis sharded.
 
     Pure EMA-state composition (``models.trix`` semantics): the triple
@@ -929,12 +981,13 @@ def sharded_trix_backtest(mesh: Mesh, close, span: int, signal: int, *,
     from ..ops.metrics import Metrics
 
     n_dev = mesh.shape[axis_name]
-    T = close.shape[-1]
-    if T % n_dev:
+    T_pad = close.shape[-1]
+    if T_pad % n_dev:
         raise ValueError(
-            f"T={T} not divisible by the {n_dev}-way {axis_name!r} axis")
+            f"T={T_pad} not divisible by the {n_dev}-way {axis_name!r} axis")
     if span < 1 or signal < 1:
         raise ValueError(f"spans must be >= 1, got {span}, {signal}")
+    T = _resolve_t_real(T_pad, t_real)
     a_span = jnp.float32(2.0 / (span + 1.0))
     a_sig = jnp.float32(2.0 / (signal + 1.0))
     spec = P(*((None,) * (close.ndim - 1) + (axis_name,)))
@@ -972,7 +1025,8 @@ def sharded_trix_backtest(mesh: Mesh, close, span: int, signal: int, *,
 
 def sharded_momentum_backtest(mesh: Mesh, close, lookback: int, *,
                               cost: float = 0.0, periods_per_year: int = 252,
-                              axis_name: str = TIME_AXIS):
+                              axis_name: str = TIME_AXIS,
+                              t_real: int | None = None):
     """End-to-end time-series momentum backtest, TIME axis sharded.
 
     The simplest windowed composition (``models.momentum`` semantics:
@@ -988,8 +1042,9 @@ def sharded_momentum_backtest(mesh: Mesh, close, lookback: int, *,
     from ..ops.metrics import Metrics
 
     n_dev = mesh.shape[axis_name]
-    T = close.shape[-1]
-    _check_time_axis(T, n_dev, lookback, axis_name, "lookback")
+    T_pad = close.shape[-1]
+    _check_time_axis(T_pad, n_dev, lookback, axis_name, "lookback")
+    T = _resolve_t_real(T_pad, t_real)
     halo = lookback
     spec = P(*((None,) * (close.ndim - 1) + (axis_name,)))
     rep = P(*((None,) * (close.ndim - 1)))
@@ -1022,7 +1077,8 @@ def sharded_momentum_backtest(mesh: Mesh, close, lookback: int, *,
 def sharded_bollinger_touch_backtest(mesh: Mesh, close, window: int,
                                      k: float, *, cost: float = 0.0,
                                      periods_per_year: int = 252,
-                                     axis_name: str = TIME_AXIS):
+                                     axis_name: str = TIME_AXIS,
+                                     t_real: int | None = None):
     """Path-free Bollinger band-touch backtest, TIME axis sharded.
 
     Same blockwise rolling z-score as :func:`sharded_bollinger_backtest`
@@ -1039,8 +1095,9 @@ def sharded_bollinger_touch_backtest(mesh: Mesh, close, window: int,
     from ..ops.metrics import Metrics
 
     n_dev = mesh.shape[axis_name]
-    T = close.shape[-1]
-    _check_time_axis(T, n_dev, window, axis_name, "window")
+    T_pad = close.shape[-1]
+    _check_time_axis(T_pad, n_dev, window, axis_name, "window")
+    T = _resolve_t_real(T_pad, t_real)
     halo_w = window
     eps = 1e-12
     k_f = jnp.float32(k)
@@ -1069,7 +1126,8 @@ def sharded_bollinger_touch_backtest(mesh: Mesh, close, window: int,
 def sharded_keltner_backtest(mesh: Mesh, close, high, low, window: int,
                              k: float, *, cost: float = 0.0,
                              periods_per_year: int = 252,
-                             axis_name: str = TIME_AXIS):
+                             axis_name: str = TIME_AXIS,
+                             t_real: int | None = None):
     """End-to-end Keltner-channel mean-reversion backtest, TIME axis sharded.
 
     A *mixed-state* composition (``models.keltner`` semantics): the EMA
@@ -1087,8 +1145,9 @@ def sharded_keltner_backtest(mesh: Mesh, close, high, low, window: int,
     from ..ops.metrics import Metrics
 
     n_dev = mesh.shape[axis_name]
-    T = close.shape[-1]
-    _check_time_axis(T, n_dev, window, axis_name, "window")
+    T_pad = close.shape[-1]
+    _check_time_axis(T_pad, n_dev, window, axis_name, "window")
+    T = _resolve_t_real(T_pad, t_real)
     alpha = jnp.float32(2.0 / (window + 1.0))
     eps = 1e-12
     k_f = jnp.float32(k)
@@ -1135,7 +1194,8 @@ def sharded_keltner_backtest(mesh: Mesh, close, high, low, window: int,
 
 def sharded_vwap_backtest(mesh: Mesh, close, volume, window: int, k: float,
                           *, cost: float = 0.0, periods_per_year: int = 252,
-                          axis_name: str = TIME_AXIS):
+                          axis_name: str = TIME_AXIS,
+                          t_real: int | None = None):
     """End-to-end VWAP-deviation mean-reversion backtest, TIME axis sharded.
 
     The volume-weighted composition (``models.vwap`` semantics): rolling
@@ -1154,8 +1214,9 @@ def sharded_vwap_backtest(mesh: Mesh, close, volume, window: int, k: float,
     from ..ops.metrics import Metrics
 
     n_dev = mesh.shape[axis_name]
-    T = close.shape[-1]
-    _check_time_axis(T, n_dev, window, axis_name, "window")
+    T_pad = close.shape[-1]
+    _check_time_axis(T_pad, n_dev, window, axis_name, "window")
+    T = _resolve_t_real(T_pad, t_real)
     halo_w = window
     eps = 1e-12
     k_f = jnp.float32(k)
@@ -1194,7 +1255,8 @@ def sharded_vwap_backtest(mesh: Mesh, close, volume, window: int, k: float,
 def sharded_macd_backtest(mesh: Mesh, close, fast: int, slow: int,
                           signal: int, *, cost: float = 0.0,
                           periods_per_year: int = 252,
-                          axis_name: str = TIME_AXIS):
+                          axis_name: str = TIME_AXIS,
+                          t_real: int | None = None):
     """End-to-end MACD signal-line backtest, TIME axis sharded.
 
     Pure EMA-chain composition (``models.macd`` semantics): the close is
@@ -1217,13 +1279,14 @@ def sharded_macd_backtest(mesh: Mesh, close, fast: int, slow: int,
     from ..ops.metrics import Metrics
 
     n_dev = mesh.shape[axis_name]
-    T = close.shape[-1]
-    if T % n_dev:
+    T_pad = close.shape[-1]
+    if T_pad % n_dev:
         raise ValueError(
-            f"T={T} not divisible by the {n_dev}-way {axis_name!r} axis")
+            f"T={T_pad} not divisible by the {n_dev}-way {axis_name!r} axis")
     if fast < 1 or slow < 1 or signal < 1:
         raise ValueError(
             f"spans must be >= 1, got {fast}, {slow}, {signal}")
+    T = _resolve_t_real(T_pad, t_real)
     a_fast = jnp.float32(2.0 / (fast + 1.0))
     a_slow = jnp.float32(2.0 / (slow + 1.0))
     a_sig = jnp.float32(2.0 / (signal + 1.0))
@@ -1259,7 +1322,8 @@ def sharded_macd_backtest(mesh: Mesh, close, fast: int, slow: int,
 
 def sharded_obv_backtest(mesh: Mesh, close, volume, window: int, *,
                          cost: float = 0.0, periods_per_year: int = 252,
-                         axis_name: str = TIME_AXIS):
+                         axis_name: str = TIME_AXIS,
+                         t_real: int | None = None):
     """End-to-end OBV-trend backtest, TIME axis sharded.
 
     A *double-accumulation* composition (``models.obv`` semantics): the
@@ -1278,16 +1342,17 @@ def sharded_obv_backtest(mesh: Mesh, close, volume, window: int, *,
     from ..ops.metrics import Metrics
 
     n_dev = mesh.shape[axis_name]
-    T = close.shape[-1]
-    if T % n_dev:
+    T_pad = close.shape[-1]
+    if T_pad % n_dev:
         raise ValueError(
-            f"T={T} not divisible by the {n_dev}-way {axis_name!r} axis")
+            f"T={T_pad} not divisible by the {n_dev}-way {axis_name!r} axis")
     if window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
-    if window > T // n_dev:
+    if window > T_pad // n_dev:
         raise ValueError(
-            f"window={window} exceeds the {T // n_dev}-bar block; the halo "
-            "exchange needs the window to fit one neighbor block")
+            f"window={window} exceeds the {T_pad // n_dev}-bar block; the "
+            "halo exchange needs the window to fit one neighbor block")
+    T = _resolve_t_real(T_pad, t_real)
     halo_w = window
     spec = P(*((None,) * (close.ndim - 1) + (axis_name,)))
     rep = P(*((None,) * (close.ndim - 1)))

@@ -50,16 +50,25 @@ class WalkForwardResult(NamedTuple):
     train_metric: Array
 
 
-def window_starts(T: int, train: int, test: int) -> jnp.ndarray:
-    """Anchored-walk schedule: windows advance by ``test`` bars.
+def window_starts_np(T: int, train: int, test: int):
+    """Anchored-walk schedule, host-side numpy: windows advance by
+    ``test`` bars. Number of windows is ``(T - train) // test`` — every
+    test bar is covered at most once, and only bars with a full train
+    span behind them are used. The ONE schedule definition: the generic
+    scan and the fused two-phase route both derive from here (the fused
+    route needs host values — a jnp array would be a tracer inside the
+    worker's shard_map body)."""
+    import numpy as np
 
-    Number of windows is ``(T - train) // test`` — every test bar is covered
-    at most once, and only bars with a full train span behind them are used.
-    """
     n = (T - train) // test
     if n <= 0:
         raise ValueError(f"history T={T} too short for train={train} test={test}")
-    return jnp.arange(n) * test
+    return np.arange(n) * test
+
+
+def window_starts(T: int, train: int, test: int) -> jnp.ndarray:
+    """:func:`window_starts_np` as a jnp array (the scan-carry form)."""
+    return jnp.asarray(window_starts_np(T, train, test))
 
 
 @functools.partial(
@@ -301,35 +310,41 @@ def walk_forward_fused(
     metric: str = "sharpe",
     cost: float = 0.0,
     periods_per_year: int = 252,
+    fields: tuple = ("close",),
 ) -> WalkForwardResult:
     """Walk-forward with the TRAIN sweep on a fused Pallas kernel.
 
     The expensive phase — the full (ticker x param) grid per refit window —
-    runs as ``train_metrics_fn(close_slice) -> Metrics`` (e.g. a
+    runs as ``train_metrics_fn(*field_slices) -> Metrics`` (e.g. a
     ``functools.partial`` of :func:`~..ops.fused.fused_sma_sweep` with the
-    flat grid arrays bound); only each ticker's argmax-chosen param is then
-    re-priced over the (train+test) span, and the stitched result uses the
-    same boundary fix-up as :func:`walk_forward`. Results match
-    :func:`walk_forward` exactly wherever the fused and generic train
-    metrics agree on the argmax (knife-edge metric ties can flip a chosen
-    param — the caveat class ``bench.py --verify`` quantifies).
+    flat grid arrays bound); ``fields`` names the OHLCV columns the kernel
+    consumes, in its positional order (``("close",)`` for the single-series
+    families, ``("close", "high", "low")`` for the channel families, …).
+    Only each ticker's argmax-chosen param is then re-priced over the
+    (train+test) span, and the stitched result uses the same boundary
+    fix-up as :func:`walk_forward`. Results match :func:`walk_forward`
+    exactly wherever the fused and generic train metrics agree on the
+    argmax (knife-edge metric ties can flip a chosen param — the caveat
+    class ``bench.py --verify`` quantifies).
     """
     import numpy as np
 
     T = ohlcv.close.shape[-1]
-    starts_np = np.asarray(window_starts(T, train, test))
+    starts_np = window_starts_np(T, train, test)
     n_tickers = ohlcv.close.shape[0]
     W = len(starts_np)
     sign = metrics_mod.metric_sign(metric)
 
     # Phase 1: ONE fused train sweep over all windows at once — the W
-    # train slices stack into a (W * n_tickers, train) panel so the whole
-    # phase is a single kernel launch (a per-window python loop was ~5x
-    # slower end to end on a remote-proxy chip: every eager slice/argmax
-    # op pays a dispatch round trip).
-    stacked = _stack_train_windows(
-        ohlcv.close, tuple(int(s) for s in starts_np), train)
-    m = train_metrics_fn(stacked)                        # (W*N, P) fields
+    # train slices (of every field the kernel consumes) stack into
+    # (W * n_tickers, train) panels so the whole phase is a single kernel
+    # launch (a per-window python loop was ~5x slower end to end on a
+    # remote-proxy chip: every eager slice/argmax op pays a dispatch
+    # round trip).
+    starts_tup = tuple(int(s) for s in starts_np)
+    stacked = [_stack_train_windows(getattr(ohlcv, f), starts_tup, train)
+               for f in fields]
+    m = train_metrics_fn(*stacked)                       # (W*N, P) fields
     best_idx, train_best = _window_argmax(
         getattr(m, metric), sign, W, n_tickers)          # (W, N) each
 

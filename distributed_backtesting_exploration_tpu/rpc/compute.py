@@ -82,6 +82,21 @@ class _FusedSpec(NamedTuple):
     fields: tuple = ("close",)        # OHLCV columns the kernel consumes
 
 
+class _TimeshardSpec(NamedTuple):
+    """One time-sharded (long-context) routing row.
+
+    Maps a strategy to its ``parallel.timeshard`` composed backtest: the
+    positional parameter order of the sharded function, the OHLCV columns
+    it consumes, and whether its signal head needs a window-sized halo
+    (EMA-state families carry O(1) state, so their windows are not bounded
+    by the per-chip block length)."""
+
+    params: tuple           # positional param axes, in the fn's order
+    fields: tuple           # OHLCV columns the backtest consumes
+    fn_name: str            # attribute in parallel.timeshard
+    halo_bound: bool = True  # window must fit one per-chip block
+
+
 def _start_result_copy(m):
     """Stack the 9 metric fields on device and begin the async d2h copy."""
     stacked = _stack_metrics(*m)
@@ -186,6 +201,7 @@ class JaxSweepBackend:
                         and jax.default_backend() == "tpu")
         self._mesh = None
         self._mesh_fns: dict = {}
+        self._time_mesh_cache = None
         if use_mesh and len(self._devices) > 1:
             from ..parallel import sharding as sharding_mod
 
@@ -337,6 +353,208 @@ class JaxSweepBackend:
         "obv_trend": _FusedSpec({"window"}, ("window",), _run_fused_obv,
                                 fields=("close", "volume")),
     }
+
+    # Time-sharded long-context backtests (parallel.timeshard): the route
+    # for jobs whose bar count exceeds the fused kernels' VMEM cap on a
+    # meshed worker whose ticker axis cannot fill the chips. Each strategy
+    # maps to its composed sharded backtest; parameters are per-combo
+    # statics (halo sizes and EMA decays bake into the compiled program),
+    # so a grid sweeps as one jitted program with one sub-backtest per
+    # combo. Fields/axes mirror _FUSED_STRATEGIES so routing cannot drift.
+    _TIMESHARD_STRATEGIES = {
+        "sma_crossover": _TimeshardSpec(("fast", "slow"), ("close",),
+                                        "sharded_sma_backtest"),
+        "bollinger": _TimeshardSpec(("window", "k"), ("close",),
+                                    "sharded_bollinger_backtest"),
+        "bollinger_touch": _TimeshardSpec(("window", "k"), ("close",),
+                                          "sharded_bollinger_touch_backtest"),
+        "momentum": _TimeshardSpec(("lookback",), ("close",),
+                                   "sharded_momentum_backtest"),
+        "donchian": _TimeshardSpec(("window",), ("close",),
+                                   "sharded_donchian_backtest"),
+        "donchian_hl": _TimeshardSpec(("window",), ("close", "high", "low"),
+                                      "sharded_donchian_hl_backtest"),
+        "rsi": _TimeshardSpec(("period", "band"), ("close",),
+                              "sharded_rsi_backtest", halo_bound=False),
+        "stochastic": _TimeshardSpec(("window", "band"),
+                                     ("close", "high", "low"),
+                                     "sharded_stochastic_backtest"),
+        "keltner": _TimeshardSpec(("window", "k"), ("close", "high", "low"),
+                                  "sharded_keltner_backtest"),
+        "macd": _TimeshardSpec(("fast", "slow", "signal"), ("close",),
+                               "sharded_macd_backtest", halo_bound=False),
+        "trix": _TimeshardSpec(("span", "signal"), ("close",),
+                               "sharded_trix_backtest", halo_bound=False),
+        "vwap_reversion": _TimeshardSpec(("window", "k"),
+                                         ("close", "volume"),
+                                         "sharded_vwap_backtest"),
+        "obv_trend": _TimeshardSpec(("window",), ("close", "volume"),
+                                    "sharded_obv_backtest"),
+    }
+
+    # Every grid combo compiles its own sub-backtest (windows are static
+    # halo sizes); cap the per-group program count so a huge grid cannot
+    # spend minutes in XLA before its first result.
+    _TIMESHARD_MAX_COMBOS = 128
+
+    # Walk-forward routes to the fused-train two-phase split only when the
+    # grid is large enough for the train sweep to dominate; below this the
+    # generic single-program walk_forward measured faster (bench.py:
+    # 11.5M/s generic vs 5.5M/s fused at P=400 on a v5e chip).
+    _WF_FUSED_MIN_COMBOS = 512
+
+    def _timeshard_window_reason(self, wins, n_combos: int, t_min: int, *,
+                                 halo_bound: bool = True,
+                                 what: str = "window") -> str | None:
+        """Shared grid gates of BOTH time-sharded routes (single-asset
+        and pairs — one implementation so they cannot drift): per-combo
+        compile cap, integral windows >= 1, and the
+        halo-fits-one-per-chip-block bound."""
+        wins = np.asarray(wins, np.float64)
+        if n_combos == 0 or wins.size == 0:
+            return "empty grid"
+        if n_combos > self._TIMESHARD_MAX_COMBOS:
+            return (f"{n_combos} grid combos exceed the per-combo compile "
+                    f"cap of {self._TIMESHARD_MAX_COMBOS}")
+        if not np.allclose(wins, np.round(wins)):
+            return f"non-integral {what} values"
+        if wins.min() < 1:
+            return f"{what} values below 1"
+        if halo_bound:
+            n_dev = self._mesh.devices.size
+            block = -(-int(t_min) // n_dev)
+            if int(wins.max()) > block:
+                return (f"max {what} {int(wins.max())} exceeds the "
+                        f"{block}-bar per-chip block; the halo exchange "
+                        "needs the window to fit one neighbor block")
+        return None
+
+    def _timeshard_reason(self, job, axes, lengths) -> str | None:
+        """None when a long-context group can route to the time-sharded
+        backtests; otherwise why it stays on the generic path."""
+        from ..parallel import sweep as sweep_mod
+
+        fam = self._TIMESHARD_STRATEGIES.get(job.strategy)
+        if fam is None:
+            return f"strategy {job.strategy!r} has no time-sharded backtest"
+        if set(axes) != set(fam.params):
+            return (f"grid axes {sorted(axes)} do not match the "
+                    f"time-sharded contract {sorted(fam.params)}")
+        prod = sweep_mod.product_grid(**axes)
+        n_combos = int(np.asarray(next(iter(prod.values()))).size)
+        int_axes = self._FUSED_STRATEGIES[job.strategy].window_axes
+        wins = np.concatenate(
+            [np.asarray(axes[a], np.float64) for a in int_axes])
+        reason = self._timeshard_window_reason(
+            wins, n_combos, min(lengths), halo_bound=fam.halo_bound,
+            what=f"window ({'/'.join(int_axes)})")
+        if reason is not None:
+            return reason
+        if job.strategy == "sma_crossover":
+            f_ = np.round(np.asarray(prod["fast"], np.float64))
+            s_ = np.round(np.asarray(prod["slow"], np.float64))
+            if (f_ >= s_).any():
+                return "grid contains fast >= slow combos"
+        if job.strategy in ("donchian", "donchian_hl", "stochastic"):
+            # The generic channel paths poison windows beyond MAX_WINDOW
+            # to NaN; keep those semantics-defining results (the fused
+            # demotion rule, applied identically here).
+            from ..models import donchian as donchian_mod
+            from ..models import stochastic as stoch_mod
+
+            bound = (stoch_mod.MAX_WINDOW if job.strategy == "stochastic"
+                     else donchian_mod.MAX_WINDOW)
+            if float(wins.max()) > bound:
+                return (f"max window {int(wins.max())} exceeds the channel "
+                        f"view bound {bound}")
+        return None
+
+    def _time_mesh(self):
+        """1-D mesh over the SAME local chips with the TIME axis name
+        (the worker's ticker mesh re-labeled for bar-axis sharding)."""
+        if self._time_mesh_cache is None:
+            from jax.sharding import Mesh
+
+            from ..parallel import timeshard
+
+            self._time_mesh_cache = Mesh(
+                self._mesh.devices, (timeshard.TIME_AXIS,))
+        return self._time_mesh_cache
+
+    def _submit_timeshard_groups(self, group, series, lengths, t0, axes):
+        """Long-context jobs: shard the BAR axis over the local chip mesh.
+
+        The submit path for groups whose history exceeds the fused VMEM
+        cap but whose ticker count cannot fill the mesh — instead of
+        demoting to a single device's generic path, each grid combo runs
+        the composed blockwise backtest from ``parallel.timeshard``
+        (distributed cumsums / EMA carries / transition-map folds over
+        ICI), so one history longer than any chip's memory uses every
+        chip. Histories pad right with repeat-last values to a mesh
+        multiple and pass their real length (``t_real``) so pad bars are
+        dead in every metric. Returns one pending entry per length
+        subgroup (ragged groups cannot share one padded panel).
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..ops.metrics import Metrics
+        from ..parallel import sweep as sweep_mod, timeshard
+
+        job0 = group[0]
+        fam = self._TIMESHARD_STRATEGIES[job0.strategy]
+        fn = getattr(timeshard, fam.fn_name)
+        tmesh = self._time_mesh()
+        n_dev = tmesh.devices.size
+        cost = float(job0.cost)
+        ppy = int(job0.periods_per_year or 252)
+        prod = sweep_mod.product_grid(**axes)
+        int_axes = set(self._FUSED_STRATEGIES[job0.strategy].window_axes)
+        n_combos = int(np.asarray(next(iter(prod.values()))).size)
+        # The DBXM column order IS product_grid order — same contract as
+        # every other sweep path.
+        combos = tuple(
+            tuple(int(round(float(np.asarray(prod[p])[i])))
+                  if p in int_axes else float(np.asarray(prod[p])[i])
+                  for p in fam.params)
+            for i in range(n_combos))
+
+        subgroups: dict[int, list[int]] = {}
+        for i, t in enumerate(lengths):
+            subgroups.setdefault(int(t), []).append(i)
+
+        pending = []
+        for t, idxs in sorted(subgroups.items()):
+            T_pad = -(-t // n_dev) * n_dev
+            sub_jobs = [group[i] for i in idxs]
+            arrays = [_stack_field_ragged([series[i] for i in idxs], T_pad,
+                                          f)
+                      for f in fam.fields]
+            sharded = [jax.device_put(
+                a, NamedSharding(tmesh, P(None, timeshard.TIME_AXIS)))
+                for a in arrays]
+            t_real = None if t == T_pad else t
+            key = (("timeshard",) + self._group_key(job0, axes)
+                   + (t, T_pad))
+            run = self._mesh_fns.get(key)
+            if run is None:
+                def run(*arrs, _tr=t_real):
+                    ms = [fn(tmesh, *arrs, *cmb, cost=cost,
+                             periods_per_year=ppy,
+                             axis_name=timeshard.TIME_AXIS, t_real=_tr)
+                          for cmb in combos]
+                    return Metrics(*(jnp.stack(cols, axis=-1)
+                                     for cols in zip(*ms)))
+
+                run = jax.jit(run)
+                if len(self._mesh_fns) >= self._MESH_FN_CAP:
+                    self._mesh_fns.pop(next(iter(self._mesh_fns)))
+                self._mesh_fns[key] = run
+            m = run(*sharded)
+            pending.append(self._finish_group(sub_jobs, m, t0,
+                                              len(sub_jobs), job0))
+        return pending
 
     @classmethod
     def _fused_eligible(cls, job, grid, lengths) -> bool:
@@ -585,7 +803,31 @@ class JaxSweepBackend:
             ppy = group[0].periods_per_year or 252
             demotion = (self._fused_demotion_reason(group[0], axes, lengths)
                         if self.use_fused else None)
-            if self.use_fused and demotion is None:
+            fused_ok = self.use_fused and demotion is None
+            t_max_g = int(max(lengths))
+            if (not fused_ok and self._mesh is not None
+                    and t_max_g > self._FUSED_MAX_BARS
+                    and len(group) < self._mesh.devices.size):
+                # Long-context route: a history too long for the fused
+                # VMEM cap, on a meshed worker whose ticker axis cannot
+                # fill the chips, shards its BAR axis instead of demoting
+                # to one device's generic path.
+                ts_reason = self._timeshard_reason(group[0], axes, lengths)
+                if ts_reason is None:
+                    log.info(
+                        "jobs %s (%s) routed to the time-sharded "
+                        "long-context path (%d bars over %d chips)",
+                        [j.id for j in group], group[0].strategy, t_max_g,
+                        self._mesh.devices.size)
+                    pending.extend(self._submit_timeshard_groups(
+                        group, series, lengths, t0, axes))
+                    continue
+                log.warning(
+                    "jobs %s (%s) are long-context (%d bars) but not "
+                    "time-shardable (%s); falling through to the generic "
+                    "path", [j.id for j in group], group[0].strategy,
+                    t_max_g, ts_reason)
+            if fused_ok:
                 # Repeat-last padding + per-ticker lengths: the kernels'
                 # padding discipline makes pad bars earn zero return and
                 # hold the final position, and all metric reductions use
@@ -819,6 +1061,53 @@ class JaxSweepBackend:
         if uniform:
             arrays = [np.stack([np.asarray(getattr(s, f)) for _, s in good])
                       for f in good[0][1]._fields]
+        # Fused-train route (VERDICT r4 item 4): when the grid is large
+        # enough that the per-window train sweep dominates, run phase 1 on
+        # the fused Pallas kernel — walk_forward_fused's two-phase split
+        # (one stacked train sweep for ALL refit windows, then re-price
+        # only each ticker's chosen param). The generic single-program
+        # walk_forward wins below the threshold (bench: 11.5M/s generic vs
+        # 5.5M/s fused at P=400), so routing is by grid size, with the
+        # same fused eligibility table and rounding-twin caveats as the
+        # plain sweep path (train span plays the role of the bar count).
+        fused_wf = (self.use_fused and uniform
+                    and sweep_mod.grid_size(grid) >=
+                    self._WF_FUSED_MIN_COMBOS
+                    and self._fused_demotion_reason(
+                        job0, axes, [job0.wf_train]) is None)
+        if fused_wf:
+            spec = self._FUSED_STRATEGIES[job0.strategy]
+            prod_np = {k: np.asarray(v)
+                       for k, v in sweep_mod.product_grid(**axes).items()}
+            cost = job0.cost
+            ppy = kwargs["periods_per_year"]
+
+            def train_fn(*fs):
+                return spec.run(*fs, prod_np, cost, ppy, None)
+
+            log.info("walk-forward jobs %s (%s, P=%d) using the "
+                     "fused-train route", [j.id for j, _ in good],
+                     job0.strategy, sweep_mod.grid_size(grid))
+            if self._mesh is not None:
+                def runner(*blks):
+                    r = walkforward.walk_forward_fused(
+                        panel_cls(*blks[:-1]), strategy, dict(grid),
+                        train_fn, fields=spec.fields, **kwargs)
+                    return Metrics(*(f[:, None] for f in r.oos_metrics))
+
+                m = self._mesh_call(
+                    ("wf-fused",) + self._group_key(job0, axes)
+                    + (job0.wf_train, job0.wf_test, metric),
+                    runner, arrays, None)
+                return ([j for j, _ in good] + bad, _start_result_copy(m),
+                        t0, len(good), None)
+            panel = panel_cls(*(jnp.asarray(a) for a in arrays))
+            m = walkforward.walk_forward_fused(
+                panel, strategy, dict(grid), train_fn, fields=spec.fields,
+                **kwargs).oos_metrics
+            m = Metrics(*(f[:, None] for f in m))   # one OOS row per job
+            return ([j for j, _ in good] + bad, _start_result_copy(m), t0,
+                    len(good), None)
         if uniform and self._mesh is not None:
             # The per-window refit is row-parallel (per-ticker scan +
             # argmax, no cross-row interaction), so walk-forward groups
@@ -980,6 +1269,30 @@ class JaxSweepBackend:
         elif t_max > self._FUSED_MAX_BARS:
             demotion = (f"{t_max} bars exceed the kernel VMEM cap of "
                         f"{self._FUSED_MAX_BARS}")
+        if ((not self.use_fused or demotion is not None)
+                and self._mesh is not None and uniform
+                and t_max > self._FUSED_MAX_BARS
+                and len(group) < self._mesh.devices.size):
+            # Long-context pairs: shard the bar axis over the chips (the
+            # single-asset _submit_timeshard_groups discipline; ragged
+            # groups keep the per-job generic loop — they cannot share
+            # one padded panel). Grid gates are the SHARED helper.
+            ts_reason = ("no 'lookback' axis in grid" if lb.size == 0
+                         else self._timeshard_window_reason(
+                             lb, int(np.asarray(grid["lookback"]).size),
+                             t_max, what="lookback"))
+            if ts_reason is None:
+                log.info(
+                    "jobs %s (pairs) routed to the time-sharded "
+                    "long-context path (%d bars over %d chips)",
+                    [j.id for j in group], t_max,
+                    self._mesh.devices.size)
+                return self._submit_pairs_timeshard(
+                    group, bad, ys, xs, t_max, t0, axes, grid)
+            log.warning(
+                "jobs %s (pairs) are long-context (%d bars) but not "
+                "time-shardable (%s); falling through to the generic "
+                "path", [j.id for j in group], t_max, ts_reason)
         if self.use_fused and demotion is not None:
             log.warning("jobs %s (pairs) demoted to the generic path: %s",
                         [j.id for j in group], demotion)
@@ -1026,6 +1339,59 @@ class JaxSweepBackend:
                                 for f in zip(*rows)))
         return self._finish_group(list(group) + bad, m, t0, len(group),
                                   group[0])
+
+    def _submit_pairs_timeshard(self, group, bad, ys, xs, t, t0,
+                                axes, grid):
+        """Uniform long-context pairs group: both legs' bar axes sharded
+        over the chip mesh via ``timeshard.sharded_pairs_backtest``, one
+        sub-backtest per grid combo (the ``_submit_timeshard_groups``
+        discipline applied to the two-legged panel). Legs re-stack
+        through ``_stack_field_ragged`` so the repeat-last padding (the
+        t_real dead-bar contract) stays the one shared implementation."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..ops.metrics import Metrics
+        from ..parallel import timeshard
+
+        job0 = group[0]
+        tmesh = self._time_mesh()
+        n_dev = tmesh.devices.size
+        T_pad = -(-t // n_dev) * n_dev
+        cost = float(job0.cost)
+        ppy = int(job0.periods_per_year or 252)
+        lbs = np.asarray(grid["lookback"])
+        zes = np.asarray(grid["z_entry"])
+        zxs = (np.asarray(grid["z_exit"]) if "z_exit" in grid
+               else np.zeros_like(zes))
+        combos = tuple(
+            (int(round(float(lbs[i]))), float(zes[i]), float(zxs[i]))
+            for i in range(lbs.size))
+
+        sharding = NamedSharding(tmesh, P(None, timeshard.TIME_AXIS))
+        y = jax.device_put(_stack_field_ragged(ys, T_pad), sharding)
+        x = jax.device_put(_stack_field_ragged(xs, T_pad), sharding)
+        t_real = None if t == T_pad else t
+        key = (("timeshard-pairs",) + self._group_key(job0, axes)
+               + (t, T_pad))
+        run = self._mesh_fns.get(key)
+        if run is None:
+            def run(yb, xb, _tr=t_real):
+                ms = [timeshard.sharded_pairs_backtest(
+                          tmesh, yb, xb, lkb, ze, z_exit=zx, cost=cost,
+                          periods_per_year=ppy,
+                          axis_name=timeshard.TIME_AXIS, t_real=_tr)
+                      for (lkb, ze, zx) in combos]
+                return Metrics(*(jnp.stack(cols, axis=-1)
+                                 for cols in zip(*ms)))
+
+            run = jax.jit(run)
+            if len(self._mesh_fns) >= self._MESH_FN_CAP:
+                self._mesh_fns.pop(next(iter(self._mesh_fns)))
+            self._mesh_fns[key] = run
+        return self._finish_group(list(group) + bad, run(y, x), t0,
+                                  len(group), job0)
 
     def collect(self, pending) -> list[Completion]:
         """Block for a submitted batch's results and pack completions."""
