@@ -5,6 +5,8 @@
 #include <algorithm>
 #include <cctype>
 #include <chrono>
+#include <cmath>
+#include <limits>
 #include <condition_variable>
 #include <cstdio>
 #include <cstdlib>
@@ -309,16 +311,53 @@ struct DbxJobQueue {
     uint64_t seq;  // insertion order, so requeue scans match the Python
                    // fallback's insertion-ordered dict iteration
   };
+  // Int-handle design: every id registers once and gets a dense int32
+  // index; all hot-path state is int-keyed (no string hashing per
+  // transition) and the batch ABI moves int32 arrays, so a batch-32 RPC
+  // costs one crossing carrying 128 bytes instead of 32 packed strings
+  // (the string-keyed version measured SLOWER than the Python dict
+  // fallback — per-id marshalling, not the transitions, was the cost).
+  static constexpr uint8_t kCompleted = 1, kFailed = 2, kTombstone = 4;
+  static constexpr double kUnregistered =
+      std::numeric_limits<double>::quiet_NaN();
+
   std::mutex mu;
-  std::deque<std::string> pending;
-  std::unordered_set<std::string> tombstones;
-  std::unordered_map<std::string, double> records;  // id -> combo credit
-  std::unordered_map<std::string, Lease> leases;
-  std::unordered_map<std::string, double> completed;
-  std::unordered_set<std::string> failed;
+  std::vector<std::string> ids;                    // idx -> id
+  std::unordered_map<std::string, int32_t> idx_of; // id -> idx
+  std::vector<double> combos;       // idx-aligned; NaN = pending-only id
+                                    // (pushed without register — the
+                                    // Python fallback allows it; complete
+                                    // reports it "unknown")
+  std::vector<uint8_t> flags;       // idx-aligned kCompleted/kFailed/...
+  std::vector<double> credited;     // idx-aligned combos credited
+  std::deque<int32_t> pending;
+  std::unordered_map<int32_t, Lease> leases;
+  int64_t tombstoned = 0;           // invariant: every tombstone is in
+                                    // the pending FIFO
+  int64_t completed_count = 0;
+  int64_t failed_count = 0;
   uint64_t lease_seq = 0;
   int64_t requeued = 0;
   double combos_done = 0.0;
+
+  // idx for an id, creating the slot on first sight (combos NaN until
+  // register fills it).
+  int32_t intern(const char* id) {
+    auto it = idx_of.find(id);
+    if (it != idx_of.end()) return it->second;
+    const int32_t idx = static_cast<int32_t>(ids.size());
+    ids.emplace_back(id);
+    idx_of.emplace(ids.back(), idx);
+    combos.push_back(kUnregistered);
+    flags.push_back(0);
+    credited.push_back(0.0);
+    return idx;
+  }
+
+  int32_t lookup(const char* id) const {
+    auto it = idx_of.find(id);
+    return it == idx_of.end() ? -1 : it->second;
+  }
 };
 
 extern "C" DbxJobQueue* dbx_jobq_new(void) { return new DbxJobQueue(); }
@@ -329,86 +368,189 @@ extern "C" int dbx_jobq_register(DbxJobQueue* q, const char* id,
                                  double combos) {
   if (std::strlen(id) > DBX_JOBQ_MAX_ID) return 1;
   std::lock_guard<std::mutex> lk(q->mu);
-  q->records[id] = combos;
+  q->combos[q->intern(id)] = combos;
   return 0;
 }
 
 extern "C" void dbx_jobq_push_pending(DbxJobQueue* q, const char* id) {
   std::lock_guard<std::mutex> lk(q->mu);
-  q->pending.emplace_back(id);
+  q->pending.push_back(q->intern(id));
 }
 
 extern "C" void dbx_jobq_mark_completed(DbxJobQueue* q, const char* id) {
   std::lock_guard<std::mutex> lk(q->mu);
-  q->completed.emplace(id, 0.0);  // no combos_done credit: prior run's work
+  const int32_t idx = q->intern(id);
+  if (!(q->flags[idx] & DbxJobQueue::kCompleted)) {
+    // No combos_done credit: a restored completion's work happened in a
+    // previous run.
+    q->flags[idx] |= DbxJobQueue::kCompleted;
+    ++q->completed_count;
+  }
 }
 
 extern "C" void dbx_jobq_mark_failed(DbxJobQueue* q, const char* id) {
   std::lock_guard<std::mutex> lk(q->mu);
-  q->failed.insert(id);
+  const int32_t idx = q->intern(id);
+  if (!(q->flags[idx] & DbxJobQueue::kFailed)) {
+    q->flags[idx] |= DbxJobQueue::kFailed;
+    ++q->failed_count;
+  }
 }
+
+namespace {
+
+// Shared bodies of the single-id and batched transitions: both surfaces
+// run these under one held lock, so they cannot drift.
+
+inline int32_t take_begin_locked(DbxJobQueue* q) {
+  while (!q->pending.empty()) {
+    const int32_t idx = q->pending.front();
+    q->pending.pop_front();
+    if (q->flags[idx] & DbxJobQueue::kTombstone) {
+      q->flags[idx] &= ~DbxJobQueue::kTombstone;  // completed while pending
+      --q->tombstoned;
+      continue;
+    }
+    return idx;
+  }
+  return -1;
+}
+
+inline int take_commit_locked(DbxJobQueue* q, int32_t idx, const char* worker,
+                              std::chrono::steady_clock::time_point deadline) {
+  if (q->flags[idx] & DbxJobQueue::kCompleted) {
+    // Completed in the unlocked take window: drop the orphan tombstone the
+    // completion installed, and do not lease.
+    if (q->flags[idx] & DbxJobQueue::kTombstone) {
+      q->flags[idx] &= ~DbxJobQueue::kTombstone;
+      --q->tombstoned;
+    }
+    return 1;
+  }
+  q->leases[idx] = DbxJobQueue::Lease{worker, deadline, q->lease_seq++};
+  return 0;
+}
+
+inline int complete_locked(DbxJobQueue* q, int32_t idx) {
+  if (idx < 0 || static_cast<size_t>(idx) >= q->ids.size() ||
+      std::isnan(q->combos[idx]))
+    return 2;  // unknown: never registered with a combo credit
+  const bool had_lease = q->leases.erase(idx) > 0;
+  if (q->flags[idx] & DbxJobQueue::kCompleted) return 1;
+  if (!had_lease && !(q->flags[idx] & DbxJobQueue::kFailed) &&
+      !(q->flags[idx] & DbxJobQueue::kTombstone)) {
+    // Completion for a job still sitting in the pending FIFO (late RPC
+    // straddling a lease expiry or restart): no interior removal, so
+    // tombstone the id for take to skip.
+    q->flags[idx] |= DbxJobQueue::kTombstone;
+    ++q->tombstoned;
+  }
+  q->flags[idx] |= DbxJobQueue::kCompleted;
+  ++q->completed_count;
+  q->credited[idx] = q->combos[idx];
+  q->combos_done += q->combos[idx];
+  return 0;
+}
+
+}  // namespace
 
 extern "C" int dbx_jobq_take_begin(DbxJobQueue* q, char* out, size_t cap) {
   std::lock_guard<std::mutex> lk(q->mu);
-  while (!q->pending.empty()) {
-    std::string id = std::move(q->pending.front());
-    q->pending.pop_front();
-    if (q->tombstones.erase(id)) continue;  // completed while pending
-    if (id.size() + 1 > cap) {
-      // Caller's buffer cannot hold the id (register caps ids at
-      // DBX_JOBQ_MAX_ID, so a >=512-byte buffer never hits this). Put the
-      // id back and report the contract violation — silently dropping a
-      // popped job would drain the queue with work unprocessed.
-      q->pending.emplace_front(std::move(id));
-      return -1;
-    }
-    std::memcpy(out, id.c_str(), id.size() + 1);
-    return 1;
+  const int32_t idx = take_begin_locked(q);
+  if (idx < 0) return 0;
+  const std::string& id = q->ids[idx];
+  if (id.size() + 1 > cap) {
+    // Caller's buffer cannot hold the id (register caps ids at
+    // DBX_JOBQ_MAX_ID, so a >=512-byte buffer never hits this). Put the
+    // id back and report the contract violation — silently dropping a
+    // popped job would drain the queue with work unprocessed.
+    q->pending.push_front(idx);
+    return -1;
   }
-  return 0;
+  std::memcpy(out, id.c_str(), id.size() + 1);
+  return 1;
 }
 
 extern "C" int dbx_jobq_take_commit(DbxJobQueue* q, const char* id,
                                     const char* worker, int64_t lease_ms) {
   std::lock_guard<std::mutex> lk(q->mu);
-  if (q->completed.count(id)) {
-    // Completed in the unlocked take window: drop the orphan tombstone the
-    // completion installed, and do not lease.
-    q->tombstones.erase(id);
-    return 1;
-  }
-  q->leases[id] = DbxJobQueue::Lease{
-      worker,
-      std::chrono::steady_clock::now() + std::chrono::milliseconds(lease_ms),
-      q->lease_seq++};
-  return 0;
+  return take_commit_locked(
+      q, q->intern(id), worker,
+      std::chrono::steady_clock::now() + std::chrono::milliseconds(lease_ms));
 }
 
 extern "C" int dbx_jobq_fail(DbxJobQueue* q, const char* id) {
   std::lock_guard<std::mutex> lk(q->mu);
-  if (q->completed.count(id)) {
-    q->tombstones.erase(id);
+  const int32_t idx = q->intern(id);
+  if (q->flags[idx] & DbxJobQueue::kCompleted) {
+    if (q->flags[idx] & DbxJobQueue::kTombstone) {
+      q->flags[idx] &= ~DbxJobQueue::kTombstone;
+      --q->tombstoned;
+    }
     return 1;
   }
-  q->failed.insert(id);
+  if (!(q->flags[idx] & DbxJobQueue::kFailed)) {
+    q->flags[idx] |= DbxJobQueue::kFailed;
+    ++q->failed_count;
+  }
   return 0;
 }
 
 extern "C" int dbx_jobq_complete(DbxJobQueue* q, const char* id) {
   std::lock_guard<std::mutex> lk(q->mu);
-  auto rec = q->records.find(id);
-  if (rec == q->records.end()) return 2;
-  const bool had_lease = q->leases.erase(id) > 0;
-  if (q->completed.count(id)) return 1;
-  if (!had_lease && !q->failed.count(id) && !q->tombstones.count(id)) {
-    // Completion for a job still sitting in the pending FIFO (late RPC
-    // straddling a lease expiry or restart): no interior removal, so
-    // tombstone the id for take to skip.
-    q->tombstones.insert(id);
+  return complete_locked(q, q->lookup(id));
+}
+
+extern "C" int dbx_jobq_enqueue_n(DbxJobQueue* q, const char* ids, int stride,
+                                  const double* combos, int n) {
+  std::lock_guard<std::mutex> lk(q->mu);
+  int accepted = 0;
+  const char* p = ids;
+  for (int i = 0; i < n; ++i) {
+    const char* id = stride > 0 ? ids + static_cast<size_t>(i) * stride : p;
+    const size_t len = std::strlen(id);
+    if (stride <= 0) p = id + len + 1;  // stride 0: NUL-separated pack
+    if (len > DBX_JOBQ_MAX_ID) continue;
+    const int32_t idx = q->intern(id);
+    q->combos[idx] = combos[i];
+    q->pending.push_back(idx);
+    ++accepted;
   }
-  q->completed[id] = rec->second;
-  q->combos_done += rec->second;
-  return 0;
+  return accepted;
+}
+
+extern "C" int dbx_jobq_take_begin_idx_n(DbxJobQueue* q, int32_t* out, int n) {
+  std::lock_guard<std::mutex> lk(q->mu);
+  int got = 0;
+  while (got < n) {
+    const int32_t idx = take_begin_locked(q);
+    if (idx < 0) break;
+    out[got++] = idx;
+  }
+  return got;
+}
+
+extern "C" int dbx_jobq_take_commit_idx_n(DbxJobQueue* q, const int32_t* idxs,
+                                          int n, const char* worker,
+                                          int64_t lease_ms,
+                                          uint8_t* committed) {
+  std::lock_guard<std::mutex> lk(q->mu);
+  const auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::milliseconds(lease_ms);
+  int done = 0;
+  for (int i = 0; i < n; ++i) {
+    committed[i] = take_commit_locked(q, idxs[i], worker, deadline) == 0;
+    done += committed[i];
+  }
+  return done;
+}
+
+extern "C" void dbx_jobq_complete_idx_n(DbxJobQueue* q, const int32_t* idxs,
+                                        int n, uint8_t* outcomes) {
+  std::lock_guard<std::mutex> lk(q->mu);
+  for (int i = 0; i < n; ++i) {
+    outcomes[i] = static_cast<uint8_t>(complete_locked(q, idxs[i]));
+  }
 }
 
 namespace {
@@ -416,25 +558,30 @@ namespace {
 int requeue_matching(
     DbxJobQueue* q, DbxPrunedFn fn, void* ctx,
     const std::function<bool(const DbxJobQueue::Lease&)>& match) {
-  std::vector<std::pair<uint64_t, std::string>> hit;
+  std::vector<std::pair<uint64_t, int32_t>> hit;
+  std::vector<std::string> hit_ids;  // copies made UNDER the lock: the
+                                     // unlocked callback loop must not
+                                     // read q->ids, which a concurrent
+                                     // enqueue's intern() can reallocate
   {
     std::lock_guard<std::mutex> lk(q->mu);
-    for (const auto& [id, lease] : q->leases) {
-      if (match(lease)) hit.emplace_back(lease.seq, id);
+    for (const auto& [idx, lease] : q->leases) {
+      if (match(lease)) hit.emplace_back(lease.seq, idx);
     }
     // Lease-insertion order, so the front-of-queue result is identical to
     // the Python fallback's insertion-ordered scan + appendleft loop.
     std::sort(hit.begin(), hit.end());
-    for (const auto& [seq, id] : hit) {
+    hit_ids.reserve(hit.size());
+    for (const auto& [seq, idx] : hit) {
       (void)seq;
-      q->leases.erase(id);
-      q->pending.emplace_front(id);
+      q->leases.erase(idx);
+      q->pending.push_front(idx);
+      hit_ids.push_back(q->ids[idx]);
     }
     q->requeued += static_cast<int64_t>(hit.size());
   }
   if (fn) {
-    for (const auto& [seq, id] : hit) {
-      (void)seq;
+    for (const auto& id : hit_ids) {
       fn(id.c_str(), ctx);
     }
   }
@@ -460,22 +607,20 @@ extern "C" int dbx_jobq_requeue_worker(DbxJobQueue* q, const char* worker,
 
 extern "C" void dbx_jobq_stats(DbxJobQueue* q, DbxJobqStats* out) {
   std::lock_guard<std::mutex> lk(q->mu);
-  out->pending = static_cast<int64_t>(q->pending.size()) -
-                 static_cast<int64_t>(q->tombstones.size());
+  out->pending = static_cast<int64_t>(q->pending.size()) - q->tombstoned;
   out->leased = static_cast<int64_t>(q->leases.size());
-  out->completed = static_cast<int64_t>(q->completed.size());
+  out->completed = q->completed_count;
   out->requeued = q->requeued;
-  out->failed = static_cast<int64_t>(q->failed.size());
+  out->failed = q->failed_count;
   out->combos_done = q->combos_done;
 }
 
 extern "C" int dbx_jobq_drained(DbxJobQueue* q) {
   std::lock_guard<std::mutex> lk(q->mu);
-  const int64_t live = static_cast<int64_t>(q->pending.size()) -
-                       static_cast<int64_t>(q->tombstones.size());
+  const int64_t live =
+      static_cast<int64_t>(q->pending.size()) - q->tombstoned;
   return (live == 0 && q->leases.empty()) ? 1 : 0;
 }
-
 // ---------------------------------------------------------------------------
 // Peer registry
 // ---------------------------------------------------------------------------

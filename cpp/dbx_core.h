@@ -147,6 +147,39 @@ int dbx_jobq_fail(DbxJobQueue* q, const char* id);
 // clears any lease; a completion for an id still in the FIFO installs a
 // tombstone so take skips it.
 int dbx_jobq_complete(DbxJobQueue* q, const char* id);
+
+// Batched transitions: one library crossing per RPC instead of one per
+// job, moving int32 HANDLES instead of strings. Every id registers once
+// and gets a dense index in registration order (the caller mirrors the
+// same order, so both sides agree without the index ever crossing at
+// registration); a batch-32 take/commit/complete then carries one
+// 128-byte int32 array per crossing. The string-keyed batch surface
+// measured SLOWER than the Python dict fallback — per-id string
+// marshalling, not the transitions, was the cost.
+//
+// Register + push n ids in one crossing (ids packed at a caller-chosen
+// `stride` bytes per NUL-terminated id; combo credits parallel to the id
+// slots). Ids longer than DBX_JOBQ_MAX_ID are skipped; returns the
+// number accepted (callers enforce the cap beforehand, so a skip is a
+// contract violation surfacing as a short count, never silent state
+// corruption).
+int dbx_jobq_enqueue_n(DbxJobQueue* q, const char* ids, int stride,
+                       const double* combos, int n);
+// Pop up to n live pending ids' indices into out. Returns the count
+// popped (0 when the FIFO is empty).
+int dbx_jobq_take_begin_idx_n(DbxJobQueue* q, int32_t* out, int n);
+// Lease n popped indices to worker in one crossing; committed[i] = 1
+// leased, 0 completed-in-the-take-window (dropped, orphan tombstone
+// cleared — dbx_jobq_take_commit's per-id semantics). Returns the number
+// leased.
+int dbx_jobq_take_commit_idx_n(DbxJobQueue* q, const int32_t* idxs, int n,
+                               const char* worker, int64_t lease_ms,
+                               uint8_t* committed);
+// Record n completions in one crossing; outcomes[i] = 0 new, 1 dup,
+// 2 unknown (dbx_jobq_complete's per-id semantics; a negative or
+// out-of-range index is unknown — the caller maps unseen RPC ids to -1).
+void dbx_jobq_complete_idx_n(DbxJobQueue* q, const int32_t* idxs, int n,
+                             uint8_t* outcomes);
 // Requeue jobs whose lease deadline passed (front of the FIFO, in lease
 // order — matching the Python fallback's insertion-ordered scan). The
 // callback receives each requeued id. Returns the count.

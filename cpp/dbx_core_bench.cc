@@ -1,0 +1,77 @@
+// Microbench: the DbxJobQueue state machine driven through the C ABI with
+// no foreign-function crossing — the grain a native dispatcher shell pays
+// (the reference's whole dispatcher state is native Rust, reference
+// src/server/main.rs:20-190). Complements bench.py's `queue_machine`
+// config, which measures the same cycle driven from Python over ctypes:
+// there the CPython dict fallback wins (zero marshalling), which is why
+// the Python-driven default substrate is python; HERE the native machine
+// is the only substrate and this records its headroom.
+//
+// Cycle per batch of 32 (mirrors JobQueue.take/complete_batch):
+//   enqueue_n -> take_begin_idx_n -> take_commit_idx_n -> complete_idx_n
+//
+// Output: one line, "<jobs> jobs in <s> s -> <jobs/s> jobs/s".
+
+#include "dbx_core.h"
+
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+int main(int argc, char** argv) {
+  const int n_jobs = argc > 1 ? std::atoi(argv[1]) : 200000;
+  const int batch = 32;
+
+  // Pre-build the NUL-separated id pack per batch (uuid-sized ids).
+  std::vector<std::string> packs;
+  std::vector<std::vector<double>> combo_batches;
+  for (int base = 0; base < n_jobs; base += batch) {
+    std::string pack;
+    std::vector<double> combos;
+    for (int i = base; i < base + batch && i < n_jobs; ++i) {
+      char id[64];
+      std::snprintf(id, sizeof id, "job-%08x-%08x", i, i * 2654435761u);
+      pack.append(id);
+      pack.push_back('\0');
+      combos.push_back(40.0);
+    }
+    packs.push_back(std::move(pack));
+    combo_batches.push_back(std::move(combos));
+  }
+
+  DbxJobQueue* q = dbx_jobq_new();
+  int32_t idxs[batch];
+  uint8_t flags[batch];
+
+  const auto t0 = std::chrono::steady_clock::now();
+  for (size_t b = 0; b < packs.size(); ++b) {
+    const int n = static_cast<int>(combo_batches[b].size());
+    dbx_jobq_enqueue_n(q, packs[b].data(), 0, combo_batches[b].data(), n);
+  }
+  int done = 0;
+  for (;;) {
+    const int got = dbx_jobq_take_begin_idx_n(q, idxs, batch);
+    if (got == 0) break;
+    dbx_jobq_take_commit_idx_n(q, idxs, got, "w", 60000, flags);
+    dbx_jobq_complete_idx_n(q, idxs, got, flags);
+    done += got;
+  }
+  const double s = std::chrono::duration<double>(
+                       std::chrono::steady_clock::now() - t0)
+                       .count();
+
+  DbxJobqStats st;
+  dbx_jobq_stats(q, &st);
+  if (done != n_jobs || st.completed != n_jobs || !dbx_jobq_drained(q)) {
+    std::fprintf(stderr, "FAIL: done=%d completed=%lld drained=%d\n", done,
+                 static_cast<long long>(st.completed), dbx_jobq_drained(q));
+    dbx_jobq_free(q);
+    return 1;
+  }
+  dbx_jobq_free(q);
+  std::printf("%d jobs in %.4f s -> %.0f jobs/s\n", n_jobs, s, n_jobs / s);
+  return 0;
+}

@@ -224,6 +224,31 @@ class _PyQueueState:
         self._combos_done += combos
         return "new"
 
+    # Batch surface (one call per RPC-sized batch): trivial loops here —
+    # the point of batching is the native substrate's ctypes crossing, but
+    # both substrates expose the same methods so JobQueue stays agnostic.
+
+    def enqueue_n(self, jids: list[str], combos: list[float]) -> None:
+        for jid, c in zip(jids, combos):
+            self.register(jid, c)
+            self.push_pending(jid)
+
+    def take_begin_n(self, n: int) -> list[str]:
+        out = []
+        while len(out) < n:
+            jid = self.take_begin()
+            if jid is None:
+                break
+            out.append(jid)
+        return out
+
+    def take_commit_n(self, jids: list[str], worker_id: str,
+                      lease_s: float) -> list[bool]:
+        return [self.take_commit(j, worker_id, lease_s) for j in jids]
+
+    def complete_n(self, jids: list[str]) -> list[str]:
+        return [self.complete(j) for j in jids]
+
     def requeue_expired(self) -> list[str]:
         now = time.monotonic()
         expired = [jid for jid, l in self._leases.items()
@@ -264,12 +289,20 @@ class JobQueue:
     be read is marked failed and journaled, never silently dropped.
 
     The id-state machine (pending FIFO + tombstones + lease table +
-    completion idempotency) runs on the native C++ core when available —
-    the reference's whole dispatcher state is native (reference
-    ``src/server/main.rs:20-190``); gRPC serving stays in Python (no
-    grpc++ in this environment). Full job records (grids, payloads,
-    paths) stay Python-side keyed by the same ids. ``use_native=False``
-    forces the pure-Python fallback, which passes the same parity tests.
+    completion idempotency) has two substrates passing identical parity
+    tests: the pure-Python one (DEFAULT when driven from Python — at
+    Python-call grain CPython's C-implemented dict/deque are already a
+    native hash map with zero marshalling, and they measured at or above
+    the ctypes-driven core even after the batch/int-handle redesign;
+    DESIGN.md "queue state machine alone"), and the native C++ core
+    (``cpp/dbx_core.h`` ``DbxJobQueue`` — the reference's whole dispatcher
+    state is native, reference ``src/server/main.rs:20-190``), opt-in here
+    via ``use_native=True`` / ``DBX_NATIVE_QUEUE=1`` and the ONLY
+    substrate when driven from a native shell through the C ABI, where it
+    does millions of transitions/s with no crossing at all
+    (``cpp/dbx_core_bench.cc``). gRPC serving stays in Python (no grpc++
+    in this environment). Full job records (grids, payloads, paths) stay
+    Python-side keyed by the same ids.
     """
 
     def __init__(self, journal: Journal | None = None, *,
@@ -278,7 +311,8 @@ class JobQueue:
         self._records: dict[str, JobRecord] = {}
         state = None
         if use_native is None:
-            use_native = native_core.available()
+            use_native = (os.environ.get("DBX_NATIVE_QUEUE") == "1"
+                          and native_core.available())
         if use_native:
             try:
                 state = native_core.NativeJobQueue()
@@ -314,16 +348,36 @@ class JobQueue:
     # -- intake ------------------------------------------------------------
 
     def enqueue(self, rec: JobRecord, *, journal: bool = True) -> None:
-        if len(rec.id.encode()) > self.MAX_ID_BYTES:
-            raise ValueError(
-                f"job id exceeds {self.MAX_ID_BYTES} bytes (native "
-                f"substrate cap, enforced on both substrates): {rec.id[:64]!r}...")
+        self.enqueue_many([rec], journal=journal)
+
+    def enqueue_many(self, recs: list[JobRecord], *,
+                     journal: bool = True) -> None:
+        """Intake a batch with ONE state-machine crossing (register + push
+        for the whole batch); journal appends stay per record. Same
+        semantics as per-record :meth:`enqueue`, batched for the same
+        reason as take/complete: per-job ctypes crossings dominated the
+        native substrate's cost."""
+        for rec in recs:
+            if len(rec.id.encode()) > self.MAX_ID_BYTES:
+                raise ValueError(
+                    f"job id exceeds {self.MAX_ID_BYTES} bytes (native "
+                    f"substrate cap, enforced on both substrates): "
+                    f"{rec.id[:64]!r}...")
+            if "\0" in rec.id:
+                # The native batch pack is NUL-separated; an embedded NUL
+                # would desynchronize the id<->index mirror from the C
+                # intern table (enforced on both substrates).
+                raise ValueError(f"job id contains NUL: {rec.id[:64]!r}")
         with self._lock:
-            self._records[rec.id] = rec
-            self._state.register(rec.id, float(rec.combos))
-            self._state.push_pending(rec.id)
-        if journal:
-            self._journal.append("enqueue", **rec.journal_form())
+            for rec in recs:
+                self._records[rec.id] = rec
+            self._state.enqueue_n([rec.id for rec in recs],
+                                  [float(rec.combos) for rec in recs])
+        if journal and self._journal.enabled:
+            # enabled-guarded: journal_form b64-encodes the payload, which
+            # the no-op journal would throw away.
+            for rec in recs:
+                self._journal.append("enqueue", **rec.journal_form())
 
     def restore(self, journal_path: str) -> int:
         """Replay a journal; re-enqueue pending jobs. Returns count restored.
@@ -365,57 +419,70 @@ class JobQueue:
     # -- dispatch ----------------------------------------------------------
 
     def take(self, n: int, worker_id: str) -> list[tuple[JobRecord, bytes]]:
-        """Pop up to ``n`` jobs, lease them to ``worker_id``, return payloads."""
+        """Pop up to ``n`` jobs, lease them to ``worker_id``, return payloads.
+
+        Batched against the state machine: ONE ``take_begin_n`` crossing
+        pops the batch, payloads materialize outside every lock, then ONE
+        ``take_commit_n`` crossing leases the readable ones (per-id
+        re-check inside: a job completed in the unlocked window is
+        dropped, not leased and recomputed — the single-id race model,
+        batch-wide). Per-job crossings made the native substrate slower
+        than the dict fallback (DESIGN.md's 42k-vs-85k row); one crossing
+        per RPC is the fix.
+        """
         out: list[tuple[JobRecord, bytes]] = []
         while len(out) < n:
             with self._lock:
-                jid = self._state.take_begin()
-                if jid is None:
+                jids = self._state.take_begin_n(n - len(out))
+                if not jids:
                     break
-                rec = self._records[jid]
-                self._in_take += 1
+                recs = [self._records[j] for j in jids]
+                self._in_take += len(jids)
+            good: list[tuple[str, JobRecord, bytes]] = []
+            failed: list[tuple[str, str, Exception]] = []  # id, path, err
             try:
-                payload = rec.ohlcv
-                try:
-                    if payload is None:
-                        if rec.path is None:
-                            raise ValueError(
-                                "job has neither payload nor path")
-                        payload = _read_payload(rec.path)
-                    if rec.ohlcv2 is None and rec.path2 is not None:
-                        # File-backed second leg (pairs --data2):
-                        # materialize at dispatch time like leg 1, onto a
-                        # COPY handed to the caller — the stored record
-                        # stays slim, and RequestJobs reads rec.ohlcv2
-                        # either way.
-                        rec = dataclasses.replace(
-                            rec, ohlcv2=_read_payload(rec.path2))
-                except (OSError, ValueError) as e:
-                    with self._lock:
-                        # A job completed mid-take must count as completed,
-                        # not failed (state.fail re-checks under its lock).
-                        if not self._state.fail(jid):
-                            continue
-                    log.error(
-                        "job %s: unreadable %s (%s) -> failed", jid,
-                        rec.path2 if payload is not None else rec.path, e)
-                    self._journal.append("fail", id=jid, reason=str(e))
-                    continue
-                with self._lock:
-                    # The id left the FIFO at take_begin but is not leased
-                    # yet; a completion landing in that unlocked window
-                    # sees no lease and no FIFO entry and installs a
-                    # tombstone for an id that will never be popped again.
-                    # take_commit re-checks: a job completed mid-take is
-                    # dropped (and its tombstone discarded), not leased and
-                    # recomputed.
-                    if not self._state.take_commit(jid, worker_id,
-                                                   self.lease_s):
+                for jid, rec in zip(jids, recs):
+                    payload = rec.ohlcv
+                    try:
+                        if payload is None:
+                            if rec.path is None:
+                                raise ValueError(
+                                    "job has neither payload nor path")
+                            payload = _read_payload(rec.path)
+                        if rec.ohlcv2 is None and rec.path2 is not None:
+                            # File-backed second leg (pairs --data2):
+                            # materialize at dispatch time like leg 1,
+                            # onto a COPY handed to the caller — the
+                            # stored record stays slim, and RequestJobs
+                            # reads rec.ohlcv2 either way.
+                            rec = dataclasses.replace(
+                                rec, ohlcv2=_read_payload(rec.path2))
+                    except (OSError, ValueError) as e:
+                        # Leg 1 read fine -> the unreadable file was leg 2.
+                        failed.append((
+                            jid,
+                            rec.path2 if payload is not None else rec.path,
+                            e))
                         continue
-                out.append((rec, payload))
+                    good.append((jid, rec, payload))
+                with self._lock:
+                    committed = self._state.take_commit_n(
+                        [jid for jid, _, _ in good], worker_id,
+                        self.lease_s)
+                    # Unreadable payloads fail under the same lock (the
+                    # per-id re-check drops jobs completed mid-take).
+                    failed = [(jid, path, e) for jid, path, e in failed
+                              if self._state.fail(jid)]
+                for jid, path, e in failed:
+                    log.error("job %s: unreadable %s (%s) -> failed",
+                              jid, path, e)
+                    self._journal.append("fail", id=jid, reason=str(e))
+                out.extend((rec, payload)
+                           for ok, (_, rec, payload) in zip(committed, good)
+                           if ok)
             finally:
                 with self._lock:
-                    self._in_take -= 1
+                    self._in_take -= len(jids)
         return out
 
     def complete(self, jid: str, worker_id: str) -> str:
@@ -439,6 +506,23 @@ class JobQueue:
             self._completed_ids.add(jid)
         self._journal.append("complete", id=jid, worker=worker_id)
         return "new"
+
+    def complete_batch(self, jids: list[str], worker_id: str) -> list[str]:
+        """Batched :meth:`complete`: one state-machine crossing for a
+        whole CompleteJobs RPC (per-id outcomes identical — the batch
+        exists because per-job ctypes crossings made the native substrate
+        slower than the dict fallback)."""
+        if not jids:
+            return []
+        with self._lock:
+            outcomes = self._state.complete_n(jids)
+            for jid, outcome in zip(jids, outcomes):
+                if outcome == "new":
+                    self._completed_ids.add(jid)
+        for jid, outcome in zip(jids, outcomes):
+            if outcome == "new":
+                self._journal.append("complete", id=jid, worker=worker_id)
+        return outcomes
 
     def completed_ids(self) -> set[str]:
         """Snapshot of completed job ids (restored + this run's)."""
@@ -631,32 +715,35 @@ class Dispatcher(service.DispatcherServicer):
         self.peers.touch(request.worker_id, status=request.status)
         return pb.Ack(ok=True)
 
+    def _record_result(self, jid: str, metrics: bytes) -> None:
+        if self.results_dir:
+            # Persist to disk only — keeping every DBXM block resident
+            # would grow without bound over a long run.
+            with open(os.path.join(self.results_dir,
+                                   f"{jid}.dbxm"), "wb") as fh:
+                fh.write(metrics)
+        else:
+            with self._results_lock:
+                self.results[jid] = metrics
+                while len(self.results) > self.MAX_RESIDENT_RESULTS:
+                    evicted = next(iter(self.results))
+                    del self.results[evicted]
+                    if self.results_evicted == 0:
+                        log.warning(
+                            "in-memory results exceeded %d blocks; "
+                            "evicting oldest (job %s). Pass "
+                            "--results-dir to persist every result to "
+                            "disk.",
+                            self.MAX_RESIDENT_RESULTS, evicted)
+                    self.results_evicted += 1
+
     def _complete_one(self, jid: str, worker_id: str, metrics: bytes,
                       elapsed_s: float) -> str:
         outcome = self.queue.complete(jid, worker_id)
         if outcome == "unknown":
             return outcome
         if metrics:
-            if self.results_dir:
-                # Persist to disk only — keeping every DBXM block resident
-                # would grow without bound over a long run.
-                with open(os.path.join(self.results_dir,
-                                       f"{jid}.dbxm"), "wb") as fh:
-                    fh.write(metrics)
-            else:
-                with self._results_lock:
-                    self.results[jid] = metrics
-                    while len(self.results) > self.MAX_RESIDENT_RESULTS:
-                        evicted = next(iter(self.results))
-                        del self.results[evicted]
-                        if self.results_evicted == 0:
-                            log.warning(
-                                "in-memory results exceeded %d blocks; "
-                                "evicting oldest (job %s). Pass "
-                                "--results-dir to persist every result to "
-                                "disk.",
-                                self.MAX_RESIDENT_RESULTS, evicted)
-                        self.results_evicted += 1
+            self._record_result(jid, metrics)
         log.info("job %s completed by %s in %.3fs", jid, worker_id, elapsed_s)
         return outcome
 
@@ -671,17 +758,24 @@ class Dispatcher(service.DispatcherServicer):
     def CompleteJobs(self, request: pb.CompleteBatch,
                      context) -> pb.CompleteBatchReply:
         """Batched completions: one round trip for a whole drained batch
-        (the per-item semantics are identical to CompleteJob and remain
+        AND one state-machine crossing for the batch (queue.complete_batch;
+        the per-item semantics are identical to CompleteJob and remain
         idempotent; see backtesting.proto for the motivation numbers)."""
         self.peers.touch(request.worker_id)
         reply = pb.CompleteBatchReply()
-        for item in request.items:
-            outcome = self._complete_one(item.id, request.worker_id,
-                                         item.metrics, item.elapsed_s)
+        items = list(request.items)
+        outcomes = self.queue.complete_batch(
+            [item.id for item in items], request.worker_id)
+        for item, outcome in zip(items, outcomes):
+            if outcome == "unknown":
+                reply.unknown_ids.append(item.id)
+                continue
+            if item.metrics:
+                self._record_result(item.id, item.metrics)
+            log.info("job %s completed by %s in %.3fs", item.id,
+                     request.worker_id, item.elapsed_s)
             if outcome == "new":
                 reply.accepted += 1
-            elif outcome == "unknown":
-                reply.unknown_ids.append(item.id)
             # "dup" (a retried delivery the dispatcher already recorded) is
             # deliberately neither accepted nor unknown: the worker already
             # counted it on the attempt the dispatcher processed.

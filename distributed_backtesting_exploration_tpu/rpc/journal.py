@@ -49,6 +49,12 @@ class Journal:
         self._lock = threading.Lock()
         self._fh = open(path, "a", encoding="utf-8") if path else None
 
+    @property
+    def enabled(self) -> bool:
+        """False for the no-op journal — callers can skip building
+        expensive payloads (``journal_form`` b64-encodes the OHLCV block)."""
+        return self._fh is not None
+
     def append(self, event: str, **payload) -> None:
         if self._fh is None:
             return

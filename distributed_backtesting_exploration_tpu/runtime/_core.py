@@ -156,6 +156,20 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dbx_jobq_fail.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.dbx_jobq_complete.restype = ctypes.c_int
     lib.dbx_jobq_complete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.dbx_jobq_enqueue_n.restype = ctypes.c_int
+    lib.dbx_jobq_enqueue_n.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_char), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int]
+    lib.dbx_jobq_take_begin_idx_n.restype = ctypes.c_int
+    lib.dbx_jobq_take_begin_idx_n.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
+    lib.dbx_jobq_take_commit_idx_n.restype = ctypes.c_int
+    lib.dbx_jobq_take_commit_idx_n.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8)]
+    lib.dbx_jobq_complete_idx_n.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8)]
     lib.dbx_jobq_requeue_expired.restype = ctypes.c_int
     lib.dbx_jobq_requeue_expired.argtypes = [
         ctypes.c_void_p, _PRUNED_CB, ctypes.c_void_p]
@@ -337,20 +351,29 @@ class NativeJobQueue:
             raise RuntimeError("native core not available")
         self._lib = lib
         self._h = lib.dbx_jobq_new()
+        # id<->index mirror of the core's intern table: every method that
+        # can intern an id C-side interns it here in the SAME call order,
+        # so the dense indices agree without ever crossing the boundary.
+        self._ids: list[str] = []
+        self._idx: dict[str, int] = {}
 
     def register(self, jid: str, combos: float) -> None:
         if self._lib.dbx_jobq_register(self._h, jid.encode(),
                                        float(combos)) != 0:
             raise ValueError(f"job id exceeds {self._ID_BUF - 1} bytes")
+        self._intern(jid)
 
     def push_pending(self, jid: str) -> None:
         self._lib.dbx_jobq_push_pending(self._h, jid.encode())
+        self._intern(jid)
 
     def mark_completed(self, jid: str) -> None:
         self._lib.dbx_jobq_mark_completed(self._h, jid.encode())
+        self._intern(jid)
 
     def mark_failed(self, jid: str) -> None:
         self._lib.dbx_jobq_mark_failed(self._h, jid.encode())
+        self._intern(jid)
 
     def take_begin(self) -> str | None:
         buf = ctypes.create_string_buffer(self._ID_BUF)
@@ -363,17 +386,123 @@ class NativeJobQueue:
 
     def take_commit(self, jid: str, worker_id: str, lease_s: float) -> bool:
         """False when the job completed in the take window (not leased)."""
-        return self._lib.dbx_jobq_take_commit(
+        rc = self._lib.dbx_jobq_take_commit(
             self._h, jid.encode(), worker_id.encode(),
             int(lease_s * 1000)) == 0
+        self._intern(jid)
+        return rc
 
     def fail(self, jid: str) -> bool:
         """False when the job completed in the take window (not failed)."""
-        return self._lib.dbx_jobq_fail(self._h, jid.encode()) == 0
+        rc = self._lib.dbx_jobq_fail(self._h, jid.encode()) == 0
+        self._intern(jid)
+        return rc
 
     def complete(self, jid: str) -> str:
         rc = self._lib.dbx_jobq_complete(self._h, jid.encode())
         return ("new", "dup", "unknown")[rc]
+
+    # -- batched transitions: ONE ctypes crossing per RPC-sized batch,
+    # moving int32 HANDLES instead of strings (per-id string marshalling
+    # made the string-keyed batch surface slower than the dict fallback).
+    # The id<->index mirror lives here: the C core assigns dense indices
+    # in first-registration order, and this class performs registrations
+    # in the same order it appends to ``_ids``, so the index never has to
+    # cross the boundary at registration time.
+
+    def _intern(self, jid: str) -> int:
+        idx = self._idx.get(jid)
+        if idx is None:
+            idx = self._idx[jid] = len(self._ids)
+            self._ids.append(jid)
+        return idx
+
+    # Reusable per-instance scratch (every call arrives under
+    # JobQueue._lock, so one set of buffers is safe): ctypes array
+    # construction per call was a measurable share of the per-batch glue.
+    _SCRATCH = 4096
+
+    def _idx_buf(self, n: int, vals=None) -> "ctypes.Array":
+        buf = self.__dict__.get("_idxs")
+        if buf is None or len(buf) < n:
+            buf = self._idxs = (ctypes.c_int32 * max(n, self._SCRATCH))()
+        if vals is not None:
+            buf[:n] = vals
+        return buf
+
+    def _u8_buf(self, n: int) -> "ctypes.Array":
+        buf = self.__dict__.get("_u8s")
+        if buf is None or len(buf) < n:
+            buf = self._u8s = (ctypes.c_uint8 * max(n, self._SCRATCH))()
+        return buf
+
+    def enqueue_n(self, jids: list[str], combos: list[float]) -> None:
+        """Register + push a batch in one crossing (the one call where
+        the id strings DO cross — once per job lifetime). Ids pack
+        NUL-separated (stride 0: the core walks strlen) — join beats any
+        per-id buffer arithmetic."""
+        if not jids:
+            return
+        import array as array_mod
+
+        raws = [j.encode() for j in jids]
+        if max(map(len, raws)) >= self._ID_BUF:
+            raise ValueError(f"job id exceeds {self._ID_BUF - 1} bytes")
+        if any(b"\0" in r for r in raws):
+            # An embedded NUL would split the pack: the C side would
+            # intern a truncated id while the mirror interns the full
+            # one, desynchronizing every later index.
+            raise ValueError("job ids must not contain NUL bytes")
+        blob = b"\0".join(raws) + b"\0"
+        arr = array_mod.array("d", combos)
+        addr, _ = arr.buffer_info()
+        accepted = self._lib.dbx_jobq_enqueue_n(
+            self._h, blob, 0,
+            ctypes.cast(addr, ctypes.POINTER(ctypes.c_double)), len(jids))
+        if accepted != len(jids):   # cap enforced above
+            raise RuntimeError("native enqueue_n rejected ids post-cap")
+        idx, ids = self._idx, self._ids
+        for jid in jids:            # inlined _intern: the per-id hot loop
+            if jid not in idx:
+                idx[jid] = len(ids)
+                ids.append(jid)
+
+    def take_begin_n(self, n: int) -> list[str]:
+        """Pop up to ``n`` live pending ids in one crossing."""
+        if n <= 0:
+            return []
+        out = self._idx_buf(min(int(n), 1 << 20))
+        got = self._lib.dbx_jobq_take_begin_idx_n(
+            self._h, out, min(int(n), len(out)))
+        ids = self._ids
+        return [ids[i] for i in out[:got]]
+
+    def take_commit_n(self, jids: list[str], worker_id: str,
+                      lease_s: float) -> list[bool]:
+        """Lease a popped batch in one crossing; False entries completed
+        in the take window (dropped, not leased)."""
+        if not jids:
+            return []
+        idxs = self._idx_buf(len(jids), [self._idx[j] for j in jids])
+        flags = self._u8_buf(len(jids))
+        self._lib.dbx_jobq_take_commit_idx_n(
+            self._h, idxs, len(jids), worker_id.encode(),
+            int(lease_s * 1000), flags)
+        return [bool(f) for f in flags[:len(jids)]]
+
+    def complete_n(self, jids: list[str]) -> list[str]:
+        """Record a completion batch in one crossing. Ids the queue has
+        never seen (possible from a stray RPC) map to index -1, which the
+        core reports "unknown"."""
+        if not jids:
+            return []
+        get = self._idx.get
+        idxs = self._idx_buf(len(jids), [get(j, -1) for j in jids])
+        outcomes = self._u8_buf(len(jids))
+        self._lib.dbx_jobq_complete_idx_n(
+            self._h, idxs, len(jids), outcomes)
+        kinds = ("new", "dup", "unknown")
+        return [kinds[o] for o in outcomes[:len(jids)]]
 
     def _requeue(self, call, *args) -> list[str]:
         hit: list[str] = []

@@ -212,6 +212,44 @@ def test_late_completion_of_pending_job_removes_it(qfactory):
     assert q.drained
 
 
+def test_complete_batch_outcomes(tmp_path, qfactory):
+    """complete_batch: one state-machine crossing per batch, per-id
+    outcomes identical to complete(), 'new' completions journaled."""
+    jpath = str(tmp_path / "journal.jsonl")
+    q = qfactory(Journal(jpath))
+    for r in _mk_jobs(3):
+        q.enqueue(r)
+    q.take(3, "w1")
+    assert q.complete_batch(["j0", "j1", "nope"], "w1") == \
+        ["new", "new", "unknown"]
+    assert q.complete_batch(["j0", "j2"], "w1") == ["dup", "new"]
+    assert q.complete_batch([], "w1") == []
+    assert q.stats()["jobs_completed"] == 3
+    assert q.drained
+    assert Journal.replay(jpath).completed == {"j0", "j1", "j2"}
+
+
+def test_batch_commit_drops_mid_take_completion(qfactory):
+    """The take race model, batch-wide: an id completed between
+    take_begin_n and take_commit_n is dropped (tombstone cleared), the
+    rest of the batch leases normally."""
+    q = qfactory()
+    for r in _mk_jobs(3):
+        q.enqueue(r)
+    st = q._state
+    jids = st.take_begin_n(3)
+    assert jids == ["j0", "j1", "j2"]
+    assert st.take_begin_n(1) == []          # FIFO drained by the batch
+    assert st.complete("j1") == "new"        # lands in the take window
+    assert st.take_commit_n(jids, "w1", 60.0) == [True, False, True]
+    s = st.stats()
+    assert s["leased"] == 2 and s["completed"] == 1
+    # the dropped id's orphan tombstone is cleared: draining the leases
+    # drains the queue.
+    assert st.complete("j0") == "new" and st.complete("j2") == "new"
+    assert st.drained()
+
+
 def test_inline_job_survives_journal_restart(tmp_path, qfactory):
     """Synthetic (inline-payload) jobs must be dispatchable after replay."""
     jpath = str(tmp_path / "journal.jsonl")
@@ -485,9 +523,15 @@ def test_completion_dropped_after_attempts_exhausted():
     assert w.completions_dropped == 1 and not w._deferred
 
 
-def test_native_substrate_live_by_default():
-    """VERDICT r1: the C++ queue/registry must back the LIVE paths, not just
-    tests. Default construction uses the native substrate when available."""
+def test_native_substrate_defaults(monkeypatch):
+    """The C++ core backs the live paths where it measures fastest: the
+    registry and worker channels default native; the job-queue state
+    machine defaults PYTHON by measurement (CPython's dict/deque beat the
+    ctypes-driven core at Python-call grain even after the batch/
+    int-handle redesign — DESIGN.md "queue state machine alone"), with
+    ``DBX_NATIVE_QUEUE=1`` / ``use_native=True`` opting in. The native
+    machine remains the only substrate at the C ABI (cpp/dbx_core_bench:
+    ~1.1M jobs/s there)."""
     from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
         JobQueue, PeerRegistry)
     from distributed_backtesting_exploration_tpu.rpc.worker import Worker
@@ -496,7 +540,11 @@ def test_native_substrate_live_by_default():
 
     if not _core.available():
         pytest.skip("native core not available")
+    monkeypatch.delenv("DBX_NATIVE_QUEUE", raising=False)
+    assert JobQueue().substrate == "python"
+    monkeypatch.setenv("DBX_NATIVE_QUEUE", "1")
     assert JobQueue().substrate == "native"
+    assert JobQueue(use_native=True).substrate == "native"
     assert PeerRegistry().substrate == "native"
     w = Worker("localhost:1", compute.InstantBackend())
     assert w._in.backend == "native" and w._out.backend == "native"
