@@ -216,8 +216,10 @@ def _metrics_pack(pos, prev, net, row_ok, t_idx, tr, *, ppy: int):
     years = jnp.maximum(n / jnp.float32(ppy), _EPS)
     final = jnp.maximum(eq_final, _EPS)
 
-    # Pack the 9 metrics onto sublanes of one (16, 128) output tile — a
-    # (1, 128)-per-metric block shape is not a legal TPU tile.
+    # Pack the 9 metrics onto sublanes of one (16, lanes) output tile — a
+    # (1, lanes)-per-metric block shape is not a legal TPU tile. The lane
+    # width comes from the position block (each launcher picks its widest
+    # legal block: <=512 for fused-SMA, <=256 for the band machines).
     rows = jnp.stack([
         mean / (std + _EPS) * ann,          # sharpe
         mean / (dstd + _EPS) * ann,         # sortino
@@ -228,9 +230,36 @@ def _metrics_pack(pos, prev, net, row_ok, t_idx, tr, *, ppy: int):
         hit,                                # hit_rate
         0.5 * turnover,                     # n_trades
         turnover,                           # turnover
-    ], axis=0)                              # (9, 128)
+    ], axis=0)                              # (9, lanes)
     return jnp.concatenate(
-        [rows, jnp.zeros((_METRIC_ROWS - 9, _LANES), jnp.float32)], axis=0)
+        [rows, jnp.zeros((_METRIC_ROWS - 9, pos.shape[-1]), jnp.float32)],
+        axis=0)
+
+
+def _sma_table(close_p, windows: tuple, W_pad: int):
+    """Distinct-window SMA table, W-as-SUBLANE ``(N, W_pad, T_pad)``: one
+    cumsum + W static shifts stacked on axis 1, keeping T_pad minor.
+
+    Two things make this layout fast: the per-window rows are pure
+    elementwise shift/sub/div expressions XLA fuses into one pass (a
+    (T_pad, W)-indexed ``jnp.take`` lowered to a slow XLA gather that
+    alone measured ~37% of the whole sweep — bench.py roofline_stages
+    "prep" stage), and T_pad staying minor avoids the 128x tile-padding
+    blow-up of a (N, T_pad, 1)-sliced stack on the lane axis. The kernel
+    contracts the table's leading (window) axis directly, so no transpose
+    is needed anywhere. Shared with bench.py's ``roofline_stages``
+    scaffold so the measured and shipped preps cannot drift.
+    """
+    N, T_pad = close_p.shape
+    cs = jnp.cumsum(close_p, axis=1)
+    t_row = jnp.arange(T_pad)[None, :]                         # (1, T_pad)
+    rows = []
+    for w in windows:
+        w = int(w)
+        sma_w = (cs - _shift_t(cs, w, 0.0)) / jnp.float32(w)
+        rows.append(jnp.where(t_row >= w - 1, sma_w, 0.0))
+    rows += [jnp.zeros((N, T_pad), jnp.float32)] * (W_pad - len(windows))
+    return jnp.stack(rows, axis=1)                       # (N, W_pad, T_pad)
 
 
 def _kernel(r_ref, sma_ref, of_ref, os_ref, warm_ref, *refs,
@@ -238,22 +267,27 @@ def _kernel(r_ref, sma_ref, of_ref, os_ref, warm_ref, *refs,
     tr, out_ref = _unpack_tr(refs, T_real)
     T_pad = r_ref.shape[1]
     r = r_ref[0]                     # (T_pad, 1) -> broadcasts over lanes
-    sma = sma_ref[0]                 # (T_pad, W_pad)
-    # Per-lane window selection as MXU contractions. HIGHEST precision: the
-    # default bf16 MXU pass truncates price-level SMAs enough to flip
-    # sign(fast - slow) near crossovers.
+    sma = sma_ref[0]                 # (W_pad, T_pad) — W-major table
+    # Per-lane window selection as MXU contractions over the table's
+    # LEADING window axis (the W-major layout lets the host program build
+    # the table with static shifts instead of a gather — the gather
+    # version measured ~37% of the whole sweep; bench.py roofline_stages).
     # ONE selection matmul on the DIFFERENCE one-hot (+1 at the fast row,
     # -1 at the slow row): each lane's contraction has exactly two nonzero
     # terms, so d == sma_fast - sma_slow and sign(d) is the crossover —
     # half the MXU work of selecting f and s separately. HIGHEST precision:
     # the default bf16 pass truncates price-level SMAs enough to flip
     # sign(d) near crossovers.
-    d = jnp.dot(sma, of_ref[:] - os_ref[:],
-                preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST)
+    d = jax.lax.dot_general(
+        sma, of_ref[:] - os_ref[:], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST)   # (T_pad, lanes)
 
-    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, _LANES), 0)
-    warm = warm_ref[0, :][None, :]               # (1, 128) max(fast, slow)
+    lanes = of_ref.shape[1]   # wider-than-128 param blocks: fewer cells
+                              # amortize per-cell overhead (bench.py
+                              # roofline_stages measured +16% at 512)
+    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, lanes), 0)
+    warm = warm_ref[0, :][None, :]            # (1, lanes) max(fast, slow)
     valid = t_idx >= (warm.astype(jnp.int32) - 1)
     pos = jnp.where(valid, jnp.sign(d), 0.0)
     out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
@@ -271,31 +305,19 @@ def _fused_call(close, onehot_f, onehot_s, warm, t_real, *, windows: tuple,
     proxy TPU backend — measured 13x slower end-to-end)."""
     N, T = close.shape
     close_p = _pad_last(close, T_pad)
-
-    # Distinct-window SMA table (N, T_pad, W_pad): one cumsum + ONE gather.
-    # (Stacking 120 per-window (N, T_pad) slices along a new minor axis makes
-    # XLA materialize each as a (8,128)-tiled (N, T_pad, 1) — a 128x padding
-    # blow-up that OOMs HBM; a single gather with a (T_pad, W) index matrix
-    # produces the final layout directly.)
-    cs = jnp.cumsum(close_p, axis=1)
-    w_vec = jnp.asarray(np.asarray(windows, np.int32))         # (W,)
-    t_idx = jnp.arange(T_pad)[:, None]                         # (T_pad, 1)
-    gather_idx = jnp.clip(t_idx - w_vec[None, :], 0, T_pad - 1)
-    shifted = jnp.take(cs, gather_idx, axis=1)                 # (N,T_pad,W)
-    shifted = jnp.where((t_idx >= w_vec[None, :])[None], shifted, 0.0)
-    sma_table = (cs[:, :, None] - shifted) / w_vec[None, None, :].astype(
-        jnp.float32)
-    sma_table = jnp.where(
-        (t_idx >= w_vec[None, :] - 1)[None], sma_table, 0.0)
-    if W_pad > len(windows):
-        sma_table = jnp.concatenate(
-            [sma_table,
-             jnp.zeros((N, T_pad, W_pad - len(windows)), jnp.float32)],
-            axis=-1)
+    sma_table = _sma_table(close_p, windows, W_pad)
 
     returns3 = _rets3(close_p)
     P_pad = onehot_f.shape[1]
-    n_blocks = P_pad // _LANES
+    # Widest legal param block up to 512 lanes: fewer, wider cells
+    # amortize per-cell overhead (+16% measured at 512 on the headline
+    # sweep — bench.py roofline_stages); small grids keep one full block.
+    lanes = P_pad
+    for cand in (512, 256, 128):
+        if P_pad >= cand and P_pad % cand == 0:
+            lanes = cand
+            break
+    n_blocks = P_pad // lanes
     grid = (N, n_blocks)
     kernel = functools.partial(_kernel, cost=cost, ppy=ppy, T_real=T_real)
     out = pl.pallas_call(
@@ -304,20 +326,20 @@ def _fused_call(close, onehot_f, onehot_s, warm, t_real, *, windows: tuple,
         in_specs=[
             pl.BlockSpec((1, T_pad, 1), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, T_pad, W_pad), lambda i, j: (i, 0, 0),
+            pl.BlockSpec((1, W_pad, T_pad), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((W_pad, _LANES), lambda i, j: (0, j),
+            pl.BlockSpec((W_pad, lanes), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((W_pad, _LANES), lambda i, j: (0, j),
+            pl.BlockSpec((W_pad, lanes), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
+            pl.BlockSpec((1, lanes), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
         ] + _tr_specs(T_real),
         out_specs=pl.BlockSpec(
-            (1, 1, _METRIC_ROWS, _LANES), lambda i, j: (i, j, 0, 0),
+            (1, 1, _METRIC_ROWS, lanes), lambda i, j: (i, j, 0, 0),
             memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(
-            (N, n_blocks, _METRIC_ROWS, _LANES), jnp.float32),
+            (N, n_blocks, _METRIC_ROWS, lanes), jnp.float32),
         interpret=interpret,
     )(returns3, sma_table, onehot_f, onehot_s, warm,
       *_tr_args(t_real, T_real))
@@ -420,10 +442,11 @@ def _band_cell_prologue(r_ref, z_ref, ow_ref, k_ref, warm_ref, refs, T_real):
                             preferred_element_type=jnp.float32,
                             precision=jax.lax.Precision.HIGHEST)  # (T_pad,128)
 
-    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, _LANES), 0)
+    lanes = ow_ref.shape[1]
+    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, lanes), 0)
     warm = warm_ref[0, :][None, :]
     valid = t_idx >= (warm.astype(jnp.int32) - 1)
-    k = k_ref[0, :][None, :]                           # (1, 128) entry band
+    k = k_ref[0, :][None, :]                         # (1, lanes) entry band
     return tr, out_ref, r, z, t_idx, valid, k
 
 
@@ -479,12 +502,23 @@ def _cumsum_window_tools(windows: tuple, T_pad: int):
     w_col = jnp.asarray(np.asarray(windows, np.int32))[:, None]  # (W,1)
     w_f = w_col.astype(jnp.float32)[None]                        # (1,W,1)
     t_row = jnp.arange(T_pad)[None, :]                           # (1,T_pad)
-    gather_idx = jnp.clip(t_row - w_col, 0, T_pad - 1)           # (W,T_pad)
-    in_win = (t_row >= w_col)[None]                              # (1,W,T_pad)
 
     def windowed_sum(series):                                    # (N,T_pad) ->
-        cs = jnp.cumsum(series, axis=1)                          # (N,W,T_pad)
-        shifted = jnp.where(in_win, jnp.take(cs, gather_idx, axis=1), 0.0)
+        # Per-window shifted reads as STATIC slice+concat (plain copies
+        # XLA fuses), NOT a (W, T_pad)-indexed gather: the gather version
+        # of the SMA table measured ~37% of that whole sweep (bench.py
+        # roofline_stages), and windowed_sum3 below learned the same
+        # lesson earlier. Bit-identical: window rows are compile-time
+        # constants, zero-filled for t < w exactly like the old
+        # clipped-gather + in-window mask.
+        cs = jnp.cumsum(series, axis=1)                          # (N,T_pad)
+        N = series.shape[0]
+        zero = jnp.zeros((N, 1), jnp.float32)
+        shifted = jnp.stack(
+            [jnp.concatenate(
+                [jnp.broadcast_to(zero, (N, min(int(w), T_pad))),
+                 cs[:, :T_pad - min(int(w), T_pad)]], axis=1)
+             for w in windows], axis=1)                          # (N,W,T_pad)
         return cs[:, None, :] - shifted
 
     def windowed_sum3(series):                                   # (N,W,T_pad)
@@ -517,7 +551,16 @@ def _band_machine_pallas(kernel, close_p, z_table, onehot_w, k_lanes, warm,
     lanes into ``_boll_kernel``-shaped cells, :class:`Metrics` out."""
     N = close_p.shape[0]
     P_pad = k_lanes.shape[1]
-    n_blocks = P_pad // _LANES
+    # Wider param blocks amortize per-cell overhead (the fused-SMA
+    # finding, bench.py roofline_stages); capped at 256 here — the
+    # 3-state compose ladder keeps ~6 (T_pad, lanes) arrays live, so 512
+    # lanes would press the VMEM budget the kernels are sized for.
+    lanes = P_pad
+    for cand in (256, _LANES):
+        if P_pad >= cand and P_pad % cand == 0:
+            lanes = cand
+            break
+    n_blocks = P_pad // lanes
     out = pl.pallas_call(
         kernel,
         grid=(N, n_blocks),
@@ -526,18 +569,18 @@ def _band_machine_pallas(kernel, close_p, z_table, onehot_w, k_lanes, warm,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, W_pad, T_pad), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((W_pad, _LANES), lambda i, j: (0, j),
+            pl.BlockSpec((W_pad, lanes), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
+            pl.BlockSpec((1, lanes), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
+            pl.BlockSpec((1, lanes), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
         ] + _tr_specs(T_real),
         out_specs=pl.BlockSpec(
-            (1, 1, _METRIC_ROWS, _LANES), lambda i, j: (i, j, 0, 0),
+            (1, 1, _METRIC_ROWS, lanes), lambda i, j: (i, j, 0, 0),
             memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(
-            (N, n_blocks, _METRIC_ROWS, _LANES), jnp.float32),
+            (N, n_blocks, _METRIC_ROWS, lanes), jnp.float32),
         interpret=interpret,
     )(_rets3(close_p), z_table, onehot_w, k_lanes, warm,
       *_tr_args(t_real, T_real))
@@ -954,9 +997,11 @@ def _grid_setup(fast_bytes: bytes, slow_bytes: bytes):
     slow = np.frombuffer(slow_bytes, np.float32)
     P = fast.shape[0]
     windows = _distinct_windows(np.concatenate([fast, slow]), "windows")
-    # The SMA table keeps its (T, W)-major layout, so W pads to 128 lanes
-    # here (the headline grid's ~120 distinct windows fill it anyway).
-    W_pad = _round_up(max(windows.shape[0], 1), _LANES)
+    # The SMA table is W-major ((N, W_pad, T_pad), T on lanes), so W pads
+    # to a SUBLANE multiple (8) only — a 128-pad here would 4x the table
+    # HBM, the per-cell table DMA (~17% of wall time per the roofline
+    # accounting), and the MXU contraction width for small grids.
+    W_pad = _round_up(max(windows.shape[0], 1), 8)
     P_pad = _round_up(max(P, 1), _LANES)
 
     warm = np.zeros((1, P_pad), np.float32)
