@@ -182,6 +182,39 @@ def _np_portfolio_metrics(returns: np.ndarray,
     }
 
 
+_MINVAR_SHRINK = 0.1   # covariance shrinkage toward the diagonal
+
+
+def _min_variance_weights(R: np.ndarray, live: np.ndarray) -> np.ndarray:
+    """Correlation-aware minimum-variance weights over leg return rows.
+
+    The unconstrained minimum of ``w'Σw`` s.t. ``w'1 = 1`` is
+    ``w ∝ Σ⁻¹1``; Σ is shrunk ``(1-λ)Σ + λ diag(Σ)`` (λ=0.1) so two
+    near-duplicate legs cannot blow the solve up into huge offsetting
+    ±weights. Dead legs (zero variance) get weight 0; fewer than two live
+    legs degrades to inverse-vol/equal exactly like that scheme's
+    fallbacks. Callers normalize to unit gross exposure afterwards."""
+    n = R.shape[0]
+    k = int(live.sum())
+    if k >= 2:
+        Rl = R[live]
+        cov = np.cov(Rl)
+        cov = (1.0 - _MINVAR_SHRINK) * cov + _MINVAR_SHRINK * np.diag(
+            np.diag(cov))
+        try:
+            wl = np.linalg.solve(cov, np.ones(k))
+        except np.linalg.LinAlgError:
+            # Singular even after shrinkage (e.g. bit-identical legs):
+            # inverse-vol is the diagonal-only special case.
+            wl = 1.0 / (Rl.std(axis=-1) + 1e-12)
+        w = np.zeros(n)
+        w[live] = wl
+        return w
+    if live.any():
+        return np.where(live, 1.0 / (R.std(axis=-1) + 1e-12), 0.0)
+    return np.ones(n)
+
+
 def portfolio(results_dir: str, journal_path: str, *,
               weights: str = "equal",
               periods_per_year: int = 252, top: int = 10) -> dict:
@@ -191,15 +224,21 @@ def portfolio(results_dir: str, journal_path: str, *,
     its winning combo's per-bar net returns, so the fleet-level portfolio —
     which per-job metric ROWS cannot produce (cross-ticker correlations are
     lost in a scalar) — is a weighted sum of stored series. ``weights`` is
-    ``"equal"`` or ``"inverse_vol"`` (per-leg 1/std of its net returns),
-    normalized to unit gross exposure like
-    ``parallel.portfolio._normalize_weights``. All legs must share one bar
-    count (compose over a uniform fleet; ragged legs error loudly with the
-    offending lengths). Runs dispatcher-side on NumPy only — no jax.
+    ``"equal"``, ``"inverse_vol"`` (per-leg 1/std of its net returns), or
+    ``"min_variance"`` (correlation-aware: the inverse-covariance
+    minimum-variance solution ``w ∝ Σ⁻¹1`` on the stored series, with the
+    covariance shrunk 10%% toward its diagonal so a near-singular Σ from
+    highly correlated legs cannot produce wild ±weights; legs may receive
+    negative weight — shorting a leg's strategy — and the book is
+    normalized to unit GROSS exposure either way, like
+    ``parallel.portfolio._normalize_weights``). All legs must share one
+    bar count (compose over a uniform fleet; ragged legs error loudly
+    with the offending lengths). Runs dispatcher-side on NumPy only — no
+    jax.
     """
-    if weights not in ("equal", "inverse_vol"):
+    if weights not in ("equal", "inverse_vol", "min_variance"):
         raise ValueError(f"unknown weights scheme {weights!r}; "
-                         "one of: equal, inverse_vol")
+                         "one of: equal, inverse_vol, min_variance")
     state = Journal.replay(journal_path)
     legs = []
     skipped: dict[str, list] = {}
@@ -281,6 +320,8 @@ def portfolio(results_dir: str, journal_path: str, *,
             w = np.where(live, 1.0 / (R.std(axis=-1) + 1e-12), 0.0)
         else:
             w = np.ones(R.shape[0])
+    elif weights == "min_variance":
+        w = _min_variance_weights(R, live)
     else:
         w = np.ones(R.shape[0])
     w = w / max(np.abs(w).sum(), 1e-12)
@@ -321,7 +362,7 @@ def main(argv=None) -> None:
                     choices=list(Metrics._fields))
     ap.add_argument("--top", type=int, default=10)
     ap.add_argument("--portfolio", nargs="?", const="equal", default=None,
-                    choices=["equal", "inverse_vol"],
+                    choices=["equal", "inverse_vol", "min_variance"],
                     help="compose stored DBXP best-return series (jobs run "
                          "with --best-returns) into the fleet book with "
                          "this weighting; prints portfolio metrics + the "
