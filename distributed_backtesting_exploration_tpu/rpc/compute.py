@@ -406,68 +406,15 @@ class JaxSweepBackend:
     def _timeshard_window_reason(self, wins, n_combos: int, t_min: int, *,
                                  halo_bound: bool = True,
                                  what: str = "window") -> str | None:
-        """Shared grid gates of BOTH time-sharded routes (single-asset
-        and pairs — one implementation so they cannot drift): per-combo
-        compile cap, integral windows >= 1, and the
-        halo-fits-one-per-chip-block bound."""
-        wins = np.asarray(wins, np.float64)
-        if n_combos == 0 or wins.size == 0:
-            return "empty grid"
-        if n_combos > self._TIMESHARD_MAX_COMBOS:
-            return (f"{n_combos} grid combos exceed the per-combo compile "
-                    f"cap of {self._TIMESHARD_MAX_COMBOS}")
-        if not np.allclose(wins, np.round(wins)):
-            return f"non-integral {what} values"
-        if wins.min() < 1:
-            return f"{what} values below 1"
-        if halo_bound:
-            n_dev = self._mesh.devices.size
-            block = -(-int(t_min) // n_dev)
-            if int(wins.max()) > block:
-                return (f"max {what} {int(wins.max())} exceeds the "
-                        f"{block}-bar per-chip block; the halo exchange "
-                        "needs the window to fit one neighbor block")
-        return None
+        return _timeshard_window_reason(
+            wins, n_combos, t_min, self._mesh.devices.size,
+            halo_bound=halo_bound, what=what)
 
     def _timeshard_reason(self, job, axes, lengths) -> str | None:
         """None when a long-context group can route to the time-sharded
         backtests; otherwise why it stays on the generic path."""
-        from ..parallel import sweep as sweep_mod
-
-        fam = self._TIMESHARD_STRATEGIES.get(job.strategy)
-        if fam is None:
-            return f"strategy {job.strategy!r} has no time-sharded backtest"
-        if set(axes) != set(fam.params):
-            return (f"grid axes {sorted(axes)} do not match the "
-                    f"time-sharded contract {sorted(fam.params)}")
-        prod = sweep_mod.product_grid(**axes)
-        n_combos = int(np.asarray(next(iter(prod.values()))).size)
-        int_axes = self._FUSED_STRATEGIES[job.strategy].window_axes
-        wins = np.concatenate(
-            [np.asarray(axes[a], np.float64) for a in int_axes])
-        reason = self._timeshard_window_reason(
-            wins, n_combos, min(lengths), halo_bound=fam.halo_bound,
-            what=f"window ({'/'.join(int_axes)})")
-        if reason is not None:
-            return reason
-        if job.strategy == "sma_crossover":
-            f_ = np.round(np.asarray(prod["fast"], np.float64))
-            s_ = np.round(np.asarray(prod["slow"], np.float64))
-            if (f_ >= s_).any():
-                return "grid contains fast >= slow combos"
-        if job.strategy in ("donchian", "donchian_hl", "stochastic"):
-            # The generic channel paths poison windows beyond MAX_WINDOW
-            # to NaN; keep those semantics-defining results (the fused
-            # demotion rule, applied identically here).
-            from ..models import donchian as donchian_mod
-            from ..models import stochastic as stoch_mod
-
-            bound = (stoch_mod.MAX_WINDOW if job.strategy == "stochastic"
-                     else donchian_mod.MAX_WINDOW)
-            if float(wins.max()) > bound:
-                return (f"max window {int(wins.max())} exceeds the channel "
-                        f"view bound {bound}")
-        return None
+        return timeshard_route_reason(job.strategy, axes, lengths,
+                                      self._mesh.devices.size)
 
     def _time_mesh(self):
         """1-D mesh over the SAME local chips with the TIME axis name
@@ -500,7 +447,7 @@ class JaxSweepBackend:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from ..ops.metrics import Metrics
-        from ..parallel import sweep as sweep_mod, timeshard
+        from ..parallel import timeshard
 
         job0 = group[0]
         fam = self._TIMESHARD_STRATEGIES[job0.strategy]
@@ -509,16 +456,9 @@ class JaxSweepBackend:
         n_dev = tmesh.devices.size
         cost = float(job0.cost)
         ppy = int(job0.periods_per_year or 252)
-        prod = sweep_mod.product_grid(**axes)
-        int_axes = set(self._FUSED_STRATEGIES[job0.strategy].window_axes)
-        n_combos = int(np.asarray(next(iter(prod.values()))).size)
-        # The DBXM column order IS product_grid order — same contract as
-        # every other sweep path.
-        combos = tuple(
-            tuple(int(round(float(np.asarray(prod[p])[i])))
-                  if p in int_axes else float(np.asarray(prod[p])[i])
-                  for p in fam.params)
-            for i in range(n_combos))
+        # DBXM column order IS product_grid order — the shared helper
+        # keeps this path and the slice worker on one contract.
+        combos = timeshard_combos(job0.strategy, axes)
 
         subgroups: dict[int, list[int]] = {}
         for i, t in enumerate(lengths):
@@ -1437,6 +1377,94 @@ class JaxSweepBackend:
 
     def process(self, jobs) -> list[Completion]:
         return self.collect(self.submit(jobs))
+
+
+def _timeshard_window_reason(wins, n_combos: int, t_min: int, n_dev: int, *,
+                             halo_bound: bool = True,
+                             what: str = "window") -> str | None:
+    """Shared grid gates of EVERY time-sharded route (single-asset,
+    pairs, and the slice worker — one implementation so they cannot
+    drift): per-combo compile cap, integral windows >= 1, and the
+    halo-fits-one-per-chip-block bound."""
+    wins = np.asarray(wins, np.float64)
+    if n_combos == 0 or wins.size == 0:
+        return "empty grid"
+    if n_combos > JaxSweepBackend._TIMESHARD_MAX_COMBOS:
+        return (f"{n_combos} grid combos exceed the per-combo compile "
+                f"cap of {JaxSweepBackend._TIMESHARD_MAX_COMBOS}")
+    if not np.allclose(wins, np.round(wins)):
+        return f"non-integral {what} values"
+    if wins.min() < 1:
+        return f"{what} values below 1"
+    if halo_bound:
+        block = -(-int(t_min) // n_dev)
+        if int(wins.max()) > block:
+            return (f"max {what} {int(wins.max())} exceeds the "
+                    f"{block}-bar per-chip block; the halo exchange "
+                    "needs the window to fit one neighbor block")
+    return None
+
+
+def timeshard_route_reason(strategy: str, axes, lengths,
+                           n_dev: int) -> str | None:
+    """None when a long-context single-asset group can route to the
+    time-sharded backtests over an ``n_dev``-chip time axis; otherwise
+    why it stays on the generic path. Shared by the single-host backend
+    (``JaxSweepBackend._timeshard_reason``) and the slice worker."""
+    from ..parallel import sweep as sweep_mod
+
+    fam = JaxSweepBackend._TIMESHARD_STRATEGIES.get(strategy)
+    if fam is None:
+        return f"strategy {strategy!r} has no time-sharded backtest"
+    if set(axes) != set(fam.params):
+        return (f"grid axes {sorted(axes)} do not match the "
+                f"time-sharded contract {sorted(fam.params)}")
+    prod = sweep_mod.product_grid(**axes)
+    n_combos = int(np.asarray(next(iter(prod.values()))).size)
+    int_axes = JaxSweepBackend._FUSED_STRATEGIES[strategy].window_axes
+    wins = np.concatenate(
+        [np.asarray(axes[a], np.float64) for a in int_axes])
+    reason = _timeshard_window_reason(
+        wins, n_combos, min(lengths), n_dev, halo_bound=fam.halo_bound,
+        what=f"window ({'/'.join(int_axes)})")
+    if reason is not None:
+        return reason
+    if strategy == "sma_crossover":
+        f_ = np.round(np.asarray(prod["fast"], np.float64))
+        s_ = np.round(np.asarray(prod["slow"], np.float64))
+        if (f_ >= s_).any():
+            return "grid contains fast >= slow combos"
+    if strategy in ("donchian", "donchian_hl", "stochastic"):
+        # The generic channel paths poison windows beyond MAX_WINDOW to
+        # NaN; keep those semantics-defining results (the fused demotion
+        # rule, applied identically here).
+        from ..models import donchian as donchian_mod
+        from ..models import stochastic as stoch_mod
+
+        bound = (stoch_mod.MAX_WINDOW if strategy == "stochastic"
+                 else donchian_mod.MAX_WINDOW)
+        if float(wins.max()) > bound:
+            return (f"max window {int(wins.max())} exceeds the channel "
+                    f"view bound {bound}")
+    return None
+
+
+def timeshard_combos(strategy: str, axes) -> tuple:
+    """The per-combo static parameter tuples of a time-sharded sweep, in
+    DBXM (product_grid) column order — ints for window axes, floats
+    otherwise. Shared by the single-host backend and the slice worker so
+    the combo order cannot drift from the metric-column contract."""
+    from ..parallel import sweep as sweep_mod
+
+    fam = JaxSweepBackend._TIMESHARD_STRATEGIES[strategy]
+    prod = sweep_mod.product_grid(**axes)
+    int_axes = set(JaxSweepBackend._FUSED_STRATEGIES[strategy].window_axes)
+    n_combos = int(np.asarray(next(iter(prod.values()))).size)
+    return tuple(
+        tuple(int(round(float(np.asarray(prod[p])[i])))
+              if p in int_axes else float(np.asarray(prod[p])[i])
+              for p in fam.params)
+        for i in range(n_combos))
 
 
 class InstantBackend:

@@ -111,6 +111,18 @@ class SliceWorker:
         self.jobs_completed = 0
         self._poll_interval_s = poll_interval_s
         self._jobs_per_chip = jobs_per_chip
+        # Long-context route: a job whose bar count exceeds this cap (the
+        # single-host fused VMEM cap; env-overridable for tests) on a
+        # group whose ticker axis cannot fill the slice shards its BAR
+        # axis over the GLOBAL mesh via parallel.timeshard instead of
+        # running ticker-sharded with every chip computing pad rows.
+        import os as _os
+
+        from .compute import JaxSweepBackend as _JSB
+
+        self.lc_bars_cap = int(_os.environ.get(
+            "DBX_SLICE_LC_CAP", _JSB._FUSED_MAX_BARS))
+        self._ts_fns: dict = {}
         self._stub = None
         if self.is_leader:
             import grpc
@@ -205,6 +217,8 @@ class SliceWorker:
         from ..utils import data as data_mod
 
         hdr, payload = _bcast_msg(msg, [flat] if flat is not None else [])
+        if hdr["op"] == "run_ts":
+            return hdr, self._run_ts_group(hdr, payload)
         if hdr["op"] != "run":
             return hdr, None
         n_pad, T = hdr["n_pad"], hdr["bars"]
@@ -237,6 +251,74 @@ class SliceWorker:
         # leader can read them host-side.
         m = Metrics(*(np.asarray(self._gather(f)) for f in m))
         return hdr, m
+
+    def _run_ts_group(self, hdr: dict, payload: np.ndarray):
+        """One long-context group: BAR axis sharded over the global mesh
+        (every process). The single-host `_submit_timeshard_groups`
+        discipline on the slice: histories pad right with repeat-last
+        values to a mesh multiple and pass ``t_real`` so pad bars are
+        dead; one jitted program per (strategy, grid, cost, ppy, bars)
+        runs one composed blockwise backtest per combo."""
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..ops.metrics import Metrics
+        from ..parallel import timeshard
+        from .compute import JaxSweepBackend, timeshard_combos
+
+        jax = self._jax
+        strat = hdr["strategy"]
+        n, T = hdr["n"], hdr["bars"]
+        cost, ppy = hdr["cost"], hdr["ppy"] or 252
+        fam = JaxSweepBackend._TIMESHARD_STRATEGIES[strat]
+        panel = payload.reshape(len(fam.fields), n, T)
+        n_dev = self.chips
+        T_pad = -(-T // n_dev) * n_dev
+        if T_pad > T:
+            panel = np.concatenate(
+                [panel, np.repeat(panel[:, :, -1:], T_pad - T, axis=2)],
+                axis=2)
+
+        axis = self.mesh.axis_names[0]
+        tspec = NamedSharding(self.mesh, P(None, axis))
+        # Each process contributes its contiguous TIME block (same
+        # device-order assumption as the ticker-sharded path above).
+        t_local = T_pad * jax.local_device_count() // jax.device_count()
+        start = jax.process_index() * t_local
+        fields = [jax.make_array_from_process_local_data(
+                      tspec,
+                      np.ascontiguousarray(
+                          panel[i][:, start:start + t_local]),
+                      global_shape=(n, T_pad))
+                  for i in range(len(fam.fields))]
+
+        axes = {k: np.asarray(v, np.float32)
+                for k, v in sorted(hdr["grid"].items())}
+        combos = timeshard_combos(strat, axes)
+        t_real = None if T == T_pad else T
+        key = (strat,
+               tuple(sorted((k, v.tobytes()) for k, v in axes.items())),
+               float(cost), int(ppy), T, T_pad)
+        run = self._ts_fns.get(key)
+        if run is None:
+            fn = getattr(timeshard, fam.fn_name)
+            mesh = self.mesh
+
+            def run(*arrs, _tr=t_real):
+                ms = [fn(mesh, *arrs, *cmb, cost=cost,
+                         periods_per_year=ppy, axis_name=axis, t_real=_tr)
+                      for cmb in combos]
+                return Metrics(*(jnp.stack(cols, axis=-1)
+                                 for cols in zip(*ms)))
+
+            run = jax.jit(run)
+            if len(self._ts_fns) >= JaxSweepBackend._MESH_FN_CAP:
+                self._ts_fns.pop(next(iter(self._ts_fns)))   # FIFO evict
+            self._ts_fns[key] = run
+        m = run(*fields)
+        # timeshard metrics are replicated across the mesh -> every
+        # process can read them host-side directly.
+        return Metrics(*(np.asarray(f) for f in m))
 
     # -- the loop ----------------------------------------------------------
 
@@ -299,18 +381,64 @@ class SliceWorker:
                                 for j in bad])
             # One broadcast round per group; followers need no counts in
             # advance — they simply process the control stream.
-            for (strat, grid_b, cost, ppy, bars), group in groups.items():
-                rows = np.stack(
+            def stack_rows(group, fields):
+                return np.stack(
                     [np.stack([np.asarray(getattr(decoded[j.id], f))
                                for j in group])
-                     for f in ("open", "high", "low", "close", "volume")])
+                     for f in fields])
+
+            for (strat, grid_b, cost, ppy, bars), group in groups.items():
+                grid_lists = {k: np.frombuffer(v, np.float32).tolist()
+                              for k, v in grid_b}
+                if bars > self.lc_bars_cap and len(group) < self.chips:
+                    # Long-context route: shard the BAR axis over the
+                    # whole slice instead of replicating pad rows on
+                    # every chip (the single-host routing rule, slice
+                    # scale — one shared eligibility implementation).
+                    from .compute import timeshard_route_reason
+
+                    axes = {k: np.frombuffer(v, np.float32)
+                            for k, v in grid_b}
+                    ts_reason = timeshard_route_reason(
+                        strat, axes, [bars], self.chips)
+                    if ts_reason is None:
+                        from .compute import JaxSweepBackend as _JSB
+
+                        fam = _JSB._TIMESHARD_STRATEGIES[strat]
+                        rows = stack_rows(group, fam.fields)
+                        msg = {"op": "run_ts", "strategy": strat,
+                               "grid": grid_lists, "cost": cost,
+                               "ppy": ppy, "bars": bars,
+                               "n": len(group)}
+                        log.info(
+                            "slice worker: jobs %s (%s) routed to the "
+                            "time-sharded long-context path (%d bars "
+                            "over %d chips)", [j.id for j in group],
+                            strat, bars, self.chips)
+                        t0 = time.perf_counter()
+                        _, m = self._run_group(msg, rows.reshape(-1))
+                        per_job = (time.perf_counter() - t0) / len(group)
+                        self._complete([
+                            pb.CompleteItem(
+                                id=job.id,
+                                metrics=wire.metrics_to_bytes(Metrics(
+                                    *(np.asarray(f)[i] for f in m))),
+                                elapsed_s=per_job)
+                            for i, job in enumerate(group)])
+                        continue
+                    log.warning(
+                        "slice worker: jobs %s (%s) are long-context "
+                        "(%d bars) but not time-shardable (%s); running "
+                        "ticker-sharded", [j.id for j in group], strat,
+                        bars, ts_reason)
+                rows = stack_rows(
+                    group, ("open", "high", "low", "close", "volume"))
                 n_pad = sharding_mod.pad_tickers(
                     len(group), self.mesh.devices.size)
                 rows = np.stack([sharding_mod.pad_rows(r, n_pad)
                                  for r in rows])
                 msg = {"op": "run", "strategy": strat,
-                       "grid": {k: np.frombuffer(v, np.float32).tolist()
-                                for k, v in grid_b},
+                       "grid": grid_lists,
                        "cost": cost, "ppy": ppy, "bars": bars,
                        "n_pad": n_pad}
                 t0 = time.perf_counter()

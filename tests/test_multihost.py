@@ -112,6 +112,7 @@ w = SliceWorker(dispatcher, worker_id="slice-under-test",
 assert w.chips == 8
 w.run(max_idle_polls=20)
 print("SLICE_OK", pid, w.jobs_completed, flush=True)
+print("SLICE_TS", pid, len(w._ts_fns), flush=True)
 """
 
 
@@ -146,6 +147,15 @@ def test_slice_worker_drains_live_dispatcher(tmp_path):
         1, 64, "pairs", {"lookback": np.float32([8.0]),
                          "z_entry": np.float32([1.0])}, seed=14)[0]
     queue.enqueue(pair_rec)
+    # A long-context job (bars above the shrunk DBX_SLICE_LC_CAP, and NOT
+    # divisible by the 8-chip mesh so the t_real pad contract is live):
+    # the slice must shard its BAR axis over the global mesh instead of
+    # replicating pad rows on every chip. Momentum keeps parity tight —
+    # its signal compares raw closes, so positions are exact.
+    lc_grid = {"lookback": np.float32([10.0, 20.0])}
+    lc_rec = synthetic_jobs(1, 201, "momentum", lc_grid, cost=1e-3,
+                            seed=15)[0]
+    queue.enqueue(lc_rec)
     results = tmp_path / "results"
     disp = Dispatcher(queue, PeerRegistry(prune_window_s=120.0),
                       results_dir=str(results))
@@ -158,6 +168,7 @@ def test_slice_worker_drains_live_dispatcher(tmp_path):
     script.write_text(_SLICE_CHILD)
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env["DBX_SLICE_LC_CAP"] = "96"   # shrink the long-context trigger
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(pid), coord, _REPO_ROOT,
@@ -176,11 +187,15 @@ def test_slice_worker_drains_live_dispatcher(tmp_path):
     srv.stop()
     for p, (out, err) in zip(procs, outs):
         assert p.returncode == 0, f"child failed:\n{err[-3000:]}"
-    assert "SLICE_OK 0 7" in outs[0][0]       # 6 sweeps + 1 empty pairs
+    assert "SLICE_OK 0 8" in outs[0][0]   # 6 sweeps + empty pairs + lc
     assert "SLICE_OK 1" in outs[1][0]
+    # The long-context job compiled a time-sharded program on BOTH
+    # processes (the SPMD route ran slice-wide, not leader-only).
+    assert "SLICE_TS 0 1" in outs[0][0]
+    assert "SLICE_TS 1 1" in outs[1][0]
     assert queue.drained
     s = queue.stats()
-    assert s["jobs_completed"] == 7 and s["jobs_failed"] == 0
+    assert s["jobs_completed"] == 8 and s["jobs_failed"] == 0
     # The unsupported pairs job completed with an EMPTY block (which the
     # dispatcher does not persist — no stored result, but no requeue loop).
     assert not (results / f"{pair_rec.id}.dbxm").exists()
@@ -201,6 +216,24 @@ def test_slice_worker_drains_live_dispatcher(tmp_path):
                 np.asarray(getattr(got, name)),
                 np.asarray(getattr(want, name))[0],
                 rtol=1e-4, atol=1e-5, err_msg=name)
+
+    # Long-context job parity: the time-sharded slice result equals the
+    # direct single-device sweep on the same series.
+    lc_blob = (results / f"{lc_rec.id}.dbxm").read_bytes()
+    lc_got = wire.metrics_from_bytes(lc_blob)
+    lc_series = data.from_wire_bytes(lc_rec.ohlcv)
+    lc_panel = type(lc_series)(*(jnp.asarray(np.asarray(f))[None, :]
+                                 for f in lc_series))
+    lc_want = sweep.jit_sweep(
+        lc_panel, base.get_strategy("momentum"),
+        dict(sweep.product_grid(
+            **{k: jnp.asarray(v) for k, v in lc_grid.items()})),
+        cost=1e-3)
+    for name in lc_want._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(lc_got, name)),
+            np.asarray(getattr(lc_want, name))[0],
+            rtol=5e-4, atol=5e-5, err_msg=f"long-context/{name}")
 
 
 def test_two_process_distributed_sharded_sweep(tmp_path):
