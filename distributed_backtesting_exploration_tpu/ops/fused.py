@@ -26,6 +26,7 @@ Numerics match :func:`~..parallel.sweep.run_sweep` +
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -102,6 +103,18 @@ def _shift_t(x, s: int, fill: float):
         return jnp.full_like(x, fill)
     pad = jnp.full(x.shape[:-1] + (s,), fill, x.dtype)
     return jnp.concatenate([pad, x[..., :T - s]], axis=-1)
+
+
+def _rot_lanes(x, w: int):
+    """``y[..., t] = x[..., (t - w) mod T`` — static rotate along the lane
+    (minor) axis, expressed as a two-slice concat Mosaic lowers to lane
+    rotations. Used by the in-kernel table builders (callers mask the
+    wrapped region before use)."""
+    T = x.shape[-1]
+    w = w % T
+    if w == 0:
+        return x
+    return jnp.concatenate([x[..., T - w:], x[..., :T - w]], axis=-1)
 
 
 def _shift_down(x, k: int, fill: float):
@@ -262,22 +275,22 @@ def _sma_table(close_p, windows: tuple, W_pad: int):
     return jnp.stack(rows, axis=1)                       # (N, W_pad, T_pad)
 
 
-def _kernel(r_ref, sma_ref, of_ref, os_ref, warm_ref, *refs,
-            cost: float, ppy: int, T_real: int | None):
-    tr, out_ref = _unpack_tr(refs, T_real)
-    T_pad = r_ref.shape[1]
-    r = r_ref[0]                     # (T_pad, 1) -> broadcasts over lanes
-    sma = sma_ref[0]                 # (W_pad, T_pad) — W-major table
-    # Per-lane window selection as MXU contractions over the table's
-    # LEADING window axis (the W-major layout lets the host program build
-    # the table with static shifts instead of a gather — the gather
-    # version measured ~37% of the whole sweep; bench.py roofline_stages).
-    # ONE selection matmul on the DIFFERENCE one-hot (+1 at the fast row,
-    # -1 at the slow row): each lane's contraction has exactly two nonzero
-    # terms, so d == sma_fast - sma_slow and sign(d) is the crossover —
-    # half the MXU work of selecting f and s separately. HIGHEST precision:
-    # the default bf16 pass truncates price-level SMAs enough to flip
-    # sign(d) near crossovers.
+def _sma_select_and_score(sma, r, of_ref, os_ref, warm_ref, tr, out_ref, *,
+                          cost: float, ppy: int):
+    """Shared SMA selection + metrics tail (both table substrates feed it).
+
+    Per-lane window selection as MXU contractions over the table's
+    LEADING window axis (the W-major layout lets the table build use
+    static shifts instead of a gather — the gather version measured ~37%
+    of the whole sweep; bench.py roofline_stages).
+    ONE selection matmul on the DIFFERENCE one-hot (+1 at the fast row,
+    -1 at the slow row): each lane's contraction has exactly two nonzero
+    terms, so d == sma_fast - sma_slow and sign(d) is the crossover —
+    half the MXU work of selecting f and s separately. HIGHEST precision:
+    the default bf16 pass truncates price-level SMAs enough to flip
+    sign(d) near crossovers.
+    """
+    T_pad = sma.shape[1]
     d = jax.lax.dot_general(
         sma, of_ref[:] - os_ref[:], (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -293,20 +306,85 @@ def _kernel(r_ref, sma_ref, of_ref, os_ref, warm_ref, *refs,
     out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
 
 
+def _kernel(r_ref, sma_ref, of_ref, os_ref, warm_ref, *refs,
+            cost: float, ppy: int, T_real: int | None):
+    tr, out_ref = _unpack_tr(refs, T_real)
+    r = r_ref[0]                     # (T_pad, 1) -> broadcasts over lanes
+    sma = sma_ref[0]                 # (W_pad, T_pad) — W-major table
+    _sma_select_and_score(sma, r, of_ref, os_ref, warm_ref, tr, out_ref,
+                          cost=cost, ppy=ppy)
+
+
+def _kernel_inline(r_ref, cs_ref, of_ref, os_ref, warm_ref, *refs,
+                   cost: float, ppy: int, T_real: int | None,
+                   windows: tuple, W_pad: int):
+    """The `_kernel` selection design with IN-KERNEL table construction.
+
+    Instead of streaming an XLA-built ``(N, W_pad, T_pad)`` SMA table from
+    HBM, this variant takes only the close cumsum ``(N, 1, T_pad)`` and
+    rebuilds the W-major table into a persistent VMEM scratch once per
+    ticker — at param-block ``j == 0``; the Pallas TPU grid is sequential
+    (last axis innermost), so the scratch built there is still live for
+    ``j = 1..n_blocks-1``. Row values use the exact op sequence of
+    :func:`_sma_table` (sub, div by ``float32(w)``, warmup mask); the
+    rotate's wrapped lanes are zeroed before the subtraction, reproducing
+    ``_shift_t``'s zero fill. On CPU (interpret) the result is
+    bit-identical to the HBM-table path (tested incl. multi-block). On
+    TPU, Mosaic and XLA lower the f32 division differently, so some table
+    entries differ by 1 ULP (measured: ~8% of entries for larger windows),
+    which can flip knife-edge crossovers in ~0.01% of backtests — the same
+    rounding class as the MXU selection matmul, and within every verify
+    budget (bench --verify with this substrate: SMA 0/40000 entry flips,
+    0 best-param flips). This removes the XLA table passes + the table
+    HBM round-trip (measured ~4-5% median end-to-end, DESIGN.md).
+    """
+    *head, sma_scr = refs
+    tr, out_ref = _unpack_tr(tuple(head), T_real)
+    T_pad = r_ref.shape[1]
+
+    @pl.when(pl.program_id(1) == 0)
+    def _build():
+        cs = cs_ref[0]                                     # (1, T_pad)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, T_pad), 1)
+        for k, w in enumerate(windows):
+            w = int(w)
+            if w < T_pad:
+                shifted = jnp.where(lane >= w, _rot_lanes(cs, w), 0.0)
+            else:
+                shifted = jnp.zeros_like(cs)
+            sma_w = (cs - shifted) / jnp.float32(w)
+            sma_scr[k:k + 1, :] = jnp.where(lane >= w - 1, sma_w, 0.0)
+        for k in range(len(windows), W_pad):
+            # One-hot weights are zero on pad rows, but 0 * garbage VMEM
+            # could still be NaN — zero them.
+            sma_scr[k:k + 1, :] = jnp.zeros((1, T_pad), jnp.float32)
+
+    r = r_ref[0]
+    _sma_select_and_score(sma_scr[:], r, of_ref, os_ref, warm_ref, tr,
+                          out_ref, cost=cost, ppy=ppy)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
-                     "ppy", "interpret"))
+                     "ppy", "interpret", "table"))
 def _fused_call(close, onehot_f, onehot_s, warm, t_real, *, windows: tuple,
                 T_pad: int, W_pad: int, P_real: int, T_real: int | None,
-                cost: float, ppy: int, interpret: bool):
+                cost: float, ppy: int, interpret: bool,
+                table: str = "inline"):
     """Table prep + pallas call in ONE jit: the prep is ~500 XLA ops and must
     not run eagerly (each eager op is a dispatch round-trip on the remote-
-    proxy TPU backend — measured 13x slower end-to-end)."""
+    proxy TPU backend — measured 13x slower end-to-end).
+
+    ``table`` selects the SMA-table substrate: ``"inline"`` rebuilds it in
+    VMEM scratch inside the kernel (`_kernel_inline` — no XLA table passes,
+    no table HBM round-trip); ``"hbm"`` is the classic XLA-built
+    ``(N, W_pad, T_pad)`` table streamed per ticker (`_kernel`), kept as
+    the A/B twin the roofline stages are cut from. Bit-identical on CPU;
+    on TPU see `_kernel_inline` for the 1-ULP division-lowering caveat.
+    """
     N, T = close.shape
     close_p = _pad_last(close, T_pad)
-    sma_table = _sma_table(close_p, windows, W_pad)
-
     returns3 = _rets3(close_p)
     P_pad = onehot_f.shape[1]
     # Widest legal param block up to 512 lanes: fewer, wider cells
@@ -319,15 +397,30 @@ def _fused_call(close, onehot_f, onehot_s, warm, t_real, *, windows: tuple,
             break
     n_blocks = P_pad // lanes
     grid = (N, n_blocks)
-    kernel = functools.partial(_kernel, cost=cost, ppy=ppy, T_real=T_real)
+    if table == "inline":
+        cs = jnp.cumsum(close_p, axis=1)[:, None, :]       # (N, 1, T_pad)
+        kernel = functools.partial(_kernel_inline, cost=cost, ppy=ppy,
+                                   T_real=T_real, windows=windows,
+                                   W_pad=W_pad)
+        table_arg = cs
+        table_spec = pl.BlockSpec((1, 1, T_pad), lambda i, j: (i, 0, 0),
+                                  memory_space=pltpu.VMEM)
+        scratch = [pltpu.VMEM((W_pad, T_pad), jnp.float32)]
+    else:
+        sma_table = _sma_table(close_p, windows, W_pad)
+        kernel = functools.partial(_kernel, cost=cost, ppy=ppy,
+                                   T_real=T_real)
+        table_arg = sma_table
+        table_spec = pl.BlockSpec((1, W_pad, T_pad), lambda i, j: (i, 0, 0),
+                                  memory_space=pltpu.VMEM)
+        scratch = []
     out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, T_pad, 1), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, W_pad, T_pad), lambda i, j: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
+            table_spec,
             pl.BlockSpec((W_pad, lanes), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((W_pad, lanes), lambda i, j: (0, j),
@@ -340,8 +433,9 @@ def _fused_call(close, onehot_f, onehot_s, warm, t_real, *, windows: tuple,
             memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(
             (N, n_blocks, _METRIC_ROWS, lanes), jnp.float32),
+        scratch_shapes=scratch,
         interpret=interpret,
-    )(returns3, sma_table, onehot_f, onehot_s, warm,
+    )(returns3, table_arg, onehot_f, onehot_s, warm,
       *_tr_args(t_real, T_real))
     # (N, n_blocks, 16, 128) -> nine (N, P_real) fields. The slice to P_real
     # stays inside the jit: eagerly slicing nine arrays after the call costs
@@ -353,7 +447,8 @@ def _fused_call(close, onehot_f, onehot_s, warm, t_real, *, windows: tuple,
 
 def fused_sma_sweep(close, fast, slow, *, t_real=None, cost: float = 0.0,
                     periods_per_year: int = 252,
-                    interpret: bool | None = None) -> Metrics:
+                    interpret: bool | None = None,
+                    table: str | None = None) -> Metrics:
     """Fused SMA-crossover sweep: ``(N, T)`` closes x ``(P,)`` param lanes.
 
     ``fast``/``slow`` are the *flat* per-combo window arrays (use
@@ -364,6 +459,16 @@ def fused_sma_sweep(close, fast, slow, *, t_real=None, cost: float = 0.0,
     TPU the MXU's 3xbf16 selection matmul can flip a *knife-edge* crossover
     (|fast_sma - slow_sma| ~ 1e-7 relative) — measured ~1 backtest in 8000
     differing by one round-trip on GBM data, all other entries tight.
+
+    ``table`` picks the SMA-table substrate (default env ``DBX_SMA_TABLE``
+    or ``"inline"``): ``"inline"`` rebuilds the W-major table in VMEM
+    scratch inside the kernel once per ticker — no XLA table passes, no
+    table HBM round-trip, measured ~1.04x median / up to ~1.15x the
+    ``"hbm"`` headline on-chip — while ``"hbm"`` streams the XLA-built
+    table (the roofline_stages scaffold's twin). Bit-identical on CPU
+    (tested); on TPU the substrates can differ at ~0.01% of knife-edge
+    crossovers (1-ULP division lowering, see `_kernel_inline`) — the
+    fused-vs-generic verify budgets hold for both (bench --verify).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -375,13 +480,17 @@ def fused_sma_sweep(close, fast, slow, *, t_real=None, cost: float = 0.0,
 
     windows, onehot_f, onehot_s, warm = _grid_setup(
         fast.astype(np.float32).tobytes(), slow.astype(np.float32).tobytes())
+    if table is None:
+        table = os.environ.get("DBX_SMA_TABLE", "inline")
+    if table not in ("inline", "hbm"):
+        raise ValueError(f"table must be 'inline' or 'hbm', got {table!r}")
     return _fused_call(close, onehot_f, onehot_s, warm,
                        _t_real_col(t_real, close),
                        windows=windows,
                        T_pad=_round_up(T, 8), W_pad=onehot_f.shape[0],
                        P_real=P, T_real=T if t_real is None else None,
                        cost=float(cost), ppy=int(periods_per_year),
-                       interpret=bool(interpret))
+                       interpret=bool(interpret), table=table)
 
 
 def _prefix_compose3(pm, p0, pp):

@@ -59,6 +59,67 @@ def test_fused_rejects_non_integer_windows():
             jnp.ones((1, 64)), np.asarray([3.5]), np.asarray([10.0]))
 
 
+def test_fused_inline_table_matches_hbm_table():
+    # The in-kernel (VMEM-scratch) table build must be BIT-identical to the
+    # XLA-built HBM table path — same op sequence per row, wrapped rotate
+    # lanes zeroed like _shift_t's fill (ops/fused.py `_kernel_inline`).
+    ohlcv = data.synthetic_ohlcv(3, 300, seed=11)
+    close = jnp.asarray(ohlcv.close)
+    grid = sweep.product_grid(fast=jnp.asarray([3, 5, 8], jnp.float32),
+                              slow=jnp.asarray([13, 21, 34], jnp.float32))
+    fa, sl = np.asarray(grid["fast"]), np.asarray(grid["slow"])
+    a = fused.fused_sma_sweep(close, fa, sl, cost=1e-3, table="hbm")
+    b = fused.fused_sma_sweep(close, fa, sl, cost=1e-3, table="inline")
+    for name in a._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, name)), np.asarray(getattr(b, name)),
+            err_msg=name)
+
+
+def test_fused_inline_table_multi_block_scratch_persists():
+    # 25x25 = 625 combos -> P_pad 640 -> lanes 128 -> n_blocks = 5: the
+    # VMEM-scratch table is built at param-block j == 0 only and must
+    # still be live (and correct) for j = 1..4. A stale/garbage scratch
+    # would corrupt every combo beyond the first 128 lanes while the
+    # single-block tests stay green.
+    ohlcv = data.synthetic_ohlcv(2, 220, seed=13)
+    close = jnp.asarray(ohlcv.close)
+    grid = sweep.product_grid(
+        fast=jnp.arange(3, 28, dtype=jnp.float32),
+        slow=jnp.arange(30, 80, 2, dtype=jnp.float32))
+    fa, sl = np.asarray(grid["fast"]), np.asarray(grid["slow"])
+    assert fa.size == 625
+    a = fused.fused_sma_sweep(close, fa, sl, cost=1e-3, table="hbm")
+    b = fused.fused_sma_sweep(close, fa, sl, cost=1e-3, table="inline")
+    for name in a._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, name)), np.asarray(getattr(b, name)),
+            err_msg=name)
+
+
+def test_fused_inline_table_matches_hbm_table_ragged():
+    ohlcv = data.synthetic_ohlcv(3, 300, seed=12)
+    close = jnp.asarray(ohlcv.close)
+    t_real = np.asarray([300, 251, 170], np.int32)
+    fa = np.asarray([4.0, 6.0], np.float32)
+    sl = np.asarray([17.0, 29.0], np.float32)
+    a = fused.fused_sma_sweep(close, fa, sl, t_real=t_real, cost=1e-3,
+                              table="hbm")
+    b = fused.fused_sma_sweep(close, fa, sl, t_real=t_real, cost=1e-3,
+                              table="inline")
+    for name in a._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, name)), np.asarray(getattr(b, name)),
+            err_msg=name)
+
+
+def test_fused_rejects_unknown_table_mode():
+    with pytest.raises(ValueError, match="table"):
+        fused.fused_sma_sweep(
+            jnp.ones((1, 64)), np.asarray([3.0]), np.asarray([10.0]),
+            table="nope")
+
+
 def _check_boll(n_tickers, T, window_axis, k_axis, cost=1e-3, seed=0,
                 z_exit=0.0):
     ohlcv = data.synthetic_ohlcv(n_tickers, T, seed=seed)
