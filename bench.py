@@ -475,11 +475,23 @@ def main():
         def run_mom():
             return fused.fused_momentum_sweep(panel.close, mlbs, cost=1e-3)
 
+        # Default substrate is the in-kernel past-close table (VMEM
+        # scratch, `_mom_kernel_inline`; measured +4% median / +8% best
+        # over the XLA-gather table on this grid): no table HBM stream.
+        mom_inline = os.environ.get("DBX_MOM_TABLE", "inline") == "inline"
+        mom_model = _model(TAIL + 4, np.unique(mlbs).size, mlbs.size,
+                           prep_passes=0 if mom_inline else 2)
+        if mom_inline:
+            mom_p_pad = -(-mlbs.size // 128) * 128
+            # 3 streamed rows per ticker: returns column, close column
+            # (the tail's `close - past`), and the close-row aux the
+            # builder rotates (SMA streams only cs + returns = 2).
+            mom_model["hbm"] = 4.0 * 3 / mom_p_pad
+            mom_model["vpu"] += 4.0 * np.unique(mlbs).size * 8 / mom_p_pad
         rates["momentum_fused"] = _measure(
             run_mom, n_tickers * len(mlbs), iters=iters, warmup=warmup,
             name="momentum_fused", n_bars=n_bars,
-            model=_model(TAIL + 4, np.unique(mlbs).size, mlbs.size,
-                         prep_passes=2))
+            model=mom_model)
 
     if enabled("donchian_fused"):
         dwins = np.tile(np.arange(10, 135, dtype=np.float32),

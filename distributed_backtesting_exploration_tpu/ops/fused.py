@@ -480,10 +480,7 @@ def fused_sma_sweep(close, fast, slow, *, t_real=None, cost: float = 0.0,
 
     windows, onehot_f, onehot_s, warm = _grid_setup(
         fast.astype(np.float32).tobytes(), slow.astype(np.float32).tobytes())
-    if table is None:
-        table = os.environ.get("DBX_SMA_TABLE", "inline")
-    if table not in ("inline", "hbm"):
-        raise ValueError(f"table must be 'inline' or 'hbm', got {table!r}")
+    table = _resolve_table(table, "DBX_SMA_TABLE", "inline")
     return _fused_call(close, onehot_f, onehot_s, warm,
                        _t_real_col(t_real, close),
                        windows=windows,
@@ -1148,17 +1145,16 @@ def _ema_rows(x, alpha: float):
     return rolling.ema_ladder(x, alpha=jnp.float32(alpha))
 
 
-def _mom_kernel(r_ref, c_ref, past_ref, ol_ref, warm_ref, *refs,
-                cost: float, ppy: int, T_real: int | None):
-    """Momentum cell: the signal is exact — the past-close table holds raw
-    close values, the one-hot contraction copies one of them per lane, and
+def _mom_signal_tail(past_tbl, r, close, ol_ref, warm_ref, tr, out_ref, *,
+                     cost: float, ppy: int):
+    """Shared momentum selection + metrics tail (both table substrates).
+
+    The signal is exact — the past-close table holds raw close values, the
+    one-hot contraction copies one of them per lane, and
     ``sign(close - past)`` involves no rounding at all."""
-    tr, out_ref = _unpack_tr(refs, T_real)
-    T_pad = r_ref.shape[1]
-    r = r_ref[0]                       # (T_pad, 1)
-    close = c_ref[0]                   # (T_pad, 1)
+    T_pad = past_tbl.shape[1]
     dn = (((0,), (0,)), ((), ()))
-    past = jax.lax.dot_general(past_ref[0], ol_ref[:], dn,
+    past = jax.lax.dot_general(past_tbl, ol_ref[:], dn,
                                preferred_element_type=jnp.float32,
                                precision=jax.lax.Precision.HIGHEST)
 
@@ -1169,27 +1165,61 @@ def _mom_kernel(r_ref, c_ref, past_ref, ol_ref, warm_ref, *refs,
     out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
 
 
-def _don_kernel(r_ref, c_ref, sig_ref, ow_ref, warm_ref, *refs,
+def _mom_kernel(r_ref, c_ref, past_ref, ol_ref, warm_ref, *refs,
                 cost: float, ppy: int, T_real: int | None):
-    """Donchian cell: breakout-sign selection + the latch machine as a
-    3-state prefix composition (breakout latches the position until the
-    opposite channel is touched — associative like the band machine, so
-    the same log-depth ladder applies; mirrors ``models.donchian``'s
-    lax.scan).
-
-    The per-(ticker, window) breakout sign (+1 above the prior channel
-    high, -1 below the prior low, up wins) is precomputed in prep — ONE
-    table and one selection matmul where separate high/low channel tables
-    would need two of each. The one-hot contraction copies exact values
-    in {-1, 0, +1}, so thresholding at ±0.5 recovers the booleans
-    exactly. The close column (``c_ref``) is unused here; it rides the
-    shared momentum/donchian plumbing (:func:`_single_window_pallas`)."""
-    del c_ref
     tr, out_ref = _unpack_tr(refs, T_real)
+    _mom_signal_tail(past_ref[0], r_ref[0], c_ref[0], ol_ref, warm_ref, tr,
+                     out_ref, cost=cost, ppy=ppy)
+
+
+def _mom_kernel_inline(r_ref, c_ref, crow_ref, ol_ref, warm_ref, *refs,
+                       cost: float, ppy: int, T_real: int | None,
+                       windows: tuple, W_pad: int):
+    """Momentum with the past-close table built in VMEM scratch.
+
+    The XLA prep's table is a clipped gather ``close_p[max(t - w, 0)]``;
+    here each distinct lookback's row is a lane-rotate of the close row
+    with the wrapped region replaced by ``close_p[0]`` — the same values
+    bit-for-bit (raw closes, no arithmetic), so this substrate is exact
+    on every backend, unlike the SMA inline table's division caveat.
+    Built once per ticker at param-block ``j == 0`` (see `_kernel_inline`
+    for the scratch-persistence contract)."""
+    *head, past_scr = refs
+    tr, out_ref = _unpack_tr(tuple(head), T_real)
     T_pad = r_ref.shape[1]
-    r = r_ref[0]
+
+    @pl.when(pl.program_id(1) == 0)
+    def _build():
+        crow = crow_ref[0]                                 # (1, T_pad)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, T_pad), 1)
+        first = crow[:, :1]                                # clip-gather fill
+        for k, w in enumerate(windows):
+            w = int(w)
+            if w < T_pad:
+                row = jnp.where(lane >= w, _rot_lanes(crow, w), first)
+            else:
+                row = jnp.broadcast_to(first, crow.shape)
+            past_scr[k:k + 1, :] = row
+        for k in range(len(windows), W_pad):
+            past_scr[k:k + 1, :] = jnp.zeros((1, T_pad), jnp.float32)
+
+    _mom_signal_tail(past_scr[:], r_ref[0], c_ref[0], ol_ref, warm_ref, tr,
+                     out_ref, cost=cost, ppy=ppy)
+
+
+def _don_latch_tail(sig_tbl, r, ow_ref, warm_ref, tr, out_ref, *,
+                    cost: float, ppy: int):
+    """Shared Donchian breakout-sign selection + latch machine + metrics.
+
+    The latch machine is a 3-state prefix composition (breakout latches
+    the position until the opposite channel is touched — associative like
+    the band machine, so the same log-depth ladder applies; mirrors
+    ``models.donchian``'s lax.scan). The one-hot contraction copies exact
+    values in {-1, 0, +1}, so thresholding at ±0.5 recovers the booleans
+    exactly."""
+    T_pad = sig_tbl.shape[1]
     dn = (((0,), (0,)), ((), ()))
-    s = jax.lax.dot_general(sig_ref[0], ow_ref[:], dn,
+    s = jax.lax.dot_general(sig_tbl, ow_ref[:], dn,
                             preferred_element_type=jnp.float32,
                             precision=jax.lax.Precision.HIGHEST)
     up = s > 0.5
@@ -1208,12 +1238,107 @@ def _don_kernel(r_ref, c_ref, sig_ref, ow_ref, warm_ref, *refs,
     out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
 
 
+def _don_kernel(r_ref, c_ref, sig_ref, ow_ref, warm_ref, *refs,
+                cost: float, ppy: int, T_real: int | None):
+    """Donchian cell over the XLA-built breakout-sign table.
+
+    The per-(ticker, window) breakout sign (+1 above the prior channel
+    high, -1 below the prior low, up wins) is precomputed in prep — ONE
+    table and one selection matmul where separate high/low channel tables
+    would need two of each. The close column (``c_ref``) is unused here;
+    it rides the shared momentum/donchian plumbing
+    (:func:`_single_window_pallas`)."""
+    del c_ref
+    tr, out_ref = _unpack_tr(refs, T_real)
+    _don_latch_tail(sig_ref[0], r_ref[0], ow_ref, warm_ref, tr, out_ref,
+                    cost=cost, ppy=ppy)
+
+
+def _don_kernel_inline(r_ref, c_ref, crow_ref, hi_ref, lo_ref, ow_ref,
+                       warm_ref, *refs, cost: float, ppy: int,
+                       T_real: int | None, windows: tuple, W_pad: int):
+    """Donchian with the breakout-sign table built in VMEM scratch.
+
+    Rebuilds `_extrema_table`'s shared sparse-table range query in-kernel
+    from the raw high/low rows — log2(max window) doubling levels once,
+    then each window's channel is the max/min of two overlapping spans —
+    and compares the close row against the 1-bar-shifted channels to form
+    the ±1/0 sign rows. Max/min and comparisons of raw prices are exact,
+    so this substrate matches the XLA-table path bit-for-bit on every
+    backend (same algebra, same neutral fills). Built once per ticker at
+    param-block ``j == 0`` (see `_kernel_inline` for the scratch
+    contract)."""
+    del c_ref
+    *head, sig_scr = refs
+    tr, out_ref = _unpack_tr(tuple(head), T_real)
+    T_pad = r_ref.shape[1]
+
+    @pl.when(pl.program_id(1) == 0)
+    def _build():
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, T_pad), 1)
+
+        def shifted_row(row, s: int, fill: float):
+            # `_shift_t`'s semantics on a (1, T_pad) lane-major row.
+            if s == 0:
+                return row
+            if s >= T_pad:
+                return jnp.full_like(row, fill)
+            return jnp.where(lane >= s, _rot_lanes(row, s), fill)
+
+        def levels_of(src, op, neutral: float):
+            max_k = max(int(w).bit_length() - 1 for w in windows)
+            levels = [src]
+            for k in range(max_k):
+                levels.append(op(levels[k],
+                                 shifted_row(levels[k], 1 << k, neutral)))
+            return levels
+
+        # Only the two log2(max window) level stacks stay live; each
+        # window's channel combine + prior-bar shift + breakout compare
+        # fuses into its own loop step. (Materializing all per-window
+        # rows first OOMs VMEM stack: a (1, T_pad) row occupies a full
+        # 8-sublane tile, so 2 x W live rows is ~16x the scratch size.)
+        hi_levels = levels_of(hi_ref[0], jnp.maximum, float("-inf"))
+        lo_levels = levels_of(lo_ref[0], jnp.minimum, float("inf"))
+        crow = crow_ref[0]
+        for k, w in enumerate(windows):
+            w = int(w)
+            kk = w.bit_length() - 1             # largest 2^kk <= w
+            hi = jnp.maximum(hi_levels[kk],
+                             shifted_row(hi_levels[kk], w - (1 << kk),
+                                         float("-inf")))
+            lo = jnp.minimum(lo_levels[kk],
+                             shifted_row(lo_levels[kk], w - (1 << kk),
+                                         float("inf")))
+            hi = jnp.where(lane >= w - 1, hi, 1e30)
+            lo = jnp.where(lane >= w - 1, lo, -1e30)
+            hi_prev = shifted_row(hi, 1, 1e30)
+            lo_prev = shifted_row(lo, 1, -1e30)
+            sig_scr[k:k + 1, :] = jnp.where(
+                crow >= hi_prev, 1.0,
+                jnp.where(crow <= lo_prev, -1.0, 0.0))
+        for k in range(len(windows), W_pad):
+            sig_scr[k:k + 1, :] = jnp.zeros((1, T_pad), jnp.float32)
+
+    _don_latch_tail(sig_scr[:], r_ref[0], ow_ref, warm_ref, tr, out_ref,
+                    cost=cost, ppy=ppy)
+
+
 def _single_window_pallas(kernel, close, tables, onehot_w, warm, t_real, *,
                           T_pad: int, W_pad: int, P_real: int,
-                          T_real: int | None, interpret: bool):
+                          T_real: int | None, interpret: bool,
+                          aux_rows=(), scratch_shapes=()):
     """Shared pallas_call plumbing for the momentum/donchian kernels:
     returns + close columns, one or two (N, W_pad, T_pad) tables, the
-    one-hot/warmup lanes, optional ragged lengths."""
+    one-hot/warmup lanes, optional ragged lengths.
+
+    ``aux_rows`` are extra ``(N, T_pad)`` series delivered to the kernel as
+    ``(1, 1, T_pad)`` lane-major rows (T on lanes), and ``scratch_shapes``
+    are forwarded to ``pallas_call`` — together they carry the in-kernel
+    (VMEM-scratch) table builders, which take raw series rows instead of
+    XLA-built ``(N, W_pad, T_pad)`` tables (see `_kernel_inline` for the
+    pattern and the scratch-persistence contract).
+    """
     N = close.shape[0]
     P_pad = onehot_w.shape[1]
     n_blocks = P_pad // _LANES
@@ -1221,6 +1346,11 @@ def _single_window_pallas(kernel, close, tables, onehot_w, warm, t_real, *,
         pl.BlockSpec((1, W_pad, T_pad), lambda i, j: (i, 0, 0),
                      memory_space=pltpu.VMEM)
         for _ in tables
+    ]
+    aux_specs = [
+        pl.BlockSpec((1, 1, T_pad), lambda i, j: (i, 0, 0),
+                     memory_space=pltpu.VMEM)
+        for _ in aux_rows
     ]
     out = pl.pallas_call(
         kernel,
@@ -1230,7 +1360,7 @@ def _single_window_pallas(kernel, close, tables, onehot_w, warm, t_real, *,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, T_pad, 1), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-        ] + table_specs + [
+        ] + table_specs + aux_specs + [
             pl.BlockSpec((W_pad, _LANES), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
@@ -1241,8 +1371,10 @@ def _single_window_pallas(kernel, close, tables, onehot_w, warm, t_real, *,
             memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(
             (N, n_blocks, _METRIC_ROWS, _LANES), jnp.float32),
+        scratch_shapes=list(scratch_shapes),
         interpret=interpret,
-    )(_rets3(close), close[..., None], *tables, onehot_w, warm,
+    )(_rets3(close), close[..., None], *tables,
+      *(row[:, None, :] for row in aux_rows), onehot_w, warm,
       *_tr_args(t_real, T_real))
     return Metrics(*(
         jnp.reshape(out[:, :, k, :], (N, P_pad))[:, :P_real]
@@ -1252,13 +1384,29 @@ def _single_window_pallas(kernel, close, tables, onehot_w, warm, t_real, *,
 @functools.partial(
     jax.jit,
     static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
-                     "ppy", "interpret"))
+                     "ppy", "interpret", "table"))
 def _fused_mom_call(close, onehot_l, warm, t_real, *, windows: tuple,
                     T_pad: int, W_pad: int, P_real: int, T_real: int | None,
-                    cost: float, ppy: int, interpret: bool):
-    """Past-close table prep + pallas call in one jit. The table is a single
-    clipped gather of raw closes — exact values, no arithmetic."""
+                    cost: float, ppy: int, interpret: bool,
+                    table: str = "inline"):
+    """Past-close table prep + pallas call in one jit.
+
+    ``table="hbm"``: the table is a single clipped XLA gather of raw
+    closes — exact values, no arithmetic. ``table="inline"`` (default):
+    the kernel rebuilds the same rows in VMEM scratch by lane-rotating the
+    close row (`_mom_kernel_inline`) — bit-identical on every backend (no
+    arithmetic either way), with no XLA gather and no table HBM stream.
+    """
     close_p = _pad_last(close, T_pad)
+    if table == "inline":
+        kernel = functools.partial(_mom_kernel_inline, cost=cost, ppy=ppy,
+                                   T_real=T_real, windows=windows,
+                                   W_pad=W_pad)
+        return _single_window_pallas(
+            kernel, close_p, [], onehot_l, warm, t_real, T_pad=T_pad,
+            W_pad=W_pad, P_real=P_real, T_real=T_real, interpret=interpret,
+            aux_rows=[close_p],
+            scratch_shapes=[pltpu.VMEM((W_pad, T_pad), jnp.float32)])
     w_col = jnp.asarray(np.asarray(windows, np.int32))[:, None]  # (W,1)
     t_row = jnp.arange(T_pad)[None, :]
     gather_idx = jnp.clip(t_row - w_col, 0, T_pad - 1)           # (W,T_pad)
@@ -1301,11 +1449,11 @@ def _extrema_table(src_p, windows: tuple, mode: str, warm_fill: float):
 @functools.partial(
     jax.jit,
     static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
-                     "ppy", "interpret"))
+                     "ppy", "interpret", "table"))
 def _fused_don_call(close, hi_src, lo_src, onehot_w, warm, t_real, *,
                     windows: tuple, T_pad: int, W_pad: int, P_real: int,
                     T_real: int | None, cost: float, ppy: int,
-                    interpret: bool):
+                    interpret: bool, table: str = "hbm"):
     """Channel-extrema table prep + pallas call in one jit. Windows are
     static, so all distinct windows' rolling max/min come from one shared
     sparse table (:func:`_extrema_table`); max/min of exact prices is
@@ -1318,8 +1466,26 @@ def _fused_don_call(close, hi_src, lo_src, onehot_w, warm, t_real, *,
     in for the generic path's ±inf warmup fill; the channel values are
     consumed only by prep-side comparisons here (the kernel sees the
     finite sign table), and no finite price ever clears 1e30, so every
-    breakout comparison is identical."""
+    breakout comparison is identical.
+
+    ``table="inline"`` skips the XLA tables entirely: the kernel rebuilds
+    the same sparse-table range query and breakout comparisons in VMEM
+    scratch (`_don_kernel_inline`) — bit-identical on every backend
+    (max/min and compares of raw prices are exact both ways). It measured
+    a wash on-chip, so the shipped default stays ``"hbm"``
+    (DESIGN.md "In-kernel table construction")."""
     close_p = _pad_last(close, T_pad)
+    if table == "inline":
+        kernel = functools.partial(_don_kernel_inline, cost=cost, ppy=ppy,
+                                   T_real=T_real, windows=windows,
+                                   W_pad=W_pad)
+        return _single_window_pallas(
+            kernel, close_p, [], onehot_w, warm, t_real,
+            T_pad=T_pad, W_pad=W_pad, P_real=P_real, T_real=T_real,
+            interpret=interpret,
+            aux_rows=[close_p, _pad_last(hi_src, T_pad),
+                      _pad_last(lo_src, T_pad)],
+            scratch_shapes=[pltpu.VMEM((W_pad, T_pad), jnp.float32)])
     hi_tbl = _extrema_table(_pad_last(hi_src, T_pad), windows, "max", 1e30)
     lo_tbl = _extrema_table(_pad_last(lo_src, T_pad), windows, "min", -1e30)
     # Channel known at the close of t-1, applied to bar t; collapsing both
@@ -1339,14 +1505,28 @@ def _fused_don_call(close, hi_src, lo_src, onehot_w, warm, t_real, *,
         interpret=interpret)
 
 
+def _resolve_table(table: str | None, env_var: str, default: str) -> str:
+    """Shared table-substrate knob: explicit arg > per-family env > default.
+
+    ``"inline"`` builds the window table in VMEM scratch inside the kernel;
+    ``"hbm"`` streams the XLA-built table (kept as the A/B twin)."""
+    if table is None:
+        table = os.environ.get(env_var, default)
+    if table not in ("inline", "hbm"):
+        raise ValueError(f"table must be 'inline' or 'hbm', got {table!r}")
+    return table
+
+
 def fused_momentum_sweep(close, lookback, *, t_real=None, cost: float = 0.0,
                          periods_per_year: int = 252,
-                         interpret: bool | None = None) -> Metrics:
+                         interpret: bool | None = None,
+                         table: str | None = None) -> Metrics:
     """Fused time-series momentum sweep: ``(N, T)`` closes x ``(P,)`` lanes.
 
     Matches ``run_sweep(..., "momentum")`` with an *exact* signal (the
     past-close selection involves no arithmetic); metrics carry the usual
-    f32 reduction tolerance.
+    f32 reduction tolerance. ``table`` picks the past-close-table substrate
+    (env ``DBX_MOM_TABLE``): both are exact, see :func:`_fused_mom_call`.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -1360,17 +1540,22 @@ def fused_momentum_sweep(close, lookback, *, t_real=None, cost: float = 0.0,
                            W_pad=onehot_l.shape[0], P_real=lookback.shape[0],
                            T_real=T if t_real is None else None,
                            cost=float(cost), ppy=int(periods_per_year),
-                           interpret=bool(interpret))
+                           interpret=bool(interpret),
+                           table=_resolve_table(table, "DBX_MOM_TABLE",
+                                                "inline"))
 
 
 def fused_donchian_sweep(close, window, *, t_real=None, cost: float = 0.0,
                          periods_per_year: int = 252,
-                         interpret: bool | None = None) -> Metrics:
+                         interpret: bool | None = None,
+                         table: str | None = None) -> Metrics:
     """Fused Donchian-breakout sweep: ``(N, T)`` closes x ``(P,)`` lanes.
 
     Matches ``run_sweep(..., "donchian")``: the channel extrema are exact
     (max/min of raw closes), so breakout comparisons and the latch path are
     bit-identical to the generic scan; metrics carry f32 tolerance.
+    ``table`` picks the sign-table substrate (env ``DBX_DON_TABLE``): both
+    are exact, see :func:`_fused_don_call`.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -1385,12 +1570,15 @@ def fused_donchian_sweep(close, window, *, t_real=None, cost: float = 0.0,
                            W_pad=onehot_w.shape[0], P_real=window.shape[0],
                            T_real=T if t_real is None else None,
                            cost=float(cost), ppy=int(periods_per_year),
-                           interpret=bool(interpret))
+                           interpret=bool(interpret),
+                           table=_resolve_table(table, "DBX_DON_TABLE",
+                                                "hbm"))
 
 
 def fused_donchian_hl_sweep(close, high, low, window, *, t_real=None,
                             cost: float = 0.0, periods_per_year: int = 252,
-                            interpret: bool | None = None) -> Metrics:
+                            interpret: bool | None = None,
+                            table: str | None = None) -> Metrics:
     """Fused high/low-channel Donchian sweep: ``(N, T)`` panels x ``(P,)``.
 
     Matches ``run_sweep(..., "donchian_hl")`` — breakout when the close
@@ -1414,7 +1602,9 @@ def fused_donchian_hl_sweep(close, high, low, window, *, t_real=None,
                            W_pad=onehot_w.shape[0], P_real=window.shape[0],
                            T_real=T if t_real is None else None,
                            cost=float(cost), ppy=int(periods_per_year),
-                           interpret=bool(interpret))
+                           interpret=bool(interpret),
+                           table=_resolve_table(table, "DBX_DON_TABLE",
+                                                "hbm"))
 
 
 @functools.partial(

@@ -120,6 +120,49 @@ def test_fused_rejects_unknown_table_mode():
             table="nope")
 
 
+def test_momentum_donchian_inline_tables_match_hbm():
+    # The momentum past-close and Donchian breakout-sign in-kernel tables
+    # involve no arithmetic (rotate / max / compare of raw prices), so
+    # unlike the SMA inline table they must be bit-identical to the
+    # XLA-table substrate on EVERY backend. 41 lookbacks -> P_pad 256 ->
+    # n_blocks 2 also covers the scratch-persistence window.
+    ohlcv = data.synthetic_ohlcv(3, 300, seed=21)
+    close = jnp.asarray(ohlcv.close)
+    high = jnp.asarray(ohlcv.high)
+    low = jnp.asarray(ohlcv.low)
+    lb = np.arange(4, 86, 2, dtype=np.float32)
+    assert lb.size == 41
+    cases = [
+        ("momentum", lambda m: fused.fused_momentum_sweep(
+            close, lb, cost=1e-3, table=m)),
+        ("donchian", lambda m: fused.fused_donchian_sweep(
+            close, lb, cost=1e-3, table=m)),
+        ("donchian_hl", lambda m: fused.fused_donchian_hl_sweep(
+            close, high, low, lb, cost=1e-3, table=m)),
+    ]
+    for name, mk in cases:
+        a, b = mk("hbm"), mk("inline")
+        for field in a._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a, field)), np.asarray(getattr(b, field)),
+                err_msg=f"{name}.{field}")
+
+
+def test_momentum_inline_table_ragged_matches_hbm():
+    ohlcv = data.synthetic_ohlcv(3, 300, seed=22)
+    close = jnp.asarray(ohlcv.close)
+    t_real = np.asarray([300, 240, 130], np.int32)
+    lb = np.asarray([5.0, 20.0, 63.0], np.float32)
+    a = fused.fused_momentum_sweep(close, lb, t_real=t_real, cost=1e-3,
+                                   table="hbm")
+    b = fused.fused_momentum_sweep(close, lb, t_real=t_real, cost=1e-3,
+                                   table="inline")
+    for field in a._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, field)), np.asarray(getattr(b, field)),
+            err_msg=field)
+
+
 def _check_boll(n_tickers, T, window_axis, k_axis, cost=1e-3, seed=0,
                 z_exit=0.0):
     ohlcv = data.synthetic_ohlcv(n_tickers, T, seed=seed)
