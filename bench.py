@@ -634,11 +634,21 @@ def main():
             return fused.fused_obv_sweep(panel.close, panel.volume, ow,
                                          cost=1e-3)
 
+        # Default substrate is the in-kernel SMA-of-OBV table (VMEM
+        # scratch, `_obv_kernel_inline`; measured 23.9 -> 25.3 M/s over
+        # the W-major XLA table): the obv/returns/cs rows are the only
+        # HBM streams, the VPU term gains the amortized table build.
+        obv_inline = os.environ.get("DBX_OBV_TABLE", "inline") == "inline"
+        obv_model = _model(TAIL + 8, np.unique(ow).size, ow.size,
+                           prep_passes=0 if obv_inline else 2)
+        if obv_inline:
+            obv_p_pad = -(-ow.size // 128) * 128
+            obv_model["hbm"] = 4.0 * 3 / obv_p_pad
+            obv_model["vpu"] += 4.0 * np.unique(ow).size * 8 / obv_p_pad
         rates["obv_fused"] = _measure(
             run_obv, n_tickers * len(ow), iters=iters, warmup=warmup,
             name="obv_fused", n_bars=n_bars,
-            model=_model(TAIL + 8, np.unique(ow).size, ow.size,
-                         prep_passes=5))
+            model=obv_model)
 
     # --- configs[3]: rolling-OLS pairs (lookback, z_entry) ----------------
     if enabled("pairs"):

@@ -45,6 +45,17 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
+def _widest_lanes(P_pad: int, cap: int) -> int:
+    """Widest legal param-block width <= ``cap``: fewer, wider cells
+    amortize per-cell fixed overhead (+16% measured at 512 on the SMA
+    headline — bench.py roofline_stages). Sign kernels take 512; kernels
+    holding a 3-state compose ladder live cap at 256 (VMEM budget)."""
+    for cand in (512, 256, _LANES):
+        if cand <= cap and P_pad >= cand and P_pad % cand == 0:
+            return cand
+    return P_pad
+
+
 def _const(a):
     """Concrete device array, safe to build *inside* a trace.
 
@@ -340,28 +351,36 @@ def _kernel_inline(r_ref, cs_ref, of_ref, os_ref, warm_ref, *refs,
     """
     *head, sma_scr = refs
     tr, out_ref = _unpack_tr(tuple(head), T_real)
-    T_pad = r_ref.shape[1]
 
     @pl.when(pl.program_id(1) == 0)
     def _build():
-        cs = cs_ref[0]                                     # (1, T_pad)
-        lane = jax.lax.broadcasted_iota(jnp.int32, (1, T_pad), 1)
-        for k, w in enumerate(windows):
-            w = int(w)
-            if w < T_pad:
-                shifted = jnp.where(lane >= w, _rot_lanes(cs, w), 0.0)
-            else:
-                shifted = jnp.zeros_like(cs)
-            sma_w = (cs - shifted) / jnp.float32(w)
-            sma_scr[k:k + 1, :] = jnp.where(lane >= w - 1, sma_w, 0.0)
-        for k in range(len(windows), W_pad):
-            # One-hot weights are zero on pad rows, but 0 * garbage VMEM
-            # could still be NaN — zero them.
-            sma_scr[k:k + 1, :] = jnp.zeros((1, T_pad), jnp.float32)
+        _build_sma_scratch(cs_ref[0], sma_scr, windows, W_pad)
 
     r = r_ref[0]
     _sma_select_and_score(sma_scr[:], r, of_ref, os_ref, warm_ref, tr,
                           out_ref, cost=cost, ppy=ppy)
+
+
+def _build_sma_scratch(cs, sma_scr, windows: tuple, W_pad: int):
+    """Fill a ``(W_pad, T_pad)`` VMEM scratch with the W-major SMA table of
+    the series whose cumsum row ``cs`` is ``(1, T_pad)`` — `_sma_table`'s
+    exact op sequence (rotate + zero wrapped lanes, subtract, divide by
+    ``float32(w)``, warmup mask). Shared by the SMA and OBV inline
+    kernels; call under ``pl.when(j == 0)``."""
+    T_pad = cs.shape[-1]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, T_pad), 1)
+    for k, w in enumerate(windows):
+        w = int(w)
+        if w < T_pad:
+            shifted = jnp.where(lane >= w, _rot_lanes(cs, w), 0.0)
+        else:
+            shifted = jnp.zeros_like(cs)
+        sma_w = (cs - shifted) / jnp.float32(w)
+        sma_scr[k:k + 1, :] = jnp.where(lane >= w - 1, sma_w, 0.0)
+    for k in range(len(windows), W_pad):
+        # One-hot weights are zero on pad rows, but 0 * garbage VMEM
+        # could still be NaN — zero them.
+        sma_scr[k:k + 1, :] = jnp.zeros((1, T_pad), jnp.float32)
 
 
 @functools.partial(
@@ -387,14 +406,7 @@ def _fused_call(close, onehot_f, onehot_s, warm, t_real, *, windows: tuple,
     close_p = _pad_last(close, T_pad)
     returns3 = _rets3(close_p)
     P_pad = onehot_f.shape[1]
-    # Widest legal param block up to 512 lanes: fewer, wider cells
-    # amortize per-cell overhead (+16% measured at 512 on the headline
-    # sweep — bench.py roofline_stages); small grids keep one full block.
-    lanes = P_pad
-    for cand in (512, 256, 128):
-        if P_pad >= cand and P_pad % cand == 0:
-            lanes = cand
-            break
+    lanes = _widest_lanes(P_pad, 512)   # sign kernel: no compose ladder
     n_blocks = P_pad // lanes
     grid = (N, n_blocks)
     if table == "inline":
@@ -657,15 +669,9 @@ def _band_machine_pallas(kernel, close_p, z_table, onehot_w, k_lanes, warm,
     lanes into ``_boll_kernel``-shaped cells, :class:`Metrics` out."""
     N = close_p.shape[0]
     P_pad = k_lanes.shape[1]
-    # Wider param blocks amortize per-cell overhead (the fused-SMA
-    # finding, bench.py roofline_stages); capped at 256 here — the
-    # 3-state compose ladder keeps ~6 (T_pad, lanes) arrays live, so 512
-    # lanes would press the VMEM budget the kernels are sized for.
-    lanes = P_pad
-    for cand in (256, _LANES):
-        if P_pad >= cand and P_pad % cand == 0:
-            lanes = cand
-            break
+    # Capped at 256 — the 3-state compose ladder keeps ~6 (T_pad, lanes)
+    # arrays live, so 512 lanes would press the VMEM budget.
+    lanes = _widest_lanes(P_pad, 256)
     n_blocks = P_pad // lanes
     out = pl.pallas_call(
         kernel,
@@ -871,11 +877,12 @@ def _pairs_kernel(zh_ref, ow_ref, k_ref, zx_ref,
     zh = jax.lax.dot_general(zh_ref[0], ow_ref[:], dn,
                              preferred_element_type=jnp.float32,
                              precision=jax.lax.Precision.HIGHEST)
-    z = zh[:T_pad]                                     # (T_pad, 128)
+    z = zh[:T_pad]                                     # (T_pad, lanes)
     hr = zh[T_pad:]                                    # hedged spread return
 
-    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, _LANES), 0)
-    warm = warm_ref[0, :][None, :]                     # (1, 128) = 2*lb - 1
+    lanes = ow_ref.shape[1]          # widest legal param block (launcher)
+    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, lanes), 0)
+    warm = warm_ref[0, :][None, :]                     # (1, lanes) = 2*lb - 1
     valid = t_idx >= (warm.astype(jnp.int32) - 1)
     k = k_ref[0, :][None, :]                           # per-lane z_entry
     zx = zx_ref[0, :][None, :]                         # per-lane z_exit
@@ -987,7 +994,10 @@ def _fused_pairs_call(y_close, x_close, onehot_w, k_lanes, zx_lanes, warm,
     zh_tbl = jnp.concatenate([z_tbl, hr_tbl], axis=2)   # (N, W_pad, 2*T_pad)
 
     P_pad = k_lanes.shape[1]
-    n_blocks = P_pad // _LANES
+    # 256-lane cap: the band ladder + two (T_pad, lanes) selection halves
+    # keep the same VMEM budget class as the band machines.
+    lanes = _widest_lanes(P_pad, 256)
+    n_blocks = P_pad // lanes
     kernel = functools.partial(_pairs_kernel, cost=cost, ppy=ppy,
                                T_real=T_real)
     out = pl.pallas_call(
@@ -996,20 +1006,20 @@ def _fused_pairs_call(y_close, x_close, onehot_w, k_lanes, zx_lanes, warm,
         in_specs=[
             pl.BlockSpec((1, W_pad, 2 * T_pad), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((W_pad, _LANES), lambda i, j: (0, j),
+            pl.BlockSpec((W_pad, lanes), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
+            pl.BlockSpec((1, lanes), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
+            pl.BlockSpec((1, lanes), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
+            pl.BlockSpec((1, lanes), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
         ] + _tr_specs(T_real),
         out_specs=pl.BlockSpec(
-            (1, 1, _METRIC_ROWS, _LANES), lambda i, j: (i, j, 0, 0),
+            (1, 1, _METRIC_ROWS, lanes), lambda i, j: (i, j, 0, 0),
             memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(
-            (N, n_blocks, _METRIC_ROWS, _LANES), jnp.float32),
+            (N, n_blocks, _METRIC_ROWS, lanes), jnp.float32),
         interpret=interpret,
     )(zh_tbl, onehot_w, k_lanes, zx_lanes,
       warm, *_tr_args(t_real, T_real))
@@ -1158,7 +1168,8 @@ def _mom_signal_tail(past_tbl, r, close, ol_ref, warm_ref, tr, out_ref, *,
                                preferred_element_type=jnp.float32,
                                precision=jax.lax.Precision.HIGHEST)
 
-    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, _LANES), 0)
+    lanes = ol_ref.shape[1]            # widest legal param block (launcher)
+    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, lanes), 0)
     warm = warm_ref[0, :][None, :]     # lookback + 1
     valid = t_idx >= (warm.astype(jnp.int32) - 1)
     pos = jnp.where(valid, jnp.sign(close - past), 0.0)
@@ -1225,7 +1236,8 @@ def _don_latch_tail(sig_tbl, r, ow_ref, warm_ref, tr, out_ref, *,
     up = s > 0.5
     down = s < -0.5
 
-    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, _LANES), 0)
+    lanes = ow_ref.shape[1]            # widest legal param block (launcher)
+    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, lanes), 0)
     warm = warm_ref[0, :][None, :]     # window + 1
     valid = t_idx >= (warm.astype(jnp.int32) - 1)
     # Latch transition maps (up wins over down, else hold the prior state),
@@ -1327,7 +1339,7 @@ def _don_kernel_inline(r_ref, c_ref, crow_ref, hi_ref, lo_ref, ow_ref,
 def _single_window_pallas(kernel, close, tables, onehot_w, warm, t_real, *,
                           T_pad: int, W_pad: int, P_real: int,
                           T_real: int | None, interpret: bool,
-                          aux_rows=(), scratch_shapes=()):
+                          aux_rows=(), scratch_shapes=(), lanes_cap=_LANES):
     """Shared pallas_call plumbing for the momentum/donchian kernels:
     returns + close columns, one or two (N, W_pad, T_pad) tables, the
     one-hot/warmup lanes, optional ragged lengths.
@@ -1341,7 +1353,8 @@ def _single_window_pallas(kernel, close, tables, onehot_w, warm, t_real, *,
     """
     N = close.shape[0]
     P_pad = onehot_w.shape[1]
-    n_blocks = P_pad // _LANES
+    lanes = _widest_lanes(P_pad, lanes_cap)
+    n_blocks = P_pad // lanes
     table_specs = [
         pl.BlockSpec((1, W_pad, T_pad), lambda i, j: (i, 0, 0),
                      memory_space=pltpu.VMEM)
@@ -1361,16 +1374,16 @@ def _single_window_pallas(kernel, close, tables, onehot_w, warm, t_real, *,
             pl.BlockSpec((1, T_pad, 1), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
         ] + table_specs + aux_specs + [
-            pl.BlockSpec((W_pad, _LANES), lambda i, j: (0, j),
+            pl.BlockSpec((W_pad, lanes), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
+            pl.BlockSpec((1, lanes), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
         ] + _tr_specs(T_real),
         out_specs=pl.BlockSpec(
-            (1, 1, _METRIC_ROWS, _LANES), lambda i, j: (i, j, 0, 0),
+            (1, 1, _METRIC_ROWS, lanes), lambda i, j: (i, j, 0, 0),
             memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(
-            (N, n_blocks, _METRIC_ROWS, _LANES), jnp.float32),
+            (N, n_blocks, _METRIC_ROWS, lanes), jnp.float32),
         scratch_shapes=list(scratch_shapes),
         interpret=interpret,
     )(_rets3(close), close[..., None], *tables,
@@ -1406,7 +1419,8 @@ def _fused_mom_call(close, onehot_l, warm, t_real, *, windows: tuple,
             kernel, close_p, [], onehot_l, warm, t_real, T_pad=T_pad,
             W_pad=W_pad, P_real=P_real, T_real=T_real, interpret=interpret,
             aux_rows=[close_p],
-            scratch_shapes=[pltpu.VMEM((W_pad, T_pad), jnp.float32)])
+            scratch_shapes=[pltpu.VMEM((W_pad, T_pad), jnp.float32)],
+            lanes_cap=512)
     w_col = jnp.asarray(np.asarray(windows, np.int32))[:, None]  # (W,1)
     t_row = jnp.arange(T_pad)[None, :]
     gather_idx = jnp.clip(t_row - w_col, 0, T_pad - 1)           # (W,T_pad)
@@ -1415,7 +1429,8 @@ def _fused_mom_call(close, onehot_l, warm, t_real, *, windows: tuple,
                                T_real=T_real)
     return _single_window_pallas(
         kernel, close_p, [past_tbl], onehot_l, warm, t_real, T_pad=T_pad,
-        W_pad=W_pad, P_real=P_real, T_real=T_real, interpret=interpret)
+        W_pad=W_pad, P_real=P_real, T_real=T_real, interpret=interpret,
+        lanes_cap=512)
 
 
 def _extrema_table(src_p, windows: tuple, mode: str, warm_fill: float):
@@ -1485,7 +1500,8 @@ def _fused_don_call(close, hi_src, lo_src, onehot_w, warm, t_real, *,
             interpret=interpret,
             aux_rows=[close_p, _pad_last(hi_src, T_pad),
                       _pad_last(lo_src, T_pad)],
-            scratch_shapes=[pltpu.VMEM((W_pad, T_pad), jnp.float32)])
+            scratch_shapes=[pltpu.VMEM((W_pad, T_pad), jnp.float32)],
+            lanes_cap=256)
     hi_tbl = _extrema_table(_pad_last(hi_src, T_pad), windows, "max", 1e30)
     lo_tbl = _extrema_table(_pad_last(lo_src, T_pad), windows, "min", -1e30)
     # Channel known at the close of t-1, applied to bar t; collapsing both
@@ -1502,7 +1518,7 @@ def _fused_don_call(close, hi_src, lo_src, onehot_w, warm, t_real, *,
     return _single_window_pallas(
         kernel, close_p, [sig_tbl], onehot_w, warm, t_real,
         T_pad=T_pad, W_pad=W_pad, P_real=P_real, T_real=T_real,
-        interpret=interpret)
+        interpret=interpret, lanes_cap=256)
 
 
 def _resolve_table(table: str | None, env_var: str, default: str) -> str:
@@ -1896,10 +1912,11 @@ def _macd_kernel(r_ref, ema_ref, of_ref, os_ref, asig_ref, warm_ref, *refs,
     macd = jax.lax.dot_general(ema_ref[0], of_ref[:] - os_ref[:], dn,
                                preferred_element_type=jnp.float32,
                                precision=jax.lax.Precision.HIGHEST)
-    a_sig = asig_ref[0, :][None, :]                  # (1, 128)
+    a_sig = asig_ref[0, :][None, :]                  # (1, lanes)
     sig = _ema_ladder(macd, a_sig)
 
-    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, _LANES), 0)
+    lanes = of_ref.shape[1]          # widest legal param block (launcher)
+    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, lanes), 0)
     warm = warm_ref[0, :][None, :]                   # slow + signal - 1
     valid = t_idx >= (warm.astype(jnp.int32) - 1)
     pos = jnp.where(valid, jnp.sign(macd - sig), 0.0)
@@ -1932,7 +1949,10 @@ def _fused_macd_call(close, onehot_f, onehot_s, a_sig, warm, t_real, *,
                                 jnp.float32)], axis=1)
 
     P_pad = a_sig.shape[1]
-    n_blocks = P_pad // _LANES
+    # 256-lane cap: the per-lane signal-EMA ladder keeps several
+    # (T_pad, lanes) arrays live (same budget class as the band machines).
+    lanes = _widest_lanes(P_pad, 256)
+    n_blocks = P_pad // lanes
     kernel = functools.partial(_macd_kernel, cost=cost, ppy=ppy,
                                T_real=T_real)
     out = pl.pallas_call(
@@ -1943,20 +1963,20 @@ def _fused_macd_call(close, onehot_f, onehot_s, a_sig, warm, t_real, *,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, W_pad, T_pad), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((W_pad, _LANES), lambda i, j: (0, j),
+            pl.BlockSpec((W_pad, lanes), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((W_pad, _LANES), lambda i, j: (0, j),
+            pl.BlockSpec((W_pad, lanes), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
+            pl.BlockSpec((1, lanes), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
+            pl.BlockSpec((1, lanes), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
         ] + _tr_specs(T_real),
         out_specs=pl.BlockSpec(
-            (1, 1, _METRIC_ROWS, _LANES), lambda i, j: (i, j, 0, 0),
+            (1, 1, _METRIC_ROWS, lanes), lambda i, j: (i, j, 0, 0),
             memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(
-            (N, n_blocks, _METRIC_ROWS, _LANES), jnp.float32),
+            (N, n_blocks, _METRIC_ROWS, lanes), jnp.float32),
         interpret=interpret,
     )(_rets3(close_p), ema_tbl, onehot_f, onehot_s, a_sig, warm,
       *_tr_args(t_real, T_real))
@@ -2021,34 +2041,64 @@ def _macd_grid_setup(fast_bytes: bytes, slow_bytes: bytes,
             _const(oh_s), _const(a_sig), _const(warm))
 
 
-def _obv_kernel(r_ref, obv_ref, sma_ref, oh_ref, warm_ref, *refs,
-                cost: float, ppy: int, T_real: int | None):
-    """OBV-trend cell: one window-table selection gives the OBV rolling
-    mean; position = sign(obv - sma). The selection one-hot has a single
-    nonzero per lane, so the MXU contraction is an exact copy — the only
-    rounding in the cell is the subtraction itself."""
-    tr, out_ref = _unpack_tr(refs, T_real)
-    T_pad = r_ref.shape[1]
-    r = r_ref[0]
-    obv = obv_ref[0]                 # (T_pad, 1) -> broadcasts over lanes
-    sma = jnp.dot(sma_ref[0], oh_ref[:],      # (T_pad, W) x (W, 128)
-                  preferred_element_type=jnp.float32,
-                  precision=jax.lax.Precision.HIGHEST)
-    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, _LANES), 0)
-    warm = warm_ref[0, :][None, :]               # (1, 128) = window
+def _obv_signal_tail(sma_tbl, r, obv, oh_ref, warm_ref, tr, out_ref, *,
+                     cost: float, ppy: int):
+    """Shared OBV selection + metrics tail (both table substrates).
+
+    One window-table selection gives the OBV rolling mean; position =
+    ``sign(obv - sma)``. The W-major ``(W_pad, T_pad)`` table contracts
+    its leading window axis (the SMA kernel's layout — a T-major/W-minor
+    table pads W up to 128 lanes, a 12.8x HBM blow-up class this file
+    keeps re-learning). The selection one-hot has a single nonzero per
+    lane, so the MXU contraction is an exact copy — the only rounding in
+    the cell is the subtraction itself."""
+    T_pad = sma_tbl.shape[1]
+    dn = (((0,), (0,)), ((), ()))
+    sma = jax.lax.dot_general(sma_tbl, oh_ref[:], dn,
+                              preferred_element_type=jnp.float32,
+                              precision=jax.lax.Precision.HIGHEST)
+    lanes = oh_ref.shape[1]          # widest legal param block (launcher)
+    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, lanes), 0)
+    warm = warm_ref[0, :][None, :]               # (1, lanes) = window
     valid = t_idx >= (warm.astype(jnp.int32) - 1)
     pos = jnp.where(valid, jnp.sign(obv - sma), 0.0)
     out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
 
 
+def _obv_kernel(r_ref, obv_ref, sma_ref, oh_ref, warm_ref, *refs,
+                cost: float, ppy: int, T_real: int | None):
+    tr, out_ref = _unpack_tr(refs, T_real)
+    _obv_signal_tail(sma_ref[0], r_ref[0], obv_ref[0], oh_ref, warm_ref,
+                     tr, out_ref, cost=cost, ppy=ppy)
+
+
+def _obv_kernel_inline(r_ref, obv_ref, cs_ref, oh_ref, warm_ref, *refs,
+                       cost: float, ppy: int, T_real: int | None,
+                       windows: tuple, W_pad: int):
+    """OBV with the SMA-of-OBV table built in VMEM scratch from the OBV
+    cumsum row (`_build_sma_scratch` — the SMA kernel's builder on a
+    different series). Same division-lowering caveat as the SMA inline
+    substrate (`_kernel_inline`): bit-identical on CPU, 1-ULP table
+    rounding possible on TPU, gated by the same verify budgets."""
+    *head, sma_scr = refs
+    tr, out_ref = _unpack_tr(tuple(head), T_real)
+
+    @pl.when(pl.program_id(1) == 0)
+    def _build():
+        _build_sma_scratch(cs_ref[0], sma_scr, windows, W_pad)
+
+    _obv_signal_tail(sma_scr[:], r_ref[0], obv_ref[0], oh_ref, warm_ref,
+                     tr, out_ref, cost=cost, ppy=ppy)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
-                     "ppy", "interpret"))
+                     "ppy", "interpret", "table"))
 def _fused_obv_call(close, volume, onehot_w, warm, t_real, *,
                     windows: tuple, T_pad: int, W_pad: int, P_real: int,
                     T_real: int | None, cost: float, ppy: int,
-                    interpret: bool):
+                    interpret: bool, table: str = "hbm"):
     """OBV series + distinct-window SMA table prep + pallas call in one jit.
 
     The OBV accumulator is the SHARED ``rolling.obv_series`` (the same
@@ -2064,26 +2114,31 @@ def _fused_obv_call(close, volume, onehot_w, warm, t_real, *,
     vol_p = _pad_last(volume, T_pad)
     obv = rolling.obv_series(close_p, vol_p)                   # (N, T_pad)
 
-    cs = jnp.cumsum(obv, axis=1)
-    w_vec = jnp.asarray(np.asarray(windows, np.int32))         # (W,)
-    t_idx = jnp.arange(T_pad)[:, None]                         # (T_pad, 1)
-    gather_idx = jnp.clip(t_idx - w_vec[None, :], 0, T_pad - 1)
-    shifted = jnp.take(cs, gather_idx, axis=1)                 # (N,T_pad,W)
-    shifted = jnp.where((t_idx >= w_vec[None, :])[None], shifted, 0.0)
-    sma_table = (cs[:, :, None] - shifted) / w_vec[None, None, :].astype(
-        jnp.float32)
-    sma_table = jnp.where(
-        (t_idx >= w_vec[None, :] - 1)[None], sma_table, 0.0)
-    if W_pad > len(windows):
-        sma_table = jnp.concatenate(
-            [sma_table,
-             jnp.zeros((N, T_pad, W_pad - len(windows)), jnp.float32)],
-            axis=-1)
-
     P_pad = onehot_w.shape[1]
-    n_blocks = P_pad // _LANES
-    kernel = functools.partial(_obv_kernel, cost=cost, ppy=ppy,
-                               T_real=T_real)
+    lanes = _widest_lanes(P_pad, 512)   # sign kernel: no compose ladder
+    n_blocks = P_pad // lanes
+    if table == "inline":
+        cs = jnp.cumsum(obv, axis=1)[:, None, :]               # (N,1,T_pad)
+        kernel = functools.partial(_obv_kernel_inline, cost=cost, ppy=ppy,
+                                   T_real=T_real, windows=windows,
+                                   W_pad=W_pad)
+        table_arg = cs
+        table_spec = pl.BlockSpec((1, 1, T_pad), lambda i, j: (i, 0, 0),
+                                  memory_space=pltpu.VMEM)
+        scratch = [pltpu.VMEM((W_pad, T_pad), jnp.float32)]
+    else:
+        # W-major SMA table of the OBV series — `_sma_table` on a
+        # different input row (same cumsum-difference op order as the
+        # generic rolling mean). The previous T-major (N, T_pad, W)
+        # layout padded W up to 128 lanes per intermediate; its static-
+        # shift prep materialized W lane-minor (N, T_pad, 1) rows — a
+        # 12.8x-class HBM blow-up that OOM'd at 500 tickers.
+        kernel = functools.partial(_obv_kernel, cost=cost, ppy=ppy,
+                                   T_real=T_real)
+        table_arg = _sma_table(obv, windows, W_pad)
+        table_spec = pl.BlockSpec((1, W_pad, T_pad), lambda i, j: (i, 0, 0),
+                                  memory_space=pltpu.VMEM)
+        scratch = []
     out = pl.pallas_call(
         kernel,
         grid=(N, n_blocks),
@@ -2092,20 +2147,20 @@ def _fused_obv_call(close, volume, onehot_w, warm, t_real, *,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, T_pad, 1), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, T_pad, W_pad), lambda i, j: (i, 0, 0),
+            table_spec,
+            pl.BlockSpec((W_pad, lanes), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((W_pad, _LANES), lambda i, j: (0, j),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
+            pl.BlockSpec((1, lanes), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
         ] + _tr_specs(T_real),
         out_specs=pl.BlockSpec(
-            (1, 1, _METRIC_ROWS, _LANES), lambda i, j: (i, j, 0, 0),
+            (1, 1, _METRIC_ROWS, lanes), lambda i, j: (i, j, 0, 0),
             memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(
-            (N, n_blocks, _METRIC_ROWS, _LANES), jnp.float32),
+            (N, n_blocks, _METRIC_ROWS, lanes), jnp.float32),
+        scratch_shapes=scratch,
         interpret=interpret,
-    )(_rets3(close_p), obv[:, :, None], sma_table, onehot_w, warm,
+    )(_rets3(close_p), obv[:, :, None], table_arg, onehot_w, warm,
       *_tr_args(t_real, T_real))
     return Metrics(*(
         jnp.reshape(out[:, :, k, :], (N, P_pad))[:, :P_real]
@@ -2114,7 +2169,8 @@ def _fused_obv_call(close, volume, onehot_w, warm, t_real, *,
 
 def fused_obv_sweep(close, volume, window, *, t_real=None, cost: float = 0.0,
                     periods_per_year: int = 252,
-                    interpret: bool | None = None) -> Metrics:
+                    interpret: bool | None = None,
+                    table: str | None = None) -> Metrics:
     """Fused OBV-trend sweep: ``(N, T)`` closes+volumes x ``(P,)`` windows.
 
     ``window`` is a flat per-combo window array (:func:`product_grid`
@@ -2122,7 +2178,9 @@ def fused_obv_sweep(close, volume, window, *, t_real=None, cost: float = 0.0,
     ``run_sweep(..., "obv_trend")`` (``models.obv``) to f32 tolerance —
     the OBV accumulation, first-bar volume normalization, and windowed
     mean follow the generic path's exact op order, and the selection
-    contraction is an exact one-hot copy.
+    contraction is an exact one-hot copy. ``table`` picks the SMA-of-OBV
+    table substrate (env ``DBX_OBV_TABLE``; the inline variant carries
+    the SMA kernel's division-lowering caveat, `_obv_kernel_inline`).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -2138,7 +2196,9 @@ def fused_obv_sweep(close, volume, window, *, t_real=None, cost: float = 0.0,
                            W_pad=onehot_w.shape[0], P_real=window.shape[0],
                            T_real=T if t_real is None else None,
                            cost=float(cost), ppy=int(periods_per_year),
-                           interpret=bool(interpret))
+                           interpret=bool(interpret),
+                           table=_resolve_table(table, "DBX_OBV_TABLE",
+                                                "inline"))
 
 
 @functools.lru_cache(maxsize=4)
@@ -2172,10 +2232,11 @@ def _trix_kernel(r_ref, ema_ref, oh_ref, asig_ref, warm_ref, *refs,
     # Padded lanes select all-zero table rows (0/0): guard the denominator
     # so they stay finite; real lanes have positive price-level EMAs.
     denom = jnp.where(prev == 0.0, 1.0, prev)
-    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, _LANES), 0)
+    lanes = oh_ref.shape[1]          # widest legal param block (launcher)
+    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, lanes), 0)
     # trix[0] = 0 exactly, matching models.trix (prev seeds with e3[0]).
     trix = jnp.where(t_idx == 0, 0.0, e3 / denom - 1.0)
-    a_sig = asig_ref[0, :][None, :]                  # (1, 128)
+    a_sig = asig_ref[0, :][None, :]                  # (1, lanes)
     sig = _ema_ladder(trix, a_sig)
 
     warm = warm_ref[0, :][None, :]                   # 3*span + signal - 2
@@ -2206,7 +2267,12 @@ def _fused_trix_call(close, onehot, a_sig, warm, t_real, *,
                                jnp.float32)], axis=1)
 
     P_pad = a_sig.shape[1]
-    n_blocks = P_pad // _LANES
+    # 128 lanes: unlike MACD (+3% at 256), TRIX measured consistently ~4%
+    # SLOWER at 256 (14.5-14.8 vs 15.3 M/s) — its ratio + two ladders keep
+    # more live state per lane, so the wider block spills what the
+    # narrower one keeps resident.
+    lanes = _widest_lanes(P_pad, _LANES)
+    n_blocks = P_pad // lanes
     kernel = functools.partial(_trix_kernel, cost=cost, ppy=ppy,
                                T_real=T_real)
     out = pl.pallas_call(
@@ -2217,18 +2283,18 @@ def _fused_trix_call(close, onehot, a_sig, warm, t_real, *,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, W_pad, T_pad), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((W_pad, _LANES), lambda i, j: (0, j),
+            pl.BlockSpec((W_pad, lanes), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
+            pl.BlockSpec((1, lanes), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _LANES), lambda i, j: (0, j),
+            pl.BlockSpec((1, lanes), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
         ] + _tr_specs(T_real),
         out_specs=pl.BlockSpec(
-            (1, 1, _METRIC_ROWS, _LANES), lambda i, j: (i, j, 0, 0),
+            (1, 1, _METRIC_ROWS, lanes), lambda i, j: (i, j, 0, 0),
             memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(
-            (N, n_blocks, _METRIC_ROWS, _LANES), jnp.float32),
+            (N, n_blocks, _METRIC_ROWS, lanes), jnp.float32),
         interpret=interpret,
     )(_rets3(close_p), e3_tbl, onehot, a_sig, warm,
       *_tr_args(t_real, T_real))

@@ -124,14 +124,16 @@ def test_momentum_donchian_inline_tables_match_hbm():
     # The momentum past-close and Donchian breakout-sign in-kernel tables
     # involve no arithmetic (rotate / max / compare of raw prices), so
     # unlike the SMA inline table they must be bit-identical to the
-    # XLA-table substrate on EVERY backend. 41 lookbacks -> P_pad 256 ->
-    # n_blocks 2 also covers the scratch-persistence window.
+    # XLA-table substrate on EVERY backend. 300 params -> P_pad 384 ->
+    # 128-lane blocks x 3 for every cap, so the scratch-persistence
+    # window (blocks j > 0 reading the table built at j == 0) is
+    # exercised for all three inline kernels.
     ohlcv = data.synthetic_ohlcv(3, 300, seed=21)
     close = jnp.asarray(ohlcv.close)
     high = jnp.asarray(ohlcv.high)
     low = jnp.asarray(ohlcv.low)
-    lb = np.arange(4, 86, 2, dtype=np.float32)
-    assert lb.size == 41
+    lb = np.linspace(4, 90, 300).round().astype(np.float32)
+    assert lb.size == 300 and -(-lb.size // 128) * 128 == 384
     cases = [
         ("momentum", lambda m: fused.fused_momentum_sweep(
             close, lb, cost=1e-3, table=m)),
@@ -146,6 +148,23 @@ def test_momentum_donchian_inline_tables_match_hbm():
             np.testing.assert_array_equal(
                 np.asarray(getattr(a, field)), np.asarray(getattr(b, field)),
                 err_msg=f"{name}.{field}")
+
+
+def test_obv_inline_table_matches_hbm():
+    # SMA-of-OBV table built in VMEM scratch (`_obv_kernel_inline`) vs the
+    # W-major XLA table: bit-identical on CPU (the on-TPU 1-ULP division
+    # caveat is the SMA inline substrate's, gated by bench --verify).
+    # 300 params -> P_pad 384 -> 3 blocks: covers scratch persistence.
+    ohlcv = data.synthetic_ohlcv(3, 300, seed=23)
+    w = np.linspace(5, 90, 300).round().astype(np.float32)
+    a = fused.fused_obv_sweep(ohlcv.close, ohlcv.volume, w, cost=1e-3,
+                              table="hbm")
+    b = fused.fused_obv_sweep(ohlcv.close, ohlcv.volume, w, cost=1e-3,
+                              table="inline")
+    for field in a._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, field)), np.asarray(getattr(b, field)),
+            err_msg=field)
 
 
 def test_momentum_inline_table_ragged_matches_hbm():
