@@ -89,7 +89,17 @@ def sortino(returns: Array, *, periods_per_year: int = 252, mask=None,
 
 def max_drawdown(equity: Array) -> Array:
     """Max peak-to-trough drawdown fraction of an equity curve (>= 0)."""
-    peak = jax.lax.associative_scan(jnp.maximum, equity, axis=-1)
+    # Running peak as a shift-doubling ladder, not lax.associative_scan:
+    # max is exact under any association order (bit-identical result), the
+    # flat pad/slice graph compiles far faster than the scan's recursive
+    # lowering, and that lowering's native compile proved load-sensitive
+    # on the CPU harness (see signals.prefix_compose_maps).
+    from .signals import _shift_last
+    peak = equity
+    span = 1
+    while span < equity.shape[-1]:
+        peak = jnp.maximum(peak, _shift_last(peak, span, -jnp.inf))
+        span *= 2
     dd = (peak - equity) / jnp.maximum(peak, 1e-12)
     return jnp.max(dd, axis=-1)
 

@@ -79,21 +79,49 @@ def _compose_maps(earlier, later):
     return apply(em), apply(e0), apply(ep)
 
 
+def _shift_last(x: Array, s: int, fill: float) -> Array:
+    """``y[..., t] = x[..., t-s]`` with ``fill`` for ``t < s`` (static s)."""
+    pad = jnp.full(x.shape[:-1] + (s,), fill, x.dtype)
+    return jnp.concatenate([pad, x[..., :-s]], axis=-1)
+
+
+def prefix_compose_maps(maps):
+    """Inclusive prefix composition of per-bar 3-state maps, last axis.
+
+    A Hillis–Steele shift-doubling ladder (log2 T rounds), NOT
+    ``lax.associative_scan``: composing these maps only *selects* among
+    exact {-1, 0, +1} values — no arithmetic — so every association order
+    yields the bit-identical prefix, and the ladder's flat pad/slice graph
+    avoids ``associative_scan``'s deeply recursive lowering (which
+    compiles ~30x slower at sweep shapes — the `_ema_rows` finding — and
+    whose native compile segfaulted under memory pressure on the CPU
+    test harness: a load-sensitive crash in ``backend_compile_and_load``
+    observed twice at ``test_assoc_traced_params_vmap``). The in-kernel
+    twin is ``fused._prefix_compose3`` (sublane axis).
+    """
+    pm, p0, pp = maps
+    T = pm.shape[-1]
+    span = 1
+    while span < T:
+        earlier = (_shift_last(pm, span, -1.0),
+                   _shift_last(p0, span, 0.0),
+                   _shift_last(pp, span, 1.0))   # identity map past the edge
+        pm, p0, pp = _compose_maps(earlier, (pm, p0, pp))
+        span *= 2
+    return pm, p0, pp
+
+
 def band_hysteresis_assoc(z: Array, valid: Array, z_entry, z_exit=0.0) -> Array:
-    """:func:`band_hysteresis` in O(log T) depth via ``associative_scan``.
+    """:func:`band_hysteresis` in O(log T) depth via prefix composition.
 
     Produces the bit-identical position sequence (states are small integers
     in float32; every comparison sees the same inputs) without a serial
     ``lax.scan`` — on TPU the whole time axis evaluates as ~log2(T) fused
     VPU passes instead of T sequential steps. This is the production path
-    for stateful strategies (Bollinger mean-reversion, pairs).
+    for stateful strategies (Bollinger mean-reversion, pairs). See
+    :func:`prefix_compose_maps` for why this is a shift-doubling ladder
+    rather than ``lax.associative_scan``.
     """
     maps = band_transition_maps(z, valid, z_entry, z_exit)
-
-    def combine(a, b):
-        # associative_scan folds left-to-right: ``a`` covers earlier bars.
-        return _compose_maps(a, b)
-
-    pm, p0, pp = jax.lax.associative_scan(combine, maps, axis=-1)
-    del pm, pp  # start state is flat: the 0-component is the position path
-    return p0
+    _, p0, _ = prefix_compose_maps(maps)
+    return p0   # start state is flat: the 0-component is the position path

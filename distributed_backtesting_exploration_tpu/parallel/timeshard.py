@@ -337,8 +337,9 @@ def _transition_positions_local(maps, axis_name: str):
     """Position path of ANY {-1,0,+1} transition-map machine, one time
     block, exact across blocks.
 
-    The block's prefix maps come from a local ``associative_scan``, the
-    whole block composes into one 3-vector summary, and the state
+    The block's prefix maps come from a local shift-doubling prefix
+    composition, the whole block composes into one 3-vector summary, and
+    the state
     *entering* this block is the exclusive left-fold of block summaries
     over ICI (same carry pattern as :func:`sharded_linear_scan` — one
     3-vector per chip crosses the wire). The fixup routes each bar's
@@ -348,8 +349,10 @@ def _transition_positions_local(maps, axis_name: str):
     3-state space shards through here."""
     from ..ops import signals
 
-    pm, p0, pp = jax.lax.associative_scan(
-        lambda a, b: signals._compose_maps(a, b), maps, axis=-1)
+    # Shift-doubling ladder, not associative_scan: bit-identical for
+    # select-only map composition and avoids the scan lowering's
+    # load-sensitive native compile (signals.prefix_compose_maps).
+    pm, p0, pp = signals.prefix_compose_maps(maps)
 
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
