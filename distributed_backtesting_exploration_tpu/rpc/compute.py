@@ -762,11 +762,51 @@ class JaxSweepBackend:
                     pending.extend(self._submit_timeshard_groups(
                         group, series, lengths, t0, axes))
                     continue
-                log.warning(
-                    "jobs %s (%s) are long-context (%d bars) but not "
-                    "time-shardable (%s); falling through to the generic "
-                    "path", [j.id for j in group], group[0].strategy,
-                    t_max_g, ts_reason)
+                # The group-level gate uses min(lengths) for the halo
+                # bound, so ONE short job in a ragged group would drag
+                # every genuinely long job off the route. Re-gate per
+                # job: the submit path already shards per length
+                # subgroup, so a partial route is natural. The per-job
+                # gate keeps the LONG-CONTEXT condition too — a short
+                # job that merely shares the group must stay on the
+                # (faster) single-device/fused path, not be dragged onto
+                # distributed cumsums for a panel that fits one chip.
+                ok_idx = [i for i, t in enumerate(lengths)
+                          if int(t) > self._FUSED_MAX_BARS
+                          and timeshard_route_reason(
+                              group[0].strategy, axes, [int(t)],
+                              self._mesh.devices.size) is None]
+                if ok_idx:
+                    log.info(
+                        "jobs %s (%s) route time-sharded individually; "
+                        "%s stay generic (%s)",
+                        [group[i].id for i in ok_idx], group[0].strategy,
+                        [group[i].id for i in range(len(group))
+                         if i not in set(ok_idx)], ts_reason)
+                    pending.extend(self._submit_timeshard_groups(
+                        [group[i] for i in ok_idx],
+                        [series[i] for i in ok_idx],
+                        [int(lengths[i]) for i in ok_idx], t0, axes))
+                    rest = [i for i in range(len(group))
+                            if i not in set(ok_idx)]
+                    if not rest:
+                        continue
+                    group = [group[i] for i in rest]
+                    series = [series[i] for i in rest]
+                    lengths = [int(lengths[i]) for i in rest]
+                    # The remainder is a different (shorter) panel:
+                    # re-evaluate the fused gate for it.
+                    demotion = (self._fused_demotion_reason(
+                        group[0], axes, lengths) if self.use_fused
+                        else None)
+                    fused_ok = self.use_fused and demotion is None
+                    t_max_g = int(max(lengths))
+                else:
+                    log.warning(
+                        "jobs %s (%s) are long-context (%d bars) but not "
+                        "time-shardable (%s); falling through to the "
+                        "generic path", [j.id for j in group],
+                        group[0].strategy, t_max_g, ts_reason)
             if fused_ok:
                 # Repeat-last padding + per-ticker lengths: the kernels'
                 # padding discipline makes pad bars earn zero return and

@@ -368,16 +368,22 @@ class JobQueue:
                 # would desynchronize the id<->index mirror from the C
                 # intern table (enforced on both substrates).
                 raise ValueError(f"job id contains NUL: {rec.id[:64]!r}")
+        if journal and self._journal.enabled:
+            # enabled-guarded: journal_form b64-encodes the payload, which
+            # the no-op journal would throw away. Journal BEFORE the state
+            # push makes the batch takeable: a worker can lease a job the
+            # instant it is published, and a crash before its enqueue
+            # record landed would orphan that in-flight job (restore would
+            # not know it existed — a batch-wide loss window). A
+            # journaled-but-unpublished job is merely re-enqueued by
+            # replay, so this order bounds the loss at zero.
+            for rec in recs:
+                self._journal.append("enqueue", **rec.journal_form())
         with self._lock:
             for rec in recs:
                 self._records[rec.id] = rec
             self._state.enqueue_n([rec.id for rec in recs],
                                   [float(rec.combos) for rec in recs])
-        if journal and self._journal.enabled:
-            # enabled-guarded: journal_form b64-encodes the payload, which
-            # the no-op journal would throw away.
-            for rec in recs:
-                self._journal.append("enqueue", **rec.journal_form())
 
     def restore(self, journal_path: str) -> int:
         """Replay a journal; re-enqueue pending jobs. Returns count restored.
@@ -436,11 +442,28 @@ class JobQueue:
                 jids = self._state.take_begin_n(n - len(out))
                 if not jids:
                     break
+                # A popped id with no record is a state/record desync
+                # (cannot happen through the public intake path, which
+                # registers the record first) — fail it loudly instead of
+                # crashing with the whole batch in limbo.
+                desynced = [j for j in jids if j not in self._records]
+                for j in desynced:
+                    self._state.fail(j)
+                jids = [j for j in jids if j not in desynced]
                 recs = [self._records[j] for j in jids]
                 self._in_take += len(jids)
             good: list[tuple[str, JobRecord, bytes]] = []
             failed: list[tuple[str, str, Exception]] = []  # id, path, err
+            resolved: set[str] = set()   # leased, failed, or completed
             try:
+                # Inside the try: a journal error here must still reach
+                # the push-back handler / _in_take decrement below, or
+                # the rest of the popped batch is stranded.
+                for j in desynced:
+                    log.error("job %s: popped with no record (state "
+                              "desync) -> failed", j)
+                    self._journal.append("fail", id=j,
+                                         reason="no job record")
                 for jid, rec in zip(jids, recs):
                     payload = rec.ohlcv
                     try:
@@ -469,6 +492,12 @@ class JobQueue:
                     committed = self._state.take_commit_n(
                         [jid for jid, _, _ in good], worker_id,
                         self.lease_s)
+                    # Every triaged id is resolved — including a failed-
+                    # triage id whose fail() returns False below because
+                    # a completion landed mid-take: that job is DONE, and
+                    # the push-back handler must not return it to pending.
+                    resolved = {jid for jid, _, _ in good}
+                    resolved.update(jid for jid, _, _ in failed)
                     # Unreadable payloads fail under the same lock (the
                     # per-id re-check drops jobs completed mid-take).
                     failed = [(jid, path, e) for jid, path, e in failed
@@ -480,6 +509,17 @@ class JobQueue:
                 out.extend((rec, payload)
                            for ok, (_, rec, payload) in zip(committed, good)
                            if ok)
+            except BaseException:
+                # Anything unexpected between the pop and the commit would
+                # otherwise strand the WHOLE popped batch — neither
+                # pending, leased, completed, nor failed, and invisible to
+                # lease expiry — while drained() flips True. Push the
+                # unresolved ids back to pending before propagating.
+                with self._lock:
+                    for jid in jids:
+                        if jid not in resolved:
+                            self._state.push_pending(jid)
+                raise
             finally:
                 with self._lock:
                     self._in_take -= len(jids)
@@ -507,11 +547,22 @@ class JobQueue:
         self._journal.append("complete", id=jid, worker=worker_id)
         return "new"
 
-    def complete_batch(self, jids: list[str], worker_id: str) -> list[str]:
+    def complete_batch(self, jids: list[str], worker_id: str, *,
+                       journal: bool = True) -> list[str]:
         """Batched :meth:`complete`: one state-machine crossing for a
         whole CompleteJobs RPC (per-id outcomes identical — the batch
         exists because per-job ctypes crossings made the native substrate
-        slower than the dict fallback)."""
+        slower than the dict fallback).
+
+        ``journal=False`` defers the durable 'complete' records so the
+        caller can persist the result blocks FIRST and then call
+        :meth:`journal_completions` — a journaled-complete whose .dbxm
+        block never landed is unrecoverable (the job is never
+        re-dispatched), and with batched RPCs that window would span a
+        whole batch, not one job. A crash in the
+        state-complete-but-unjournaled window merely re-runs the batch
+        after restart (idempotent overwrite).
+        """
         if not jids:
             return []
         with self._lock:
@@ -519,10 +570,19 @@ class JobQueue:
             for jid, outcome in zip(jids, outcomes):
                 if outcome == "new":
                     self._completed_ids.add(jid)
-        for jid, outcome in zip(jids, outcomes):
-            if outcome == "new":
-                self._journal.append("complete", id=jid, worker=worker_id)
+        if journal:
+            for jid, outcome in zip(jids, outcomes):
+                if outcome == "new":
+                    self._journal.append("complete", id=jid,
+                                         worker=worker_id)
         return outcomes
+
+    def journal_completions(self, jids: list[str], worker_id: str) -> None:
+        """Durable 'complete' records for ids whose result blocks the
+        caller has already persisted (the deferred half of
+        ``complete_batch(journal=False)``)."""
+        for jid in jids:
+            self._journal.append("complete", id=jid, worker=worker_id)
 
     def completed_ids(self) -> set[str]:
         """Snapshot of completed job ids (restored + this run's)."""
@@ -739,12 +799,19 @@ class Dispatcher(service.DispatcherServicer):
 
     def _complete_one(self, jid: str, worker_id: str, metrics: bytes,
                       elapsed_s: float) -> str:
-        outcome = self.queue.complete(jid, worker_id)
+        # Same persist-then-journal order as CompleteJobs (see there).
+        outcome = self.queue.complete_batch([jid], worker_id,
+                                            journal=False)[0]
         if outcome == "unknown":
             return outcome
         if metrics:
             self._record_result(jid, metrics)
         log.info("job %s completed by %s in %.3fs", jid, worker_id, elapsed_s)
+        if outcome == "new" or (outcome == "dup" and metrics):
+            # Journal metric-carrying dups too: the redelivery of a
+            # delivery whose block landed but whose journal append never
+            # ran (same rationale as CompleteJobs).
+            self.queue.journal_completions([jid], worker_id)
         return outcome
 
     def CompleteJob(self, request: pb.CompleteRequest, context) -> pb.Ack:
@@ -764,14 +831,36 @@ class Dispatcher(service.DispatcherServicer):
         self.peers.touch(request.worker_id)
         reply = pb.CompleteBatchReply()
         items = list(request.items)
+        # journal=False: persist every .dbxm block BEFORE the durable
+        # 'complete' records land. The reverse order loses a whole
+        # batch's results on a crash in between (journaled-complete jobs
+        # are never re-dispatched); this order merely re-runs the batch.
         outcomes = self.queue.complete_batch(
-            [item.id for item in items], request.worker_id)
+            [item.id for item in items], request.worker_id, journal=False)
+        journal_ids: list[str] = []
+        record_errors: list[tuple[str, Exception]] = []
         for item, outcome in zip(items, outcomes):
             if outcome == "unknown":
                 reply.unknown_ids.append(item.id)
                 continue
             if item.metrics:
-                self._record_result(item.id, item.metrics)
+                try:
+                    self._record_result(item.id, item.metrics)
+                except OSError as e:
+                    # One item's disk failure must not forfeit the
+                    # durable records of the OTHER items whose blocks
+                    # landed. Skip this item's journal record and error
+                    # the RPC below so the worker redelivers the batch
+                    # ("dup" redeliveries re-record + re-journal — the
+                    # journal tolerates duplicate 'complete' records).
+                    record_errors.append((item.id, e))
+                    log.error("job %s: result block not persisted (%s); "
+                              "batch will be redelivered", item.id, e)
+                    continue
+            # Journal dups too: a dup may be the redelivery of exactly
+            # this case (completed in state, block recorded now, durable
+            # record still missing).
+            journal_ids.append(item.id)
             log.info("job %s completed by %s in %.3fs", item.id,
                      request.worker_id, item.elapsed_s)
             if outcome == "new":
@@ -779,6 +868,12 @@ class Dispatcher(service.DispatcherServicer):
             # "dup" (a retried delivery the dispatcher already recorded) is
             # deliberately neither accepted nor unknown: the worker already
             # counted it on the attempt the dispatcher processed.
+        self.queue.journal_completions(journal_ids, request.worker_id)
+        if record_errors:
+            raise RuntimeError(
+                f"{len(record_errors)} result block(s) not persisted "
+                f"(first: job {record_errors[0][0]}: "
+                f"{record_errors[0][1]}); redeliver the batch")
         return reply
 
     def GetStats(self, request: pb.StatsRequest, context) -> pb.StatsReply:

@@ -417,6 +417,12 @@ class SliceWorker:
                             strat, bars, self.chips)
                         t0 = time.perf_counter()
                         _, m = self._run_group(msg, rows.reshape(-1))
+                        # The group runs as ONE sharded program, so
+                        # per-job wall time does not exist; elapsed_s is
+                        # the group wall divided evenly (sums correctly
+                        # in aggregate accounting, per-job values are an
+                        # attribution convention — same as the
+                        # ticker-sharded path below).
                         per_job = (time.perf_counter() - t0) / len(group)
                         self._complete([
                             pb.CompleteItem(

@@ -456,16 +456,27 @@ class NativeJobQueue:
         blob = b"\0".join(raws) + b"\0"
         arr = array_mod.array("d", combos)
         addr, _ = arr.buffer_info()
-        accepted = self._lib.dbx_jobq_enqueue_n(
-            self._h, blob, 0,
-            ctypes.cast(addr, ctypes.POINTER(ctypes.c_double)), len(jids))
-        if accepted != len(jids):   # cap enforced above
-            raise RuntimeError("native enqueue_n rejected ids post-cap")
+        # Mirror BEFORE the native call: the C side interns accepted ids
+        # as a side effect, so raising between the call and the mirror
+        # update would leave the id<->index translation permanently
+        # desynced (every later take would return wrong ids). With the
+        # mirror written first, the only divergent path is a C-side
+        # reject — impossible while both sides enforce the same cap
+        # (pre-validated above) — and that path raises below with the
+        # substrate declared unusable rather than silently corrupt.
         idx, ids = self._idx, self._ids
         for jid in jids:            # inlined _intern: the per-id hot loop
             if jid not in idx:
                 idx[jid] = len(ids)
                 ids.append(jid)
+        accepted = self._lib.dbx_jobq_enqueue_n(
+            self._h, blob, 0,
+            ctypes.cast(addr, ctypes.POINTER(ctypes.c_double)), len(jids))
+        if accepted != len(jids):   # cap enforced above
+            raise RuntimeError(
+                "native enqueue_n rejected ids post-cap; the C intern "
+                "table and the Python id mirror may now disagree — this "
+                "queue instance must not be reused")
 
     def take_begin_n(self, n: int) -> list[str]:
         """Pop up to ``n`` live pending ids in one crossing."""

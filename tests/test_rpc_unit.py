@@ -92,6 +92,33 @@ def test_complete_idempotent_and_unknown(qfactory):
     assert q.drained
 
 
+def test_take_pushes_batch_back_on_unexpected_error(tmp_path, qfactory,
+                                                    monkeypatch):
+    # An exception in the pop->lease window that is NOT the triaged
+    # unreadable-payload class (OSError/ValueError) must not strand the
+    # popped batch: the ids go back to pending and the error propagates.
+    # Regression for the batched-take refactor (a stranded batch was
+    # invisible to lease expiry and let drained() flip True early).
+    import distributed_backtesting_exploration_tpu.rpc.dispatcher as dmod
+
+    q = qfactory()
+    q.enqueue(JobRecord(id="pathy", strategy="s", grid={},
+                        path=str(tmp_path / "whatever.csv")))
+
+    def boom(path):
+        raise RuntimeError("infra hiccup, not an unreadable payload")
+
+    monkeypatch.setattr(dmod, "_read_payload", boom)
+    with pytest.raises(RuntimeError, match="infra hiccup"):
+        q.take(4, "w1")
+    assert not q.drained                   # still pending, not stranded
+    assert q.stats()["jobs_pending"] == 1
+    monkeypatch.undo()
+    got = q.take(4, "w1")                  # unreadable now (missing file)
+    assert got == []
+    assert q.stats()["jobs_failed"] == 1
+
+
 def test_unreadable_file_marked_failed(tmp_path, qfactory):
     jpath = str(tmp_path / "journal.jsonl")
     q = qfactory(Journal(jpath))

@@ -106,6 +106,27 @@ def test_long_context_ragged_group(ts_backend, one_backend):
     _assert_same_payloads(_run(ts_backend, specs), _run(one_backend, specs))
 
 
+def test_long_context_mixed_group_routes_partially(ts_backend, one_backend,
+                                                   caplog):
+    """One short job in a ragged group must not drag the long jobs off
+    the time-sharded route: the group-level gate fails on min(lengths)'
+    halo bound, the long job re-gates individually and routes, the short
+    one runs generic — both match the single-device path. Lengths are
+    chosen to share one power-of-two wire-size bucket (else they never
+    group) with a window that fits the long job's per-chip block but not
+    the short one's."""
+    grid = {"fast": np.float32([5.0]), "slow": np.float32([90.0])}
+    recs = synthetic_jobs(1, 600, "sma_crossover", grid, cost=1e-3, seed=80)
+    recs += synthetic_jobs(1, 780, "sma_crossover", grid, cost=1e-3, seed=81)
+    specs = _specs(recs)
+    with caplog.at_level(logging.INFO, logger="dbx.compute"):
+        got = _run(ts_backend, specs)
+    assert any("route time-sharded individually" in r.message
+               for r in caplog.records), \
+        [r.message for r in caplog.records]
+    _assert_same_payloads(got, _run(one_backend, specs))
+
+
 def test_long_context_topk(ts_backend, one_backend):
     """top-k reduction composes with the timeshard route (DBXS payloads:
     same chosen combos, same metric rows)."""
