@@ -1321,6 +1321,16 @@ def verify():
 
 
 if __name__ == "__main__":
+    extra = [a for a in sys.argv[1:] if a != "--verify"]
+    if extra:
+        # An unrecognized flag (--help included) must NOT fall through to
+        # the full 20-minute bench run.
+        sys.exit(
+            "usage: python bench.py [--verify]\n"
+            "  (no flag)  full throughput bench; prints one JSON line\n"
+            "  --verify   on-chip fused-vs-generic parity sweep\n"
+            "config via env: DBX_BENCH_TICKERS/BARS/PARAMS/ITERS/WARMUP, "
+            "DBX_BENCH_CONFIGS=name,name,...")
     if "--verify" in sys.argv[1:]:
         verify()
     else:

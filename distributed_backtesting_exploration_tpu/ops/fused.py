@@ -45,12 +45,33 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
-def _widest_lanes(P_pad: int, cap: int) -> int:
+# A 1024-lane block only qualifies while one (T_pad, lanes) f32 value
+# stays under this budget — past it the sign kernels' live set (returns,
+# sign, pos, equity, two ladder temps) presses v5e VMEM and Mosaic
+# spills. 6 MiB admits the headline T_pad=1280 (5.2 MiB/array).
+_WIDE_BLOCK_BYTES = 6 * 1024 * 1024
+
+
+def _widest_lanes(P_pad: int, cap: int, T_pad: int | None = None) -> int:
     """Widest legal param-block width <= ``cap``: fewer, wider cells
     amortize per-cell fixed overhead (+16% measured at 512 on the SMA
     headline — bench.py roofline_stages). Sign kernels take 512; kernels
-    holding a 3-state compose ladder live cap at 256 (VMEM budget)."""
-    for cand in (512, 256, _LANES):
+    holding a 3-state compose ladder cap at 256 (VMEM budget).
+
+    1024 stays OFF the default ladder: the roofline stage twin (HBM-table
+    SMA) measured +7% at 1024, but the SHIPPED inline kernels measured a
+    wash-to-regression in the 3x interleaved on-chip A/B (median sma
+    -0.6%, momentum -2.6%, obv -0.5%) — the scratch table build plus the
+    wider live set spills what the stage twin keeps resident. The
+    ``DBX_LANES_CAP`` override (read at trace time; replaces ``cap`` for
+    sign kernels, still VMEM-gated) keeps the A/B reproducible."""
+    env = int(os.environ.get("DBX_LANES_CAP") or 0)
+    if env and cap > 256:
+        cap = env
+    for cand in (1024, 512, 256, _LANES):
+        if cand > 512 and (T_pad is None
+                           or T_pad * cand * 4 > _WIDE_BLOCK_BYTES):
+            continue
         if cand <= cap and P_pad >= cand and P_pad % cand == 0:
             return cand
     return P_pad
@@ -406,7 +427,7 @@ def _fused_call(close, onehot_f, onehot_s, warm, t_real, *, windows: tuple,
     close_p = _pad_last(close, T_pad)
     returns3 = _rets3(close_p)
     P_pad = onehot_f.shape[1]
-    lanes = _widest_lanes(P_pad, 512)   # sign kernel: no compose ladder
+    lanes = _widest_lanes(P_pad, 512, T_pad)   # sign kernel: no compose ladder
     n_blocks = P_pad // lanes
     grid = (N, n_blocks)
     if table == "inline":
@@ -541,22 +562,24 @@ def _band_ladder(z, valid, k, z_exit):
     return p0   # start state is flat: the 0-component is the position path
 
 
-def _band_cell_prologue(r_ref, z_ref, ow_ref, k_ref, warm_ref, refs, T_real):
+def _band_cell_core(z_wt, r_ref, ow_ref, k_ref, warm_ref, refs, T_real):
     """Shared head of every band-family cell (Bollinger hysteresis, band
     touch; RSI and VWAP reuse those kernels): ragged/uniform unpack, the
     z-selection matmul, warmup mask and band lanes.
 
-    The z-table arrives (W_pad, T_pad) — T on lanes, so HBM tiling pads W
-    to a sublane multiple (8) instead of a lane multiple (128); at the
-    baseline grid's ~20 distinct windows the old (T, W)-minor layout
-    inflated every table and prep intermediate 6.4x (same fix as the pairs
-    kernel). Returns ``(tr, out_ref, r, z, t_idx, valid, k)``.
+    ``z_wt`` is the ``(W_pad, T_pad)`` z-table VALUE — read from an HBM-
+    streamed input block or from the in-kernel VMEM scratch build; T on
+    lanes, so HBM tiling pads W to a sublane multiple (8) instead of a
+    lane multiple (128); at the baseline grid's ~20 distinct windows the
+    old (T, W)-minor layout inflated every table and prep intermediate
+    6.4x (same fix as the pairs kernel). Returns
+    ``(tr, out_ref, r, z, t_idx, valid, k)``.
     """
     tr, out_ref = _unpack_tr(refs, T_real)
     T_pad = r_ref.shape[1]
     r = r_ref[0]                     # (T_pad, 1)
     dn = (((0,), (0,)), ((), ()))
-    z = jax.lax.dot_general(z_ref[0], ow_ref[:], dn,
+    z = jax.lax.dot_general(z_wt, ow_ref[:], dn,
                             preferred_element_type=jnp.float32,
                             precision=jax.lax.Precision.HIGHEST)  # (T_pad,128)
 
@@ -568,31 +591,115 @@ def _band_cell_prologue(r_ref, z_ref, ow_ref, k_ref, warm_ref, refs, T_real):
     return tr, out_ref, r, z, t_idx, valid, k
 
 
+def _band_cell_prologue(r_ref, z_ref, ow_ref, k_ref, warm_ref, refs, T_real):
+    """`_band_cell_core` over an HBM-streamed ``(1, W_pad, T_pad)`` block."""
+    return _band_cell_core(z_ref[0], r_ref, ow_ref, k_ref, warm_ref, refs,
+                           T_real)
+
+
+def _band_cell_finish(machine: str, z, valid, k, z_exit, r, t_idx, tr,
+                      out_ref, *, cost: float, ppy: int):
+    """Tail of both Bollinger-family cells — one body for both table
+    substrates so the position semantics cannot drift between them.
+
+    ``"hysteresis"``: the 3-state band machine (enter outside ±k, exit
+    through ±z_exit). ``"touch"``: memoryless — exposure is which band
+    you are currently outside of (``models.bollinger.bollinger_touch``),
+    so the compose ladder drops out entirely."""
+    if machine == "touch":
+        pos = jnp.where(z < -k, 1.0, jnp.where(z > k, -1.0, 0.0))
+        pos = jnp.where(valid, pos, 0.0)
+    else:
+        pos = _band_ladder(z, valid, k, z_exit)
+    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
+
+
 def _boll_kernel(r_ref, z_ref, ow_ref, k_ref, warm_ref, *refs,
                  cost: float, ppy: int, z_exit: float,
                  T_real: int | None):
     """Bollinger mean-reversion cell: z-selection matmul + hysteresis ladder."""
     tr, out_ref, r, z, t_idx, valid, k = _band_cell_prologue(
         r_ref, z_ref, ow_ref, k_ref, warm_ref, refs, T_real)
-    pos = _band_ladder(z, valid, k, z_exit)
-    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
+    _band_cell_finish("hysteresis", z, valid, k, z_exit, r, t_idx, tr,
+                      out_ref, cost=cost, ppy=ppy)
 
 
 def _touch_kernel(r_ref, z_ref, ow_ref, k_ref, warm_ref, *refs,
                   cost: float, ppy: int, z_exit: float,
                   T_real: int | None):
-    """Band-touch cell: the memoryless Bollinger variant — exposure is
-    which band you are currently outside of (``models.bollinger``'s
-    ``bollinger_touch``), so the hysteresis ladder drops out entirely and
-    the cell is one z-selection matmul + a two-select position.
-    ``z_exit`` is unused (the machine has no exit memory); the parameter
-    stays so the kernel is plug-compatible with ``_boll_kernel`` in
-    :func:`_fused_boll_call`."""
+    """Band-touch cell: the memoryless Bollinger variant (see
+    :func:`_band_cell_finish`). ``z_exit`` is unused (the machine has no
+    exit memory); the parameter stays so the kernel is plug-compatible
+    with ``_boll_kernel`` in :func:`_fused_boll_call`."""
     tr, out_ref, r, z, t_idx, valid, k = _band_cell_prologue(
         r_ref, z_ref, ow_ref, k_ref, warm_ref, refs, T_real)
-    pos = jnp.where(z < -k, 1.0, jnp.where(z > k, -1.0, 0.0))
-    pos = jnp.where(valid, pos, 0.0)
-    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
+    _band_cell_finish("touch", z, valid, k, z_exit, r, t_idx, tr,
+                      out_ref, cost=cost, ppy=ppy)
+
+
+def _build_boll_z_scratch(c, cs, csx, csx2, z_scr, windows: tuple,
+                          W_pad: int):
+    """Fill a ``(W_pad, T_pad)`` VMEM scratch with the W-major Bollinger
+    z-table of the series whose close row / close cumsum / centered cumsum
+    / centered-square cumsum rows are ``(1, T_pad)`` each — the exact op
+    sequence of `_fused_boll_call`'s XLA prep (cumsum-difference windowed
+    sums, rolling.py's series-centered cancellation guard, eps=1e-12,
+    warmup zero-fill), with `_shift_t`'s zero fill reproduced as
+    rotate + zero the wrapped lanes. Call under ``pl.when(j == 0)``."""
+    T_pad = cs.shape[-1]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, T_pad), 1)
+    for i, w in enumerate(windows):
+        w = int(w)
+
+        def wsum(row):
+            if w < T_pad:
+                shifted = jnp.where(lane >= w, _rot_lanes(row, w), 0.0)
+            else:
+                shifted = jnp.zeros_like(row)
+            return row - shifted
+
+        w_f = jnp.float32(w)
+        m = wsum(cs) / w_f
+        s1 = wsum(csx)
+        s2 = wsum(csx2)
+        var = jnp.maximum((s2 - s1 * s1 / w_f) / w_f, 0.0)
+        z_w = (c - m) / (jnp.sqrt(var) + 1e-12)
+        z_scr[i:i + 1, :] = jnp.where(lane >= w - 1, z_w, 0.0)
+    for i in range(len(windows), W_pad):
+        # One-hot weights are zero on pad rows, but 0 * garbage VMEM
+        # could still be NaN — zero them (same discipline as
+        # `_build_sma_scratch`).
+        z_scr[i:i + 1, :] = jnp.zeros((1, T_pad), jnp.float32)
+
+
+def _band_kernel_inline(r_ref, c_ref, cs_ref, csx_ref, csx2_ref, ow_ref,
+                        k_ref, warm_ref, *refs, cost: float, ppy: int,
+                        z_exit: float, T_real: int | None, machine: str,
+                        windows: tuple, W_pad: int):
+    """Both Bollinger-family cells with IN-KERNEL z-table construction.
+
+    Takes the close row plus three cumsum rows ``(N, 1, T_pad)`` instead
+    of the XLA-built ``(N, W_pad, T_pad)`` z-table and rebuilds the
+    W-major table into persistent VMEM scratch once per ticker at
+    param-block ``j == 0`` (same scratch-persistence contract as
+    `_kernel_inline`). This deletes the largest XLA prep in the file —
+    three windowed sums + var/sqrt over table-shaped intermediates — and
+    the z-table HBM round-trip (~61 MB at headline shapes; the prep
+    measured ~17% of bollinger's and ~34% of touch's end-to-end wall).
+    Bit-identical on CPU interpret mode (tested); on TPU Mosaic's f32
+    div/sqrt lowering differs from XLA's by ~1 ULP on some entries — the
+    knife-edge flip class every verify budget already covers."""
+    *head, z_scr = refs
+
+    @pl.when(pl.program_id(1) == 0)
+    def _build():
+        _build_boll_z_scratch(c_ref[0], cs_ref[0], csx_ref[0], csx2_ref[0],
+                              z_scr, windows, W_pad)
+
+    tr, out_ref, r, z, t_idx, valid, k = _band_cell_core(
+        z_scr[:], r_ref, ow_ref, k_ref, warm_ref, tuple(head), T_real)
+    _band_cell_finish(machine, z, valid, k, z_exit, r, t_idx, tr,
+                      out_ref, cost=cost, ppy=ppy)
 
 
 _BAND_KERNELS = {"hysteresis": _boll_kernel, "touch": _touch_kernel}
@@ -663,24 +770,42 @@ def _cumsum_window_tools(windows: tuple, T_pad: int):
 
 def _band_machine_pallas(kernel, close_p, z_table, onehot_w, k_lanes, warm,
                          t_real, *, T_pad: int, W_pad: int, P_real: int,
-                         T_real: int | None, interpret: bool):
+                         T_real: int | None, interpret: bool,
+                         lanes_cap: int = 256, aux_rows=(),
+                         scratch_shapes=()):
     """Shared launch for every band-machine strategy (Bollinger, RSI, VWAP):
     returns column + ``(N, W_pad, T_pad)`` z-table + one-hot/band/warmup
-    lanes into ``_boll_kernel``-shaped cells, :class:`Metrics` out."""
+    lanes into ``_boll_kernel``-shaped cells, :class:`Metrics` out.
+
+    ``lanes_cap`` defaults to 256 — the hysteresis cell's 3-state compose
+    ladder keeps ~6 (T_pad, lanes) arrays live, so 512 lanes would press
+    the VMEM budget. The ladder-free touch cell overrides to 512 (sign-
+    kernel class).
+
+    ``z_table=None`` selects the in-kernel substrate: ``aux_rows`` (each
+    ``(N, T_pad)``, delivered as ``(1, 1, T_pad)`` lane-major blocks) and
+    ``scratch_shapes`` carry the VMEM-scratch z-table build instead
+    (`_band_kernel_inline`)."""
     N = close_p.shape[0]
     P_pad = k_lanes.shape[1]
-    # Capped at 256 — the 3-state compose ladder keeps ~6 (T_pad, lanes)
-    # arrays live, so 512 lanes would press the VMEM budget.
-    lanes = _widest_lanes(P_pad, 256)
+    lanes = _widest_lanes(P_pad, lanes_cap, T_pad)
     n_blocks = P_pad // lanes
+    table_specs = [] if z_table is None else [
+        pl.BlockSpec((1, W_pad, T_pad), lambda i, j: (i, 0, 0),
+                     memory_space=pltpu.VMEM)]
+    table_args = [] if z_table is None else [z_table]
+    aux_specs = [
+        pl.BlockSpec((1, 1, T_pad), lambda i, j: (i, 0, 0),
+                     memory_space=pltpu.VMEM)
+        for _ in aux_rows
+    ]
     out = pl.pallas_call(
         kernel,
         grid=(N, n_blocks),
         in_specs=[
             pl.BlockSpec((1, T_pad, 1), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, W_pad, T_pad), lambda i, j: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
+        ] + table_specs + aux_specs + [
             pl.BlockSpec((W_pad, lanes), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, lanes), lambda i, j: (0, j),
@@ -693,8 +818,10 @@ def _band_machine_pallas(kernel, close_p, z_table, onehot_w, k_lanes, warm,
             memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(
             (N, n_blocks, _METRIC_ROWS, lanes), jnp.float32),
+        scratch_shapes=list(scratch_shapes),
         interpret=interpret,
-    )(_rets3(close_p), z_table, onehot_w, k_lanes, warm,
+    )(_rets3(close_p), *table_args,
+      *(row[:, None, :] for row in aux_rows), onehot_w, k_lanes, warm,
       *_tr_args(t_real, T_real))
     return Metrics(*(
         jnp.reshape(out[:, :, k, :], (N, P_pad))[:, :P_real]
@@ -704,11 +831,11 @@ def _band_machine_pallas(kernel, close_p, z_table, onehot_w, k_lanes, warm,
 @functools.partial(
     jax.jit,
     static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
-                     "ppy", "z_exit", "machine", "interpret"))
+                     "ppy", "z_exit", "machine", "interpret", "table"))
 def _fused_boll_call(close, onehot_w, k_lanes, warm, t_real, *, windows: tuple,
                      T_pad: int, W_pad: int, P_real: int, T_real: int | None,
                      cost: float, ppy: int, z_exit: float, interpret: bool,
-                     machine: str = "hysteresis"):
+                     machine: str = "hysteresis", table: str = "inline"):
     """Z-score table prep + pallas call in one jit (same dispatch-economy
     rationale as ``_fused_call``).
 
@@ -716,15 +843,38 @@ def _fused_boll_call(close, onehot_w, k_lanes, warm, t_real, *, windows: tuple,
     CPU interpret-mode results are bit-identical to the generic path:
     numerator from the *uncentered* rolling mean, std from series-centered
     second moments (rolling.py's cancellation guard), eps=1e-12.
+
+    ``table="inline"`` (default) ships only the close row + three cumsum
+    rows to the kernel and rebuilds the z-table in VMEM scratch
+    (`_band_kernel_inline`) — the three windowed sums + var/sqrt XLA prep
+    and the z-table HBM round-trip measured ~17% (hysteresis) / ~34%
+    (touch) of end-to-end wall at headline shapes. ``"hbm"`` keeps the
+    XLA-built table as the A/B twin.
     """
     N, T = close.shape
     close_p = _pad_last(close, T_pad)
-    w_col, w_f, t_row, windowed_sum, _ = _cumsum_window_tools(windows, T_pad)
-
-    m = windowed_sum(close_p) / w_f                              # rolling mean
+    # The memoryless touch cell has no compose ladder: sign-kernel VMEM
+    # class, so it takes the sign kernels' 512-lane blocks (measured +5%
+    # in the 3x interleaved on-chip A/B).
+    lanes_cap = 512 if machine == "touch" else 256
     # Center with the mean over the REAL bars only (the generic path sees the
     # unpadded series); the pad region's xc values never reach a real output.
     xc = close_p - jnp.mean(close_p[:, :T], axis=1, keepdims=True)
+    if table == "inline":
+        kernel = functools.partial(_band_kernel_inline, cost=cost, ppy=ppy,
+                                   z_exit=z_exit, T_real=T_real,
+                                   machine=machine, windows=windows,
+                                   W_pad=W_pad)
+        return _band_machine_pallas(
+            kernel, close_p, None, onehot_w, k_lanes, warm, t_real,
+            T_pad=T_pad, W_pad=W_pad, P_real=P_real, T_real=T_real,
+            interpret=interpret, lanes_cap=lanes_cap,
+            aux_rows=[close_p, jnp.cumsum(close_p, axis=1),
+                      jnp.cumsum(xc, axis=1), jnp.cumsum(xc * xc, axis=1)],
+            scratch_shapes=[pltpu.VMEM((W_pad, T_pad), jnp.float32)])
+
+    w_col, w_f, t_row, windowed_sum, _ = _cumsum_window_tools(windows, T_pad)
+    m = windowed_sum(close_p) / w_f                              # rolling mean
     s1 = windowed_sum(xc)
     s2 = windowed_sum(xc * xc)
     var = jnp.maximum((s2 - s1 * s1 / w_f) / w_f, 0.0)
@@ -737,14 +887,16 @@ def _fused_boll_call(close, onehot_w, k_lanes, warm, t_real, *, windows: tuple,
     return _band_machine_pallas(
         kernel, close_p, z_table, onehot_w, k_lanes, warm, t_real,
         T_pad=T_pad, W_pad=W_pad, P_real=P_real, T_real=T_real,
-        interpret=interpret)
+        interpret=interpret, lanes_cap=lanes_cap)
 
 
 def _bollinger_family_sweep(close, window, k, *, machine: str, z_exit: float,
                             t_real, cost: float, periods_per_year: int,
-                            interpret: bool | None) -> Metrics:
+                            interpret: bool | None,
+                            table: str | None = None) -> Metrics:
     """Shared prep for both Bollinger-family wrappers (one z-table/grid
-    pipeline, the ``machine`` picks the cell)."""
+    pipeline, the ``machine`` picks the cell; ``table`` picks the z-table
+    substrate — env ``DBX_BOLL_TABLE`` or ``"inline"``)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     close = jnp.asarray(close, jnp.float32)
@@ -764,13 +916,16 @@ def _bollinger_family_sweep(close, window, k, *, machine: str, z_exit: float,
                             T_real=T if t_real is None else None,
                             cost=float(cost), ppy=int(periods_per_year),
                             z_exit=float(z_exit), machine=machine,
-                            interpret=bool(interpret))
+                            interpret=bool(interpret),
+                            table=_resolve_table(table, "DBX_BOLL_TABLE",
+                                                 "inline"))
 
 
 def fused_bollinger_touch_sweep(close, window, k, *, t_real=None,
                                 cost: float = 0.0,
                                 periods_per_year: int = 252,
-                                interpret: bool | None = None) -> Metrics:
+                                interpret: bool | None = None,
+                                table: str | None = None) -> Metrics:
     """Fused band-touch sweep: the path-free Bollinger variant.
 
     Same z-table and grid layout as :func:`fused_bollinger_sweep`, but the
@@ -782,13 +937,15 @@ def fused_bollinger_touch_sweep(close, window, k, *, t_real=None,
     """
     return _bollinger_family_sweep(
         close, window, k, machine="touch", z_exit=0.0, t_real=t_real,
-        cost=cost, periods_per_year=periods_per_year, interpret=interpret)
+        cost=cost, periods_per_year=periods_per_year, interpret=interpret,
+        table=table)
 
 
 def fused_bollinger_sweep(close, window, k, *, t_real=None,
                           z_exit: float = 0.0,
                           cost: float = 0.0, periods_per_year: int = 252,
-                          interpret: bool | None = None) -> Metrics:
+                          interpret: bool | None = None,
+                          table: str | None = None) -> Metrics:
     """Fused Bollinger mean-reversion sweep: ``(N, T)`` closes x ``(P,)`` lanes.
 
     ``window``/``k`` are flat per-combo arrays (:func:`product_grid` order);
@@ -801,7 +958,7 @@ def fused_bollinger_sweep(close, window, k, *, t_real=None,
     return _bollinger_family_sweep(
         close, window, k, machine="hysteresis", z_exit=z_exit,
         t_real=t_real, cost=cost, periods_per_year=periods_per_year,
-        interpret=interpret)
+        interpret=interpret, table=table)
 
 
 
@@ -1353,7 +1510,7 @@ def _single_window_pallas(kernel, close, tables, onehot_w, warm, t_real, *,
     """
     N = close.shape[0]
     P_pad = onehot_w.shape[1]
-    lanes = _widest_lanes(P_pad, lanes_cap)
+    lanes = _widest_lanes(P_pad, lanes_cap, T_pad)
     n_blocks = P_pad // lanes
     table_specs = [
         pl.BlockSpec((1, W_pad, T_pad), lambda i, j: (i, 0, 0),
@@ -2115,7 +2272,7 @@ def _fused_obv_call(close, volume, onehot_w, warm, t_real, *,
     obv = rolling.obv_series(close_p, vol_p)                   # (N, T_pad)
 
     P_pad = onehot_w.shape[1]
-    lanes = _widest_lanes(P_pad, 512)   # sign kernel: no compose ladder
+    lanes = _widest_lanes(P_pad, 512, T_pad)   # sign kernel: no compose ladder
     n_blocks = P_pad // lanes
     if table == "inline":
         cs = jnp.cumsum(obv, axis=1)[:, None, :]               # (N,1,T_pad)

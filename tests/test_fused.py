@@ -167,6 +167,55 @@ def test_obv_inline_table_matches_hbm():
             err_msg=field)
 
 
+def test_bollinger_inline_ztable_matches_hbm():
+    # The in-kernel z-table build (`_band_kernel_inline` /
+    # `_build_boll_z_scratch`) vs the XLA-built z-table, both machines:
+    # bit-identical on CPU (the on-TPU 1-ULP div/sqrt caveat is gated by
+    # bench --verify). window axis deliberately sized so W_pad (8-row
+    # sublane padding) EXCEEDS the distinct-window count — the scratch pad
+    # rows must be zeroed, not left as garbage VMEM (a NaN there survives
+    # the 0-weight one-hot contraction and silently flattens positions).
+    ohlcv = data.synthetic_ohlcv(3, 300, seed=29)
+    close = jnp.asarray(ohlcv.close)
+    grid = sweep.product_grid(
+        window=jnp.asarray([10, 17, 26], jnp.float32),
+        k=jnp.asarray([0.8, 1.5, 2.2], jnp.float32))
+    w, k = np.asarray(grid["window"]), np.asarray(grid["k"])
+    cases = [
+        ("bollinger", lambda m: fused.fused_bollinger_sweep(
+            close, w, k, cost=1e-3, table=m)),
+        ("bollinger_touch", lambda m: fused.fused_bollinger_touch_sweep(
+            close, w, k, cost=1e-3, table=m)),
+    ]
+    for name, mk in cases:
+        a, b = mk("hbm"), mk("inline")
+        for field in a._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a, field)), np.asarray(getattr(b, field)),
+                err_msg=f"{name}.{field}")
+
+
+def test_bollinger_inline_ztable_multi_block_ragged():
+    # 25 windows x 24 k = 600 combos -> P_pad 640 -> 128-lane blocks x 5:
+    # scratch persistence across param blocks, plus per-ticker lengths.
+    ohlcv = data.synthetic_ohlcv(3, 300, seed=31)
+    close = jnp.asarray(ohlcv.close)
+    t_real = np.asarray([300, 254, 147], np.int32)
+    grid = sweep.product_grid(
+        window=jnp.arange(10, 60, 2, dtype=jnp.float32),
+        k=jnp.linspace(0.5, 3.0, 24).astype(jnp.float32))
+    w, k = np.asarray(grid["window"]), np.asarray(grid["k"])
+    for machine, fn in (("bollinger", fused.fused_bollinger_sweep),
+                        ("bollinger_touch",
+                         fused.fused_bollinger_touch_sweep)):
+        a = fn(close, w, k, t_real=t_real, cost=1e-3, table="hbm")
+        b = fn(close, w, k, t_real=t_real, cost=1e-3, table="inline")
+        for field in a._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a, field)), np.asarray(getattr(b, field)),
+                err_msg=f"{machine}.{field}")
+
+
 def test_momentum_inline_table_ragged_matches_hbm():
     ohlcv = data.synthetic_ohlcv(3, 300, seed=22)
     close = jnp.asarray(ohlcv.close)
