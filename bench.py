@@ -1097,6 +1097,14 @@ def main():
     else:
         metric = (f"backtests/sec/chip (ticker x param combos), "
                   f"config={headline_name}")
+    # Live per-phase attribution: the obs registry every configured layer
+    # recorded into during this run (RPC latency histograms from the e2e /
+    # direct-dispatch configs, decode/submit/collect splits and kernel
+    # wall from the worker backend, journal fsync timing). Snapshotting it
+    # into BENCH JSON gives the roofline numbers their runtime
+    # counterparts (metric names in DESIGN.md "Observability").
+    from distributed_backtesting_exploration_tpu import obs as obs_mod
+
     print(json.dumps({
         "metric": metric,
         "value": round(rates[headline_name], 1),
@@ -1107,6 +1115,7 @@ def main():
         # Per-kernel utilization model (% of approximate v5e peaks +
         # binding resource); see the roofline comment in main().
         "roofline": ROOFLINE,
+        "obs": obs_mod.get_registry().summaries(prefix="dbx_"),
     }))
 
 

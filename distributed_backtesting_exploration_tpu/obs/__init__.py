@@ -1,0 +1,21 @@
+"""Unified observability layer: metrics registry, spans, event log, /metrics.
+
+The one place every layer records into (DESIGN.md "Observability"):
+
+- :mod:`.registry` — process-local counters / gauges / fixed-bucket
+  histograms, rendered as Prometheus text or JSON summaries;
+- :mod:`.trace` — the span API (phase attribution + nesting), ``timed``,
+  ``StepTimer``, ``device_profile`` (absorbed from ``utils.trace``, which
+  is now a deprecation shim);
+- :mod:`.events` — opt-in JSONL event log (``DBX_OBS_JSONL``) for
+  post-mortem trace reconstruction;
+- :mod:`.http` — the ``/metrics`` + ``/stats.json`` HTTP surface;
+- :mod:`.dump` — ``python -m ...obs.dump`` pretty-printer / phase table.
+"""
+
+from . import events  # noqa: F401
+from .http import MetricsServer, start_metrics_server  # noqa: F401
+from .registry import (  # noqa: F401
+    LATENCY_BUCKETS_S, Counter, Gauge, Histogram, Registry, get_registry)
+from .trace import (  # noqa: F401
+    StepTimer, current_span, device_profile, span, timed, timer)

@@ -1,0 +1,143 @@
+"""Phase-attribution viewer: ``python -m ...obs.dump <target>``.
+
+``target`` is either a live endpoint (``http://host:port`` — its
+``/stats.json`` is fetched) or a JSONL event-log path (``DBX_OBS_JSONL``
+output). Either way the output is a phase table: where wall-clock went,
+by span/histogram, share-ranked — the live counterpart of bench.py's
+roofline stage accounting.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def _fmt_s(v: float) -> str:
+    if v >= 1.0:
+        return f"{v:.3f}s"
+    if v >= 1e-3:
+        return f"{v * 1e3:.2f}ms"
+    return f"{v * 1e6:.0f}us"
+
+
+def _table(rows: list[tuple], header: tuple) -> str:
+    rows = [tuple(str(c) for c in r) for r in rows]
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(header)]
+    def line(cells):
+        return "  ".join(c.ljust(w) for c, w in zip(cells, widths)).rstrip()
+    out = [line(header), line(tuple("-" * w for w in widths))]
+    out.extend(line(r) for r in rows)
+    return "\n".join(out)
+
+
+def _phase_rows(digests: dict) -> list[tuple]:
+    """(name, count, total_s, avg, p50, p99, max, share%) rows from
+    ``{label: histogram-summary}`` digests, share-ranked."""
+    total = sum(d.get("sum", 0.0) for d in digests.values()) or 1.0
+    rows = []
+    for label, d in sorted(digests.items(),
+                           key=lambda kv: -kv[1].get("sum", 0.0)):
+        if not d.get("count"):
+            continue
+        rows.append((label, d["count"], _fmt_s(d["sum"]),
+                     _fmt_s(d.get("avg", 0.0)),
+                     _fmt_s(d.get("p50", 0.0)), _fmt_s(d.get("p99", 0.0)),
+                     _fmt_s(d.get("max", 0.0)),
+                     f"{100.0 * d['sum'] / total:.1f}%"))
+    return rows
+
+
+_PHASE_HEADER = ("phase", "count", "total", "avg", "p50", "p99", "max",
+                 "share")
+
+
+def render_snapshot(snap: dict) -> str:
+    """Registry snapshot (``/stats.json`` shape) -> report text."""
+    out: list[str] = []
+    hists = {name: fam["values"] for name, fam in snap.items()
+             if fam.get("type") == "histogram"}
+    for name, values in sorted(hists.items()):
+        rows = _phase_rows(values)
+        if rows:
+            out.append(f"== {name} ==")
+            out.append(_table(rows, _PHASE_HEADER))
+            out.append("")
+    scalars = []
+    for name, fam in sorted(snap.items()):
+        if fam.get("type") in ("counter", "gauge"):
+            for label, v in sorted(fam["values"].items()):
+                key = f"{name}{{{label}}}" if label else name
+                scalars.append((key, fam["type"],
+                                round(v, 6) if isinstance(v, float) else v))
+    if scalars:
+        out.append("== counters / gauges ==")
+        out.append(_table(scalars, ("metric", "type", "value")))
+        out.append("")
+    return "\n".join(out) if out else "(no metrics recorded)\n"
+
+
+def render_jsonl(path: str) -> str:
+    """Aggregate a span event log into the phase table."""
+    agg: dict[str, dict] = {}
+    n_events = 0
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue   # torn tail is diagnostic-grade, skip quietly
+            n_events += 1
+            if rec.get("ev") != "span":
+                continue
+            name = rec.get("name", "?")
+            if rec.get("parent"):
+                name = f"{rec['parent']}/{name}"
+            dur = float(rec.get("dur_s", 0.0))
+            d = agg.setdefault(name, {"count": 0, "sum": 0.0, "max": 0.0,
+                                      "durs": []})
+            d["count"] += 1
+            d["sum"] += dur
+            d["max"] = max(d["max"], dur)
+            d["durs"].append(dur)
+    digests = {}
+    for name, d in agg.items():
+        durs = sorted(d["durs"])
+        digests[name] = {
+            "count": d["count"], "sum": d["sum"],
+            "avg": d["sum"] / d["count"], "max": d["max"],
+            "p50": durs[len(durs) // 2],
+            "p99": durs[min(len(durs) - 1, int(len(durs) * 0.99))]}
+    rows = _phase_rows(digests)
+    head = f"{n_events} events, {len(agg)} span phases from {path}"
+    if not rows:
+        return head + "\n(no span events)\n"
+    return head + "\n" + _table(rows, _PHASE_HEADER) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="pretty-print a dbx obs endpoint or JSONL event log "
+                    "as a phase-attribution table")
+    ap.add_argument("target",
+                    help="http://host:port of a live /metrics server, or "
+                         "a JSONL event-log path")
+    args = ap.parse_args(argv)
+    if args.target.startswith(("http://", "https://")):
+        url = args.target.rstrip("/") + "/stats.json"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            snap = json.loads(resp.read())
+        sys.stdout.write(render_snapshot(snap))
+    else:
+        sys.stdout.write(render_jsonl(args.target))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,71 @@
+"""Opt-in JSONL event log for post-mortem trace reconstruction.
+
+Spans (and any layer that wants durable breadcrumbs) emit one JSON object
+per line. Disabled by default — :func:`emit` is a single ``is None`` check
+— and enabled either explicitly (:func:`configure`) or by exporting
+``DBX_OBS_JSONL=/path/to/events.jsonl`` before process start.
+
+Unlike the dispatcher's job journal (``rpc.journal``), this log is
+diagnostic, not durable state: writes are flushed but not fsync'd, and a
+lost tail loses nothing but trace detail.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+_lock = threading.Lock()
+_fh = None
+_path: str | None = None
+
+
+def configure(path: str | None) -> None:
+    """Open (or with ``None``, close) the process-wide event log."""
+    global _fh, _path
+    with _lock:
+        if _fh is not None:
+            _fh.close()
+            _fh = None
+        _path = path
+        if path:
+            _fh = open(path, "a", encoding="utf-8")
+
+
+def configured_path() -> str | None:
+    return _path
+
+
+def enabled() -> bool:
+    return _fh is not None
+
+
+def emit(event: str, **payload) -> None:
+    """Append one event line; no-op (one attribute read) when disabled."""
+    if _fh is None:
+        return
+    rec = {"ev": event, "ts": time.time(), **payload}
+    line = json.dumps(rec, separators=(",", ":"), default=str)
+    with _lock:
+        if _fh is None:
+            return
+        _fh.write(line + "\n")
+        _fh.flush()
+
+
+# Environment opt-in at import time: workers/dispatchers started with
+# DBX_OBS_JSONL set begin logging without any code change. A bad path must
+# not kill the process at import — this log is diagnostic, so degrade to
+# disabled with a loud warning instead.
+_env_path = os.environ.get("DBX_OBS_JSONL")
+if _env_path:
+    try:
+        configure(_env_path)
+    except OSError as e:
+        import logging
+
+        logging.getLogger("dbx.obs").warning(
+            "DBX_OBS_JSONL=%s could not be opened (%s); event logging "
+            "disabled", _env_path, e)

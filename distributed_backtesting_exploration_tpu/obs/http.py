@@ -1,0 +1,85 @@
+"""Tiny stdlib HTTP exposure: ``/metrics`` (Prometheus text) + ``/stats.json``.
+
+One daemon thread per server; ``port=0`` binds an ephemeral port (the bound
+port is on ``MetricsServer.port``). No external deps — the scrape surface
+must exist on any box the dispatcher or a worker lands on.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .registry import Registry, get_registry
+
+log = logging.getLogger("dbx.obs.http")
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsServer:
+    """Serves a registry over HTTP; ``start()``/``stop()`` lifecycle."""
+
+    def __init__(self, port: int = 0, *, registry: Registry | None = None,
+                 bind: str = "0.0.0.0"):
+        self.registry = registry or get_registry()
+        self._bind = bind
+        self._requested_port = port
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        self.port: int | None = None
+
+    def start(self) -> "MetricsServer":
+        reg = self.registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):                      # noqa: N802 (stdlib API)
+                path = self.path.split("?", 1)[0]
+                if path in ("/metrics", "/"):
+                    body = reg.render_prometheus().encode()
+                    ctype = PROMETHEUS_CONTENT_TYPE
+                elif path == "/stats.json":
+                    body = json.dumps(reg.snapshot()).encode()
+                    ctype = "application/json"
+                elif path == "/healthz":
+                    body, ctype = b"ok\n", "text/plain"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):     # scrapes are not news
+                pass
+
+        self._httpd = ThreadingHTTPServer((self._bind, self._requested_port),
+                                          Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="dbx-metrics-http",
+            daemon=True)
+        self._thread.start()
+        log.info("metrics endpoint on http://%s:%d/metrics", self._bind,
+                 self.port)
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+def start_metrics_server(port: int, *,
+                         registry: Registry | None = None) -> MetricsServer:
+    """Start a /metrics endpoint on ``port`` (0 = ephemeral)."""
+    return MetricsServer(port, registry=registry).start()

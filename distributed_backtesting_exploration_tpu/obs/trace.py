@@ -1,0 +1,153 @@
+"""Span/trace API + timing utilities (supersedes ``utils.trace``).
+
+A :func:`span` is the unit of phase attribution: it times a named phase,
+records the duration into the shared registry's ``dbx_span_seconds``
+histogram (labeled by span name), tracks nesting per thread, and — when the
+JSONL event log is configured — emits one event per span with its parent,
+so a post-mortem reader can rebuild the per-batch chain
+(``decode -> submit -> collect -> report``) from the log alone.
+
+``timed`` (log-only), ``StepTimer`` (running throughput meter) and
+``device_profile`` (jax.profiler wrapper) move here from ``utils.trace``,
+which remains as a deprecation shim for one release.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import time
+
+from . import events
+from .registry import get_registry
+
+log = logging.getLogger("dbx.trace")
+
+_tls = threading.local()
+
+
+def current_span() -> str | None:
+    """Name of the innermost active span on this thread, or None."""
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+# Span histograms are get-or-create per distinct name; cache the children so
+# repeated spans cost a dict lookup, not a registry resolution.
+_span_hists: dict = {}
+_span_hists_lock = threading.Lock()
+
+
+def _span_hist(name: str):
+    h = _span_hists.get(name)
+    if h is None:
+        with _span_hists_lock:
+            h = _span_hists.get(name)
+            if h is None:
+                h = get_registry().histogram(
+                    "dbx_span_seconds",
+                    help="wall-clock duration of named phases (span API)",
+                    span=name)
+                _span_hists[name] = h
+    return h
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """Time a named phase: ``with span("decode", jobs=32): ...``.
+
+    Durations land in ``dbx_span_seconds{span=name}``; when the JSONL
+    event log is configured each span also emits
+    ``{"ev": "span", "name", "dur_s", "parent", "thread", ...attrs}``.
+    Exceptions propagate; the span records either way (``ok`` marks it).
+    """
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    parent = stack[-1] if stack else None
+    stack.append(name)
+    t0 = time.perf_counter()
+    ok = True
+    try:
+        yield
+    except BaseException:
+        ok = False
+        raise
+    finally:
+        dur = time.perf_counter() - t0
+        stack.pop()
+        _span_hist(name).observe(dur)
+        if events.enabled():
+            events.emit("span", name=name, dur_s=round(dur, 9),
+                        parent=parent, thread=threading.current_thread().name,
+                        ok=ok, **attrs)
+
+
+@contextlib.contextmanager
+def timer(hist):
+    """Observe the block's wall into a pre-resolved histogram — in a
+    ``finally``, so failures and timeouts are measured too (an RPC
+    latency histogram that excludes the 30 s deadline-exceeded calls
+    reads healthy while throughput is zero)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        hist.observe(time.perf_counter() - t0)
+
+
+@contextlib.contextmanager
+def timed(name: str, *, logger: logging.Logger = log, level=logging.INFO):
+    """Log the wall-clock duration of a phase: ``with timed("decode"): ...``"""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        logger.log(level, "%s took %.1fms", name,
+                   1e3 * (time.perf_counter() - t0))
+
+
+@contextlib.contextmanager
+def device_profile(logdir: str):
+    """Capture a jax.profiler trace (XLA kernel timeline) under ``logdir``.
+
+    View with TensorBoard's profile plugin. On the remote-proxy TPU backend
+    host-side events still capture; device traces need a directly-attached
+    chip.
+    """
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Running throughput meter: the ``backtests/sec`` counter surfaced by
+    the dispatcher's GetStats — usable worker-side for per-batch logs.
+
+    Pass ``gauge`` (an :class:`~.registry.Gauge`) to publish the running
+    rate on every :meth:`add`."""
+
+    def __init__(self, gauge=None):
+        self.t0 = time.monotonic()
+        self.units = 0.0
+        self._gauge = gauge
+
+    def bind_gauge(self, gauge) -> None:
+        """Attach (or detach, with None) the published-rate gauge after
+        construction — for owners whose metric lifecycle starts later
+        than their own (e.g. a Worker binds in run(), not __init__)."""
+        self._gauge = gauge
+
+    def add(self, n: float) -> None:
+        self.units += n
+        if self._gauge is not None:
+            self._gauge.set(self.rate)
+
+    @property
+    def rate(self) -> float:
+        return self.units / max(time.monotonic() - self.t0, 1e-9)

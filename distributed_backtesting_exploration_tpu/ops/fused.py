@@ -52,7 +52,44 @@ def _round_up(x: int, m: int) -> int:
 _WIDE_BLOCK_BYTES = 6 * 1024 * 1024
 
 
-def _widest_lanes(P_pad: int, cap: int, T_pad: int | None = None) -> int:
+# The legal param-block widths (f32 lane multiples the kernels tile by).
+# DBX_LANES_CAP must name one of these — an off-ladder value can satisfy
+# no candidate, and the old fall-through then returned the FULL un-blocked
+# P_pad: the opposite of a cap, blowing VMEM on headline sweeps (ADVICE.md).
+_LANES_LADDER = (_LANES, 256, 512, 1024)
+
+
+def resolve_lanes_cap() -> int:
+    """Validated ``DBX_LANES_CAP`` override (0 = unset).
+
+    Read ONCE per public sweep call, host-side, and threaded into the
+    jitted kernels as the static ``lanes_env`` argument — part of the jit
+    cache key, so changing it in-process recompiles at the new width
+    instead of silently reusing the stale one (ADVICE.md; the in-process
+    A/B measured nothing before this). Raises on values outside the
+    {128, 256, 512, 1024} ladder rather than falling through to an
+    unbounded block width.
+    """
+    raw = os.environ.get("DBX_LANES_CAP")
+    if not raw:
+        return 0
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"DBX_LANES_CAP={raw!r} is not an integer; expected one of "
+            f"{_LANES_LADDER} (or 0/empty to disable)") from None
+    if v == 0:
+        return 0   # explicit disable, same as unset (the old sentinel)
+    if v not in _LANES_LADDER:
+        raise ValueError(
+            f"DBX_LANES_CAP={v} is unusable: no kernel block ladder "
+            f"candidate matches it (legal values: {_LANES_LADDER})")
+    return v
+
+
+def _widest_lanes(P_pad: int, cap: int, T_pad: int | None = None,
+                  env_cap: int = 0) -> int:
     """Widest legal param-block width <= ``cap``: fewer, wider cells
     amortize per-cell fixed overhead (+16% measured at 512 on the SMA
     headline — bench.py roofline_stages). Sign kernels take 512; kernels
@@ -62,12 +99,12 @@ def _widest_lanes(P_pad: int, cap: int, T_pad: int | None = None) -> int:
     SMA) measured +7% at 1024, but the SHIPPED inline kernels measured a
     wash-to-regression in the 3x interleaved on-chip A/B (median sma
     -0.6%, momentum -2.6%, obv -0.5%) — the scratch table build plus the
-    wider live set spills what the stage twin keeps resident. The
-    ``DBX_LANES_CAP`` override (read at trace time; replaces ``cap`` for
-    sign kernels, still VMEM-gated) keeps the A/B reproducible."""
-    env = int(os.environ.get("DBX_LANES_CAP") or 0)
-    if env and cap > 256:
-        cap = env
+    wider live set spills what the stage twin keeps resident. ``env_cap``
+    is the :func:`resolve_lanes_cap`-validated ``DBX_LANES_CAP`` override
+    (replaces ``cap`` for sign-kernel-class calls, still VMEM-gated),
+    passed in as a jit-static so the A/B recompiles per setting."""
+    if env_cap and cap > 256:
+        cap = env_cap
     for cand in (1024, 512, 256, _LANES):
         if cand > 512 and (T_pad is None
                            or T_pad * cand * 4 > _WIDE_BLOCK_BYTES):
@@ -407,11 +444,11 @@ def _build_sma_scratch(cs, sma_scr, windows: tuple, W_pad: int):
 @functools.partial(
     jax.jit,
     static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
-                     "ppy", "interpret", "table"))
+                     "ppy", "interpret", "table", "lanes_env"))
 def _fused_call(close, onehot_f, onehot_s, warm, t_real, *, windows: tuple,
                 T_pad: int, W_pad: int, P_real: int, T_real: int | None,
                 cost: float, ppy: int, interpret: bool,
-                table: str = "inline"):
+                table: str = "inline", lanes_env: int = 0):
     """Table prep + pallas call in ONE jit: the prep is ~500 XLA ops and must
     not run eagerly (each eager op is a dispatch round-trip on the remote-
     proxy TPU backend — measured 13x slower end-to-end).
@@ -427,7 +464,8 @@ def _fused_call(close, onehot_f, onehot_s, warm, t_real, *, windows: tuple,
     close_p = _pad_last(close, T_pad)
     returns3 = _rets3(close_p)
     P_pad = onehot_f.shape[1]
-    lanes = _widest_lanes(P_pad, 512, T_pad)   # sign kernel: no compose ladder
+    # sign kernel: no compose ladder
+    lanes = _widest_lanes(P_pad, 512, T_pad, lanes_env)
     n_blocks = P_pad // lanes
     grid = (N, n_blocks)
     if table == "inline":
@@ -520,7 +558,8 @@ def fused_sma_sweep(close, fast, slow, *, t_real=None, cost: float = 0.0,
                        T_pad=_round_up(T, 8), W_pad=onehot_f.shape[0],
                        P_real=P, T_real=T if t_real is None else None,
                        cost=float(cost), ppy=int(periods_per_year),
-                       interpret=bool(interpret), table=table)
+                       interpret=bool(interpret), table=table,
+                       lanes_env=resolve_lanes_cap())
 
 
 def _prefix_compose3(pm, p0, pp):
@@ -772,7 +811,7 @@ def _band_machine_pallas(kernel, close_p, z_table, onehot_w, k_lanes, warm,
                          t_real, *, T_pad: int, W_pad: int, P_real: int,
                          T_real: int | None, interpret: bool,
                          lanes_cap: int = 256, aux_rows=(),
-                         scratch_shapes=()):
+                         scratch_shapes=(), lanes_env: int = 0):
     """Shared launch for every band-machine strategy (Bollinger, RSI, VWAP):
     returns column + ``(N, W_pad, T_pad)`` z-table + one-hot/band/warmup
     lanes into ``_boll_kernel``-shaped cells, :class:`Metrics` out.
@@ -788,7 +827,7 @@ def _band_machine_pallas(kernel, close_p, z_table, onehot_w, k_lanes, warm,
     (`_band_kernel_inline`)."""
     N = close_p.shape[0]
     P_pad = k_lanes.shape[1]
-    lanes = _widest_lanes(P_pad, lanes_cap, T_pad)
+    lanes = _widest_lanes(P_pad, lanes_cap, T_pad, lanes_env)
     n_blocks = P_pad // lanes
     table_specs = [] if z_table is None else [
         pl.BlockSpec((1, W_pad, T_pad), lambda i, j: (i, 0, 0),
@@ -831,11 +870,13 @@ def _band_machine_pallas(kernel, close_p, z_table, onehot_w, k_lanes, warm,
 @functools.partial(
     jax.jit,
     static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
-                     "ppy", "z_exit", "machine", "interpret", "table"))
+                     "ppy", "z_exit", "machine", "interpret", "table",
+                     "lanes_env"))
 def _fused_boll_call(close, onehot_w, k_lanes, warm, t_real, *, windows: tuple,
                      T_pad: int, W_pad: int, P_real: int, T_real: int | None,
                      cost: float, ppy: int, z_exit: float, interpret: bool,
-                     machine: str = "hysteresis", table: str = "inline"):
+                     machine: str = "hysteresis", table: str = "inline",
+                     lanes_env: int = 0):
     """Z-score table prep + pallas call in one jit (same dispatch-economy
     rationale as ``_fused_call``).
 
@@ -871,7 +912,8 @@ def _fused_boll_call(close, onehot_w, k_lanes, warm, t_real, *, windows: tuple,
             interpret=interpret, lanes_cap=lanes_cap,
             aux_rows=[close_p, jnp.cumsum(close_p, axis=1),
                       jnp.cumsum(xc, axis=1), jnp.cumsum(xc * xc, axis=1)],
-            scratch_shapes=[pltpu.VMEM((W_pad, T_pad), jnp.float32)])
+            scratch_shapes=[pltpu.VMEM((W_pad, T_pad), jnp.float32)],
+            lanes_env=lanes_env)
 
     w_col, w_f, t_row, windowed_sum, _ = _cumsum_window_tools(windows, T_pad)
     m = windowed_sum(close_p) / w_f                              # rolling mean
@@ -887,7 +929,7 @@ def _fused_boll_call(close, onehot_w, k_lanes, warm, t_real, *, windows: tuple,
     return _band_machine_pallas(
         kernel, close_p, z_table, onehot_w, k_lanes, warm, t_real,
         T_pad=T_pad, W_pad=W_pad, P_real=P_real, T_real=T_real,
-        interpret=interpret, lanes_cap=lanes_cap)
+        interpret=interpret, lanes_cap=lanes_cap, lanes_env=lanes_env)
 
 
 def _bollinger_family_sweep(close, window, k, *, machine: str, z_exit: float,
@@ -918,7 +960,8 @@ def _bollinger_family_sweep(close, window, k, *, machine: str, z_exit: float,
                             z_exit=float(z_exit), machine=machine,
                             interpret=bool(interpret),
                             table=_resolve_table(table, "DBX_BOLL_TABLE",
-                                                 "inline"))
+                                                 "inline"),
+                            lanes_env=resolve_lanes_cap())
 
 
 def fused_bollinger_touch_sweep(close, window, k, *, t_real=None,
@@ -1496,7 +1539,8 @@ def _don_kernel_inline(r_ref, c_ref, crow_ref, hi_ref, lo_ref, ow_ref,
 def _single_window_pallas(kernel, close, tables, onehot_w, warm, t_real, *,
                           T_pad: int, W_pad: int, P_real: int,
                           T_real: int | None, interpret: bool,
-                          aux_rows=(), scratch_shapes=(), lanes_cap=_LANES):
+                          aux_rows=(), scratch_shapes=(), lanes_cap=_LANES,
+                          lanes_env: int = 0):
     """Shared pallas_call plumbing for the momentum/donchian kernels:
     returns + close columns, one or two (N, W_pad, T_pad) tables, the
     one-hot/warmup lanes, optional ragged lengths.
@@ -1510,7 +1554,7 @@ def _single_window_pallas(kernel, close, tables, onehot_w, warm, t_real, *,
     """
     N = close.shape[0]
     P_pad = onehot_w.shape[1]
-    lanes = _widest_lanes(P_pad, lanes_cap, T_pad)
+    lanes = _widest_lanes(P_pad, lanes_cap, T_pad, lanes_env)
     n_blocks = P_pad // lanes
     table_specs = [
         pl.BlockSpec((1, W_pad, T_pad), lambda i, j: (i, 0, 0),
@@ -1554,11 +1598,11 @@ def _single_window_pallas(kernel, close, tables, onehot_w, warm, t_real, *,
 @functools.partial(
     jax.jit,
     static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
-                     "ppy", "interpret", "table"))
+                     "ppy", "interpret", "table", "lanes_env"))
 def _fused_mom_call(close, onehot_l, warm, t_real, *, windows: tuple,
                     T_pad: int, W_pad: int, P_real: int, T_real: int | None,
                     cost: float, ppy: int, interpret: bool,
-                    table: str = "inline"):
+                    table: str = "inline", lanes_env: int = 0):
     """Past-close table prep + pallas call in one jit.
 
     ``table="hbm"``: the table is a single clipped XLA gather of raw
@@ -1577,7 +1621,7 @@ def _fused_mom_call(close, onehot_l, warm, t_real, *, windows: tuple,
             W_pad=W_pad, P_real=P_real, T_real=T_real, interpret=interpret,
             aux_rows=[close_p],
             scratch_shapes=[pltpu.VMEM((W_pad, T_pad), jnp.float32)],
-            lanes_cap=512)
+            lanes_cap=512, lanes_env=lanes_env)
     w_col = jnp.asarray(np.asarray(windows, np.int32))[:, None]  # (W,1)
     t_row = jnp.arange(T_pad)[None, :]
     gather_idx = jnp.clip(t_row - w_col, 0, T_pad - 1)           # (W,T_pad)
@@ -1587,7 +1631,7 @@ def _fused_mom_call(close, onehot_l, warm, t_real, *, windows: tuple,
     return _single_window_pallas(
         kernel, close_p, [past_tbl], onehot_l, warm, t_real, T_pad=T_pad,
         W_pad=W_pad, P_real=P_real, T_real=T_real, interpret=interpret,
-        lanes_cap=512)
+        lanes_cap=512, lanes_env=lanes_env)
 
 
 def _extrema_table(src_p, windows: tuple, mode: str, warm_fill: float):
@@ -1715,7 +1759,8 @@ def fused_momentum_sweep(close, lookback, *, t_real=None, cost: float = 0.0,
                            cost=float(cost), ppy=int(periods_per_year),
                            interpret=bool(interpret),
                            table=_resolve_table(table, "DBX_MOM_TABLE",
-                                                "inline"))
+                                                "inline"),
+                           lanes_env=resolve_lanes_cap())
 
 
 def fused_donchian_sweep(close, window, *, t_real=None, cost: float = 0.0,
@@ -2251,11 +2296,12 @@ def _obv_kernel_inline(r_ref, obv_ref, cs_ref, oh_ref, warm_ref, *refs,
 @functools.partial(
     jax.jit,
     static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
-                     "ppy", "interpret", "table"))
+                     "ppy", "interpret", "table", "lanes_env"))
 def _fused_obv_call(close, volume, onehot_w, warm, t_real, *,
                     windows: tuple, T_pad: int, W_pad: int, P_real: int,
                     T_real: int | None, cost: float, ppy: int,
-                    interpret: bool, table: str = "hbm"):
+                    interpret: bool, table: str = "hbm",
+                    lanes_env: int = 0):
     """OBV series + distinct-window SMA table prep + pallas call in one jit.
 
     The OBV accumulator is the SHARED ``rolling.obv_series`` (the same
@@ -2272,7 +2318,8 @@ def _fused_obv_call(close, volume, onehot_w, warm, t_real, *,
     obv = rolling.obv_series(close_p, vol_p)                   # (N, T_pad)
 
     P_pad = onehot_w.shape[1]
-    lanes = _widest_lanes(P_pad, 512, T_pad)   # sign kernel: no compose ladder
+    # sign kernel: no compose ladder
+    lanes = _widest_lanes(P_pad, 512, T_pad, lanes_env)
     n_blocks = P_pad // lanes
     if table == "inline":
         cs = jnp.cumsum(obv, axis=1)[:, None, :]               # (N,1,T_pad)
@@ -2355,7 +2402,8 @@ def fused_obv_sweep(close, volume, window, *, t_real=None, cost: float = 0.0,
                            cost=float(cost), ppy=int(periods_per_year),
                            interpret=bool(interpret),
                            table=_resolve_table(table, "DBX_OBV_TABLE",
-                                                "inline"))
+                                                "inline"),
+                           lanes_env=resolve_lanes_cap())
 
 
 @functools.lru_cache(maxsize=4)

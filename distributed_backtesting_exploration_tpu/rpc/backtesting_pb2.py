@@ -1,5 +1,11 @@
 # -*- coding: utf-8 -*-
-# Generated by the protocol buffer compiler.  DO NOT EDIT!
+# Generated protocol buffer code (message classes only; the service/stub
+# layer is hand-written in service.py). Regenerated WITHOUT protoc: the
+# environment lacks grpc_tools, so the serialized FileDescriptorProto below
+# was produced by loading the previous descriptor, appending the new field
+# (StatsReply.obs_json = 9) via google.protobuf.descriptor_pb2, and
+# re-serializing. backtesting.proto remains the source of truth; keep the
+# two in sync.
 # source: backtesting.proto
 """Generated protocol buffer code."""
 from google.protobuf.internal import builder as _builder
@@ -13,43 +19,11 @@ _sym_db = _symbol_database.Default()
 
 
 
-DESCRIPTOR = _descriptor_pool.Default().AddSerializedFile(b'\n\x11\x62\x61\x63ktesting.proto\x12\x07\x64\x62x.rpc\"F\n\x0bJobsRequest\x12\x11\n\tworker_id\x18\x01 \x01(\t\x12\r\n\x05\x63hips\x18\x02 \x01(\x05\x12\x15\n\rjobs_per_chip\x18\x03 \x01(\x05\"\x1a\n\x08GridAxis\x12\x0e\n\x06values\x18\x01 \x03(\x02\"\xc8\x02\n\x07JobSpec\x12\n\n\x02id\x18\x01 \x01(\t\x12\x10\n\x08strategy\x18\x02 \x01(\t\x12\r\n\x05ohlcv\x18\x03 \x01(\x0c\x12(\n\x04grid\x18\x04 \x03(\x0b\x32\x1a.dbx.rpc.JobSpec.GridEntry\x12\x0c\n\x04\x63ost\x18\x05 \x01(\x02\x12\x18\n\x10periods_per_year\x18\x06 \x01(\x05\x12\x0e\n\x06ohlcv2\x18\x07 \x01(\x0c\x12\x10\n\x08wf_train\x18\x08 \x01(\x05\x12\x0f\n\x07wf_test\x18\t \x01(\x05\x12\x11\n\twf_metric\x18\n \x01(\t\x12\r\n\x05top_k\x18\x0b \x01(\x05\x12\x13\n\x0brank_metric\x18\x0c \x01(\t\x12\x14\n\x0c\x62\x65st_returns\x18\r \x01(\x08\x1a>\n\tGridEntry\x12\x0b\n\x03key\x18\x01 \x01(\t\x12 \n\x05value\x18\x02 \x01(\x0b\x32\x11.dbx.rpc.GridAxis:\x02\x38\x01\"+\n\tJobsReply\x12\x1e\n\x04jobs\x18\x01 \x03(\x0b\x32\x10.dbx.rpc.JobSpec\"I\n\rStatusRequest\x12\x11\n\tworker_id\x18\x01 \x01(\t\x12%\n\x06status\x18\x02 \x01(\x0e\x32\x15.dbx.rpc.WorkerStatus\"!\n\x03\x41\x63k\x12\n\n\x02ok\x18\x01 \x01(\x08\x12\x0e\n\x06\x64\x65tail\x18\x02 \x01(\t\"T\n\x0f\x43ompleteRequest\x12\n\n\x02id\x18\x01 \x01(\t\x12\x11\n\tworker_id\x18\x02 \x01(\t\x12\x0f\n\x07metrics\x18\x03 \x01(\x0c\x12\x11\n\telapsed_s\x18\x04 \x01(\x02\">\n\x0c\x43ompleteItem\x12\n\n\x02id\x18\x01 \x01(\t\x12\x0f\n\x07metrics\x18\x02 \x01(\x0c\x12\x11\n\telapsed_s\x18\x03 \x01(\x02\"H\n\rCompleteBatch\x12\x11\n\tworker_id\x18\x01 \x01(\t\x12$\n\x05items\x18\x02 \x03(\x0b\x32\x15.dbx.rpc.CompleteItem\";\n\x12\x43ompleteBatchReply\x12\x10\n\x08\x61\x63\x63\x65pted\x18\x01 \x01(\x05\x12\x13\n\x0bunknown_ids\x18\x02 \x03(\t\"\x0e\n\x0cStatsRequest\"\xc0\x01\n\nStatsReply\x12\x14\n\x0cjobs_pending\x18\x01 \x01(\x03\x12\x13\n\x0bjobs_leased\x18\x02 \x01(\x03\x12\x16\n\x0ejobs_completed\x18\x03 \x01(\x03\x12\x15\n\rjobs_requeued\x18\x04 \x01(\x03\x12\x13\n\x0bjobs_failed\x18\x05 \x01(\x03\x12\x15\n\rworkers_alive\x18\x06 \x01(\x05\x12\x19\n\x11\x62\x61\x63ktests_per_sec\x18\x07 \x01(\x01\x12\x11\n\tsubstrate\x18\x08 \x01(\t*A\n\x0cWorkerStatus\x12\x16\n\x12WORKER_STATUS_IDLE\x10\x00\x12\x19\n\x15WORKER_STATUS_RUNNING\x10\x01\x32\xad\x02\n\nDispatcher\x12\x37\n\x0bRequestJobs\x12\x14.dbx.rpc.JobsRequest\x1a\x12.dbx.rpc.JobsReply\x12\x32\n\nSendStatus\x12\x16.dbx.rpc.StatusRequest\x1a\x0c.dbx.rpc.Ack\x12\x35\n\x0b\x43ompleteJob\x12\x18.dbx.rpc.CompleteRequest\x1a\x0c.dbx.rpc.Ack\x12\x43\n\x0c\x43ompleteJobs\x12\x16.dbx.rpc.CompleteBatch\x1a\x1b.dbx.rpc.CompleteBatchReply\x12\x36\n\x08GetStats\x12\x15.dbx.rpc.StatsRequest\x1a\x13.dbx.rpc.StatsReplyb\x06proto3')
+DESCRIPTOR = _descriptor_pool.Default().AddSerializedFile(b'\n\x11backtesting.proto\x12\x07dbx.rpc"F\n\x0bJobsRequest\x12\x11\n\tworker_id\x18\x01 \x01(\t\x12\r\n\x05chips\x18\x02 \x01(\x05\x12\x15\n\rjobs_per_chip\x18\x03 \x01(\x05"\x1a\n\x08GridAxis\x12\x0e\n\x06values\x18\x01 \x03(\x02"\xc8\x02\n\x07JobSpec\x12\n\n\x02id\x18\x01 \x01(\t\x12\x10\n\x08strategy\x18\x02 \x01(\t\x12\r\n\x05ohlcv\x18\x03 \x01(\x0c\x12(\n\x04grid\x18\x04 \x03(\x0b2\x1a.dbx.rpc.JobSpec.GridEntry\x12\x0c\n\x04cost\x18\x05 \x01(\x02\x12\x18\n\x10periods_per_year\x18\x06 \x01(\x05\x12\x0e\n\x06ohlcv2\x18\x07 \x01(\x0c\x12\x10\n\x08wf_train\x18\x08 \x01(\x05\x12\x0f\n\x07wf_test\x18\t \x01(\x05\x12\x11\n\twf_metric\x18\n \x01(\t\x12\r\n\x05top_k\x18\x0b \x01(\x05\x12\x13\n\x0brank_metric\x18\x0c \x01(\t\x12\x14\n\x0cbest_returns\x18\r \x01(\x08\x1a>\n\tGridEntry\x12\x0b\n\x03key\x18\x01 \x01(\t\x12 \n\x05value\x18\x02 \x01(\x0b2\x11.dbx.rpc.GridAxis:\x028\x01"+\n\tJobsReply\x12\x1e\n\x04jobs\x18\x01 \x03(\x0b2\x10.dbx.rpc.JobSpec"I\n\rStatusRequest\x12\x11\n\tworker_id\x18\x01 \x01(\t\x12%\n\x06status\x18\x02 \x01(\x0e2\x15.dbx.rpc.WorkerStatus"!\n\x03Ack\x12\n\n\x02ok\x18\x01 \x01(\x08\x12\x0e\n\x06detail\x18\x02 \x01(\t"T\n\x0fCompleteRequest\x12\n\n\x02id\x18\x01 \x01(\t\x12\x11\n\tworker_id\x18\x02 \x01(\t\x12\x0f\n\x07metrics\x18\x03 \x01(\x0c\x12\x11\n\telapsed_s\x18\x04 \x01(\x02">\n\x0cCompleteItem\x12\n\n\x02id\x18\x01 \x01(\t\x12\x0f\n\x07metrics\x18\x02 \x01(\x0c\x12\x11\n\telapsed_s\x18\x03 \x01(\x02"H\n\rCompleteBatch\x12\x11\n\tworker_id\x18\x01 \x01(\t\x12$\n\x05items\x18\x02 \x03(\x0b2\x15.dbx.rpc.CompleteItem";\n\x12CompleteBatchReply\x12\x10\n\x08accepted\x18\x01 \x01(\x05\x12\x13\n\x0bunknown_ids\x18\x02 \x03(\t"\x0e\n\x0cStatsRequest"\xdb\x01\n\nStatsReply\x12\x14\n\x0cjobs_pending\x18\x01 \x01(\x03\x12\x13\n\x0bjobs_leased\x18\x02 \x01(\x03\x12\x16\n\x0ejobs_completed\x18\x03 \x01(\x03\x12\x15\n\rjobs_requeued\x18\x04 \x01(\x03\x12\x13\n\x0bjobs_failed\x18\x05 \x01(\x03\x12\x15\n\rworkers_alive\x18\x06 \x01(\x05\x12\x19\n\x11backtests_per_sec\x18\x07 \x01(\x01\x12\x11\n\tsubstrate\x18\x08 \x01(\t\x12\x19\n\x08obs_json\x18\t \x01(\tR\x07obsJson*A\n\x0cWorkerStatus\x12\x16\n\x12WORKER_STATUS_IDLE\x10\x00\x12\x19\n\x15WORKER_STATUS_RUNNING\x10\x012\xad\x02\n\nDispatcher\x127\n\x0bRequestJobs\x12\x14.dbx.rpc.JobsRequest\x1a\x12.dbx.rpc.JobsReply\x122\n\nSendStatus\x12\x16.dbx.rpc.StatusRequest\x1a\x0c.dbx.rpc.Ack\x125\n\x0bCompleteJob\x12\x18.dbx.rpc.CompleteRequest\x1a\x0c.dbx.rpc.Ack\x12C\n\x0cCompleteJobs\x12\x16.dbx.rpc.CompleteBatch\x1a\x1b.dbx.rpc.CompleteBatchReply\x126\n\x08GetStats\x12\x15.dbx.rpc.StatsRequest\x1a\x13.dbx.rpc.StatsReplyb\x06proto3')
 
 _builder.BuildMessageAndEnumDescriptors(DESCRIPTOR, globals())
 _builder.BuildTopDescriptorsAndMessages(DESCRIPTOR, 'backtesting_pb2', globals())
 if _descriptor._USE_C_DESCRIPTORS == False:
-
-  DESCRIPTOR._options = None
-  _JOBSPEC_GRIDENTRY._options = None
-  _JOBSPEC_GRIDENTRY._serialized_options = b'8\001'
-  _WORKERSTATUS._serialized_start=1112
-  _WORKERSTATUS._serialized_end=1177
-  _JOBSREQUEST._serialized_start=30
-  _JOBSREQUEST._serialized_end=100
-  _GRIDAXIS._serialized_start=102
-  _GRIDAXIS._serialized_end=128
-  _JOBSPEC._serialized_start=131
-  _JOBSPEC._serialized_end=459
-  _JOBSPEC_GRIDENTRY._serialized_start=397
-  _JOBSPEC_GRIDENTRY._serialized_end=459
-  _JOBSREPLY._serialized_start=461
-  _JOBSREPLY._serialized_end=504
-  _STATUSREQUEST._serialized_start=506
-  _STATUSREQUEST._serialized_end=579
-  _ACK._serialized_start=581
-  _ACK._serialized_end=614
-  _COMPLETEREQUEST._serialized_start=616
-  _COMPLETEREQUEST._serialized_end=700
-  _COMPLETEITEM._serialized_start=702
-  _COMPLETEITEM._serialized_end=764
-  _COMPLETEBATCH._serialized_start=766
-  _COMPLETEBATCH._serialized_end=838
-  _COMPLETEBATCHREPLY._serialized_start=840
-  _COMPLETEBATCHREPLY._serialized_end=899
-  _STATSREQUEST._serialized_start=901
-  _STATSREQUEST._serialized_end=915
-  _STATSREPLY._serialized_start=918
-  _STATSREPLY._serialized_end=1110
-  _DISPATCHER._serialized_start=1180
-  _DISPATCHER._serialized_end=1481
+    DESCRIPTOR._options = None
+    DESCRIPTOR._serialized_options = None
 # @@protoc_insertion_point(module_scope)

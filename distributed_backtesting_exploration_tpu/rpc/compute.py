@@ -18,6 +18,7 @@ import numpy as np
 
 from . import backtesting_pb2 as pb
 from . import wire
+from .. import obs
 from ..utils import data as data_mod
 
 log = logging.getLogger("dbx.compute")
@@ -206,6 +207,77 @@ class JaxSweepBackend:
             from ..parallel import sharding as sharding_mod
 
             self._mesh = sharding_mod.make_mesh(self._devices)
+        # Observability (DESIGN.md "Observability"): per-phase attribution
+        # of the decode -> submit -> device-drain pipeline, kernel wall
+        # keyed by route:strategy (the live counterpart of bench.py's
+        # roofline stages), and the jit compile-vs-execute split (first
+        # call on a signature = compile-inclusive "cold").
+        reg = obs.get_registry()
+        self._obs = reg
+        self._h_decode = reg.histogram(
+            "dbx_compute_decode_seconds",
+            help="OHLCV wire decode wall per job group")
+        self._c_decode_bytes = reg.counter(
+            "dbx_compute_decode_bytes_total",
+            help="OHLCV payload bytes decoded")
+        self._h_collect = reg.histogram(
+            "dbx_compute_collect_seconds",
+            help="device drain + d2h wait per pending group")
+        self._c_d2h_bytes = reg.counter(
+            "dbx_compute_d2h_bytes_total",
+            help="result bytes copied device->host")
+        self._c_backtests = reg.counter(
+            "dbx_backtests_total", help="(ticker x param) combos computed")
+        self._bt_rate = obs.StepTimer(reg.gauge(
+            "dbx_compute_backtests_per_sec",
+            help="combos/s since backend start"))
+        self._h_jit = {
+            phase: reg.histogram(
+                "dbx_jit_call_seconds",
+                help="mesh-fn dispatch wall: cold includes trace+compile, "
+                     "warm is async launch only", phase=phase)
+            for phase in ("cold", "warm")}
+        self._kern_h: dict = {}    # (strategy, route, cold) -> Histogram
+        self._seen_cold: set = set()
+        # jit caches per input SHAPE, not just per program key: a cached
+        # mesh fn hit with a new (rows, bars) signature recompiles for
+        # seconds and must not be attributed as "warm" async launch.
+        self._seen_shapes: set = set()
+
+    def _evict_mesh_fn(self) -> None:
+        """FIFO-evict the oldest compiled mesh fn AND its shape-signature
+        memory: eviction discards the jit cache, so the rebuilt fn's first
+        call recompiles and must count as "cold" again."""
+        evicted = next(iter(self._mesh_fns))
+        del self._mesh_fns[evicted]
+        self._seen_shapes = {sk for sk in self._seen_shapes
+                             if sk[0] != evicted}
+
+    def _observe_submit(self, strategy: str, route: str, t0: float,
+                        cold_key=None) -> None:
+        """Record a group's submit-side wall (group start -> kernels
+        launched, decode included) into
+        ``dbx_kernel_submit_seconds{kernel=route:strategy}``. ``cold_key``
+        marks the first submission of a compile signature as
+        phase="compile" (the jit compile-vs-execute split at group grain)."""
+        dt = time.perf_counter() - t0
+        cold = False
+        if cold_key is not None:
+            cold = cold_key not in self._seen_cold
+            if cold:
+                if len(self._seen_cold) > 4096:   # long-lived worker bound
+                    self._seen_cold.clear()
+                self._seen_cold.add(cold_key)
+        hk = (strategy, route, cold)
+        h = self._kern_h.get(hk)
+        if h is None:
+            h = self._kern_h[hk] = self._obs.histogram(
+                "dbx_kernel_submit_seconds",
+                help="per-group submit wall (decode + H2D + launch) by "
+                     "route:strategy",
+                kernel=f"{route}:{strategy}",
+                phase="compile" if cold else "execute")
+        h.observe(dt)
 
     @property
     def chips(self) -> int:
@@ -489,7 +561,7 @@ class JaxSweepBackend:
 
                 run = jax.jit(run)
                 if len(self._mesh_fns) >= self._MESH_FN_CAP:
-                    self._mesh_fns.pop(next(iter(self._mesh_fns)))
+                    self._evict_mesh_fn()
                 self._mesh_fns[key] = run
             m = run(*sharded)
             pending.append(self._finish_group(sub_jobs, m, t0,
@@ -609,9 +681,21 @@ class JaxSweepBackend:
                 # FIFO eviction: a long-lived worker cycling through many
                 # distinct grids must not grow compiled executables forever
                 # (an evicted entry simply recompiles on next use).
-                self._mesh_fns.pop(next(iter(self._mesh_fns)))
+                self._evict_mesh_fn()
             self._mesh_fns[key] = fn
-        return fn(*args)
+        shape_key = (key, tuple(a.shape for a in args))
+        cold = shape_key not in self._seen_shapes
+        if cold:
+            if len(self._seen_shapes) > 4096:
+                self._seen_shapes.clear()
+            self._seen_shapes.add(shape_key)
+        t_call = time.perf_counter()
+        out = fn(*args)
+        # Cold dispatch blocks on trace+compile (first call of this
+        # program x shape signature); warm is the async launch.
+        self._h_jit["cold" if cold else "warm"].observe(
+            time.perf_counter() - t_call)
+        return out
 
     _MESH_FN_CAP = 32
 
@@ -724,16 +808,24 @@ class JaxSweepBackend:
                 continue
             if group[0].strategy == "pairs":
                 pending.append(self._submit_pairs_group(group, t0))
+                self._observe_submit(
+                    "pairs", "pairs_wf" if group[0].wf_train > 0
+                    else "pairs", t0)
                 continue
+            t_dec = time.perf_counter()
             series = [data_mod.from_wire_bytes(j.ohlcv) for j in group]
+            self._h_decode.observe(time.perf_counter() - t_dec)
+            self._c_decode_bytes.inc(sum(len(j.ohlcv) for j in group))
             lengths = [s.n_bars for s in series]
             if group[0].wf_train > 0:
                 pending.append(self._submit_walkforward_group(
                     group, series, lengths, t0))
+                self._observe_submit(group[0].strategy, "walkforward", t0)
                 continue
             if group[0].best_returns:
                 pending.append(self._submit_best_returns_group(
                     group, series, lengths, t0))
+                self._observe_submit(group[0].strategy, "best_returns", t0)
                 continue
             # JobSpec.grid carries per-parameter AXES; the cartesian product
             # is materialized worker-side (backtesting.proto JobSpec.grid).
@@ -761,6 +853,10 @@ class JaxSweepBackend:
                         self._mesh.devices.size)
                     pending.extend(self._submit_timeshard_groups(
                         group, series, lengths, t0, axes))
+                    self._observe_submit(
+                        group[0].strategy, "timeshard", t0,
+                        cold_key=("timeshard", len(group), t_max_g)
+                        + self._group_key(group[0], axes))
                     continue
                 # The group-level gate uses min(lengths) for the halo
                 # bound, so ONE short job in a ragged group would drag
@@ -787,10 +883,19 @@ class JaxSweepBackend:
                         [group[i] for i in ok_idx],
                         [series[i] for i in ok_idx],
                         [int(lengths[i]) for i in ok_idx], t0, axes))
+                    self._observe_submit(
+                        group[0].strategy, "timeshard", t0,
+                        cold_key=("timeshard", len(ok_idx),
+                                  max(int(lengths[i]) for i in ok_idx))
+                        + self._group_key(group[0], axes))
                     rest = [i for i in range(len(group))
                             if i not in set(ok_idx)]
                     if not rest:
                         continue
+                    # The remainder restarts the clock: its route
+                    # observation (and completion elapsed) must not
+                    # re-attribute the timeshard subset's submit wall.
+                    t0 = time.perf_counter()
                     group = [group[i] for i in rest]
                     series = [series[i] for i in rest]
                     lengths = [int(lengths[i]) for i in rest]
@@ -883,6 +988,14 @@ class JaxSweepBackend:
                     else:
                         m = sweep_mod.jit_sweep(panel, strategy, grid,
                                                 **kwargs)
+            route = (("fused" if fused_ok else "generic")
+                     + ("_mesh" if self._mesh is not None else ""))
+            # Shape in the cold key: jit compiles per (rows, bars), so a
+            # new group size IS a compile, not an execute.
+            self._observe_submit(
+                group[0].strategy, route, t0,
+                cold_key=(route, len(group), t_max_g)
+                + self._group_key(group[0], axes))
             pending.append(self._finish_group(group, m, t0, len(group),
                                               group[0]))
         return pending
@@ -982,7 +1095,7 @@ class JaxSweepBackend:
             return m_best, idx, res.returns
 
         if len(self._mesh_fns) >= self._MESH_FN_CAP:
-            self._mesh_fns.pop(next(iter(self._mesh_fns)))
+            self._evict_mesh_fn()
         self._mesh_fns[key] = f
         return f
 
@@ -1158,6 +1271,7 @@ class JaxSweepBackend:
                     job0.wf_test, metric)
                 return (list(group), None, t0, 0, None)
         good, bad = [], []
+        t_dec = time.perf_counter()
         for j in group:
             if not j.ohlcv2:
                 log.error("pairs job %s has no second leg (ohlcv2); "
@@ -1181,6 +1295,9 @@ class JaxSweepBackend:
                 bad.append(j)
                 continue
             good.append((j, y, x))
+        self._h_decode.observe(time.perf_counter() - t_dec)
+        self._c_decode_bytes.inc(
+            sum(len(j.ohlcv) + len(j.ohlcv2) for j in group))
         if not good:
             return (bad, None, t0, 0, None)
         group = [j for j, _, _ in good]
@@ -1368,7 +1485,7 @@ class JaxSweepBackend:
 
             run = jax.jit(run)
             if len(self._mesh_fns) >= self._MESH_FN_CAP:
-                self._mesh_fns.pop(next(iter(self._mesh_fns)))
+                self._evict_mesh_fn()
             self._mesh_fns[key] = run
         return self._finish_group(list(group) + bad, run(y, x), t0,
                                   len(group), job0)
@@ -1379,7 +1496,23 @@ class JaxSweepBackend:
 
         out: list[Completion] = []
         for group, stacked, t0, n_real, extra in pending:
+            t_wait = time.perf_counter()
             host = None if stacked is None else np.asarray(stacked)
+            if host is not None:
+                # The blocking d2h drain: everything after here is host-side
+                # packing. Combo credit counts only real jobs (mesh pad rows
+                # are compute, not results) and is derived from each job's
+                # GRID, not the result shape — a top-k/best_returns group
+                # ships k (or 1) rows but computed the full grid, and the
+                # dispatcher's backtests_per_sec credits grid combos too
+                # (the two gauges must agree).
+                self._h_collect.observe(time.perf_counter() - t_wait)
+                self._c_d2h_bytes.inc(host.nbytes)
+                n_rows = min(host.shape[1], n_real)
+                combos = sum(wire.grid_n_combos(job.grid)
+                             for job in group[:n_rows])
+                self._c_backtests.inc(combos)
+                self._bt_rate.add(combos)
             idx_host = ret_host = lens = None
             mode = None
             if isinstance(extra, dict):          # best_returns (DBXP) group

@@ -14,7 +14,10 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from dataclasses import dataclass, field
+
+from ..obs import get_registry
 
 
 class JournalCorruptError(ValueError):
@@ -48,6 +51,17 @@ class Journal:
         self._path = path
         self._lock = threading.Lock()
         self._fh = open(path, "a", encoding="utf-8") if path else None
+        # fsync dominates append latency and gates every durable queue
+        # transition — it gets its own histogram (DESIGN.md
+        # "Observability"). Resolved once; zero cost on the no-op journal.
+        reg = get_registry()
+        self._h_append = reg.histogram(
+            "dbx_journal_append_seconds",
+            help="journal append wall (write + flush + fsync)")
+        self._h_fsync = reg.histogram(
+            "dbx_journal_fsync_seconds", help="journal fsync wall alone")
+        self._c_appends = reg.counter(
+            "dbx_journal_appends_total", help="journal records appended")
 
     @property
     def enabled(self) -> bool:
@@ -60,10 +74,16 @@ class Journal:
             return
         rec = {"ev": event, **payload}
         line = json.dumps(rec, separators=(",", ":"))
+        t0 = time.perf_counter()
         with self._lock:
             self._fh.write(line + "\n")
             self._fh.flush()
+            t1 = time.perf_counter()
             os.fsync(self._fh.fileno())
+        t2 = time.perf_counter()
+        self._h_fsync.observe(t2 - t1)
+        self._h_append.observe(t2 - t0)
+        self._c_appends.inc()
 
     def close(self) -> None:
         if self._fh is not None:

@@ -46,6 +46,8 @@ import uuid
 
 import numpy as np
 
+from .. import obs
+
 log = logging.getLogger("dbx.slice_worker")
 
 _STOP = {"op": "stop"}
@@ -133,6 +135,16 @@ class SliceWorker:
             self._channel = grpc.insecure_channel(
                 connect, options=service.default_channel_options())
             self._stub = service.DispatcherStub(self._channel)
+            # Leader-side RPC timing shares the worker metric family (the
+            # dispatcher sees a slice as one worker; so does /metrics).
+            reg = obs.get_registry()
+            self._h_rpc = {
+                m: reg.histogram("dbx_worker_rpc_seconds",
+                                 help="worker-side RPC wall (incl. wire)",
+                                 method=m)
+                for m in ("RequestJobs", "CompleteJobs")}
+            self._c_jobs_in = reg.counter(
+                "dbx_worker_jobs_received_total", help="jobs received")
             log.info("slice worker %s: leader of %d processes, %d chips",
                      self.worker_id, jax.process_count(), self.chips)
 
@@ -141,10 +153,14 @@ class SliceWorker:
     def _poll(self) -> list:
         from . import backtesting_pb2 as pb
 
-        reply = self._stub.RequestJobs(pb.JobsRequest(
-            worker_id=self.worker_id, chips=self.chips,
-            jobs_per_chip=self._jobs_per_chip), timeout=10.0)
-        return list(reply.jobs)
+        with obs.timer(self._h_rpc["RequestJobs"]):
+            reply = self._stub.RequestJobs(pb.JobsRequest(
+                worker_id=self.worker_id, chips=self.chips,
+                jobs_per_chip=self._jobs_per_chip), timeout=10.0)
+        jobs = list(reply.jobs)
+        if jobs:
+            self._c_jobs_in.inc(len(jobs))
+        return jobs
 
     def _group_jobs(self, jobs):
         """Group a poll batch like the single-host backend: same strategy,
@@ -200,7 +216,9 @@ class SliceWorker:
         from . import backtesting_pb2 as pb
 
         batch = pb.CompleteBatch(worker_id=self.worker_id, items=items)
-        self._stub.CompleteJobs(batch, timeout=10.0)
+        with obs.span("worker.report", jobs=len(items)), \
+                obs.timer(self._h_rpc["CompleteJobs"]):
+            self._stub.CompleteJobs(batch, timeout=10.0)
         self.jobs_completed += len(items)
 
     # -- the SPMD round ----------------------------------------------------
@@ -218,7 +236,9 @@ class SliceWorker:
 
         hdr, payload = _bcast_msg(msg, [flat] if flat is not None else [])
         if hdr["op"] == "run_ts":
-            return hdr, self._run_ts_group(hdr, payload)
+            with obs.span("slice.run_ts_group",
+                          strategy=hdr.get("strategy", "?")):
+                return hdr, self._run_ts_group(hdr, payload)
         if hdr["op"] != "run":
             return hdr, None
         n_pad, T = hdr["n_pad"], hdr["bars"]
@@ -244,12 +264,13 @@ class SliceWorker:
                 for k, v in hdr["grid"].items()}
         strategy = models_base.get_strategy(hdr["strategy"])
         flat_grid = sweep_mod.product_grid(**grid)
-        m = sharding_mod.sharded_sweep(
-            self.mesh, panel, strategy, flat_grid, cost=hdr["cost"],
-            periods_per_year=hdr["ppy"] or 252)
-        # In-program all-gather: replicate the row-sharded metrics so the
-        # leader can read them host-side.
-        m = Metrics(*(np.asarray(self._gather(f)) for f in m))
+        with obs.span("slice.run_group", strategy=hdr["strategy"]):
+            m = sharding_mod.sharded_sweep(
+                self.mesh, panel, strategy, flat_grid, cost=hdr["cost"],
+                periods_per_year=hdr["ppy"] or 252)
+            # In-program all-gather: replicate the row-sharded metrics so
+            # the leader can read them host-side.
+            m = Metrics(*(np.asarray(self._gather(f)) for f in m))
         return hdr, m
 
     def _run_ts_group(self, hdr: dict, payload: np.ndarray):

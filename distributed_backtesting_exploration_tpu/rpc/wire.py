@@ -18,8 +18,30 @@ from typing import Mapping
 
 import numpy as np
 
+from ..obs import get_registry
 from ..ops.metrics import Metrics
 from . import backtesting_pb2 as pb
+
+# Codec volume counters (pre-resolved module-level: encode runs once per
+# completed job on the worker hot path — two lock-cheap increments).
+_WIRE_COUNTERS = {
+    (d, kind): (get_registry().counter(
+                    "dbx_wire_blocks_total",
+                    help="result blocks through the codec",
+                    direction=d, kind=kind),
+                get_registry().counter(
+                    "dbx_wire_bytes_total",
+                    help="result bytes through the codec",
+                    direction=d, kind=kind))
+    for d in ("encode", "decode")
+    for kind in ("metrics", "topk", "returns")}
+
+
+def _count_wire(direction: str, kind: str, n_bytes: int) -> None:
+    blocks, total = _WIRE_COUNTERS[(direction, kind)]
+    blocks.inc()
+    total.inc(n_bytes)
+
 
 _METRICS_MAGIC = b"DBXM"
 
@@ -31,7 +53,9 @@ def metrics_to_bytes(m: Metrics) -> bytes:
     if any(f.shape[0] != P for f in fields):
         raise ValueError("all metric fields must have equal length")
     head = _METRICS_MAGIC + struct.pack("<II", P, len(fields))
-    return head + b"".join(f.tobytes() for f in fields)
+    out = head + b"".join(f.tobytes() for f in fields)
+    _count_wire("encode", "metrics", len(out))
+    return out
 
 
 def metrics_from_bytes(data: bytes) -> Metrics:
@@ -53,6 +77,7 @@ def metrics_from_bytes(data: bytes) -> Metrics:
     for _ in range(n_fields):
         out.append(np.frombuffer(data, dtype="<f4", count=P, offset=off).copy())
         off += 4 * P
+    _count_wire("decode", "metrics", len(data))
     return Metrics(*out)
 
 
@@ -76,7 +101,9 @@ def topk_to_bytes(indices: "np.ndarray", m: Metrics, rank_metric: str) -> bytes:
     if len(name) > 255:
         raise ValueError("rank_metric name too long")
     head = _TOPK_MAGIC + struct.pack("<IIB", k, len(fields), len(name)) + name
-    return head + idx.tobytes() + b"".join(f.tobytes() for f in fields)
+    out = head + idx.tobytes() + b"".join(f.tobytes() for f in fields)
+    _count_wire("encode", "topk", len(out))
+    return out
 
 
 def topk_from_bytes(data: bytes) -> tuple["np.ndarray", Metrics, str]:
@@ -106,6 +133,7 @@ def topk_from_bytes(data: bytes) -> tuple["np.ndarray", Metrics, str]:
         out.append(np.frombuffer(data, dtype="<f4", count=k,
                                  offset=off).copy())
         off += 4 * k
+    _count_wire("decode", "topk", len(data))
     return idx, Metrics(*out), rank_metric
 
 
@@ -135,7 +163,9 @@ def best_returns_to_bytes(grid_idx: int, m_row: Metrics,
     head = _RETURNS_MAGIC + struct.pack(
         "<IIIB", int(grid_idx), ret.shape[0], vals.shape[0],
         len(name)) + name
-    return head + vals.tobytes() + ret.tobytes()
+    out = head + vals.tobytes() + ret.tobytes()
+    _count_wire("encode", "returns", len(out))
+    return out
 
 
 def best_returns_from_bytes(
@@ -166,6 +196,7 @@ def best_returns_from_bytes(
     vals = np.frombuffer(data, dtype="<f4", count=n_fields, offset=off)
     off += 4 * n_fields
     ret = np.frombuffer(data, dtype="<f4", count=T, offset=off).copy()
+    _count_wire("decode", "returns", len(data))
     return int(grid_idx), Metrics(*(np.float32(v) for v in vals)), ret, \
         rank_metric
 

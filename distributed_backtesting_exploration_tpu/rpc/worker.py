@@ -29,6 +29,7 @@ import grpc
 
 from . import backtesting_pb2 as pb
 from . import compute, service
+from .. import obs
 from ..runtime import _core as native_core
 
 log = logging.getLogger("dbx.worker")
@@ -97,6 +98,12 @@ class _Channel:
             return len(self._nq) == 0
         return self._pq.empty()
 
+    def depth(self) -> int:
+        """Approximate occupancy (observability gauge; racy by nature)."""
+        if self._nq is not None:
+            return len(self._nq)
+        return self._pq.qsize()
+
 
 _BATCH_SENTINEL = b"S"
 
@@ -135,7 +142,8 @@ class Worker:
                  poll_interval_s: float = 0.25,
                  status_interval_s: float = 1.0,
                  jobs_per_chip: int = 1,
-                 max_inflight_batches: int = 2):
+                 max_inflight_batches: int = 2,
+                 registry: "obs.Registry | None" = None):
         self.target = target
         self.backend = backend
         self.worker_id = worker_id or f"worker-{uuid.uuid4().hex[:8]}"
@@ -157,6 +165,50 @@ class Worker:
         # window, getting a healthy worker pruned mid-drain).
         self._deferred: list[tuple[float, int, compute.Completion]] = []
         self._next_status = 0.0
+        # Observability: client-side RPC latency histograms + poll/error
+        # counters (pre-resolved — the poll loop is a hot path), channel
+        # occupancy and retry backlog as scrape-time gauges (labeled by
+        # worker_id: several workers can share one process, e.g. bench's
+        # control-plane saturation config).
+        self.obs = registry or obs.get_registry()
+        self._h_rpc = {
+            m: self.obs.histogram("dbx_worker_rpc_seconds",
+                                  help="worker-side RPC wall (incl. wire)",
+                                  method=m)
+            for m in ("RequestJobs", "SendStatus", "CompleteJobs")}
+        self._c_rpc_errors = {
+            m: self.obs.counter("dbx_worker_rpc_errors_total",
+                                help="failed worker RPC attempts", method=m)
+            for m in ("RequestJobs", "SendStatus", "CompleteJobs")}
+        self._c_polls = self.obs.counter(
+            "dbx_worker_polls_total", help="RequestJobs polls sent")
+        self._c_idle_polls = self.obs.counter(
+            "dbx_worker_idle_polls_total", help="polls answered empty")
+        self._c_jobs_in = self.obs.counter(
+            "dbx_worker_jobs_received_total", help="jobs received")
+        self._c_dropped = self.obs.counter(
+            "dbx_worker_completions_dropped_total",
+            help="completions dropped after retry exhaustion")
+        # Every per-worker-labeled metric (the jobs/sec gauge and the
+        # collector-maintained channel/deferred/busy gauges) is created in
+        # run() and removed in its finally — a constructed-but-never-run
+        # Worker must leak neither a collector closing over itself nor a
+        # uuid-labeled gauge child.
+        self._jobs_rate = obs.StepTimer()
+        self._gauges: dict | None = None
+
+    def _collect_gauges(self, reg: "obs.Registry") -> None:
+        # Sets the children PRE-CREATED in run() (held on self._gauges)
+        # instead of get-or-create per scrape: a scrape racing run()'s
+        # cleanup then merely sets detached objects and cannot re-register
+        # the just-removed uuid-labeled children.
+        g = self._gauges
+        if g is None:
+            return
+        g["in"].set(self._in.depth())
+        g["out"].set(self._out.depth())
+        g["deferred"].set(len(self._deferred))
+        g["busy"].set(1 if self._busy.is_set() else 0)
 
     # -- compute side ------------------------------------------------------
 
@@ -174,8 +226,9 @@ class Worker:
                 return
             self._busy.set()
             try:
-                for completion in self.backend.process(batch):
-                    self._out.put(completion)
+                with obs.span("worker.process", jobs=len(batch)):
+                    for completion in self.backend.process(batch):
+                        self._out.put(completion)
             except Exception:
                 log.exception("backend failed on a %d-job batch; jobs will "
                               "be re-queued by lease expiry", len(batch))
@@ -225,7 +278,13 @@ class Worker:
 
     def _try_submit(self, batch):
         try:
-            return (self.backend.submit(batch), batch)
+            # The per-batch span chain (worker.submit -> worker.collect ->
+            # worker.report): submit covers decode + H2D + kernel launch,
+            # collect the device drain + d2h wait, report the completion
+            # RPC — the decode->compute->report attribution the JSONL
+            # event log reconstructs per batch.
+            with obs.span("worker.submit", jobs=len(batch)):
+                return (self.backend.submit(batch), batch)
         except Exception:
             log.exception("backend failed submitting a %d-job batch; jobs "
                           "will be re-queued by lease expiry", len(batch))
@@ -234,8 +293,9 @@ class Worker:
     def _collect_into_out(self, pending) -> None:
         handle, batch = pending
         try:
-            for completion in self.backend.collect(handle):
-                self._out.put(completion)
+            with obs.span("worker.collect", jobs=len(batch)):
+                for completion in self.backend.collect(handle):
+                    self._out.put(completion)
         except Exception:
             log.exception("backend failed on a %d-job batch; jobs will "
                           "be re-queued by lease expiry", len(batch))
@@ -252,6 +312,23 @@ class Worker:
             self.target, options=service.default_channel_options(),
             compression=grpc.Compression.Gzip)
         stub = service.DispatcherStub(channel)
+        # Fresh timer epoch: the rate is "since the worker STARTED", not
+        # since it was constructed (a harness may build workers long
+        # before running them).
+        self._jobs_rate = obs.StepTimer(self.obs.gauge(
+            "dbx_worker_jobs_per_sec",
+            help="accepted completions/s since worker start",
+            worker=self.worker_id))
+        wid = self.worker_id
+        self._gauges = {
+            "in": self.obs.gauge("dbx_worker_channel_depth", worker=wid,
+                                 channel="in"),
+            "out": self.obs.gauge("dbx_worker_channel_depth", worker=wid,
+                                  channel="out"),
+            "deferred": self.obs.gauge("dbx_worker_deferred_completions",
+                                       worker=wid),
+            "busy": self.obs.gauge("dbx_worker_busy", worker=wid)}
+        self.obs.add_collector(f"worker-{wid}", self._collect_gauges)
         self._compute_thread = threading.Thread(
             target=self._compute_loop, name="dbx-compute", daemon=True)
         self._compute_thread.start()
@@ -285,6 +362,21 @@ class Worker:
             self._shutdown(stub)
         finally:
             channel.close()
+            # Lifecycle hygiene: a long-lived process constructing many
+            # Workers (bench's control-plane saturation config) must not
+            # accumulate dead collectors or uuid-labeled gauge children —
+            # every scrape, GetStats payload, and BENCH obs blob would
+            # carry them forever.
+            self.obs.remove_collector(f"worker-{self.worker_id}")
+            self._jobs_rate.bind_gauge(None)
+            wid = self.worker_id
+            self.obs.remove_child("dbx_worker_jobs_per_sec", worker=wid)
+            for ch in ("in", "out"):
+                self.obs.remove_child("dbx_worker_channel_depth",
+                                      worker=wid, channel=ch)
+            self.obs.remove_child("dbx_worker_deferred_completions",
+                                  worker=wid)
+            self.obs.remove_child("dbx_worker_busy", worker=wid)
 
     def stop(self) -> None:
         self._stop.set()
@@ -314,28 +406,36 @@ class Worker:
         status = (pb.WORKER_STATUS_RUNNING if self._busy.is_set()
                   else pb.WORKER_STATUS_IDLE)
         try:
-            stub.SendStatus(pb.StatusRequest(
-                worker_id=self.worker_id, status=status), timeout=5.0)
+            with obs.timer(self._h_rpc["SendStatus"]):
+                stub.SendStatus(pb.StatusRequest(
+                    worker_id=self.worker_id, status=status), timeout=5.0)
             self._log_reconnected()
         except grpc.RpcError as e:
+            self._c_rpc_errors["SendStatus"].inc()
             self._log_disconnected(e)
 
     def _poll_jobs(self, stub):
         """Request a batch if the compute queue has room; None on RPC error."""
         if self._in.full():
             return None
+        self._c_polls.inc()
         try:
-            reply = stub.RequestJobs(pb.JobsRequest(
-                worker_id=self.worker_id, chips=self.backend.chips,
-                jobs_per_chip=self.jobs_per_chip), timeout=30.0)
+            with obs.timer(self._h_rpc["RequestJobs"]):
+                reply = stub.RequestJobs(pb.JobsRequest(
+                    worker_id=self.worker_id, chips=self.backend.chips,
+                    jobs_per_chip=self.jobs_per_chip), timeout=30.0)
             self._log_reconnected()
         except grpc.RpcError as e:
+            self._c_rpc_errors["RequestJobs"].inc()
             self._log_disconnected(e)
             return None
         jobs = list(reply.jobs)
         if jobs:
             log.info("received %d jobs", len(jobs))
+            self._c_jobs_in.inc(len(jobs))
             self._in.put(jobs)
+        else:
+            self._c_idle_polls.inc()
         return jobs
 
     # Retry due-times for failed completion RPCs. Attempts are spread over
@@ -393,16 +493,21 @@ class Worker:
             # yields between chunks), so 8 s bounds the worst heartbeat gap.
             # A link too slow to move a chunk in 8 s fails the attempt; items
             # park for retry and, if attempts exhaust, leases re-queue them.
-            reply = stub.CompleteJobs(req, timeout=8.0)
+            with obs.span("worker.report", jobs=len(chunk)), \
+                    obs.timer(self._h_rpc["CompleteJobs"]):
+                reply = stub.CompleteJobs(req, timeout=8.0)
             self._log_reconnected()
             self.jobs_completed += reply.accepted
+            self._jobs_rate.add(reply.accepted)
             for jid in reply.unknown_ids:
                 log.warning("completion %s rejected: unknown job", jid)
         except grpc.RpcError as e:
+            self._c_rpc_errors["CompleteJobs"].inc()
             self._log_disconnected(e)
             for attempts, comp in chunk:
                 if attempts >= len(self._COMPLETION_BACKOFF_S):
                     self.completions_dropped += 1
+                    self._c_dropped.inc()
                     log.error("dropping completion %s after %d attempts "
                               "(lease will re-queue it)", comp.job_id,
                               attempts + 1)
@@ -453,6 +558,12 @@ def main(argv=None) -> None:
     ap.add_argument("--jobs-per-chip", type=int, default=1)
     ap.add_argument("--exit-after-idle", type=int, default=None,
                     help="exit after N consecutive empty polls (batch mode)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve Prometheus /metrics (+ /stats.json) on this "
+                         "port (0 = ephemeral; omit to disable)")
+    ap.add_argument("--metrics-host", default="0.0.0.0",
+                    help="interface for the /metrics server (use 127.0.0.1 "
+                         "to scope the scrape surface to this host)")
     args = ap.parse_args(argv)
 
     logging.basicConfig(
@@ -474,9 +585,16 @@ def main(argv=None) -> None:
 
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: worker.stop())
+    metrics_srv = (obs.MetricsServer(args.metrics_port,
+                                     bind=args.metrics_host).start()
+                   if args.metrics_port is not None else None)
     log.info("worker %s -> %s (backend=%s, chips=%d)",
              worker.worker_id, args.connect, args.backend, backend.chips)
-    worker.run(max_idle_polls=args.exit_after_idle)
+    try:
+        worker.run(max_idle_polls=args.exit_after_idle)
+    finally:
+        if metrics_srv is not None:
+            metrics_srv.stop()
 
 
 if __name__ == "__main__":

@@ -9,4 +9,6 @@ from .data import (  # noqa: F401
     from_wire_bytes,
     pad_and_stack,
 )
-from . import trace  # noqa: F401
+# NOTE: `utils.trace` is a deprecation shim over `..obs` and is no longer
+# imported eagerly — importing it emits a DeprecationWarning, which an
+# unconditional package-level import would fire on every process start.

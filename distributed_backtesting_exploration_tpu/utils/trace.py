@@ -1,61 +1,21 @@
-"""Tracing / profiling utilities.
+"""DEPRECATED: moved to :mod:`distributed_backtesting_exploration_tpu.obs`.
 
-The reference's observability is structured logging plus one hand-timed
-phase (file reads timed with an Instant and logged, reference
-``src/server/main.rs:167-175``). This module keeps that per-phase timing
-pattern as a context manager and adds the TPU-native profiler: a context
-that wraps ``jax.profiler`` and writes a TensorBoard-loadable trace of XLA
-kernels.
+``utils.trace`` grew into the unified observability layer — the span API,
+metrics registry, JSONL event log and ``/metrics`` surface all live under
+``obs`` now (DESIGN.md "Observability"). This shim re-exports the old
+names unchanged and is kept for ONE release; import from ``..obs`` (or
+``..obs.trace``) instead.
 """
 
 from __future__ import annotations
 
-import contextlib
-import logging
-import time
+import warnings
 
-log = logging.getLogger("dbx.trace")
+from ..obs.trace import (  # noqa: F401
+    StepTimer, device_profile, span, timed)
 
-
-@contextlib.contextmanager
-def timed(name: str, *, logger: logging.Logger = log, level=logging.INFO):
-    """Log the wall-clock duration of a phase: ``with timed("decode"): ...``"""
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        logger.log(level, "%s took %.1fms", name,
-                   1e3 * (time.perf_counter() - t0))
-
-
-@contextlib.contextmanager
-def device_profile(logdir: str):
-    """Capture a jax.profiler trace (XLA kernel timeline) under ``logdir``.
-
-    View with TensorBoard's profile plugin. On the remote-proxy TPU backend
-    host-side events still capture; device traces need a directly-attached
-    chip.
-    """
-    import jax
-
-    jax.profiler.start_trace(logdir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
-
-
-class StepTimer:
-    """Running throughput meter: the ``backtests/sec`` counter surfaced by
-    the dispatcher's GetStats — usable worker-side for per-batch logs."""
-
-    def __init__(self):
-        self.t0 = time.monotonic()
-        self.units = 0.0
-
-    def add(self, n: float) -> None:
-        self.units += n
-
-    @property
-    def rate(self) -> float:
-        return self.units / max(time.monotonic() - self.t0, 1e-9)
+warnings.warn(
+    "distributed_backtesting_exploration_tpu.utils.trace is deprecated; "
+    "use distributed_backtesting_exploration_tpu.obs (same names: timed, "
+    "device_profile, StepTimer, plus the new span/registry APIs)",
+    DeprecationWarning, stacklevel=2)

@@ -873,3 +873,59 @@ def test_fused_keltner_rejects_non_integer_windows():
         fused.fused_keltner_sweep(
             jnp.ones((1, 64)), jnp.ones((1, 64)), jnp.ones((1, 64)),
             np.asarray([10.5]), np.asarray([1.5]))
+
+
+# ---------------------------------------------------------------------------
+# DBX_LANES_CAP: validation + in-process recompile (ADVICE.md findings)
+# ---------------------------------------------------------------------------
+
+def test_lanes_cap_rejects_off_ladder_values(monkeypatch):
+    """A cap below 128 (or any non-ladder value) used to fall through to
+    the FULL un-blocked P_pad — the opposite of a cap. It must raise."""
+    for bad in ("64", "100", "1000", "abc", "-512"):
+        monkeypatch.setenv("DBX_LANES_CAP", bad)
+        with pytest.raises(ValueError, match="DBX_LANES_CAP"):
+            fused.resolve_lanes_cap()
+        with pytest.raises(ValueError, match="DBX_LANES_CAP"):
+            fused.fused_sma_sweep(
+                jnp.ones((1, 64)) + jnp.arange(64.0),
+                np.asarray([3.0], np.float32), np.asarray([10.0], np.float32))
+
+
+def test_lanes_cap_accepts_ladder_values(monkeypatch):
+    # "0" is the explicit-disable sentinel, same as unset (old behavior)
+    for good, want in (("128", 128), ("256", 256), ("512", 512),
+                       ("1024", 1024), ("0", 0)):
+        monkeypatch.setenv("DBX_LANES_CAP", good)
+        assert fused.resolve_lanes_cap() == want
+    monkeypatch.delenv("DBX_LANES_CAP")
+    assert fused.resolve_lanes_cap() == 0
+
+
+def test_widest_lanes_env_cap_never_unblocks():
+    # env cap narrows sign-kernel calls; cap <= 256 calls ignore it
+    assert fused._widest_lanes(1024, 512, 1280, env_cap=256) == 256
+    assert fused._widest_lanes(1024, 512, 1280, env_cap=0) == 512
+    assert fused._widest_lanes(1024, 256, 1280, env_cap=512) == 256
+
+
+def test_lanes_cap_change_recompiles_in_process(monkeypatch):
+    """The resolved cap is a static jit argument: changing DBX_LANES_CAP
+    within one process must compile a NEW kernel, not silently reuse the
+    stale lane width (the in-process A/B measured nothing before)."""
+    monkeypatch.delenv("DBX_LANES_CAP", raising=False)
+    close = np.cumsum(np.ones((2, 64), np.float32), axis=1) + 100.0
+    fast = np.asarray([3.0, 3.0], np.float32)
+    slow = np.asarray([10.0, 12.0], np.float32)
+    m_default = fused.fused_sma_sweep(close, fast, slow)
+    n_before = fused._fused_call._cache_size()
+    monkeypatch.setenv("DBX_LANES_CAP", "512")
+    m_capped = fused.fused_sma_sweep(close, fast, slow)
+    assert fused._fused_call._cache_size() == n_before + 1
+    # identical numerics either way — the cap changes blocking, not math
+    for name in m_default._fields:
+        np.testing.assert_allclose(np.asarray(getattr(m_capped, name)),
+                                   np.asarray(getattr(m_default, name)))
+    # same setting again: cache hit, no further compile
+    fused.fused_sma_sweep(close, fast, slow)
+    assert fused._fused_call._cache_size() == n_before + 1

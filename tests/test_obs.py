@@ -1,0 +1,215 @@
+"""The obs layer: registry semantics, spans, rendering, endpoint, dump CLI."""
+
+import json
+import math
+import threading
+import urllib.request
+
+import pytest
+
+from distributed_backtesting_exploration_tpu import obs
+from distributed_backtesting_exploration_tpu.obs import dump, events
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics
+# ---------------------------------------------------------------------------
+
+def test_counter_semantics():
+    reg = obs.Registry()
+    c = reg.counter("dbx_t_total", "help", method="A")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    # get-or-create: same name+labels -> same child; new labels -> new child
+    assert reg.counter("dbx_t_total", method="A") is c
+    c2 = reg.counter("dbx_t_total", method="B")
+    assert c2 is not c and c2.value == 0.0
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_kind_and_name_validation():
+    reg = obs.Registry()
+    reg.counter("dbx_x_total")
+    with pytest.raises(ValueError):
+        reg.gauge("dbx_x_total")          # same name, different kind
+    with pytest.raises(ValueError):
+        reg.counter("bad-name")           # invalid prometheus name
+    with pytest.raises(ValueError):
+        reg.counter("dbx_z_total", **{"0bad": 1})  # invalid label name
+
+
+def test_gauge_set_fn_and_collector():
+    reg = obs.Registry()
+    g = reg.gauge("dbx_depth")
+    g.set(4)
+    assert g.value == 4
+    reg.gauge_fn("dbx_live", lambda: 7)
+    state = {"n": 0}
+    reg.add_collector("c", lambda r: r.gauge("dbx_coll").set(
+        state.__setitem__("n", state["n"] + 1) or state["n"]))
+    snap = reg.snapshot()
+    assert snap["dbx_live"]["values"][""] == 7
+    assert snap["dbx_coll"]["values"][""] == 1
+    reg.snapshot()
+    assert state["n"] == 2                 # collector runs once per snapshot
+    reg.remove_collector("c")
+    reg.snapshot()
+    assert state["n"] == 2
+
+
+def test_histogram_buckets_and_summary():
+    reg = obs.Registry()
+    h = reg.histogram("dbx_lat_seconds", buckets=(0.001, 0.01, 0.1))
+    for v in (0.0005, 0.001, 0.005, 0.05, 5.0):
+        h.observe(v)
+    cum = dict(h.cumulative())
+    # le is inclusive: the 0.001 observation lands in the 0.001 bucket
+    assert cum[0.001] == 2 and cum[0.01] == 3 and cum[0.1] == 4
+    assert cum[math.inf] == 5
+    s = h.summary()
+    assert s["count"] == 5 and s["max"] == 5.0
+    assert s["sum"] == pytest.approx(5.0565)
+    assert 0 < s["p50"] <= 0.01
+
+
+def test_prometheus_rendering():
+    reg = obs.Registry()
+    reg.counter("dbx_c_total", "a counter", kind="x").inc(2)
+    reg.gauge("dbx_g").set(1.5)
+    reg.histogram("dbx_h_seconds", buckets=(0.1, 1.0)).observe(0.5)
+    txt = reg.render_prometheus()
+    assert "# TYPE dbx_c_total counter" in txt
+    assert 'dbx_c_total{kind="x"} 2.0' in txt
+    assert "dbx_g 1.5" in txt
+    assert 'dbx_h_seconds_bucket{le="0.1"} 0' in txt
+    assert 'dbx_h_seconds_bucket{le="1.0"} 1' in txt
+    assert 'dbx_h_seconds_bucket{le="+Inf"} 1' in txt
+    assert "dbx_h_seconds_count 1" in txt
+
+
+def test_registry_thread_safety():
+    reg = obs.Registry()
+    c = reg.counter("dbx_mt_total")
+    h = reg.histogram("dbx_mt_seconds")
+
+    def work():
+        for _ in range(1000):
+            c.inc()
+            h.observe(0.001)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8000
+    assert h.count == 8000
+
+
+# ---------------------------------------------------------------------------
+# Spans + event log
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_and_event_log(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    events.configure(path)
+    try:
+        with obs.span("outer"):
+            assert obs.current_span() == "outer"
+            with obs.span("inner", jobs=3):
+                assert obs.current_span() == "inner"
+        assert obs.current_span() is None
+    finally:
+        events.configure(None)
+    recs = [json.loads(ln) for ln in open(path)]
+    inner = next(r for r in recs if r["name"] == "inner")
+    outer = next(r for r in recs if r["name"] == "outer")
+    assert inner["parent"] == "outer" and inner["jobs"] == 3
+    assert outer["parent"] is None
+    assert inner["dur_s"] <= outer["dur_s"]
+    # span durations also land in the shared registry histogram
+    s = obs.get_registry().summaries()
+    assert s["dbx_span_seconds{span=inner}"]["count"] >= 1
+
+
+def test_span_records_on_exception(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    events.configure(path)
+    try:
+        with pytest.raises(RuntimeError):
+            with obs.span("boom"):
+                raise RuntimeError("x")
+    finally:
+        events.configure(None)
+    rec = json.loads(open(path).read().splitlines()[-1])
+    assert rec["name"] == "boom" and rec["ok"] is False
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoint + dump CLI (the tier-1 smoke of the tooling)
+# ---------------------------------------------------------------------------
+
+def test_metrics_endpoint_and_dump_cli(tmp_path, capsys):
+    reg = obs.Registry()
+    reg.counter("dbx_cli_total").inc(3)
+    h = reg.histogram("dbx_cli_seconds")
+    h.observe(0.01)
+    srv = obs.start_metrics_server(0, registry=reg)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        body = urllib.request.urlopen(base + "/metrics").read().decode()
+        assert "dbx_cli_total 3.0" in body
+        snap = json.loads(
+            urllib.request.urlopen(base + "/stats.json").read())
+        assert snap["dbx_cli_seconds"]["type"] == "histogram"
+        # dump CLI against the live endpoint
+        assert dump.main([base]) == 0
+        out = capsys.readouterr().out
+        assert "dbx_cli_seconds" in out and "dbx_cli_total" in out
+    finally:
+        srv.stop()
+
+    # dump CLI against a JSONL event log
+    path = str(tmp_path / "trace.jsonl")
+    events.configure(path)
+    try:
+        with obs.span("phase_a"):
+            with obs.span("phase_b"):
+                pass
+    finally:
+        events.configure(None)
+    assert dump.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "phase_a" in out and "phase_a/phase_b" in out and "share" in out
+
+
+def test_steptimer_gauge():
+    reg = obs.Registry()
+    g = reg.gauge("dbx_rate")
+    t = obs.StepTimer(g)
+    t.add(100)
+    assert t.rate > 0
+    assert g.value > 0   # published at add() time (rate decays after)
+
+
+# ---------------------------------------------------------------------------
+# utils.trace deprecation shim
+# ---------------------------------------------------------------------------
+
+def test_utils_trace_shim_warns_and_reexports():
+    import importlib
+    import warnings
+
+    import distributed_backtesting_exploration_tpu.utils.trace as shim
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        importlib.reload(shim)
+    assert any(issubclass(x.category, DeprecationWarning) for x in w)
+    from distributed_backtesting_exploration_tpu.obs import trace as obs_trace
+
+    assert shim.timed is obs_trace.timed
+    assert shim.StepTimer is obs_trace.StepTimer
+    assert shim.device_profile is obs_trace.device_profile

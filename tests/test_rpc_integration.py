@@ -920,3 +920,129 @@ def test_best_returns_jobs_over_the_wire_match_direct_composition(tmp_path):
         panel, base.get_strategy("sma_crossover"), canonical, cost=1e-3)
     assert out["portfolio"]["sharpe"] == pytest.approx(
         float(pm.sharpe), rel=2e-4, abs=2e-5)
+
+
+def test_obs_end_to_end_metrics_and_extended_stats(tmp_path):
+    """The observability acceptance path: a dispatcher+worker run exports
+    non-empty RPC latency histograms, queue-depth gauges, and worker
+    per-batch span timings via BOTH /metrics (Prometheus text) and the
+    extended GetStats obs_json payload."""
+    import json
+    import urllib.request
+
+    from distributed_backtesting_exploration_tpu import obs
+    from distributed_backtesting_exploration_tpu.obs import dump
+
+    # Fresh registry: assertions must not depend on what earlier tests
+    # recorded into the process-global one. The worker's span chain and
+    # the compute backend record globally, so only dispatcher/worker
+    # families use the injected registry.
+    reg = obs.Registry()
+    queue = JobQueue()
+    for rec in synthetic_jobs(8, 64, "sma_crossover", GRID):
+        queue.enqueue(rec)
+    disp = Dispatcher(queue, PeerRegistry(prune_window_s=10.0),
+                      results_dir=str(tmp_path / "results"), registry=reg)
+    srv = DispatcherServer(disp, bind="localhost:0", prune_interval_s=0.1,
+                           metrics_port=0).start()
+    try:
+        backend = compute.InstantBackend()
+        w = Worker(f"localhost:{srv.port}", backend, poll_interval_s=0.02,
+                   status_interval_s=0.05, registry=reg)
+        t = threading.Thread(target=lambda: w.run(max_idle_polls=10),
+                             daemon=True)
+        t.start()
+        _LIVE_WORKERS.append((w, t))
+        _wait(lambda: queue.drained, msg="queue drained")
+
+        # -- extended stats over the existing wire --------------------------
+        import grpc as grpc_mod
+
+        from distributed_backtesting_exploration_tpu.rpc import (
+            backtesting_pb2 as pb2, service as service_mod)
+
+        channel = grpc_mod.insecure_channel(f"localhost:{srv.port}")
+        try:
+            stub = service_mod.DispatcherStub(channel)
+            reply = stub.GetStats(pb2.StatsRequest(), timeout=10.0)
+            assert reply.jobs_completed == 8
+            ext = json.loads(reply.obs_json)
+        finally:
+            channel.close()
+        assert ext["dbx_rpc_seconds{method=RequestJobs}"]["count"] > 0
+        assert ext["dbx_rpc_seconds{method=CompleteJobs}"]["count"] > 0
+        assert ext["dbx_rpc_seconds{method=RequestJobs}"]["sum"] > 0
+        assert ext["dbx_queue_jobs{pool=completed}"] == 8.0
+        assert ext["dbx_queue_jobs{pool=pending}"] == 0.0
+        assert ext["dbx_jobs_dispatched_total"] == 8.0
+        assert ext["dbx_completions_total{outcome=new}"] == 8.0
+
+        # -- /metrics (Prometheus text) -------------------------------------
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.metrics.port}/metrics",
+            timeout=10).read().decode()
+        assert 'dbx_rpc_seconds_count{method="RequestJobs"}' in body
+        assert 'dbx_rpc_seconds_bucket{method="RequestJobs",le="+Inf"}' \
+            in body
+        assert 'dbx_queue_jobs{pool="completed"} 8.0' in body
+        # worker-side client RPC latency + per-batch span chain
+        assert 'dbx_worker_rpc_seconds_count{method="CompleteJobs"}' in body
+        ws = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.metrics.port}/stats.json",
+            timeout=10).read())
+        spans = obs.get_registry().summaries(prefix="dbx_span")
+        assert spans["dbx_span_seconds{span=worker.process}"]["count"] > 0
+        assert ws["dbx_worker_rpc_seconds"]["type"] == "histogram"
+
+        # -- dump CLI smoke against the live endpoint -----------------------
+        assert dump.main([f"http://127.0.0.1:{srv.metrics.port}"]) == 0
+    finally:
+        srv.stop()
+
+
+def test_obs_pipelined_span_chain_and_kernel_attribution(tmp_path):
+    """The JAX backend populates the decode -> submit -> collect span chain,
+    per-route kernel wall-time, and the JSONL event log."""
+    import json
+
+    from distributed_backtesting_exploration_tpu import obs
+    from distributed_backtesting_exploration_tpu.obs import events
+
+    jsonl = str(tmp_path / "events.jsonl")
+    events.configure(jsonl)
+    try:
+        queue = JobQueue()
+        for rec in synthetic_jobs(3, 64, "sma_crossover", GRID):
+            queue.enqueue(rec)
+        disp, srv = _server(queue, results_dir=str(tmp_path / "results"))
+        try:
+            _run_worker(f"localhost:{srv.port}",
+                        compute.JaxSweepBackend(use_fused=True))
+            _wait(lambda: queue.drained, msg="queue drained")
+        finally:
+            srv.stop()
+    finally:
+        events.configure(None)
+
+    s = obs.get_registry().summaries()
+    assert s["dbx_span_seconds{span=worker.submit}"]["count"] > 0
+    assert s["dbx_span_seconds{span=worker.collect}"]["count"] > 0
+    assert s["dbx_span_seconds{span=worker.report}"]["count"] > 0
+    assert s["dbx_compute_decode_seconds"]["count"] > 0
+    assert s["dbx_compute_decode_bytes_total"] > 0
+    assert s["dbx_compute_collect_seconds"]["count"] > 0
+    assert s["dbx_compute_d2h_bytes_total"] > 0
+    # per-strategy kernel wall keyed by route:strategy, compile/execute split
+    kern = [k for k in s if k.startswith("dbx_kernel_submit_seconds")
+            and "fused:sma_crossover" in k]
+    assert kern, sorted(k for k in s if k.startswith("dbx_kernel"))
+    assert any("phase=compile" in k for k in kern)
+    # combos credited: 3 jobs x |GRID| combos
+    import numpy as np
+
+    combos = int(np.prod([v.size for v in GRID.values()]))
+    assert s["dbx_backtests_total"] >= 3 * combos
+    # event log carries the span chain for post-mortem reconstruction
+    names = {json.loads(ln)["name"] for ln in open(jsonl)
+             if json.loads(ln).get("ev") == "span"}
+    assert {"worker.submit", "worker.collect", "worker.report"} <= names
