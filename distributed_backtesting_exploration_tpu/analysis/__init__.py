@@ -1,0 +1,38 @@
+"""dbxlint: static analysis for the dbx codebase (AST + jaxpr layers).
+
+Round-5 review found two real bugs of ONE class — ``os.environ`` read at
+trace time inside a jit-compiled kernel, invisible to the jit cache key —
+and the fix was manual. Compiler-first systems (TVM, arxiv 1802.04799;
+the Julia->TPU full-compilation work, arxiv 1810.09868) get reliability
+from mechanical invariant checks over their IR rather than from review
+vigilance. This package gives the repo the same treatment across two
+layers it already has IRs for:
+
+- **AST layer** (:mod:`.ast_rules`): *trace-time-env* (env reads reachable
+  from jit/pallas-traced code), *lock-discipline* (guarded-field mutations
+  outside ``with ...lock`` blocks), *import-time-config* (module-level
+  env/IO capture), *blocking-call* (sleeps/subprocesses inside gRPC
+  servicer handlers and the worker control loop).
+- **jaxpr/IR layer** (:mod:`.jaxpr_rules`): *kernel-hygiene* — trace every
+  registered fused kernel with ``jax.make_jaxpr`` and flag host callbacks,
+  float64 leaks, and weak-type promotions escaping the kernel.
+- **wire layer** (:mod:`.proto_rules`): *proto-drift* — structural
+  comparison of ``.proto`` source against the generated ``_pb2``
+  serialized descriptor (this repo regenerates pb2 without protoc, so
+  drift is a real failure mode).
+
+CLI::
+
+    python -m distributed_backtesting_exploration_tpu.analysis.lint \
+        [paths ...] [--format text|json] [--rules a,b] [--list-rules]
+
+Inline suppression (same line or the comment line directly above), only
+with a justifying comment::
+
+    x = os.environ.get("DBX_X")  # dbxlint: disable=trace-time-env -- <why>
+
+See DESIGN.md "Static analysis" for the rule catalogue and how to add a
+rule.
+"""
+
+from .core import Finding, LintContext, all_rules, lint_path  # noqa: F401

@@ -1,0 +1,547 @@
+"""dbxlint AST-layer rules.
+
+Four rules over parsed source, all sharing one scope model
+(:class:`_Scope`): a tree of function-like nodes (def / async def /
+lambda) with bare-name resolution walking lexically outward. Class bodies
+are transparent for scoping (names defined in a class body are NOT
+visible inside its methods, matching Python), but methods are still
+scanned as potential roots/targets.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from .core import Finding, LintContext, PyFile
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _terminal_name(node: ast.AST) -> str | None:
+    """Last component of a callee expression (``jax.jit`` -> ``jit``)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+@dataclasses.dataclass
+class _Scope:
+    """One function-like scope (or the module itself)."""
+
+    node: ast.AST                       # Module / FunctionDef / Lambda
+    parent: "_Scope | None"
+    qualname: str
+    defs: dict = dataclasses.field(default_factory=dict)  # name -> _Scope
+
+    def resolve(self, name: str) -> "_Scope | None":
+        scope = self
+        while scope is not None:
+            hit = scope.defs.get(name)
+            if hit is not None:
+                return hit
+            scope = scope.parent
+        return None
+
+    def own_nodes(self):
+        """AST nodes belonging directly to this scope — descent stops at
+        nested function-like nodes (their bodies are their own scopes)."""
+        stack = (list(ast.iter_child_nodes(self.node))
+                 if isinstance(self.node, _FUNC_NODES + (ast.Module,))
+                 else [self.node])
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, _FUNC_NODES):
+                # Still yield decorators/defaults — they evaluate in THIS
+                # scope — but not the nested body.
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    stack.extend(node.decorator_list)
+                    stack.extend(node.args.defaults)
+                    stack.extend(d for d in node.args.kw_defaults if d)
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _build_scopes(tree: ast.Module) -> tuple[_Scope, list[_Scope]]:
+    """Scope tree + flat list of every function-like scope in the module."""
+    module = _Scope(tree, None, "<module>")
+    all_scopes: list[_Scope] = []
+
+    def visit(node: ast.AST, scope: _Scope, in_class: str | None):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = (f"{in_class}.{child.name}" if in_class
+                        else child.name)
+                sub = _Scope(child, scope, qual)
+                if in_class is None:
+                    # Methods are not bare-name-resolvable from peers.
+                    scope.defs[child.name] = sub
+                all_scopes.append(sub)
+                visit(child, sub, None)
+            elif isinstance(child, ast.Lambda):
+                sub = _Scope(child, scope, f"{scope.qualname}.<lambda>")
+                all_scopes.append(sub)
+                visit(child, sub, None)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, scope, child.name)
+            else:
+                visit(child, scope, in_class)
+
+    visit(tree, module, None)
+    return module, all_scopes
+
+
+# ---------------------------------------------------------------------------
+# Rule 1: trace-time-env
+# ---------------------------------------------------------------------------
+
+# Callables whose function arguments are traced (executed at trace time,
+# baked into the jit cache without being part of its key).
+_TRACE_ENTRY_CALLS = {
+    "jit", "pallas_call", "pmap", "vmap", "grad", "value_and_grad",
+    "shard_map", "make_jaxpr", "eval_shape", "checkpoint", "remat", "scan",
+    "while_loop", "cond",
+}
+_TRACE_DECORATORS = {"jit", "pmap", "pallas_call", "shard_map", "vmap"}
+
+
+def _is_env_read(node: ast.AST) -> bool:
+    if isinstance(node, ast.Attribute) and node.attr == "environ":
+        return True
+    if isinstance(node, ast.Call) and _terminal_name(node.func) == "getenv":
+        return True
+    return False
+
+
+class TraceTimeEnvRule:
+    """``os.environ`` reads reachable from jit/pallas-traced functions.
+
+    An env read inside traced code executes once at trace time and is
+    invisible to the jit cache key — later in-process changes silently
+    reuse the stale compile (the ``DBX_LANES_CAP`` bug class, ADVICE.md
+    round 5). Reachability is same-module and over-approximate: a traced
+    root reaches every module/nested function it references by name.
+    The fix is to read the variable host-side and thread it in as a
+    static argument (``ops.fused.resolve_lanes_cap`` is the template).
+    """
+
+    name = "trace-time-env"
+    doc = "os.environ read reachable from jit/pallas-traced code"
+
+    def check(self, ctx: LintContext) -> list[Finding]:
+        out: list[Finding] = []
+        for pf in ctx.files:
+            out.extend(self._check_file(pf))
+        return out
+
+    def _roots(self, module: _Scope, scopes: list[_Scope]) -> list[_Scope]:
+        roots: list[_Scope] = []
+        # (a) decorated defs: @jax.jit / @functools.partial(jax.jit, ...).
+        for scope in scopes:
+            deco = getattr(scope.node, "decorator_list", [])
+            for d in deco:
+                names = {n for sub in ast.walk(d)
+                         for n in [_terminal_name(sub)] if n}
+                if names & _TRACE_DECORATORS:
+                    roots.append(scope)
+                    break
+        # (b) call-form: jax.jit(fn) / pl.pallas_call(kernel, ...) — every
+        # function reference inside the call's arguments is a traced root.
+        for scope in [module] + scopes:
+            for node in scope.own_nodes():
+                if not (isinstance(node, ast.Call)
+                        and _terminal_name(node.func)
+                        in _TRACE_ENTRY_CALLS):
+                    continue
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Lambda):
+                            hit = next((s for s in scopes
+                                        if s.node is sub), None)
+                            if hit:
+                                roots.append(hit)
+                        elif isinstance(sub, ast.Name):
+                            hit = scope.resolve(sub.id)
+                            if hit:
+                                roots.append(hit)
+        return roots
+
+    def _check_file(self, pf: PyFile) -> list[Finding]:
+        module, scopes = _build_scopes(pf.tree)
+        reachable: dict[int, tuple[_Scope, str]] = {}   # id -> (scope, root)
+        work = [(s, s.qualname) for s in self._roots(module, scopes)]
+        while work:
+            scope, root = work.pop()
+            if id(scope) in reachable:
+                continue
+            reachable[id(scope)] = (scope, root)
+            # Nested defs of a traced function execute at trace time when
+            # called; include them outright (over-approximation is safe
+            # here — anything inside a traced region IS trace-time code).
+            for sub in scope.defs.values():
+                work.append((sub, root))
+            for node in scope.own_nodes():
+                if isinstance(node, ast.Name):
+                    hit = scope.resolve(node.id)
+                    if hit is not None:
+                        work.append((hit, root))
+        findings: dict[tuple, Finding] = {}
+        for scope, root in reachable.values():
+            for node in ast.walk(scope.node):
+                if _is_env_read(node):
+                    key = (pf.rel, node.lineno)
+                    findings.setdefault(key, Finding(
+                        self.name, pf.rel, node.lineno,
+                        f"os.environ read at trace time (reachable from "
+                        f"traced function `{root}`); it is invisible to "
+                        f"the jit cache key — read it host-side and "
+                        f"thread it in as a static argument"))
+        return list(findings.values())
+
+
+# ---------------------------------------------------------------------------
+# Rule 2: lock-discipline
+# ---------------------------------------------------------------------------
+
+# Method names that mutate their receiver (dict/list/set/deque surface).
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "discard", "remove", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "sort", "reverse", "push", "push_front",
+}
+_LOCK_FACTORIES = {"Lock", "RLock"}
+
+
+def _lock_value(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and _terminal_name(node.func) in _LOCK_FACTORIES)
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """``self.X`` -> ``X``."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _mutations(body_nodes, field_of):
+    """Yield ``(field, line, under_lock)`` for mutations in an iterable of
+    ``(node, under_lock)`` pairs. ``field_of(expr)`` maps a target
+    expression to a tracked field name (or None)."""
+    for node, locked in body_nodes:
+        targets: list[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        elif isinstance(node, ast.Delete):
+            targets = node.targets
+        for t in targets:
+            base = t.value if isinstance(t, ast.Subscript) else t
+            f = field_of(base)
+            if f is not None:
+                yield f, node.lineno, locked
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if (isinstance(fn, ast.Attribute) and fn.attr in _MUTATORS):
+                f = field_of(fn.value)
+                if f is not None:
+                    yield f, node.lineno, locked
+
+
+def _walk_with_locks(root: ast.AST, is_lock_expr):
+    """Yield ``(node, under_lock)`` over ``root``'s body, not descending
+    into nested function-like nodes (their bodies run on their own call
+    stack, possibly under the caller's lock — out of scope here)."""
+    def rec(node, locked):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNC_NODES):
+                continue
+            child_locked = locked
+            if isinstance(child, ast.With):
+                if any(is_lock_expr(item.context_expr)
+                       for item in child.items):
+                    child_locked = True
+            yield child, child_locked
+            yield from rec(child, child_locked)
+    yield from rec(root, False)
+
+
+class LockDisciplineRule:
+    """Guarded-field mutations outside ``with <lock>`` blocks.
+
+    A field is *guarded* when the class (or module) that owns a
+    ``threading.Lock``/``RLock`` mutates it at least once inside a
+    ``with <lock>:`` block outside ``__init__``. Any other mutation of
+    the same field outside a lock block is a discipline violation — the
+    single-lock model every threaded class here documents (JobQueue,
+    PeerRegistry, the obs registry, the journal). Constructor bodies are
+    initialization and exempt. Reads are not checked.
+    """
+
+    name = "lock-discipline"
+    doc = "guarded-field mutation outside the owning lock"
+
+    def check(self, ctx: LintContext) -> list[Finding]:
+        out: list[Finding] = []
+        for pf in ctx.files:
+            for node in ast.walk(pf.tree):
+                if isinstance(node, ast.ClassDef):
+                    out.extend(self._check_class(pf, node))
+            out.extend(self._check_module(pf))
+        return out
+
+    def _check_class(self, pf: PyFile, cls: ast.ClassDef) -> list[Finding]:
+        lock_attrs = {
+            _self_attr(t)
+            for m in ast.walk(cls) if isinstance(m, ast.Assign)
+            if _lock_value(m.value)
+            for t in m.targets if _self_attr(t)
+        }
+        lock_attrs.discard(None)
+        if not lock_attrs:
+            return []
+
+        def is_lock_expr(expr):
+            return _self_attr(expr) in lock_attrs
+
+        methods = [m for m in cls.body
+                   if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+                   and m.name != "__init__"]
+        muts: list[tuple[str, int, bool]] = []
+        for m in methods:
+            muts.extend(_mutations(_walk_with_locks(m, is_lock_expr),
+                                   _self_attr))
+        guarded = {f for f, _, locked in muts if locked}
+        return [
+            Finding(self.name, pf.rel, line,
+                    f"`self.{f}` is mutated under `{cls.name}`'s lock "
+                    f"elsewhere but mutated here without holding it")
+            for f, line, locked in muts
+            if f in guarded and not locked
+        ]
+
+    def _check_module(self, pf: PyFile) -> list[Finding]:
+        lock_names = {
+            t.id
+            for stmt in pf.tree.body if isinstance(stmt, ast.Assign)
+            if _lock_value(stmt.value)
+            for t in stmt.targets if isinstance(t, ast.Name)
+        }
+        if not lock_names:
+            return []
+        module_globals = {
+            t.id
+            for stmt in pf.tree.body
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign))
+            for t in (stmt.targets if isinstance(stmt, ast.Assign)
+                      else [stmt.target])
+            if isinstance(t, ast.Name)
+        } - lock_names
+
+        def field_of(expr):
+            if isinstance(expr, ast.Name) and expr.id in module_globals:
+                return expr.id
+            return None
+
+        def is_lock_expr(expr):
+            return isinstance(expr, ast.Name) and expr.id in lock_names
+
+        funcs = [n for n in ast.walk(pf.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        muts: list[tuple[str, int, bool]] = []
+        for fn in funcs:
+            declared_global = {
+                name for node in ast.walk(fn)
+                if isinstance(node, ast.Global) for name in node.names}
+            # Python scoping: ANY plain assignment to a name (without
+            # `global`) makes it function-local for the WHOLE function —
+            # every mutation of such a name targets the local shadow, not
+            # the guarded global, and must not be reported.
+            local_shadows = {
+                t.id
+                for node in ast.walk(fn)
+                if isinstance(node, (ast.Assign, ast.AnnAssign, ast.For))
+                for t in (node.targets if isinstance(node, ast.Assign)
+                          else [node.target])
+                if isinstance(t, ast.Name)
+            } - declared_global
+            muts.extend(
+                (f, line, locked)
+                for f, line, locked in _mutations(
+                    _walk_with_locks(fn, is_lock_expr), field_of)
+                if f not in local_shadows)
+        guarded = {f for f, _, locked in muts if locked}
+        return [
+            Finding(self.name, pf.rel, line,
+                    f"module global `{f}` is mutated under the module "
+                    f"lock elsewhere but mutated here without holding it")
+            for f, line, locked in muts
+            if f in guarded and not locked
+        ]
+
+# ---------------------------------------------------------------------------
+# Rule 3: import-time-config
+# ---------------------------------------------------------------------------
+
+class ImportTimeConfigRule:
+    """Module-level env reads / file IO (configuration captured at import).
+
+    Import-time capture freezes the value for the process regardless of
+    later in-process changes, runs in an order the importer cannot see,
+    and makes a module un-reimportable with different config (the
+    ``DBX_OBS_JSONL`` import-time read this rule was cut from). Read
+    config lazily at first use instead. ``if __name__ == "__main__"``
+    blocks are runtime, not import time, and are exempt.
+    """
+
+    name = "import-time-config"
+    doc = "module-level os.environ read or file IO"
+
+    _IO_CALLS = {"open", "urlopen", "create_connection", "socket"}
+
+    def check(self, ctx: LintContext) -> list[Finding]:
+        out: list[Finding] = []
+        for pf in ctx.files:
+            for node in self._import_time_nodes(pf.tree.body):
+                if _is_env_read(node):
+                    out.append(Finding(
+                        self.name, pf.rel, node.lineno,
+                        "module-level environment read: captured once at "
+                        "import, frozen for the process — read it lazily "
+                        "at first use"))
+                elif (isinstance(node, ast.Call)
+                      and _terminal_name(node.func) in self._IO_CALLS):
+                    # Terminal-name match covers the attribute spellings
+                    # these calls actually use (`socket.create_connection`,
+                    # `urllib.request.urlopen`), not just bare `open(...)`.
+                    out.append(Finding(
+                        self.name, pf.rel, node.lineno,
+                        f"module-level `{_terminal_name(node.func)}(...)`: "
+                        "IO at import time runs before any caller can "
+                        "configure or handle it"))
+        return out
+
+    @classmethod
+    def _import_time_nodes(cls, body):
+        """Walk statements executed at import: module body + class bodies,
+        descending through If/Try/With/loops, pruning function bodies,
+        lambdas, and `if __name__ == \"__main__\"` guards."""
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(stmt, ast.ClassDef):
+                yield from cls._import_time_nodes(stmt.body)
+                continue
+            if isinstance(stmt, ast.If) and cls._is_main_guard(stmt.test):
+                continue
+            stack = [stmt]
+            while stack:
+                node = stack.pop()
+                if isinstance(node, _FUNC_NODES):
+                    continue
+                if isinstance(node, ast.ClassDef):
+                    yield from cls._import_time_nodes(node.body)
+                    continue
+                yield node
+                stack.extend(ast.iter_child_nodes(node))
+
+    @staticmethod
+    def _is_main_guard(test: ast.AST) -> bool:
+        return (isinstance(test, ast.Compare)
+                and isinstance(test.left, ast.Name)
+                and test.left.id == "__name__")
+
+
+# ---------------------------------------------------------------------------
+# Rule 4: blocking-call
+# ---------------------------------------------------------------------------
+
+class BlockingCallRule:
+    """Sleeps / subprocesses inside gRPC servicer handlers and the worker
+    control loop.
+
+    A dispatcher RPC handler runs on the shared gRPC thread pool — one
+    sleeping handler steals a pool slot from every worker; the worker's
+    control loop owns the liveness heartbeat — a sleep there starves
+    SendStatus past the dispatcher's prune window and gets a healthy
+    worker pruned mid-drain (the deferred-completion redesign exists
+    because exactly that happened). File IO is deliberately allowed
+    (journal/results persistence is the handlers' job). The poll-tick
+    and bounded-drain sleeps are allowlisted by qualname below.
+    """
+
+    name = "blocking-call"
+    doc = "time.sleep/subprocess in a servicer handler or the worker loop"
+
+    # Control-plane classes scanned in addition to *Servicer subclasses.
+    _CONTROL_PLANE_CLASSES = {"Worker", "SliceWorker"}
+
+    # qualname -> why a SLEEP there is the design, not a bug. Only `sleep`
+    # is exempted in these methods; any other blocking call (subprocess,
+    # input, ...) added to them is still flagged.
+    _ALLOW_SLEEP = {
+        "Worker.run": "the poll tick itself (bounded by poll_interval_s)",
+        "Worker._shutdown": "bounded exit-budget drain wait",
+        "SliceWorker.run": "follower idle tick between broadcast rounds",
+        "SliceWorker._leader_loop": "leader idle tick between empty polls",
+    }
+
+    _BLOCKING_TERMINAL = {"sleep", "input"}
+    _BLOCKING_MODULES = {"subprocess"}
+
+    def check(self, ctx: LintContext) -> list[Finding]:
+        out: list[Finding] = []
+        for pf in ctx.files:
+            for node in ast.walk(pf.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                servicer = any(
+                    (_dotted(b) or "").split(".")[-1].endswith("Servicer")
+                    for b in node.bases)
+                if not servicer and (node.name
+                                     not in self._CONTROL_PLANE_CLASSES):
+                    continue
+                for m in node.body:
+                    if not isinstance(m, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        continue
+                    out.extend(self._check_method(pf, node.name, m))
+        return out
+
+    def _check_method(self, pf: PyFile, cls: str, m) -> list[Finding]:
+        out = []
+        sleep_allowed = f"{cls}.{m.name}" in self._ALLOW_SLEEP
+        for node in ast.walk(m):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func) or ""
+            terminal = _terminal_name(node.func)
+            if terminal == "sleep" and sleep_allowed:
+                continue
+            blocking = (terminal in self._BLOCKING_TERMINAL
+                        or dotted.split(".")[0] in self._BLOCKING_MODULES)
+            if blocking:
+                out.append(Finding(
+                    self.name, pf.rel, node.lineno,
+                    f"blocking call `{dotted or terminal}` inside "
+                    f"`{cls}.{m.name}` (gRPC handler / worker control "
+                    "loop): it stalls the shared thread pool or starves "
+                    "the liveness heartbeat"))
+        return out

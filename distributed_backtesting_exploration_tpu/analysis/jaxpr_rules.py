@@ -1,0 +1,192 @@
+"""dbxlint jaxpr/IR-layer rule: kernel hygiene for the fused sweeps.
+
+The AST layer sees source; this layer sees what jax will actually compile.
+Every strategy registered in ``rpc.compute.JaxSweepBackend._FUSED_STRATEGIES``
+is traced with ``jax.make_jaxpr`` over tiny synthetic inputs and the full
+(nested) jaxpr is walked for:
+
+- **host callbacks** (``pure_callback`` / ``io_callback`` /
+  ``debug_callback``): a host round-trip inside a fused kernel defeats the
+  whole VMEM-resident design and deadlocks under some collectives;
+- **float64 leaks**: every kernel is float32 by contract (f64 either
+  crashes Mosaic or silently doubles VMEM pressure); any f64/c128 aval in
+  any equation is flagged;
+- **weak-type escapes**: a weakly-typed *output* means a Python-scalar
+  promotion reached the public Metrics contract — downstream dtype now
+  depends on a constant's Python type, the classic silent-promotion trap.
+
+Tracing is shape-polymorphic work only (no compile, no device); the whole
+registry traces in a few seconds on CPU.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+
+import numpy as np
+
+from .core import Finding, LintContext
+
+_CALLBACK_PRIMS = {
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "outside_call",
+}
+
+# One representative value per grid-axis name used across the fused
+# registry (windows/periods must be small integral bar counts; MACD/TRIX
+# need fast < slow).
+_AXIS_VALUES = {
+    "fast": [2.0], "slow": [5.0], "window": [3.0], "k": [1.0],
+    "lookback": [2.0], "period": [3.0], "band": [20.0], "signal": [2.0],
+    "span": [2.0],
+}
+_T_BARS = 32
+
+
+def _tiny_inputs(fields: tuple) -> list[np.ndarray]:
+    """One-ticker OHLCV-ish panel, ``(1, _T_BARS)`` float32 per field."""
+    t = np.arange(1, _T_BARS + 1, dtype=np.float32)
+    close = 100.0 + np.sin(t) + 0.01 * t
+    by_name = {
+        "close": close,
+        "high": close * 1.01,
+        "low": close * 0.99,
+        "open": close,
+        "volume": np.full(_T_BARS, 1e4, np.float32),
+    }
+    return [by_name[f][None, :].astype(np.float32) for f in fields]
+
+
+def _iter_jaxprs(jaxpr):
+    """Yield ``jaxpr`` and every jaxpr nested in its equations' params
+    (pjit bodies, pallas kernels, scan/cond branches, custom calls)."""
+    seen: set[int] = set()
+    stack = [jaxpr]
+    while stack:
+        j = stack.pop()
+        if id(j) in seen:
+            continue
+        seen.add(id(j))
+        yield j
+        for eqn in j.eqns:
+            for v in eqn.params.values():
+                stack.extend(_as_jaxprs(v))
+
+
+def _as_jaxprs(v) -> list:
+    out = []
+    if hasattr(v, "jaxpr"):            # ClosedJaxpr
+        out.append(v.jaxpr)
+    elif hasattr(v, "eqns"):           # Jaxpr
+        out.append(v)
+    elif isinstance(v, (tuple, list)):
+        for item in v:
+            out.extend(_as_jaxprs(item))
+    return out
+
+
+def check_traced(name: str, fn, args, *, path: str = "?",
+                 line: int = 0) -> list[Finding]:
+    """Trace ``fn(*args)`` and lint the jaxpr. ``name`` labels findings;
+    ``path``/``line`` anchor them (the kernel's def site)."""
+    import jax
+
+    rule = KernelHygieneRule.name
+    try:
+        closed = jax.make_jaxpr(fn)(*args)
+    except Exception as e:  # a kernel that fails to even trace is finding #0
+        return [Finding(rule, path, line,
+                        f"kernel `{name}` failed to trace: {e!r}")]
+    findings: list[Finding] = []
+    callbacks_seen: set[str] = set()
+    f64_seen = False
+    for jaxpr in _iter_jaxprs(closed.jaxpr):
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            if prim in _CALLBACK_PRIMS and prim not in callbacks_seen:
+                callbacks_seen.add(prim)
+                findings.append(Finding(
+                    rule, path, line,
+                    f"kernel `{name}`: host callback `{prim}` in the "
+                    "traced program — a host round-trip inside a fused "
+                    "kernel defeats the VMEM-resident design"))
+            if not f64_seen:
+                for var in eqn.outvars:
+                    dt = getattr(getattr(var, "aval", None), "dtype", None)
+                    if dt is not None and str(dt) in ("float64",
+                                                      "complex128"):
+                        f64_seen = True
+                        findings.append(Finding(
+                            rule, path, line,
+                            f"kernel `{name}`: {dt} value produced by "
+                            f"`{prim}` — the fused kernels are float32 "
+                            "by contract (f64 blows VMEM budgets and "
+                            "Mosaic lowering)"))
+                        break
+    for i, aval in enumerate(closed.out_avals):
+        dt = str(getattr(aval, "dtype", ""))
+        if dt and dt != "float32":
+            findings.append(Finding(
+                rule, path, line,
+                f"kernel `{name}`: output {i} is {dt}, not float32 — "
+                "the Metrics wire contract is float32"))
+        elif getattr(aval, "weak_type", False):
+            findings.append(Finding(
+                rule, path, line,
+                f"kernel `{name}`: output {i} is weakly typed — a "
+                "Python-scalar promotion escaped the kernel; anchor the "
+                "dtype with an explicit jnp.float32 cast"))
+    return findings
+
+
+class KernelHygieneRule:
+    """Trace every registered fused kernel; flag callbacks/f64/weak types."""
+
+    name = "kernel-hygiene"
+    doc = "host callbacks, float64 leaks, weak-type escapes in fused kernels"
+
+    def applicable(self, ctx: LintContext) -> bool:
+        # The kernel registry belongs to the installed package; linting an
+        # arbitrary directory (fixtures) has no registry to trace — the
+        # engine reports the rule as skipped rather than silently "clean".
+        return ctx.package
+
+    def check(self, ctx: LintContext) -> list[Finding]:
+        if not self.applicable(ctx):
+            return []
+        from ..rpc.compute import JaxSweepBackend
+
+        findings: list[Finding] = []
+        for strategy, spec in sorted(
+                JaxSweepBackend._FUSED_STRATEGIES.items()):
+            run = spec.run
+            target = inspect.unwrap(getattr(run, "__func__", run))
+            try:
+                src, line = (inspect.getsourcefile(target),
+                             inspect.getsourcelines(target)[1])
+            except (OSError, TypeError):
+                src, line = None, 0
+            rel = (os.path.relpath(src, ctx.root) if src
+                   else "rpc/compute.py")
+            try:
+                grid = {axis: np.asarray(_AXIS_VALUES[axis], np.float32)
+                        for axis in sorted(spec.axes)}
+                arrays = _tiny_inputs(spec.fields)
+            except KeyError as e:
+                # A newly registered kernel with an axis/field this rule
+                # has no tiny-input template for must surface as a loud
+                # finding, not crash the whole lint run.
+                findings.append(Finding(
+                    self.name, rel, line,
+                    f"kernel `{strategy}`: no tiny-input template for "
+                    f"grid axis/field {e.args[0]!r} — extend _AXIS_VALUES/"
+                    f"_tiny_inputs in analysis/jaxpr_rules.py so this "
+                    f"kernel stays under kernel-hygiene coverage"))
+                continue
+            findings.extend(check_traced(
+                strategy,
+                lambda *arrs, _run=run, _g=grid: _run(*arrs, _g, 0.0, 252,
+                                                      None),
+                arrays, path=rel, line=line))
+        return findings

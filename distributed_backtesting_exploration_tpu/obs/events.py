@@ -2,8 +2,16 @@
 
 Spans (and any layer that wants durable breadcrumbs) emit one JSON object
 per line. Disabled by default — :func:`emit` is a single ``is None`` check
-— and enabled either explicitly (:func:`configure`) or by exporting
-``DBX_OBS_JSONL=/path/to/events.jsonl`` before process start.
+once initialized — and enabled either explicitly (:func:`configure`) or by
+exporting ``DBX_OBS_JSONL=/path/to/events.jsonl``.
+
+The environment variable is read LAZILY at first use, not at import
+(dbxlint *import-time-config*): an import-time read froze the setting for
+the process, so a harness that imported ``obs`` before deciding on a log
+path could never enable logging in-process. Now ``os.environ`` is
+consulted on the first :func:`emit`/:func:`enabled` call, and an explicit
+:func:`configure` always wins over (and stops further consultation of)
+the environment.
 
 Unlike the dispatcher's job journal (``rpc.journal``), this log is
 diagnostic, not durable state: writes are flushed but not fsync'd, and a
@@ -20,12 +28,21 @@ import time
 _lock = threading.Lock()
 _fh = None
 _path: str | None = None
+# False until the first configure()/first use: emit/enabled consult
+# DBX_OBS_JSONL exactly once, lazily, so in-process toggling before first
+# use works and importing this module never does IO.
+_env_checked = False
 
 
 def configure(path: str | None) -> None:
-    """Open (or with ``None``, close) the process-wide event log."""
-    global _fh, _path
+    """Open (or with ``None``, close) the process-wide event log.
+
+    Explicit configuration wins: after any call the environment variable
+    is never consulted (``configure(None)`` therefore disables logging
+    even with ``DBX_OBS_JSONL`` set)."""
+    global _fh, _path, _env_checked
     with _lock:
+        _env_checked = True
         if _fh is not None:
             _fh.close()
             _fh = None
@@ -34,16 +51,46 @@ def configure(path: str | None) -> None:
             _fh = open(path, "a", encoding="utf-8")
 
 
+def _check_env() -> None:
+    """First-use environment opt-in: workers/dispatchers started with
+    ``DBX_OBS_JSONL`` set begin logging without any code change. A bad
+    path must not kill the process — this log is diagnostic, so degrade
+    to disabled with a loud warning instead."""
+    global _fh, _path, _env_checked
+    with _lock:
+        if _env_checked:
+            return
+        _env_checked = True
+        env_path = os.environ.get("DBX_OBS_JSONL")
+        if not env_path:
+            return
+        try:
+            _fh = open(env_path, "a", encoding="utf-8")
+            _path = env_path
+        except OSError as e:
+            import logging
+
+            logging.getLogger("dbx.obs").warning(
+                "DBX_OBS_JSONL=%s could not be opened (%s); event logging "
+                "disabled", env_path, e)
+
+
 def configured_path() -> str | None:
+    if not _env_checked:
+        _check_env()
     return _path
 
 
 def enabled() -> bool:
+    if not _env_checked:
+        _check_env()
     return _fh is not None
 
 
 def emit(event: str, **payload) -> None:
     """Append one event line; no-op (one attribute read) when disabled."""
+    if not _env_checked:
+        _check_env()
     if _fh is None:
         return
     rec = {"ev": event, "ts": time.time(), **payload}
@@ -53,19 +100,3 @@ def emit(event: str, **payload) -> None:
             return
         _fh.write(line + "\n")
         _fh.flush()
-
-
-# Environment opt-in at import time: workers/dispatchers started with
-# DBX_OBS_JSONL set begin logging without any code change. A bad path must
-# not kill the process at import — this log is diagnostic, so degrade to
-# disabled with a loud warning instead.
-_env_path = os.environ.get("DBX_OBS_JSONL")
-if _env_path:
-    try:
-        configure(_env_path)
-    except OSError as e:
-        import logging
-
-        logging.getLogger("dbx.obs").warning(
-            "DBX_OBS_JSONL=%s could not be opened (%s); event logging "
-            "disabled", _env_path, e)

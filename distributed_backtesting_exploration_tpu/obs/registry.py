@@ -81,7 +81,11 @@ class Gauge:
         self._fn = fn
 
     def set(self, v: float) -> None:
-        self._value = float(v)
+        # Under the lock like inc/dec (dbxlint lock-discipline): a set
+        # racing an inc on another thread must not lose the increment to
+        # a stale read-modify-write interleaving.
+        with self._lock:
+            self._value = float(v)
 
     def set_fn(self, fn) -> None:
         """Evaluate ``fn()`` at scrape time instead of a stored value."""

@@ -1,0 +1,44 @@
+"""``shard_map`` across jax generations — one call site contract.
+
+``jax.shard_map`` (with its ``check_vma`` flag) only exists on newer jax;
+older releases ship it as ``jax.experimental.shard_map.shard_map`` with
+the same flag named ``check_rep``. Every sharded program in this repo
+goes through this wrapper so the call sites are written once against the
+new spelling and still run on the older runtime (the container this repo
+is verified in has shipped both generations). jax is imported lazily so
+control-plane modules that import compute code keep their no-jax-until-
+needed discipline.
+"""
+
+from __future__ import annotations
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` if available, else the legacy axis-env query.
+
+    Must return a STATIC Python int (callers build python-level fold
+    loops and ppermute patterns from it); ``psum(1, axis)`` would trace.
+    On the older runtime ``jax.core.axis_frame(name)`` resolves the bound
+    axis to its concrete size."""
+    import jax
+
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return int(jax.core.axis_frame(axis_name))
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` if available, else the experimental spelling
+    (``check_vma`` transparently mapped to legacy ``check_rep``)."""
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as legacy_shard_map
+
+    kw = {} if check_vma is None else {"check_rep": check_vma}
+    return legacy_shard_map(f, mesh=mesh, in_specs=in_specs,
+                            out_specs=out_specs, **kw)

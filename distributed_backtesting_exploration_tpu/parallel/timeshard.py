@@ -53,6 +53,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ._shardmap_compat import axis_size, shard_map
+
 TIME_AXIS = "time"
 
 
@@ -89,7 +91,7 @@ def sharded_cumsum(mesh: Mesh, x, *, axis_name: str = TIME_AXIS):
         offset = _exclusive_block_offset(cs[..., -1], axis_name)
         return cs + offset[..., None]
 
-    return jax.shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec,
+    return shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec,
                          check_vma=False)(x)
 
 
@@ -110,7 +112,7 @@ def sharded_linear_scan(mesh: Mesh, a, b, *, axis_name: str = TIME_AXIS):
     def local_simple(a_blk, b_blk):
         return _linear_scan_local(a_blk, b_blk, axis_name)
 
-    return jax.shard_map(local_simple, mesh=mesh, in_specs=(spec, spec),
+    return shard_map(local_simple, mesh=mesh, in_specs=(spec, spec),
                          out_specs=spec, check_vma=False)(a, b)
 
 
@@ -127,7 +129,7 @@ def _linear_scan_local(a_blk, b_blk, axis_name: str):
         combine, (a_blk, b_blk), axis=-1)
     A = prefix_a[..., -1]
     B = y_local[..., -1]
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     all_A = jax.lax.all_gather(A, axis_name)   # (n, ...)
     all_B = jax.lax.all_gather(B, axis_name)
@@ -176,7 +178,7 @@ def sharded_ema(mesh: Mesh, x, *, span=None, alpha=None,
         gidx = jnp.arange(Tb) + jax.lax.axis_index(axis_name) * Tb
         return _ema_local(x_blk, gidx, alpha, axis_name)
 
-    return jax.shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec,
+    return shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec,
                          check_vma=False)(x)
 
 
@@ -208,7 +210,7 @@ def chunked_scan(step, init_carry, inputs, *, chunk: int, unroll: int = 8):
 
 def _from_left(x_blk, k: int, axis_name: str):
     """Last ``k`` elements of the LEFT neighbor's block (zeros on chip 0)."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     perm = [(i, i + 1) for i in range(n - 1)]
     return jax.lax.ppermute(x_blk[..., -k:], axis_name, perm)
 
@@ -354,7 +356,7 @@ def _transition_positions_local(maps, axis_name: str):
     # load-sensitive native compile (signals.prefix_compose_maps).
     pm, p0, pp = signals.prefix_compose_maps(maps)
 
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     # One latency-bound collective, not three: the block summary is a
     # stacked (3, ...) map — (next state from -1, from 0, from +1).
@@ -422,7 +424,7 @@ def sharded_band_positions(mesh: Mesh, z, valid, z_entry, z_exit=0.0, *,
         return _band_positions_local(z_blk, valid_blk, z_entry, z_exit,
                                      axis_name)
 
-    return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec),
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec),
                          out_specs=spec, check_vma=False)(
         z, jnp.broadcast_to(valid, z.shape))
 
@@ -487,7 +489,7 @@ def sharded_sma_backtest(mesh: Mesh, close, fast: int, slow: int, *,
                                   axis_name=axis_name)
 
     out_specs = Metrics(*(rep for _ in Metrics._fields))
-    return jax.shard_map(local, mesh=mesh, in_specs=spec,
+    return shard_map(local, mesh=mesh, in_specs=spec,
                          out_specs=out_specs, check_vma=False)(close)
 
 
@@ -548,7 +550,7 @@ def sharded_bollinger_backtest(mesh: Mesh, close, window: int, k: float, *,
                                   axis_name=axis_name)
 
     out_specs = Metrics(*(rep for _ in Metrics._fields))
-    return jax.shard_map(local, mesh=mesh, in_specs=spec,
+    return shard_map(local, mesh=mesh, in_specs=spec,
                          out_specs=out_specs, check_vma=False)(close)
 
 
@@ -617,7 +619,7 @@ def sharded_rsi_backtest(mesh: Mesh, close, period: int, band: float, *,
                                   axis_name=axis_name)
 
     out_specs = Metrics(*(rep for _ in Metrics._fields))
-    return jax.shard_map(local, mesh=mesh, in_specs=spec,
+    return shard_map(local, mesh=mesh, in_specs=spec,
                          out_specs=out_specs, check_vma=False)(close)
 
 
@@ -725,7 +727,7 @@ def sharded_pairs_backtest(mesh: Mesh, y_close, x_close, lookback: int,
                                   axis_name=axis_name, prev_pos=prev_pos)
 
     out_specs = Metrics(*(rep for _ in Metrics._fields))
-    return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec),
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec),
                          out_specs=out_specs, check_vma=False)(
         y_close, x_close)
 
@@ -854,7 +856,7 @@ def sharded_donchian_backtest(mesh: Mesh, close, window: int, *,
             periods_per_year=periods_per_year, axis_name=axis_name)
 
     out_specs = Metrics(*(rep for _ in Metrics._fields))
-    return jax.shard_map(local, mesh=mesh, in_specs=spec,
+    return shard_map(local, mesh=mesh, in_specs=spec,
                          out_specs=out_specs, check_vma=False)(close)
 
 
@@ -886,7 +888,7 @@ def sharded_donchian_hl_backtest(mesh: Mesh, close, high, low, window: int,
             periods_per_year=periods_per_year, axis_name=axis_name)
 
     out_specs = Metrics(*(rep for _ in Metrics._fields))
-    return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=out_specs, check_vma=False)(
         close, high, low)
 
@@ -958,7 +960,7 @@ def sharded_stochastic_backtest(mesh: Mesh, close, high, low, window: int,
                                   axis_name=axis_name)
 
     out_specs = Metrics(*(rep for _ in Metrics._fields))
-    return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=out_specs, check_vma=False)(
         close, high, low)
 
@@ -1022,7 +1024,7 @@ def sharded_trix_backtest(mesh: Mesh, close, span: int, signal: int, *,
                                   axis_name=axis_name)
 
     out_specs = Metrics(*(rep for _ in Metrics._fields))
-    return jax.shard_map(local, mesh=mesh, in_specs=spec,
+    return shard_map(local, mesh=mesh, in_specs=spec,
                          out_specs=out_specs, check_vma=False)(close)
 
 
@@ -1073,7 +1075,7 @@ def sharded_momentum_backtest(mesh: Mesh, close, lookback: int, *,
                                   axis_name=axis_name)
 
     out_specs = Metrics(*(rep for _ in Metrics._fields))
-    return jax.shard_map(local, mesh=mesh, in_specs=spec,
+    return shard_map(local, mesh=mesh, in_specs=spec,
                          out_specs=out_specs, check_vma=False)(close)
 
 
@@ -1122,7 +1124,7 @@ def sharded_bollinger_touch_backtest(mesh: Mesh, close, window: int,
                                   axis_name=axis_name)
 
     out_specs = Metrics(*(rep for _ in Metrics._fields))
-    return jax.shard_map(local, mesh=mesh, in_specs=spec,
+    return shard_map(local, mesh=mesh, in_specs=spec,
                          out_specs=out_specs, check_vma=False)(close)
 
 
@@ -1190,7 +1192,7 @@ def sharded_keltner_backtest(mesh: Mesh, close, high, low, window: int,
                                   axis_name=axis_name)
 
     out_specs = Metrics(*(rep for _ in Metrics._fields))
-    return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=out_specs, check_vma=False)(
         close, high, low)
 
@@ -1251,7 +1253,7 @@ def sharded_vwap_backtest(mesh: Mesh, close, volume, window: int, k: float,
                                   axis_name=axis_name)
 
     out_specs = Metrics(*(rep for _ in Metrics._fields))
-    return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec),
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec),
                          out_specs=out_specs, check_vma=False)(close, volume)
 
 
@@ -1319,7 +1321,7 @@ def sharded_macd_backtest(mesh: Mesh, close, fast: int, slow: int,
                                   axis_name=axis_name)
 
     out_specs = Metrics(*(rep for _ in Metrics._fields))
-    return jax.shard_map(local, mesh=mesh, in_specs=spec,
+    return shard_map(local, mesh=mesh, in_specs=spec,
                          out_specs=out_specs, check_vma=False)(close)
 
 
@@ -1399,5 +1401,5 @@ def sharded_obv_backtest(mesh: Mesh, close, volume, window: int, *,
                                   axis_name=axis_name)
 
     out_specs = Metrics(*(rep for _ in Metrics._fields))
-    return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec),
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec),
                          out_specs=out_specs, check_vma=False)(close, volume)

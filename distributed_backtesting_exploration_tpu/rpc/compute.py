@@ -19,6 +19,7 @@ import numpy as np
 from . import backtesting_pb2 as pb
 from . import wire
 from .. import obs
+from ..parallel._shardmap_compat import shard_map
 from ..utils import data as data_mod
 
 log = logging.getLogger("dbx.compute")
@@ -660,7 +661,15 @@ class JaxSweepBackend:
                     np.asarray(t_real, np.int32).reshape(-1, 1), n_pad),
                 row))
 
-        key = key + (ragged,)
+        # The lanes cap must be part of the cache key: the fused runners
+        # read DBX_LANES_CAP (host-side, via resolve_lanes_cap) while this
+        # outer jit(shard_map) traces, so without it an in-process cap
+        # change would silently reuse the stale lane width on the mesh
+        # path — the same cache-key bug class the single-device path fixed
+        # by threading lanes_env as a jit static (dbxlint trace-time-env).
+        from ..ops.fused import resolve_lanes_cap
+
+        key = key + (ragged, resolve_lanes_cap())
         fn = self._mesh_fns.get(key)
         if fn is None:
             from ..ops.metrics import Metrics
@@ -671,7 +680,7 @@ class JaxSweepBackend:
                     return runner(*data, tr_blk[:, 0])
                 return runner(*blks, None)
 
-            fn = jax.jit(jax.shard_map(
+            fn = jax.jit(shard_map(
                 local, mesh=mesh,
                 in_specs=tuple(P(axis, None) for _ in args),
                 out_specs=Metrics(*(P(axis, None)

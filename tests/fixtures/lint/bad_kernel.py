@@ -1,0 +1,32 @@
+"""Seeded jaxpr-layer violations for the kernel-hygiene rule.
+
+Unlike the AST fixtures this one IS imported (the rule lints traced
+jaxprs, not source): one kernel with a host callback, one with a float64
+leak, one with a weak-type escape, one clean."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def kernel_with_callback(x):
+    def host_side(v):
+        return np.asarray(v)
+
+    y = jax.pure_callback(
+        host_side, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+    return jnp.asarray(y, jnp.float32) * jnp.float32(2.0)
+
+
+def kernel_with_f64(x):
+    return (x.astype(jnp.float64) * 2.0).astype(jnp.float32)
+
+
+def kernel_weak_output(x):
+    # A Python-scalar constant fill: the output is weakly typed, so its
+    # dtype downstream depends on promotion rules, not an explicit anchor.
+    return jnp.full(x.shape, 2.0)
+
+
+def kernel_clean(x):
+    return jnp.asarray(x, jnp.float32) * jnp.float32(2.0)

@@ -1,0 +1,19 @@
+"""Seeded violation: a sleep inside a gRPC servicer handler."""
+
+import time
+
+
+class DispatcherServicer:
+    """Stand-in for the generated base class."""
+
+
+class SlowDispatcher(DispatcherServicer):
+    def RequestJobs(self, request, context):
+        # VIOLATION: a sleeping handler steals a slot from the shared
+        # gRPC thread pool.
+        time.sleep(0.5)
+        return None
+
+    def _helper(self):
+        # NOT in the allowlist either; helpers of a servicer class count.
+        return 1

@@ -1,0 +1,19 @@
+"""Seeded violations: configuration captured at import time."""
+
+import os
+
+# VIOLATION: env read frozen at import.
+_CAP = os.environ.get("DBX_FIXTURE_CAP")
+
+# VIOLATION: file IO at import.
+_CONFIG = open("/dev/null")
+
+
+def runtime_read():
+    # NOT a violation: function-scope read happens at call time.
+    return os.environ.get("DBX_FIXTURE_CAP")
+
+
+if __name__ == "__main__":
+    # NOT a violation: main-guard blocks are runtime, not import time.
+    print(os.environ.get("DBX_FIXTURE_CAP"))

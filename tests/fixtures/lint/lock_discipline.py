@@ -1,0 +1,26 @@
+"""Seeded violation: a guarded field mutated outside the owning lock."""
+
+import threading
+
+
+class LeakyQueue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = []
+        self._done = set()
+
+    def push(self, item):
+        with self._lock:
+            self._pending.append(item)
+
+    def complete(self, item):
+        # VIOLATION: `_pending` is mutated under the lock in push() but
+        # mutated here without holding it.
+        self._pending.remove(item)
+        self._done.add(item)   # `_done` never mutated under lock: not guarded
+
+    def drain(self):
+        with self._lock:
+            out = list(self._pending)
+            self._pending.clear()
+        return out

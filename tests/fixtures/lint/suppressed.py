@@ -1,0 +1,14 @@
+"""Seeded-but-suppressed violations: the directive must silence exactly
+the named rule, same line or the comment line directly above."""
+
+import os
+
+# Same-line directive:
+_A = os.environ.get("DBX_SUP_A")  # dbxlint: disable=import-time-config -- fixture: suppression-respected test
+
+# Directive on the comment line above:
+# dbxlint: disable=import-time-config -- fixture: line-above form
+_B = os.environ.get("DBX_SUP_B")
+
+# Directive naming a DIFFERENT rule does NOT suppress (stays a finding):
+_C = os.environ.get("DBX_SUP_C")  # dbxlint: disable=blocking-call -- wrong rule on purpose

@@ -1,0 +1,32 @@
+"""Seeded violation: the pre-PR-1 ``DBX_LANES_CAP`` bug class, verbatim
+shape — an ``os.environ`` read inside a helper reachable from a
+jit-compiled kernel launcher (ops/fused.py:68 before the round-5 fix).
+Never imported; the trace-time-env rule works on the AST alone."""
+
+import functools
+import os
+
+import jax
+
+
+def _widest_lanes(P_pad, cap):
+    # VIOLATION: read at trace time, invisible to the jit cache key — an
+    # in-process change silently reuses the stale compile.
+    env = os.environ.get("DBX_LANES_CAP")
+    if env:
+        cap = min(cap, int(env))
+    for cand in (1024, 512, 256, 128):
+        if cand <= cap and P_pad % cand == 0:
+            return cand
+    return P_pad
+
+
+@functools.partial(jax.jit, static_argnames=("P_pad",))
+def _fused_call(close, *, P_pad):
+    lanes = _widest_lanes(P_pad, 512)
+    return close * lanes
+
+
+def host_side_helper():
+    # NOT a violation: host-side read, not reachable from any traced root.
+    return os.environ.get("DBX_HOST_ONLY", "")

@@ -32,6 +32,7 @@ def test_dryrun_inline_on_virtual_devices():
     graft.dryrun_multichip(8)
 
 
+@pytest.mark.slow   # fresh-jax subprocess: minutes of wall on CPU-only boxes
 def test_dryrun_subprocess_path():
     # Force the re-exec path regardless of ambient device count: the child
     # must self-provision its mesh from a bare environment.
@@ -52,6 +53,7 @@ def test_dryrun_subprocess_propagates_failure(monkeypatch):
         graft._dryrun_in_subprocess(2)
 
 
+@pytest.mark.slow   # fresh-jax subprocess: minutes of wall on CPU-only boxes
 def test_driver_style_import_and_call():
     # Replicate the driver exactly: fresh process, ambient (TPU or 1-device)
     # platform, direct import + call — no __main__ env setup.

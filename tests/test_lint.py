@@ -1,0 +1,353 @@
+"""dbxlint rule tests: every rule demonstrated against a seeded fixture
+violation (exact file, line, rule id), plus suppression semantics and the
+CLI contract. The package-lints-clean gate lives in test_lint_clean.py."""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_backtesting_exploration_tpu.analysis import (
+    ast_rules, core, jaxpr_rules, lint as lint_cli, proto_rules)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "lint")
+
+
+def _fixture_line(name: str, marker: str) -> int:
+    """1-indexed line of the first source line containing ``marker``."""
+    with open(os.path.join(FIXTURES, name)) as fh:
+        for i, line in enumerate(fh, 1):
+            if marker in line:
+                return i
+    raise AssertionError(f"marker {marker!r} not in {name}")
+
+
+def _lint_fixture(name: str, rule):
+    findings, suppressed, _ = core.lint_path(
+        os.path.join(FIXTURES, name), [rule])
+    return findings, suppressed
+
+
+# ---------------------------------------------------------------------------
+# One test per rule: exactly the planted finding
+# ---------------------------------------------------------------------------
+
+def test_trace_time_env_detects_pre_pr1_lanes_cap_pattern():
+    """The regression fixture reproduces the pre-PR-1 ops/fused.py shape:
+    DBX_LANES_CAP read inside a helper called from a jitted kernel
+    launcher. Exactly that read is flagged; the host-side read is not."""
+    findings, _ = _lint_fixture("trace_time_env.py",
+                                ast_rules.TraceTimeEnvRule())
+    assert [(f.rule, f.path, f.line) for f in findings] == [
+        ("trace-time-env", "trace_time_env.py",
+         _fixture_line("trace_time_env.py",
+                       'os.environ.get("DBX_LANES_CAP")'))]
+    assert "static argument" in findings[0].message
+
+
+def test_lock_discipline_flags_unlocked_mutation_only():
+    findings, _ = _lint_fixture("lock_discipline.py",
+                                ast_rules.LockDisciplineRule())
+    assert [(f.rule, f.path, f.line) for f in findings] == [
+        ("lock-discipline", "lock_discipline.py",
+         _fixture_line("lock_discipline.py", "self._pending.remove(item)"))]
+    assert "_pending" in findings[0].message
+    # `_done` is never mutated under the lock -> unguarded, not flagged.
+    assert not any("_done" in f.message for f in findings)
+
+
+def test_import_time_config_flags_module_level_env_and_io():
+    findings, _ = _lint_fixture("import_time_config.py",
+                                ast_rules.ImportTimeConfigRule())
+    assert [(f.rule, f.path, f.line) for f in findings] == [
+        ("import-time-config", "import_time_config.py",
+         _fixture_line("import_time_config.py",
+                       '_CAP = os.environ.get')),
+        ("import-time-config", "import_time_config.py",
+         _fixture_line("import_time_config.py", '_CONFIG = open')),
+    ]
+
+
+def test_blocking_call_flags_sleep_in_servicer_handler():
+    findings, _ = _lint_fixture("blocking_call.py",
+                                ast_rules.BlockingCallRule())
+    assert [(f.rule, f.path, f.line) for f in findings] == [
+        ("blocking-call", "blocking_call.py",
+         _fixture_line("blocking_call.py", "time.sleep(0.5)"))]
+    assert "SlowDispatcher.RequestJobs" in findings[0].message
+
+
+def _load_bad_kernels():
+    spec = importlib.util.spec_from_file_location(
+        "dbxlint_fixture_bad_kernel", os.path.join(FIXTURES, "bad_kernel.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_kernel_hygiene_flags_host_callback():
+    mod = _load_bad_kernels()
+    x = np.ones((4, 8), np.float32)
+    findings = jaxpr_rules.check_traced(
+        "cb", mod.kernel_with_callback, [x], path="bad_kernel.py", line=13)
+    assert [(f.rule, f.path, f.line) for f in findings] == [
+        ("kernel-hygiene", "bad_kernel.py", 13)]
+    assert "pure_callback" in findings[0].message
+
+
+def test_kernel_hygiene_flags_float64_leak():
+    import jax
+
+    mod = _load_bad_kernels()
+    x = np.ones((4, 8), np.float32)
+    with jax.experimental.enable_x64():
+        findings = jaxpr_rules.check_traced(
+            "f64", mod.kernel_with_f64, [x], path="bad_kernel.py", line=22)
+    assert any("float64" in f.message for f in findings)
+    assert all(f.rule == "kernel-hygiene" and f.path == "bad_kernel.py"
+               and f.line == 22 for f in findings)
+
+
+def test_kernel_hygiene_flags_weak_type_escape_and_passes_clean():
+    mod = _load_bad_kernels()
+    x = np.ones((4, 8), np.float32)
+    weak = jaxpr_rules.check_traced("weak", mod.kernel_weak_output, [x])
+    assert len(weak) == 1 and "weakly typed" in weak[0].message
+    assert jaxpr_rules.check_traced("clean", mod.kernel_clean, [x]) == []
+
+
+def test_kernel_hygiene_unknown_axis_is_a_finding_not_a_crash(monkeypatch):
+    """A newly registered fused kernel with a grid axis/field the rule has
+    no tiny-input template for must surface as a loud finding (telling the
+    maintainer to extend the template), never crash the lint run."""
+    from distributed_backtesting_exploration_tpu.rpc import compute
+
+    spec = compute._FusedSpec({"threshold"}, ("threshold",),
+                              lambda *a, **k: None)
+    monkeypatch.setattr(compute.JaxSweepBackend, "_FUSED_STRATEGIES",
+                        {"novel_strategy": spec})
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(
+        ast_rules.__file__)))
+    ctx = core.load_context(pkg)
+    findings = jaxpr_rules.KernelHygieneRule().check(ctx)
+    assert len(findings) == 1
+    assert findings[0].rule == "kernel-hygiene"
+    assert "novel_strategy" in findings[0].message
+    assert "'threshold'" in findings[0].message
+
+
+def test_kernel_hygiene_skip_is_reported_not_clean_coverage():
+    """Outside the package the kernel registry cannot be traced: the rule
+    must land in rules_skipped, never in `rules` (skipped != clean)."""
+    result = lint_cli.run([FIXTURES], core.all_rules())
+    assert "kernel-hygiene" in result["rules_skipped"]
+    assert "kernel-hygiene" not in result["rules"]
+    assert "trace-time-env" in result["rules"]
+
+
+def test_proto_drift_detects_planted_divergences():
+    """Drifted copy of the real contract vs the real pb2 descriptor: a
+    renumbered field, a renamed field (missing+extra pair), and a field
+    the descriptor lacks — nothing else."""
+    from distributed_backtesting_exploration_tpu.rpc import backtesting_pb2
+
+    path = os.path.join(FIXTURES, "proto_drift", "drifted.proto")
+    with open(path) as fh:
+        text = fh.read()
+    model = proto_rules.parse_proto_text(text)
+    pb2_model = proto_rules.describe_pb2(backtesting_pb2)
+    findings = proto_rules.diff_models(model, pb2_model,
+                                       path="drifted.proto")
+
+    def line_of(marker):
+        for i, line in enumerate(text.splitlines(), 1):
+            if marker in line:
+                return i
+        raise AssertionError(marker)
+
+    assert len(findings) == 4, [f.message for f in findings]
+    renum = next(f for f in findings
+                 if "CompleteItem.elapsed_s" in f.message)
+    assert "number 4" in renum.message and "3 in the pb2" in renum.message
+    assert renum.line == line_of("DRIFT: pb2 has number 3")
+    missing = next(f for f in findings if "Ack.details" in f.message)
+    assert "missing from the pb2" in missing.message
+    assert missing.line == line_of("string details = 2;")
+    extra = next(f for f in findings
+                 if "`Ack.detail`" in f.message)
+    assert "does not declare" in extra.message
+    prio = next(f for f in findings if "JobsRequest.priority" in f.message)
+    assert "missing from the pb2" in prio.message
+    assert prio.line == line_of("int32 priority = 4;")
+
+
+def test_proto_parser_survives_oneof_and_nested_blocks():
+    """A `oneof`'s closing brace must pop only its own frame: its fields
+    attribute to the enclosing message (descriptor semantics) and fields
+    declared AFTER it are not lost."""
+    model = proto_rules.parse_proto_text(
+        "message M {\n"
+        "  int32 a = 1;\n"
+        "  oneof kind {\n"
+        "    int32 b = 2;\n"
+        "  }\n"
+        "  int32 c = 3;\n"
+        "}\n"
+        "message N { int32 d = 1; }\n")
+    assert model.messages == {"M": {"a": 1, "b": 2, "c": 3},
+                              "N": {"d": 1}}
+
+
+def test_proto_drift_real_contract_is_clean():
+    from distributed_backtesting_exploration_tpu.rpc import backtesting_pb2
+
+    proto = os.path.join(
+        os.path.dirname(FIXTURES), "..", "..",
+        "distributed_backtesting_exploration_tpu", "rpc",
+        "backtesting.proto")
+    with open(proto) as fh:
+        model = proto_rules.parse_proto_text(fh.read())
+    assert proto_rules.diff_models(
+        model, proto_rules.describe_pb2(backtesting_pb2),
+        path="backtesting.proto") == []
+    # Sanity that the parser actually saw the contract (not vacuous).
+    assert "JobSpec" in model.messages
+    assert model.messages["JobSpec"]["best_returns"] == 13
+    assert model.services["Dispatcher"]["GetStats"] == ("StatsRequest",
+                                                        "StatsReply")
+
+
+# ---------------------------------------------------------------------------
+# Suppressions + CLI
+# ---------------------------------------------------------------------------
+
+def test_suppression_respected_same_line_and_line_above():
+    findings, suppressed = _lint_fixture("suppressed.py",
+                                         ast_rules.ImportTimeConfigRule())
+    # _A (same-line) and _B (line-above) suppressed; _C names the wrong
+    # rule so its finding survives.
+    assert suppressed == 2
+    assert [(f.rule, f.line) for f in findings] == [
+        ("import-time-config",
+         _fixture_line("suppressed.py", "DBX_SUP_C"))]
+
+
+def test_suppression_directive_inside_string_literal_does_not_count(tmp_path):
+    """A directive appearing in a STRING VALUE (docs, error messages) must
+    not silence findings — only real comment tokens do."""
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        'import os\n'
+        '_X = os.environ.get("A", "see dbxlint: disable=all in docs")\n')
+    findings, suppressed = core.lint_path(
+        str(mod), [ast_rules.ImportTimeConfigRule()])[:2]
+    assert suppressed == 0
+    assert [(f.rule, f.line) for f in findings] == [("import-time-config", 2)]
+
+
+def test_import_time_config_flags_attribute_form_io(tmp_path):
+    """Network IO at import is spelled as attributes (socket.create_connection,
+    urllib.request.urlopen) — the rule must match terminal names, not just
+    bare `open`."""
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "import socket\n"
+        '_CONN = socket.create_connection(("localhost", 1))\n')
+    findings, _, _ = core.lint_path(str(mod),
+                                    [ast_rules.ImportTimeConfigRule()])
+    assert [(f.rule, f.line) for f in findings] == [("import-time-config", 2)]
+    assert "create_connection" in findings[0].message
+
+
+def test_blocking_call_allowlist_is_sleep_only(tmp_path):
+    """The Worker.run allowlist sanctions the poll-tick SLEEP only: any
+    other blocking call added to an allowlisted method is still flagged."""
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "import subprocess, time\n"
+        "class Worker:\n"
+        "    def run(self):\n"
+        "        time.sleep(0.05)          # sanctioned poll tick\n"
+        "        subprocess.run(['x'])     # NOT sanctioned\n")
+    findings, _, _ = core.lint_path(str(mod), [ast_rules.BlockingCallRule()])
+    assert [(f.rule, f.line) for f in findings] == [("blocking-call", 5)]
+    assert "subprocess.run" in findings[0].message
+
+
+def test_proto_drift_skipped_for_single_file_targets():
+    """Single-file lint targets have no proto scan: proto-drift must land
+    in rules_skipped, not claim clean coverage."""
+    result = lint_cli.run([os.path.join(FIXTURES, "lock_discipline.py")],
+                          core.all_rules())
+    assert "proto-drift" in result["rules_skipped"]
+    assert "kernel-hygiene" in result["rules_skipped"]
+    assert "proto-drift" not in result["rules"]
+
+
+def test_suppression_comma_space_list_and_justification_tail(tmp_path):
+    """`disable=a, b -- why` (comma-space style) suppresses BOTH rules;
+    prose after `--` never parses as a rule name."""
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "import os\n"
+        "# dbxlint: disable=import-time-config, trace-time-env -- test\n"
+        '_A = os.environ.get("A")\n')
+    findings, suppressed = core.lint_path(
+        str(mod), [ast_rules.ImportTimeConfigRule()])[:2]
+    assert findings == [] and suppressed == 1
+
+
+def test_lock_discipline_ignores_local_shadow_of_guarded_global(tmp_path):
+    """A function-local variable that shadows a guarded module global is
+    local for the WHOLE function (Python scoping) — mutating it without
+    the lock is not a violation."""
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "import threading\n"
+        "_lock = threading.Lock()\n"
+        "_buf = []\n"
+        "def guarded(x):\n"
+        "    with _lock:\n"
+        "        _buf.append(x)\n"
+        "def shadow(x):\n"
+        "    _buf = []\n"
+        "    _buf.append(x)   # the LOCAL, not the guarded global\n"
+        "def real_violation(x):\n"
+        "    _buf.append(x)\n")
+    findings, _, _ = core.lint_path(str(mod),
+                                    [ast_rules.LockDisciplineRule()])
+    assert [(f.rule, f.line) for f in findings] == [("lock-discipline", 11)]
+
+
+def test_cli_json_format_and_exit_codes(capsys, tmp_path):
+    rc = lint_cli.main([os.path.join(FIXTURES, "import_time_config.py"),
+                        "--rules", "import-time-config",
+                        "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert not out["clean"]
+    assert {f["rule"] for f in out["findings"]} == {"import-time-config"}
+    assert out["rules"] == ["import-time-config"]
+
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    rc = lint_cli.main([str(clean), "--rules", "import-time-config"])
+    assert rc == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_cli_unknown_rule_errors():
+    with pytest.raises(SystemExit):
+        lint_cli.main(["--rules", "no-such-rule"])
+
+
+def test_unparseable_file_is_loud(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    findings, _, ctx = core.lint_path(str(tmp_path), core.all_rules())
+    assert findings == []
+    assert len(ctx.skipped) == 1 and ctx.skipped[0][0] == "bad.py"
+    result = lint_cli.run([str(tmp_path)], core.all_rules())
+    assert not result["clean"]          # a syntax error never passes silently

@@ -116,6 +116,8 @@ print("SLICE_TS", pid, len(w._ts_fns), flush=True)
 """
 
 
+@pytest.mark.slow   # 2-process jax.distributed slice: minutes of wall on
+                    # CPU-only boxes (gloo collectives + fresh-jax children)
 def test_slice_worker_drains_live_dispatcher(tmp_path):
     """VERDICT r3 #8 — the two proven halves joined: a 2-process
     jax.distributed worker (4+4 virtual devices, ONE 8-device mesh)
@@ -236,6 +238,7 @@ def test_slice_worker_drains_live_dispatcher(tmp_path):
             rtol=5e-4, atol=5e-5, err_msg=f"long-context/{name}")
 
 
+@pytest.mark.slow   # 2-process jax.distributed slice (see above)
 def test_two_process_distributed_sharded_sweep(tmp_path):
     with socket.socket() as s:
         s.bind(("localhost", 0))

@@ -185,6 +185,28 @@ def test_metrics_endpoint_and_dump_cli(tmp_path, capsys):
     assert "phase_a" in out and "phase_a/phase_b" in out and "share" in out
 
 
+def test_event_log_env_opt_in_is_lazy(tmp_path, monkeypatch):
+    """DBX_OBS_JSONL is consulted at FIRST USE, not import (dbxlint
+    import-time-config): setting it after import but before first use
+    enables logging, and an explicit configure() always wins over the
+    environment — in-process toggling, no reimport."""
+    path = str(tmp_path / "lazy.jsonl")
+    monkeypatch.setattr(events, "_env_checked", False)
+    monkeypatch.setattr(events, "_fh", None)
+    monkeypatch.setattr(events, "_path", None)
+    monkeypatch.setenv("DBX_OBS_JSONL", path)
+    try:
+        assert events.enabled()                    # first use reads the env
+        assert events.configured_path() == path
+        events.emit("lazy_probe", k=1)
+        assert json.loads(open(path).read())["ev"] == "lazy_probe"
+        # Explicit configure(None) disables even though the env is set.
+        events.configure(None)
+        assert not events.enabled()
+    finally:
+        events.configure(None)
+
+
 def test_steptimer_gauge():
     reg = obs.Registry()
     g = reg.gauge("dbx_rate")
