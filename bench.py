@@ -166,22 +166,35 @@ def main():
         return only is None or name in only
 
     # --- Roofline models: per-(cell, bar) resource counts read off the
-    # kernel structure in ops/fused.py. Every in-kernel recurrence is a
-    # log2(T_pad)-round shift ladder, so op counts scale with `rounds`:
-    #   metrics tail  = ~26 reduction/PnL ops + 2 ladders x 2 ops/round
-    #   3-state prefix compose (band/latch machines) = 9 ops/round
-    #   in-kernel EMA ladder (MACD signal line)      = 5 ops/round
+    # kernel structure in ops/fused.py. The per-bar recurrences (equity
+    # cumsum + running-peak cummax, the band machines' 3-state compose)
+    # default to the SINGLE-PASS carry scan over T-blocks (`_equity_scan`
+    # / `_compose3_path`): per-row ladder work is log2(B) rounds for the
+    # static scan block B instead of log2(T_pad), plus a few carry-combine
+    # ops per row. `DBX_EPILOGUE=ladder` restores the full-T ladders (and
+    # this model follows it, so the A/B's utilization figures stay honest):
+    #   metrics tail  = ~26 reduction/PnL ops + 2 ladders x 2 ops/round + 7
+    #   3-state prefix compose (band/latch machines) = 9 ops/round + 2
+    #   in-kernel EMA ladder (MACD signal line)      = 5 ops/round (full-T:
+    #     the signal EMA's per-lane decay is not blocked — carry state is a
+    #     multiply chain, not a select)
     # MXU = 2 FLOP x W_pad contraction per selection matmul per cell-bar
     # (HIGHEST precision — the peak constant already folds the 6-pass
     # schedule). HBM = the (W_pad x T_pad) table stream amortized over
     # P_pad lanes, times (1 + prep passes over table-shaped intermediates).
-    # The models explain the kernel-family spread: sign kernels
-    # (SMA/momentum, ~100 ops) vs state-machine kernels (Donchian/band
-    # family, ~210 ops) differ ~2.1x in work per cell-bar — matching their
-    # measured M/s ratio at roughly equal VPU utilization.
     rounds = max(int(np.ceil(np.log2(max(n_bars, 2)))), 1)
-    TAIL = 26 + 4 * rounds          # shared metrics tail
-    LADDER3 = 9 * rounds            # band/latch 3-state compose
+    _epi = fused._resolve_epilogue(None)      # same arg>env>default chain
+    if _epi == "ladder":
+        tail_rounds = compose_rounds = rounds
+        tail_fix = compose_fix = 0
+    else:
+        # The kernels' own block pick (incl. the doubling past 256 blocks
+        # for long-context shapes) — the model must not re-derive it.
+        _blk = fused._scan_block(-(-n_bars // 8) * 8, _epi)
+        tail_rounds = compose_rounds = max(int(np.ceil(np.log2(_blk))), 1)
+        tail_fix, compose_fix = 7, 2          # carry combines per row
+    TAIL = 26 + 4 * tail_rounds + tail_fix    # shared metrics tail
+    LADDER3 = 9 * compose_rounds + compose_fix  # band/latch 3-state compose
 
     def _model(vpu, n_distinct_w, p, *, w_align=8, selections=1,
                prep_passes=3):
@@ -253,15 +266,15 @@ def main():
             slow=jnp.arange(30, 30 + 2 * n_slow, 2, dtype=jnp.float32))
         sfa = np.asarray(sgrid["fast"])
         ssl = np.asarray(sgrid["slow"])
-        windows, onehot_f, onehot_s, warm = F._grid_setup(
+        windows, onehot_d, warm = F._grid_setup(
             sfa.astype(np.float32).tobytes(),
             ssl.astype(np.float32).tobytes())
         T_pad = F._round_up(n_bars, 8)
-        W_pad = onehot_f.shape[0]
+        W_pad = onehot_d.shape[0]
         P_real = sfa.shape[0]
         interp = jax.default_backend() != "tpu"
 
-        def stage_kernel(r_ref, sma_ref, of_ref, os_ref, warm_ref, out_ref,
+        def stage_kernel(r_ref, sma_ref, od_ref, warm_ref, out_ref,
                          *, stage, lanes):
             # Mirrors ops.fused._kernel exactly through the requested
             # stage, then writes a cheap stand-in tile so every variant
@@ -280,7 +293,7 @@ def main():
                     (F._METRIC_ROWS, lanes), jnp.sum(sma), jnp.float32)
                 return
             d = jax.lax.dot_general(
-                sma, of_ref[:] - os_ref[:], (((0,), (0,)), ((), ())),
+                sma, od_ref[:], (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
                 precision=jax.lax.Precision.HIGHEST)
             t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pd, lanes), 0)
@@ -297,11 +310,15 @@ def main():
                     (F._METRIC_ROWS, lanes))
                 return
             tr = n_bars
-            if stage == "full":
+            if stage in ("full", "full_ladder"):
                 # The REAL shipped tail (shared code, not a copy): this
-                # variant IS ops.fused._kernel end to end.
-                out_ref[0, 0] = F._metrics_tail(pos, r, t_idx, tr,
-                                                cost=1e-3, ppy=252)
+                # variant IS ops.fused._kernel end to end. "full" runs the
+                # shipped single-pass carry scan; "full_ladder" the
+                # O(T log T) fallback substrate — their delta over
+                # no_ladders is the scan's win on this exact kernel.
+                out_ref[0, 0] = F._metrics_tail(
+                    pos, r, t_idx, tr, cost=1e-3, ppy=252,
+                    epilogue="scan" if stage == "full" else "ladder")
                 return
             # no_ladders: the shipped reductions with the two shift
             # ladders (equity cumsum + running-peak cummax) replaced by
@@ -337,7 +354,7 @@ def main():
             close_p = F._pad_last(close, T_pad)
             tbl = F._sma_table(close_p, windows, W_pad)
             r3 = F._rets3(close_p)
-            P_pad = onehot_f.shape[1]
+            P_pad = onehot_d.shape[1]
             if stage == "prep":
                 # XLA table construction alone, no pallas call: the
                 # host-program share of the "matmul" base.
@@ -355,8 +372,6 @@ def main():
                                  memory_space=pltpu.VMEM),
                     pl.BlockSpec((W_pad, lanes), lambda i, j: (0, j),
                                  memory_space=pltpu.VMEM),
-                    pl.BlockSpec((W_pad, lanes), lambda i, j: (0, j),
-                                 memory_space=pltpu.VMEM),
                     pl.BlockSpec((1, lanes), lambda i, j: (0, j),
                                  memory_space=pltpu.VMEM),
                 ],
@@ -367,20 +382,19 @@ def main():
                     (close.shape[0], nb, F._METRIC_ROWS, lanes),
                     jnp.float32),
                 interpret=interp,
-            )(r3, tbl, F._const(onehot_f), F._const(onehot_s),
-              F._const(warm))
+            )(r3, tbl, F._const(onehot_d), F._const(warm))
             return jnp.reshape(out[:, :, 0, :],
                                (close.shape[0], P_pad))[:, :P_real]
 
         stage_times = {}
         n_bt = n_tickers * P_real
-        P_pad_all = onehot_f.shape[1]
+        P_pad_all = onehot_d.shape[1]
         cases = [(stage, lanes)
                  for stage, lanes in
                  [("prep", 128), ("touch", 128), ("matmul", 128),
                   ("signal", 128), ("no_ladders", 128),
-                  ("full", 128), ("full", 256), ("full", 512),
-                  ("full", 1024), ("no_ladders", 512)]
+                  ("full", 128), ("full_ladder", 128), ("full", 256),
+                  ("full", 512), ("full", 1024), ("no_ladders", 512)]
                  # Non-headline DBX_BENCH_PARAMS values can make P_pad
                  # smaller than (or not a multiple of) a lane case; skip
                  # those instead of building a zero/ragged grid.
@@ -395,27 +409,47 @@ def main():
             rate = _measure(run_stage, n_bt, iters=iters, warmup=warmup,
                             name=f"sma_stage_{stage}_l{lanes}")
             stage_times[f"{stage}_l{lanes}"] = n_bt / rate  # s per sweep
+
+        def _attribution(times, full_key="full_l128"):
+            # Consecutive-delta attribution shared by the SMA and
+            # bollinger scaffolds. "full" runs the SHIPPED carry-scan
+            # epilogue, so ladders_delta_pct is the scan's residual share
+            # (the acceptance metric); "full_ladder" re-times the
+            # O(T log T) fallback substrate on the same kernel, so
+            # ladder_fallback_delta_pct is the old 47.6%-class number and
+            # epilogue_scan_speedup their end-to-end ratio.
+            full_s = times[full_key]
+            out = {
+                "selection_matmul_pct": round(
+                    100 * times["matmul_l128"] / full_s, 1),
+                "signal_delta_pct": round(
+                    100 * (times["signal_l128"] - times["matmul_l128"])
+                    / full_s, 1),
+                "reductions_delta_pct": round(
+                    100 * (times["no_ladders_l128"] - times["signal_l128"])
+                    / full_s, 1),
+                "ladders_delta_pct": round(
+                    100 * (full_s - times["no_ladders_l128"]) / full_s, 1),
+            }
+            if "full_ladder_l128" in times:
+                out["ladder_fallback_delta_pct"] = round(
+                    100 * (times["full_ladder_l128"]
+                           - times["no_ladders_l128"])
+                    / times["full_ladder_l128"], 1)
+                out["epilogue_scan_speedup"] = round(
+                    times["full_ladder_l128"] / full_s, 3)
+            return out
+
         full_s = stage_times["full_l128"]
-        attribution = {
-            "selection_matmul_pct": round(
-                100 * stage_times["matmul_l128"] / full_s, 1),
-            "signal_delta_pct": round(
-                100 * (stage_times["signal_l128"]
-                       - stage_times["matmul_l128"]) / full_s, 1),
-            "reductions_delta_pct": round(
-                100 * (stage_times["no_ladders_l128"]
-                       - stage_times["signal_l128"]) / full_s, 1),
-            "ladders_delta_pct": round(
-                100 * (full_s - stage_times["no_ladders_l128"])
-                / full_s, 1),
-        }
+        attribution = _attribution(stage_times)
         if "full_l512" in stage_times:   # skipped for small P_pad
             attribution["wide_block_speedup_l512"] = round(
                 full_s / stage_times["full_l512"], 2)
-        # Shipped-path A/B on top of the cut stages: the in-kernel
-        # (VMEM-scratch) table vs the XLA/HBM table, both through the
-        # real fused_sma_sweep at its auto-picked block width — the
-        # number that justifies DBX_SMA_TABLE's "inline" default.
+        # Shipped-path A/Bs on top of the cut stages, both through the
+        # real fused_sma_sweep at its auto-picked block width: the
+        # in-kernel (VMEM-scratch) table vs the XLA/HBM table (justifies
+        # DBX_SMA_TABLE's "inline" default), and the carry-scan epilogue
+        # vs the ladder fallback (justifies DBX_EPILOGUE's "scan").
         for mode in ("hbm", "inline"):
             rate = _measure(
                 lambda mode=mode: fused.fused_sma_sweep(
@@ -425,12 +459,185 @@ def main():
             stage_times[f"table_{mode}"] = n_bt / rate
         attribution["inline_table_speedup"] = round(
             stage_times["table_hbm"] / stage_times["table_inline"], 3)
+        for mode in ("ladder", "scan"):
+            rate = _measure(
+                lambda mode=mode: fused.fused_sma_sweep(
+                    panel.close, sfa, ssl, cost=1e-3, epilogue=mode),
+                n_bt, iters=iters, warmup=warmup,
+                name=f"sma_epilogue_{mode}")
+            stage_times[f"epilogue_{mode}"] = n_bt / rate
+        attribution["epilogue_e2e_speedup"] = round(
+            stage_times["epilogue_ladder"] / stage_times["epilogue_scan"],
+            3)
         ROOFLINE["sma_stages"] = {
             **{f"{k}_s_per_sweep": round(v, 6)
                for k, v in stage_times.items()},
             **attribution}
         rates["roofline_stages_full"] = n_bt / full_s
         print(f"bench[roofline_stages]: attribution {attribution}",
+              file=sys.stderr)
+
+        # --- bollinger stages: the band-machine twin of the SMA scaffold.
+        # Same cut-down discipline over the EXACT hbm-table bollinger
+        # kernel (z-table prep shared with _fused_boll_call): attributes
+        # the selection matmul, the 3-state compose machine (scan vs
+        # ladder substrate), and the metrics-tail ladders for the family
+        # whose vpu_ops_per_cell_bar sat at 179 vs the sign kernels' 76.
+        n_win, n_k = 20, max(min(n_params, 1000) // 20, 1)
+        rgrid = sweep.product_grid(
+            k=jnp.linspace(0.5, 3.0, n_k).astype(jnp.float32),
+            window=jnp.arange(10, 10 + 2 * n_win, 2, dtype=jnp.float32))
+        rw = np.asarray(rgrid["window"])
+        rk = np.asarray(rgrid["k"])
+        bwindows, b_onehot, b_klanes, b_warm = F._boll_grid_setup(
+            rw.astype(np.float32).tobytes(), rk.tobytes())
+        bT_pad = F._round_up(n_bars, 128)
+        bW_pad = b_onehot.shape[0]
+        bP_real = rw.shape[0]
+        bP_pad = b_onehot.shape[1]
+
+        def boll_stage_kernel(r_ref, z_ref, ow_ref, k_ref, warm_ref,
+                              out_ref, *, stage, lanes):
+            # Mirrors ops.fused._boll_kernel through the requested stage
+            # (same scaffolding contract as stage_kernel above).
+            T_pd = r_ref.shape[1]
+            r = r_ref[0]
+            zt = z_ref[0]                    # (W_pad, T_pad) z-table
+            if stage == "touch":
+                out_ref[0, 0] = jnp.full(
+                    (F._METRIC_ROWS, lanes), jnp.sum(zt), jnp.float32)
+                return
+            z = jax.lax.dot_general(
+                zt, ow_ref[:], (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)
+            t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pd, lanes), 0)
+            if stage == "matmul":
+                out_ref[0, 0] = jnp.broadcast_to(
+                    jnp.sum(z, axis=0)[None, :], (F._METRIC_ROWS, lanes))
+                return
+            warm_v = warm_ref[0, :][None, :]
+            valid = t_idx >= (warm_v.astype(jnp.int32) - 1)
+            k_l = k_ref[0, :][None, :]
+            epi = "ladder" if stage.endswith("_ladder") else "scan"
+            pos = F._band_ladder(z, valid, k_l, 0.0, epi)
+            if stage in ("signal", "signal_ladder"):
+                # + the 3-state compose machine (the band family's extra
+                # cost vs sign kernels), in the requested substrate.
+                out_ref[0, 0] = jnp.broadcast_to(
+                    jnp.sum(pos * r, axis=0)[None, :],
+                    (F._METRIC_ROWS, lanes))
+                return
+            tr = n_bars
+            if stage in ("full", "full_ladder"):
+                out_ref[0, 0] = F._metrics_tail(pos, r, t_idx, tr,
+                                                cost=1e-3, ppy=252,
+                                                epilogue=epi)
+                return
+            # no_ladders: compose machine (scan) + the one-pass reduction
+            # stand-ins of the SMA scaffold.
+            row_ok = t_idx < tr
+            pos_last = F._row_at(pos, tr, t_idx, keepdims=True)
+            pos = jnp.where(row_ok, pos, pos_last)
+            prev = F._shift_down(pos, 1, 0.0)
+            net = prev * r - 1e-3 * jnp.abs(pos - prev)
+            n_f = jnp.asarray(tr, jnp.float32)
+            s1 = jnp.sum(net, axis=0)
+            s2 = jnp.sum(net * net, axis=0)
+            meanv = s1 / n_f
+            std = jnp.sqrt(jnp.maximum(s2 / n_f - meanv * meanv, 0.0))
+            turnover = jnp.sum(jnp.abs(pos - prev), axis=0)
+            rows = jnp.stack([s1, s2, meanv, std, std, s1,
+                              turnover, std, s1], axis=0)
+            out_ref[0, 0] = jnp.concatenate(
+                [rows, jnp.zeros((F._METRIC_ROWS - 9, lanes),
+                                 jnp.float32)], axis=0)
+
+        @functools.partial(jax.jit, static_argnames=("stage", "lanes"))
+        def boll_stage_call(close, *, stage, lanes=128):
+            # THE shipped hbm z-table prep (_fused_boll_call's op order,
+            # via the shared cumsum-window closures).
+            close_p = F._pad_last(close, bT_pad)
+            T = close.shape[1]
+            xc = close_p - jnp.mean(close_p[:, :T], axis=1, keepdims=True)
+            w_col, w_f, t_row, windowed_sum, _ = F._cumsum_window_tools(
+                bwindows, bT_pad)
+            m = windowed_sum(close_p) / w_f
+            s1 = windowed_sum(xc)
+            s2 = windowed_sum(xc * xc)
+            var = jnp.maximum((s2 - s1 * s1 / w_f) / w_f, 0.0)
+            z_tbl = (close_p[:, None, :] - m) / (jnp.sqrt(var) + 1e-12)
+            z_tbl = F._pad_w(
+                jnp.where((t_row >= w_col - 1)[None], z_tbl, 0.0), bW_pad)
+            r3 = F._rets3(close_p)
+            if stage == "prep":
+                return jnp.broadcast_to(
+                    jnp.sum(z_tbl, axis=(1, 2))[:, None] + r3[:, 0, :],
+                    (close.shape[0], bP_pad))[:, :bP_real]
+            nb = bP_pad // lanes
+            out = pl.pallas_call(
+                functools.partial(boll_stage_kernel, stage=stage,
+                                  lanes=lanes),
+                grid=(close.shape[0], nb),
+                in_specs=[
+                    pl.BlockSpec((1, bT_pad, 1), lambda i, j: (i, 0, 0),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((1, bW_pad, bT_pad),
+                                 lambda i, j: (i, 0, 0),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((bW_pad, lanes), lambda i, j: (0, j),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((1, lanes), lambda i, j: (0, j),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((1, lanes), lambda i, j: (0, j),
+                                 memory_space=pltpu.VMEM),
+                ],
+                out_specs=pl.BlockSpec(
+                    (1, 1, F._METRIC_ROWS, lanes),
+                    lambda i, j: (i, j, 0, 0), memory_space=pltpu.VMEM),
+                out_shape=jax.ShapeDtypeStruct(
+                    (close.shape[0], nb, F._METRIC_ROWS, lanes),
+                    jnp.float32),
+                interpret=interp,
+            )(r3, z_tbl, F._const(b_onehot), F._const(b_klanes),
+              F._const(b_warm))
+            return jnp.reshape(out[:, :, 0, :],
+                               (close.shape[0], bP_pad))[:, :bP_real]
+
+        boll_times = {}
+        b_bt = n_tickers * bP_real
+        for stage in ("prep", "touch", "matmul", "signal", "signal_ladder",
+                      "no_ladders", "full", "full_ladder"):
+            def run_bstage(stage=stage):
+                from types import SimpleNamespace
+                return SimpleNamespace(
+                    sharpe=boll_stage_call(panel.close, stage=stage))
+            rate = _measure(run_bstage, b_bt, iters=iters, warmup=warmup,
+                            name=f"boll_stage_{stage}_l128")
+            boll_times[f"{stage}_l128"] = b_bt / rate
+        boll_attr = _attribution(boll_times)
+        boll_attr["compose_delta_pct"] = round(
+            100 * (boll_times["signal_l128"] - boll_times["matmul_l128"])
+            / boll_times["full_l128"], 1)
+        boll_attr["compose_ladder_delta_pct"] = round(
+            100 * (boll_times["signal_ladder_l128"]
+                   - boll_times["matmul_l128"])
+            / boll_times["full_l128"], 1)
+        for mode in ("ladder", "scan"):
+            rate = _measure(
+                lambda mode=mode: fused.fused_bollinger_sweep(
+                    panel.close, rw, rk, cost=1e-3, epilogue=mode),
+                b_bt, iters=iters, warmup=warmup,
+                name=f"boll_epilogue_{mode}")
+            boll_times[f"epilogue_{mode}"] = b_bt / rate
+        boll_attr["epilogue_e2e_speedup"] = round(
+            boll_times["epilogue_ladder"] / boll_times["epilogue_scan"], 3)
+        ROOFLINE["bollinger_stages"] = {
+            **{f"{k}_s_per_sweep": round(v, 6)
+               for k, v in boll_times.items()},
+            **boll_attr}
+        rates["roofline_stages_boll_full"] = b_bt / boll_times["full_l128"]
+        print(f"bench[roofline_stages/bollinger]: attribution {boll_attr}",
               file=sys.stderr)
 
     # --- configs[2]: fused Bollinger (window, k) --------------------------

@@ -155,11 +155,38 @@ class KernelHygieneRule:
     def check(self, ctx: LintContext) -> list[Finding]:
         if not self.applicable(ctx):
             return []
+        findings: list[Finding] = []
+        # BOTH epilogue substrates trace: the default single-pass carry
+        # scan (T-block loop with carry state — new scratch/carry code
+        # must not leak f64 or weak types) AND the ladder fallback, which
+        # otherwise only runs when an operator flips DBX_EPILOGUE and
+        # would rot unlinted. The env var is the same host-side knob the
+        # public wrappers resolve per call, so setting it between traces
+        # selects the substrate.
+        prior = os.environ.get("DBX_EPILOGUE")
+        try:
+            # "scan:8" pins the production T-block size: a bare "scan"
+            # re-blocks to one block in interpret mode (CPU lint boxes),
+            # which would not trace the multi-block carry chain.
+            for epilogue in ("scan:8", "ladder"):
+                os.environ["DBX_EPILOGUE"] = epilogue
+                findings.extend(self._check_registry(ctx, epilogue))
+        finally:
+            if prior is None:
+                os.environ.pop("DBX_EPILOGUE", None)
+            else:
+                os.environ["DBX_EPILOGUE"] = prior
+        return findings
+
+    def _check_registry(self, ctx: LintContext,
+                        epilogue: str) -> list[Finding]:
         from ..rpc.compute import JaxSweepBackend
 
         findings: list[Finding] = []
+        suffix = "" if epilogue.startswith("scan") else f"@{epilogue}"
         for strategy, spec in sorted(
                 JaxSweepBackend._FUSED_STRATEGIES.items()):
+            strategy = strategy + suffix
             run = spec.run
             target = inspect.unwrap(getattr(run, "__func__", run))
             try:
@@ -176,13 +203,16 @@ class KernelHygieneRule:
             except KeyError as e:
                 # A newly registered kernel with an axis/field this rule
                 # has no tiny-input template for must surface as a loud
-                # finding, not crash the whole lint run.
-                findings.append(Finding(
-                    self.name, rel, line,
-                    f"kernel `{strategy}`: no tiny-input template for "
-                    f"grid axis/field {e.args[0]!r} — extend _AXIS_VALUES/"
-                    f"_tiny_inputs in analysis/jaxpr_rules.py so this "
-                    f"kernel stays under kernel-hygiene coverage"))
+                # finding, not crash the whole lint run. Template gaps are
+                # substrate-independent — report once, on the scan pass.
+                if epilogue.startswith("scan"):
+                    findings.append(Finding(
+                        self.name, rel, line,
+                        f"kernel `{strategy}`: no tiny-input template for "
+                        f"grid axis/field {e.args[0]!r} — extend "
+                        f"_AXIS_VALUES/_tiny_inputs in "
+                        f"analysis/jaxpr_rules.py so this kernel stays "
+                        f"under kernel-hygiene coverage"))
                 continue
             findings.extend(check_traced(
                 strategy,

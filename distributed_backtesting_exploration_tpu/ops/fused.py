@@ -13,8 +13,11 @@ backtest back to HBM:
   its two rows inside the kernel with a one-hot matmul — turning a per-lane
   gather (slow on TPU) into an MXU contraction.
 - **Time on sublanes, params on lanes.** Each cell works on ``(T_pad, 128)``
-  f32 tiles; per-bar recurrences (equity cumsum, running peak for drawdown)
-  are log-depth shift-op ladders over the sublane axis, entirely in VMEM.
+  f32 tiles; per-bar recurrences (equity cumsum, running peak for drawdown,
+  the band machines' 3-state compose) run as a SINGLE sequential pass over
+  T-blocks with carry state between blocks (O(T) work — see
+  :func:`_equity_scan`), with the original full-T log-depth shift-op
+  ladders kept as the ``"ladder"`` fallback substrate, entirely in VMEM.
 - **Padding discipline.** Bars padded beyond ``T`` hold the last position and
   earn zero return, so every reduction matches the unpadded reference
   exactly; metric denominators use the static true ``T``.
@@ -212,6 +215,117 @@ def _cummax0(x):
     return x
 
 
+# ---------------------------------------------------------------------------
+# Single-pass carry-scan epilogue (the "scan" substrate)
+#
+# BENCH_r05's roofline_stages put 47.6% of the flagship SMA sweep in the
+# shared metrics tail's two full-T shift ladders (equity cumsum + running-
+# peak cummax: O(T log T) element-ops), and another ~55% of every band
+# machine's tail in the 3-state compose ladder. All three recurrences are
+# now evaluated as ONE sequential pass over T-blocks with carry state
+# threaded between blocks — O(T log B) work for a fixed block B, i.e. O(T).
+# The carries (cumulative return, running-max equity, band machine state)
+# live in VMEM vregs across an unrolled static block loop; block bounds are
+# compile-time constants, so every slice is a static sublane slice (the
+# T-block analogue of the sequential-grid scratch the inline tables use,
+# without re-tiling the signal stage). The ladder path survives verbatim as
+# the "ladder" fallback substrate so parity and flip budgets verify
+# substrate-vs-substrate (`DBX_EPILOGUE=ladder`, bench roofline A/B rows).
+# ---------------------------------------------------------------------------
+
+_EPILOGUE_DEFAULT = "scan"
+_SCAN_BLOCK_DEFAULT = 8          # one f32 sublane tile per block step
+_SCAN_MAX_BLOCKS = 256           # unroll bound: B doubles past this
+
+
+def _resolve_epilogue(epilogue: str | None) -> str:
+    """Shared epilogue-substrate knob: explicit arg > ``DBX_EPILOGUE`` >
+    ``"scan"``. ``"scan"`` (default) is the single-pass blocked carry scan;
+    ``"scan:<B>"`` pins the T-block size to ``B`` sublane rows (multiple of
+    8 — the tuning surface for the on-chip A/B); ``"ladder"`` is the
+    O(T log T) full-T shift-ladder fallback kept for substrate-vs-substrate
+    verification."""
+    if epilogue is None:
+        epilogue = os.environ.get("DBX_EPILOGUE", _EPILOGUE_DEFAULT)
+    if epilogue == "ladder" or epilogue == "scan":
+        return epilogue
+    if epilogue.startswith("scan:"):
+        try:
+            b = int(epilogue[5:])
+        except ValueError:
+            b = -1
+        if b >= 8 and b % 8 == 0:
+            return epilogue
+    raise ValueError(
+        f"epilogue must be 'scan', 'scan:<B>' (B a positive multiple of 8) "
+        f"or 'ladder', got {epilogue!r}")
+
+
+def _scan_block(T_pad: int, epilogue: str) -> int:
+    """Static T-block size for the carry scan. The default starts at one
+    sublane tile (8 rows — the modeled sweet spot: per-row ladder work is
+    4*log2(B), so smaller blocks do strictly less VPU work) and doubles
+    until the unrolled block count fits ``_SCAN_MAX_BLOCKS`` (bounding
+    Mosaic program size for long-context shapes)."""
+    if epilogue.startswith("scan:"):
+        return int(epilogue[5:])
+    b = _SCAN_BLOCK_DEFAULT
+    while -(-T_pad // b) > _SCAN_MAX_BLOCKS:
+        b *= 2
+    return b
+
+
+def _spans(T_pad: int, block: int):
+    """Static (start, stop) spans tiling the sublane axis by ``block``."""
+    return [(s, min(s + block, T_pad)) for s in range(0, T_pad, block)]
+
+
+def _interp_epilogue(epilogue: str, T_pad: int, interpret: bool) -> str:
+    """Interpret mode (the CPU test path) re-blocks the default scan to
+    ONE T-block: the long unrolled per-block op chain that is cheap for
+    Mosaic is expensive for trace + XLA-CPU interpretation (measured ~8x
+    golden-test wall at the default 8-row block vs ~1x single-block —
+    a single block does the ladder's exact op count through the scan
+    code path). Carry chains across block boundaries are exercised by
+    the dedicated multi-block substrate tests (tests/test_z_epilogue.py),
+    which pin ``"scan:<B>"`` explicitly; pinned values and ``"ladder"``
+    pass through untouched. Block size only moves the f32 association
+    rounding of the equity-path metrics."""
+    if not interpret or epilogue != "scan":
+        return epilogue
+    return f"scan:{_round_up(T_pad, 8)}"
+
+
+def _equity_scan(net, block: int):
+    """``(mdd, eq_final)`` of ``equity = 1 + cumsum(net)`` in one
+    sequential pass over T-blocks.
+
+    Carries: the cumulative net return and the running-max equity, both
+    ``(1, lanes)`` rows threaded between blocks. Per block the local
+    cumsum/cummax ladders are log2(block)-deep instead of log2(T_pad) —
+    total O(T log B) = O(T) for the static ``block``. Padding discipline
+    (``net == 0`` for ``t >= tr``) makes masks unnecessary: equity and
+    peak freeze at the last real bar, so pad rows' drawdown replays
+    ``dd[tr-1]`` exactly and the final carry IS the total return. For a
+    single block this is bit-identical to the ladder substrate
+    (``x + 0.0 == x``); across blocks the summation tree differs by the
+    usual f32 association rounding (~1 ULP class — positions, and hence
+    every flip-sensitive comparison, are untouched)."""
+    T_pad, lanes = net.shape
+    carry = jnp.zeros((1, lanes), jnp.float32)
+    peak_c = jnp.full((1, lanes), -jnp.inf, jnp.float32)
+    mdd = jnp.zeros((1, lanes), jnp.float32)
+    for s, e in _spans(T_pad, block):
+        cs = _cumsum0(net[s:e])
+        eq = (1.0 + carry) + cs
+        peak = jnp.maximum(_cummax0(eq), peak_c)
+        dd = (peak - eq) / jnp.maximum(peak, _EPS)
+        mdd = jnp.maximum(mdd, jnp.max(dd, axis=0, keepdims=True))
+        carry = carry + cs[e - s - 1:]
+        peak_c = peak[e - s - 1:]
+    return mdd[0], 1.0 + carry[0]
+
+
 def _unpack_tr(refs, T_real):
     """Shared ragged-vs-uniform ref plumbing for all sweep kernels: with a
     static ``T_real`` the refs are just ``(out_ref,)``; in ragged mode an
@@ -247,7 +361,8 @@ def _row_at(x, tr, t_idx, *, keepdims: bool):
                    keepdims=keepdims)
 
 
-def _metrics_tail(pos, r, t_idx, tr, *, cost: float, ppy: int):
+def _metrics_tail(pos, r, t_idx, tr, *, cost: float, ppy: int,
+                  epilogue: str = _EPILOGUE_DEFAULT):
     """Shared kernel tail: positions -> packed (16, 128) metric rows.
 
     ``pos`` is the per-lane position path over ``(T_pad, 128)`` (any signal
@@ -255,7 +370,8 @@ def _metrics_tail(pos, r, t_idx, tr, *, cost: float, ppy: int):
     scalar — traced, so ragged groups work with one compiled kernel). Bars
     at ``t >= tr`` are overwritten to hold the final real position so every
     reduction over T_pad equals the unpadded reduction over tr (zero
-    return, zero turnover in the pad).
+    return, zero turnover in the pad). ``epilogue`` picks the equity/
+    drawdown substrate (see `_equity_scan` / `_resolve_epilogue`).
     """
     row_ok = t_idx < tr
     pos_last = _row_at(pos, tr, t_idx, keepdims=True)
@@ -263,10 +379,12 @@ def _metrics_tail(pos, r, t_idx, tr, *, cost: float, ppy: int):
 
     prev = _shift_down(pos, 1, 0.0)
     net = prev * r - cost * jnp.abs(pos - prev)
-    return _metrics_pack(pos, prev, net, row_ok, t_idx, tr, ppy=ppy)
+    return _metrics_pack(pos, prev, net, row_ok, t_idx, tr, ppy=ppy,
+                         epilogue=epilogue)
 
 
-def _metrics_pack(pos, prev, net, row_ok, t_idx, tr, *, ppy: int):
+def _metrics_pack(pos, prev, net, row_ok, t_idx, tr, *, ppy: int,
+                  epilogue: str = _EPILOGUE_DEFAULT):
     """Reduce per-bar ``net``/positions to the packed (16, 128) metric rows.
 
     Callers guarantee the padding discipline: ``pos`` holds its final real
@@ -283,11 +401,15 @@ def _metrics_pack(pos, prev, net, row_ok, t_idx, tr, *, ppy: int):
     down = jnp.minimum(net, 0.0)
     dstd = jnp.sqrt(jnp.sum(down * down, axis=0) / n)
 
-    equity = 1.0 + _cumsum0(net)
-    peak = _cummax0(equity)
-    dd = (peak - equity) / jnp.maximum(peak, _EPS)
-    mdd = jnp.max(jnp.where(row_ok, dd, 0.0), axis=0)
-    eq_final = _row_at(equity, tr, t_idx, keepdims=False)
+    if epilogue == "ladder":
+        equity = 1.0 + _cumsum0(net)
+        peak = _cummax0(equity)
+        dd = (peak - equity) / jnp.maximum(peak, _EPS)
+        mdd = jnp.max(jnp.where(row_ok, dd, 0.0), axis=0)
+        eq_final = _row_at(equity, tr, t_idx, keepdims=False)
+    else:
+        mdd, eq_final = _equity_scan(
+            net, _scan_block(net.shape[0], epilogue))
 
     active = (jnp.abs(prev) > 0) & row_ok
     wins = (net > 0) & active
@@ -344,8 +466,8 @@ def _sma_table(close_p, windows: tuple, W_pad: int):
     return jnp.stack(rows, axis=1)                       # (N, W_pad, T_pad)
 
 
-def _sma_select_and_score(sma, r, of_ref, os_ref, warm_ref, tr, out_ref, *,
-                          cost: float, ppy: int):
+def _sma_select_and_score(sma, r, od_ref, warm_ref, tr, out_ref, *,
+                          cost: float, ppy: int, epilogue: str):
     """Shared SMA selection + metrics tail (both table substrates feed it).
 
     Per-lane window selection as MXU contractions over the table's
@@ -355,38 +477,42 @@ def _sma_select_and_score(sma, r, of_ref, os_ref, warm_ref, tr, out_ref, *,
     ONE selection matmul on the DIFFERENCE one-hot (+1 at the fast row,
     -1 at the slow row): each lane's contraction has exactly two nonzero
     terms, so d == sma_fast - sma_slow and sign(d) is the crossover —
-    half the MXU work of selecting f and s separately. HIGHEST precision:
+    half the MXU work of selecting f and s separately. The difference is
+    now formed HOST-side (`_grid_setup` ships one ``(W_pad, lanes)``
+    selector instead of two): exact 0/±1 integers either way, half the
+    selector VMEM stream and one fewer per-cell pass. HIGHEST precision:
     the default bf16 pass truncates price-level SMAs enough to flip
     sign(d) near crossovers.
     """
     T_pad = sma.shape[1]
     d = jax.lax.dot_general(
-        sma, of_ref[:] - os_ref[:], (((0,), (0,)), ((), ())),
+        sma, od_ref[:], (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.HIGHEST)   # (T_pad, lanes)
 
-    lanes = of_ref.shape[1]   # wider-than-128 param blocks: fewer cells
+    lanes = od_ref.shape[1]   # wider-than-128 param blocks: fewer cells
                               # amortize per-cell overhead (bench.py
                               # roofline_stages measured +16% at 512)
     t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, lanes), 0)
     warm = warm_ref[0, :][None, :]            # (1, lanes) max(fast, slow)
     valid = t_idx >= (warm.astype(jnp.int32) - 1)
     pos = jnp.where(valid, jnp.sign(d), 0.0)
-    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
+    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy,
+                                  epilogue=epilogue)
 
 
-def _kernel(r_ref, sma_ref, of_ref, os_ref, warm_ref, *refs,
-            cost: float, ppy: int, T_real: int | None):
+def _kernel(r_ref, sma_ref, od_ref, warm_ref, *refs,
+            cost: float, ppy: int, T_real: int | None, epilogue: str):
     tr, out_ref = _unpack_tr(refs, T_real)
     r = r_ref[0]                     # (T_pad, 1) -> broadcasts over lanes
     sma = sma_ref[0]                 # (W_pad, T_pad) — W-major table
-    _sma_select_and_score(sma, r, of_ref, os_ref, warm_ref, tr, out_ref,
-                          cost=cost, ppy=ppy)
+    _sma_select_and_score(sma, r, od_ref, warm_ref, tr, out_ref,
+                          cost=cost, ppy=ppy, epilogue=epilogue)
 
 
-def _kernel_inline(r_ref, cs_ref, of_ref, os_ref, warm_ref, *refs,
+def _kernel_inline(r_ref, cs_ref, od_ref, warm_ref, *refs,
                    cost: float, ppy: int, T_real: int | None,
-                   windows: tuple, W_pad: int):
+                   windows: tuple, W_pad: int, epilogue: str):
     """The `_kernel` selection design with IN-KERNEL table construction.
 
     Instead of streaming an XLA-built ``(N, W_pad, T_pad)`` SMA table from
@@ -415,8 +541,8 @@ def _kernel_inline(r_ref, cs_ref, of_ref, os_ref, warm_ref, *refs,
         _build_sma_scratch(cs_ref[0], sma_scr, windows, W_pad)
 
     r = r_ref[0]
-    _sma_select_and_score(sma_scr[:], r, of_ref, os_ref, warm_ref, tr,
-                          out_ref, cost=cost, ppy=ppy)
+    _sma_select_and_score(sma_scr[:], r, od_ref, warm_ref, tr,
+                          out_ref, cost=cost, ppy=ppy, epilogue=epilogue)
 
 
 def _build_sma_scratch(cs, sma_scr, windows: tuple, W_pad: int):
@@ -444,11 +570,12 @@ def _build_sma_scratch(cs, sma_scr, windows: tuple, W_pad: int):
 @functools.partial(
     jax.jit,
     static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
-                     "ppy", "interpret", "table", "lanes_env"))
-def _fused_call(close, onehot_f, onehot_s, warm, t_real, *, windows: tuple,
+                     "ppy", "interpret", "table", "lanes_env", "epilogue"))
+def _fused_call(close, onehot_d, warm, t_real, *, windows: tuple,
                 T_pad: int, W_pad: int, P_real: int, T_real: int | None,
                 cost: float, ppy: int, interpret: bool,
-                table: str = "inline", lanes_env: int = 0):
+                table: str = "inline", lanes_env: int = 0,
+                epilogue: str = _EPILOGUE_DEFAULT):
     """Table prep + pallas call in ONE jit: the prep is ~500 XLA ops and must
     not run eagerly (each eager op is a dispatch round-trip on the remote-
     proxy TPU backend — measured 13x slower end-to-end).
@@ -461,9 +588,10 @@ def _fused_call(close, onehot_f, onehot_s, warm, t_real, *, windows: tuple,
     on TPU see `_kernel_inline` for the 1-ULP division-lowering caveat.
     """
     N, T = close.shape
+    epilogue = _interp_epilogue(epilogue, T_pad, interpret)
     close_p = _pad_last(close, T_pad)
     returns3 = _rets3(close_p)
-    P_pad = onehot_f.shape[1]
+    P_pad = onehot_d.shape[1]
     # sign kernel: no compose ladder
     lanes = _widest_lanes(P_pad, 512, T_pad, lanes_env)
     n_blocks = P_pad // lanes
@@ -472,7 +600,7 @@ def _fused_call(close, onehot_f, onehot_s, warm, t_real, *, windows: tuple,
         cs = jnp.cumsum(close_p, axis=1)[:, None, :]       # (N, 1, T_pad)
         kernel = functools.partial(_kernel_inline, cost=cost, ppy=ppy,
                                    T_real=T_real, windows=windows,
-                                   W_pad=W_pad)
+                                   W_pad=W_pad, epilogue=epilogue)
         table_arg = cs
         table_spec = pl.BlockSpec((1, 1, T_pad), lambda i, j: (i, 0, 0),
                                   memory_space=pltpu.VMEM)
@@ -480,7 +608,7 @@ def _fused_call(close, onehot_f, onehot_s, warm, t_real, *, windows: tuple,
     else:
         sma_table = _sma_table(close_p, windows, W_pad)
         kernel = functools.partial(_kernel, cost=cost, ppy=ppy,
-                                   T_real=T_real)
+                                   T_real=T_real, epilogue=epilogue)
         table_arg = sma_table
         table_spec = pl.BlockSpec((1, W_pad, T_pad), lambda i, j: (i, 0, 0),
                                   memory_space=pltpu.VMEM)
@@ -494,8 +622,6 @@ def _fused_call(close, onehot_f, onehot_s, warm, t_real, *, windows: tuple,
             table_spec,
             pl.BlockSpec((W_pad, lanes), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((W_pad, lanes), lambda i, j: (0, j),
-                         memory_space=pltpu.VMEM),
             pl.BlockSpec((1, lanes), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
         ] + _tr_specs(T_real),
@@ -506,7 +632,7 @@ def _fused_call(close, onehot_f, onehot_s, warm, t_real, *, windows: tuple,
             (N, n_blocks, _METRIC_ROWS, lanes), jnp.float32),
         scratch_shapes=scratch,
         interpret=interpret,
-    )(returns3, table_arg, onehot_f, onehot_s, warm,
+    )(returns3, table_arg, onehot_d, warm,
       *_tr_args(t_real, T_real))
     # (N, n_blocks, 16, 128) -> nine (N, P_real) fields. The slice to P_real
     # stays inside the jit: eagerly slicing nine arrays after the call costs
@@ -519,7 +645,8 @@ def _fused_call(close, onehot_f, onehot_s, warm, t_real, *, windows: tuple,
 def fused_sma_sweep(close, fast, slow, *, t_real=None, cost: float = 0.0,
                     periods_per_year: int = 252,
                     interpret: bool | None = None,
-                    table: str | None = None) -> Metrics:
+                    table: str | None = None,
+                    epilogue: str | None = None) -> Metrics:
     """Fused SMA-crossover sweep: ``(N, T)`` closes x ``(P,)`` param lanes.
 
     ``fast``/``slow`` are the *flat* per-combo window arrays (use
@@ -540,6 +667,9 @@ def fused_sma_sweep(close, fast, slow, *, t_real=None, cost: float = 0.0,
     (tested); on TPU the substrates can differ at ~0.01% of knife-edge
     crossovers (1-ULP division lowering, see `_kernel_inline`) — the
     fused-vs-generic verify budgets hold for both (bench --verify).
+    ``epilogue`` picks the metrics-tail substrate (env ``DBX_EPILOGUE``,
+    default ``"scan"`` — the single-pass carry scan; ``"ladder"`` keeps
+    the O(T log T) shift-ladder fallback, see `_equity_scan`).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -549,17 +679,18 @@ def fused_sma_sweep(close, fast, slow, *, t_real=None, cost: float = 0.0,
     T = close.shape[1]
     P = fast.shape[0]
 
-    windows, onehot_f, onehot_s, warm = _grid_setup(
+    windows, onehot_d, warm = _grid_setup(
         fast.astype(np.float32).tobytes(), slow.astype(np.float32).tobytes())
-    table = _resolve_table(table, "DBX_SMA_TABLE", "inline")
-    return _fused_call(close, onehot_f, onehot_s, warm,
+    table = _family_table("sma", table)
+    return _fused_call(close, onehot_d, warm,
                        _t_real_col(t_real, close),
                        windows=windows,
-                       T_pad=_round_up(T, 8), W_pad=onehot_f.shape[0],
+                       T_pad=_round_up(T, 8), W_pad=onehot_d.shape[0],
                        P_real=P, T_real=T if t_real is None else None,
                        cost=float(cost), ppy=int(periods_per_year),
                        interpret=bool(interpret), table=table,
-                       lanes_env=resolve_lanes_cap())
+                       lanes_env=resolve_lanes_cap(),
+                       epilogue=_resolve_epilogue(epilogue))
 
 
 def _prefix_compose3(pm, p0, pp):
@@ -587,7 +718,35 @@ def _prefix_compose3(pm, p0, pp):
     return pm, p0, pp
 
 
-def _band_ladder(z, valid, k, z_exit):
+def _compose3_path(pm, p0, pp, epilogue: str):
+    """Position path of a 3-state machine from its per-bar transition maps,
+    starting flat.
+
+    ``"ladder"``: the full-T doubling ladder (`_prefix_compose3`), O(T log T).
+    ``"scan"`` (default): ONE sequential pass over T-blocks — each block's
+    maps compose locally (log2(B) rounds), the entry STATE carried from the
+    previous block selects the component, and the block's last row is the
+    next carry. Map composition and component selection are pure selects
+    (no float arithmetic), so the two substrates are BIT-IDENTICAL on every
+    backend; the scan does O(T log B) = O(T) work — the band machines'
+    ~55%-of-tail compose cost (the 179-vs-76 ``vpu_ops_per_cell_bar``
+    spread vs the sign kernels) drops to the sign kernels' class."""
+    if epilogue == "ladder":
+        _, p0, _ = _prefix_compose3(pm, p0, pp)
+        return p0   # start state is flat: the 0-component is the path
+    T_pad = pm.shape[0]
+    state = None
+    outs = []
+    for s, e in _spans(T_pad, _scan_block(T_pad, epilogue)):
+        m, z, p = _prefix_compose3(pm[s:e], p0[s:e], pp[s:e])
+        pos = z if state is None else jnp.where(
+            state < 0, m, jnp.where(state > 0, p, z))
+        outs.append(pos)
+        state = pos[e - s - 1:]
+    return jnp.concatenate(outs, axis=0)
+
+
+def _band_ladder(z, valid, k, z_exit, epilogue: str = _EPILOGUE_DEFAULT):
     """Band-hysteresis position path over ``(T_pad, 128)`` tiles, in-kernel.
 
     ``k``/``z_exit`` broadcast against the tile (scalars or (1, 128) lanes).
@@ -597,8 +756,7 @@ def _band_ladder(z, valid, k, z_exit):
     pm = jnp.where(valid & (z > z_exit), -1.0, 0.0)
     p0 = jnp.where(valid, entered, 0.0)
     pp = jnp.where(valid & (z < -z_exit), 1.0, 0.0)
-    _, p0, _ = _prefix_compose3(pm, p0, pp)
-    return p0   # start state is flat: the 0-component is the position path
+    return _compose3_path(pm, p0, pp, epilogue)
 
 
 def _band_cell_core(z_wt, r_ref, ow_ref, k_ref, warm_ref, refs, T_real):
@@ -637,7 +795,7 @@ def _band_cell_prologue(r_ref, z_ref, ow_ref, k_ref, warm_ref, refs, T_real):
 
 
 def _band_cell_finish(machine: str, z, valid, k, z_exit, r, t_idx, tr,
-                      out_ref, *, cost: float, ppy: int):
+                      out_ref, *, cost: float, ppy: int, epilogue: str):
     """Tail of both Bollinger-family cells — one body for both table
     substrates so the position semantics cannot drift between them.
 
@@ -649,23 +807,25 @@ def _band_cell_finish(machine: str, z, valid, k, z_exit, r, t_idx, tr,
         pos = jnp.where(z < -k, 1.0, jnp.where(z > k, -1.0, 0.0))
         pos = jnp.where(valid, pos, 0.0)
     else:
-        pos = _band_ladder(z, valid, k, z_exit)
-    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
+        pos = _band_ladder(z, valid, k, z_exit, epilogue)
+    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy,
+                                  epilogue=epilogue)
 
 
 def _boll_kernel(r_ref, z_ref, ow_ref, k_ref, warm_ref, *refs,
                  cost: float, ppy: int, z_exit: float,
-                 T_real: int | None):
-    """Bollinger mean-reversion cell: z-selection matmul + hysteresis ladder."""
+                 T_real: int | None, epilogue: str = _EPILOGUE_DEFAULT):
+    """Bollinger mean-reversion cell: z-selection matmul + hysteresis
+    machine (blocked compose scan by default, see `_compose3_path`)."""
     tr, out_ref, r, z, t_idx, valid, k = _band_cell_prologue(
         r_ref, z_ref, ow_ref, k_ref, warm_ref, refs, T_real)
     _band_cell_finish("hysteresis", z, valid, k, z_exit, r, t_idx, tr,
-                      out_ref, cost=cost, ppy=ppy)
+                      out_ref, cost=cost, ppy=ppy, epilogue=epilogue)
 
 
 def _touch_kernel(r_ref, z_ref, ow_ref, k_ref, warm_ref, *refs,
                   cost: float, ppy: int, z_exit: float,
-                  T_real: int | None):
+                  T_real: int | None, epilogue: str = _EPILOGUE_DEFAULT):
     """Band-touch cell: the memoryless Bollinger variant (see
     :func:`_band_cell_finish`). ``z_exit`` is unused (the machine has no
     exit memory); the parameter stays so the kernel is plug-compatible
@@ -673,7 +833,7 @@ def _touch_kernel(r_ref, z_ref, ow_ref, k_ref, warm_ref, *refs,
     tr, out_ref, r, z, t_idx, valid, k = _band_cell_prologue(
         r_ref, z_ref, ow_ref, k_ref, warm_ref, refs, T_real)
     _band_cell_finish("touch", z, valid, k, z_exit, r, t_idx, tr,
-                      out_ref, cost=cost, ppy=ppy)
+                      out_ref, cost=cost, ppy=ppy, epilogue=epilogue)
 
 
 def _build_boll_z_scratch(c, cs, csx, csx2, z_scr, windows: tuple,
@@ -714,7 +874,8 @@ def _build_boll_z_scratch(c, cs, csx, csx2, z_scr, windows: tuple,
 def _band_kernel_inline(r_ref, c_ref, cs_ref, csx_ref, csx2_ref, ow_ref,
                         k_ref, warm_ref, *refs, cost: float, ppy: int,
                         z_exit: float, T_real: int | None, machine: str,
-                        windows: tuple, W_pad: int):
+                        windows: tuple, W_pad: int,
+                        epilogue: str = _EPILOGUE_DEFAULT):
     """Both Bollinger-family cells with IN-KERNEL z-table construction.
 
     Takes the close row plus three cumsum rows ``(N, 1, T_pad)`` instead
@@ -738,7 +899,7 @@ def _band_kernel_inline(r_ref, c_ref, cs_ref, csx_ref, csx2_ref, ow_ref,
     tr, out_ref, r, z, t_idx, valid, k = _band_cell_core(
         z_scr[:], r_ref, ow_ref, k_ref, warm_ref, tuple(head), T_real)
     _band_cell_finish(machine, z, valid, k, z_exit, r, t_idx, tr,
-                      out_ref, cost=cost, ppy=ppy)
+                      out_ref, cost=cost, ppy=ppy, epilogue=epilogue)
 
 
 _BAND_KERNELS = {"hysteresis": _boll_kernel, "touch": _touch_kernel}
@@ -871,12 +1032,12 @@ def _band_machine_pallas(kernel, close_p, z_table, onehot_w, k_lanes, warm,
     jax.jit,
     static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
                      "ppy", "z_exit", "machine", "interpret", "table",
-                     "lanes_env"))
+                     "lanes_env", "epilogue"))
 def _fused_boll_call(close, onehot_w, k_lanes, warm, t_real, *, windows: tuple,
                      T_pad: int, W_pad: int, P_real: int, T_real: int | None,
                      cost: float, ppy: int, z_exit: float, interpret: bool,
                      machine: str = "hysteresis", table: str = "inline",
-                     lanes_env: int = 0):
+                     lanes_env: int = 0, epilogue: str = _EPILOGUE_DEFAULT):
     """Z-score table prep + pallas call in one jit (same dispatch-economy
     rationale as ``_fused_call``).
 
@@ -893,6 +1054,7 @@ def _fused_boll_call(close, onehot_w, k_lanes, warm, t_real, *, windows: tuple,
     XLA-built table as the A/B twin.
     """
     N, T = close.shape
+    epilogue = _interp_epilogue(epilogue, T_pad, interpret)
     close_p = _pad_last(close, T_pad)
     # The memoryless touch cell has no compose ladder: sign-kernel VMEM
     # class, so it takes the sign kernels' 512-lane blocks (measured +5%
@@ -905,7 +1067,7 @@ def _fused_boll_call(close, onehot_w, k_lanes, warm, t_real, *, windows: tuple,
         kernel = functools.partial(_band_kernel_inline, cost=cost, ppy=ppy,
                                    z_exit=z_exit, T_real=T_real,
                                    machine=machine, windows=windows,
-                                   W_pad=W_pad)
+                                   W_pad=W_pad, epilogue=epilogue)
         return _band_machine_pallas(
             kernel, close_p, None, onehot_w, k_lanes, warm, t_real,
             T_pad=T_pad, W_pad=W_pad, P_real=P_real, T_real=T_real,
@@ -925,7 +1087,8 @@ def _fused_boll_call(close, onehot_w, k_lanes, warm, t_real, *, windows: tuple,
                      W_pad)
 
     kernel = functools.partial(_BAND_KERNELS[machine], cost=cost, ppy=ppy,
-                               z_exit=z_exit, T_real=T_real)
+                               z_exit=z_exit, T_real=T_real,
+                               epilogue=epilogue)
     return _band_machine_pallas(
         kernel, close_p, z_table, onehot_w, k_lanes, warm, t_real,
         T_pad=T_pad, W_pad=W_pad, P_real=P_real, T_real=T_real,
@@ -935,7 +1098,8 @@ def _fused_boll_call(close, onehot_w, k_lanes, warm, t_real, *, windows: tuple,
 def _bollinger_family_sweep(close, window, k, *, machine: str, z_exit: float,
                             t_real, cost: float, periods_per_year: int,
                             interpret: bool | None,
-                            table: str | None = None) -> Metrics:
+                            table: str | None = None,
+                            epilogue: str | None = None) -> Metrics:
     """Shared prep for both Bollinger-family wrappers (one z-table/grid
     pipeline, the ``machine`` picks the cell; ``table`` picks the z-table
     substrate — env ``DBX_BOLL_TABLE`` or ``"inline"``)."""
@@ -959,16 +1123,17 @@ def _bollinger_family_sweep(close, window, k, *, machine: str, z_exit: float,
                             cost=float(cost), ppy=int(periods_per_year),
                             z_exit=float(z_exit), machine=machine,
                             interpret=bool(interpret),
-                            table=_resolve_table(table, "DBX_BOLL_TABLE",
-                                                 "inline"),
-                            lanes_env=resolve_lanes_cap())
+                            table=_family_table("boll", table),
+                            lanes_env=resolve_lanes_cap(),
+                            epilogue=_resolve_epilogue(epilogue))
 
 
 def fused_bollinger_touch_sweep(close, window, k, *, t_real=None,
                                 cost: float = 0.0,
                                 periods_per_year: int = 252,
                                 interpret: bool | None = None,
-                                table: str | None = None) -> Metrics:
+                                table: str | None = None,
+                                epilogue: str | None = None) -> Metrics:
     """Fused band-touch sweep: the path-free Bollinger variant.
 
     Same z-table and grid layout as :func:`fused_bollinger_sweep`, but the
@@ -981,14 +1146,15 @@ def fused_bollinger_touch_sweep(close, window, k, *, t_real=None,
     return _bollinger_family_sweep(
         close, window, k, machine="touch", z_exit=0.0, t_real=t_real,
         cost=cost, periods_per_year=periods_per_year, interpret=interpret,
-        table=table)
+        table=table, epilogue=epilogue)
 
 
 def fused_bollinger_sweep(close, window, k, *, t_real=None,
                           z_exit: float = 0.0,
                           cost: float = 0.0, periods_per_year: int = 252,
                           interpret: bool | None = None,
-                          table: str | None = None) -> Metrics:
+                          table: str | None = None,
+                          epilogue: str | None = None) -> Metrics:
     """Fused Bollinger mean-reversion sweep: ``(N, T)`` closes x ``(P,)`` lanes.
 
     ``window``/``k`` are flat per-combo arrays (:func:`product_grid` order);
@@ -1001,7 +1167,7 @@ def fused_bollinger_sweep(close, window, k, *, t_real=None,
     return _bollinger_family_sweep(
         close, window, k, machine="hysteresis", z_exit=z_exit,
         t_real=t_real, cost=cost, periods_per_year=periods_per_year,
-        interpret=interpret, table=table)
+        interpret=interpret, table=table, epilogue=epilogue)
 
 
 
@@ -1053,7 +1219,7 @@ def _boll_grid_setup(window_bytes: bytes, k_bytes: bytes):
 
 def _pairs_kernel(zh_ref, ow_ref, k_ref, zx_ref,
                   warm_ref, *refs, cost: float, ppy: int,
-                  T_real: int | None):
+                  T_real: int | None, epilogue: str = _EPILOGUE_DEFAULT):
     """Pairs-trade cell: one stacked selection matmul + hysteresis + PnL.
 
     The per-pair z-score and *hedged-return* tables arrive stacked along
@@ -1087,7 +1253,7 @@ def _pairs_kernel(zh_ref, ow_ref, k_ref, zx_ref,
     k = k_ref[0, :][None, :]                           # per-lane z_entry
     zx = zx_ref[0, :][None, :]                         # per-lane z_exit
 
-    pos = _band_ladder(z, valid, k, zx)
+    pos = _band_ladder(z, valid, k, zx, epilogue)
 
     row_ok = t_idx < tr
     pos_last = _row_at(pos, tr, t_idx, keepdims=True)
@@ -1095,18 +1261,19 @@ def _pairs_kernel(zh_ref, ow_ref, k_ref, zx_ref,
     prev = _shift_down(pos, 1, 0.0)
     net = prev * hr - cost * jnp.abs(pos - prev)
     out_ref[0, 0] = _metrics_pack(pos, prev, net, row_ok, t_idx, tr,
-                                  ppy=ppy)
+                                  ppy=ppy, epilogue=epilogue)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
-                     "ppy", "interpret"))
+                     "ppy", "interpret", "epilogue"))
 def _fused_pairs_call(y_close, x_close, onehot_w, k_lanes, zx_lanes, warm,
                       t_real, *,
                       windows: tuple, T_pad: int, W_pad: int, P_real: int,
                       T_real: int | None,
-                      cost: float, ppy: int, interpret: bool):
+                      cost: float, ppy: int, interpret: bool,
+                      epilogue: str = _EPILOGUE_DEFAULT):
     """Beta/z table prep + pallas call in one jit.
 
     The tables follow ``rolling.rolling_ols`` / ``rolling.rolling_zscore``'s
@@ -1118,6 +1285,7 @@ def _fused_pairs_call(y_close, x_close, onehot_w, k_lanes, zx_lanes, warm,
     reference algebra (see :func:`fused_pairs_sweep`).
     """
     N, T = y_close.shape
+    epilogue = _interp_epilogue(epilogue, T_pad, interpret)
     y_p, x_p = _pad_last(y_close, T_pad), _pad_last(x_close, T_pad)
 
     # Tables are built (N, W, T_pad) — T on the minor axis — so HBM tiling
@@ -1199,7 +1367,7 @@ def _fused_pairs_call(y_close, x_close, onehot_w, k_lanes, zx_lanes, warm,
     lanes = _widest_lanes(P_pad, 256)
     n_blocks = P_pad // lanes
     kernel = functools.partial(_pairs_kernel, cost=cost, ppy=ppy,
-                               T_real=T_real)
+                               T_real=T_real, epilogue=epilogue)
     out = pl.pallas_call(
         kernel,
         grid=(N, n_blocks),
@@ -1231,7 +1399,8 @@ def _fused_pairs_call(y_close, x_close, onehot_w, k_lanes, zx_lanes, warm,
 def fused_pairs_sweep(y_close, x_close, lookback, z_entry, *, t_real=None,
                       z_exit=0.0,
                       cost: float = 0.0, periods_per_year: int = 252,
-                      interpret: bool | None = None) -> Metrics:
+                      interpret: bool | None = None,
+                      epilogue: str | None = None) -> Metrics:
     """Fused rolling-OLS pairs sweep: ``(N, T)`` pair legs x ``(P,)`` lanes.
 
     ``lookback``/``z_entry`` are flat per-combo arrays (:func:`product_grid`
@@ -1270,7 +1439,8 @@ def fused_pairs_sweep(y_close, x_close, lookback, z_entry, *, t_real=None,
                              P_real=P, T_real=T if t_real is None else None,
                              cost=float(cost),
                              ppy=int(periods_per_year),
-                             interpret=bool(interpret))
+                             interpret=bool(interpret),
+                             epilogue=_resolve_epilogue(epilogue))
 
 
 @functools.lru_cache(maxsize=4)
@@ -1323,10 +1493,12 @@ def _grid_setup(fast_bytes: bytes, slow_bytes: bytes):
     warm = np.zeros((1, P_pad), np.float32)
     warm[0, :P] = np.maximum(fast, slow)
     warm[0, P:] = 1.0
-    return (tuple(int(w) for w in windows),
-            _const(_window_onehot(windows, fast, W_pad, P_pad)),
-            _const(_window_onehot(windows, slow, W_pad, P_pad)),
-            _const(warm))
+    # ONE difference selector (+1 fast row, -1 slow row) built host-side:
+    # exact 0/±1 integers (identical to the in-kernel subtraction it
+    # replaces), half the per-cell selector VMEM stream.
+    oh_d = (_window_onehot(windows, fast, W_pad, P_pad)
+            - _window_onehot(windows, slow, W_pad, P_pad))
+    return (tuple(int(w) for w in windows), _const(oh_d), _const(warm))
 
 
 # ---------------------------------------------------------------------------
@@ -1356,7 +1528,7 @@ def _ema_rows(x, alpha: float):
 
 
 def _mom_signal_tail(past_tbl, r, close, ol_ref, warm_ref, tr, out_ref, *,
-                     cost: float, ppy: int):
+                     cost: float, ppy: int, epilogue: str):
     """Shared momentum selection + metrics tail (both table substrates).
 
     The signal is exact — the past-close table holds raw close values, the
@@ -1373,19 +1545,20 @@ def _mom_signal_tail(past_tbl, r, close, ol_ref, warm_ref, tr, out_ref, *,
     warm = warm_ref[0, :][None, :]     # lookback + 1
     valid = t_idx >= (warm.astype(jnp.int32) - 1)
     pos = jnp.where(valid, jnp.sign(close - past), 0.0)
-    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
+    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy,
+                                  epilogue=epilogue)
 
 
 def _mom_kernel(r_ref, c_ref, past_ref, ol_ref, warm_ref, *refs,
-                cost: float, ppy: int, T_real: int | None):
+                cost: float, ppy: int, T_real: int | None, epilogue: str):
     tr, out_ref = _unpack_tr(refs, T_real)
     _mom_signal_tail(past_ref[0], r_ref[0], c_ref[0], ol_ref, warm_ref, tr,
-                     out_ref, cost=cost, ppy=ppy)
+                     out_ref, cost=cost, ppy=ppy, epilogue=epilogue)
 
 
 def _mom_kernel_inline(r_ref, c_ref, crow_ref, ol_ref, warm_ref, *refs,
                        cost: float, ppy: int, T_real: int | None,
-                       windows: tuple, W_pad: int):
+                       windows: tuple, W_pad: int, epilogue: str):
     """Momentum with the past-close table built in VMEM scratch.
 
     The XLA prep's table is a clipped gather ``close_p[max(t - w, 0)]``;
@@ -1415,11 +1588,11 @@ def _mom_kernel_inline(r_ref, c_ref, crow_ref, ol_ref, warm_ref, *refs,
             past_scr[k:k + 1, :] = jnp.zeros((1, T_pad), jnp.float32)
 
     _mom_signal_tail(past_scr[:], r_ref[0], c_ref[0], ol_ref, warm_ref, tr,
-                     out_ref, cost=cost, ppy=ppy)
+                     out_ref, cost=cost, ppy=ppy, epilogue=epilogue)
 
 
 def _don_latch_tail(sig_tbl, r, ow_ref, warm_ref, tr, out_ref, *,
-                    cost: float, ppy: int):
+                    cost: float, ppy: int, epilogue: str):
     """Shared Donchian breakout-sign selection + latch machine + metrics.
 
     The latch machine is a 3-state prefix composition (breakout latches
@@ -1446,12 +1619,13 @@ def _don_latch_tail(sig_tbl, r, ow_ref, warm_ref, tr, out_ref, *,
     pm = jnp.where(valid, enter(-1.0), 0.0)
     p0 = jnp.where(valid, enter(0.0), 0.0)
     pp = jnp.where(valid, enter(1.0), 0.0)
-    _, pos, _ = _prefix_compose3(pm, p0, pp)
-    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
+    pos = _compose3_path(pm, p0, pp, epilogue)
+    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy,
+                                  epilogue=epilogue)
 
 
 def _don_kernel(r_ref, c_ref, sig_ref, ow_ref, warm_ref, *refs,
-                cost: float, ppy: int, T_real: int | None):
+                cost: float, ppy: int, T_real: int | None, epilogue: str):
     """Donchian cell over the XLA-built breakout-sign table.
 
     The per-(ticker, window) breakout sign (+1 above the prior channel
@@ -1463,12 +1637,13 @@ def _don_kernel(r_ref, c_ref, sig_ref, ow_ref, warm_ref, *refs,
     del c_ref
     tr, out_ref = _unpack_tr(refs, T_real)
     _don_latch_tail(sig_ref[0], r_ref[0], ow_ref, warm_ref, tr, out_ref,
-                    cost=cost, ppy=ppy)
+                    cost=cost, ppy=ppy, epilogue=epilogue)
 
 
 def _don_kernel_inline(r_ref, c_ref, crow_ref, hi_ref, lo_ref, ow_ref,
                        warm_ref, *refs, cost: float, ppy: int,
-                       T_real: int | None, windows: tuple, W_pad: int):
+                       T_real: int | None, windows: tuple, W_pad: int,
+                       epilogue: str):
     """Donchian with the breakout-sign table built in VMEM scratch.
 
     Rebuilds `_extrema_table`'s shared sparse-table range query in-kernel
@@ -1533,7 +1708,7 @@ def _don_kernel_inline(r_ref, c_ref, crow_ref, hi_ref, lo_ref, ow_ref,
             sig_scr[k:k + 1, :] = jnp.zeros((1, T_pad), jnp.float32)
 
     _don_latch_tail(sig_scr[:], r_ref[0], ow_ref, warm_ref, tr, out_ref,
-                    cost=cost, ppy=ppy)
+                    cost=cost, ppy=ppy, epilogue=epilogue)
 
 
 def _single_window_pallas(kernel, close, tables, onehot_w, warm, t_real, *,
@@ -1598,11 +1773,12 @@ def _single_window_pallas(kernel, close, tables, onehot_w, warm, t_real, *,
 @functools.partial(
     jax.jit,
     static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
-                     "ppy", "interpret", "table", "lanes_env"))
+                     "ppy", "interpret", "table", "lanes_env", "epilogue"))
 def _fused_mom_call(close, onehot_l, warm, t_real, *, windows: tuple,
                     T_pad: int, W_pad: int, P_real: int, T_real: int | None,
                     cost: float, ppy: int, interpret: bool,
-                    table: str = "inline", lanes_env: int = 0):
+                    table: str = "inline", lanes_env: int = 0,
+                    epilogue: str = _EPILOGUE_DEFAULT):
     """Past-close table prep + pallas call in one jit.
 
     ``table="hbm"``: the table is a single clipped XLA gather of raw
@@ -1611,11 +1787,12 @@ def _fused_mom_call(close, onehot_l, warm, t_real, *, windows: tuple,
     close row (`_mom_kernel_inline`) — bit-identical on every backend (no
     arithmetic either way), with no XLA gather and no table HBM stream.
     """
+    epilogue = _interp_epilogue(epilogue, T_pad, interpret)
     close_p = _pad_last(close, T_pad)
     if table == "inline":
         kernel = functools.partial(_mom_kernel_inline, cost=cost, ppy=ppy,
                                    T_real=T_real, windows=windows,
-                                   W_pad=W_pad)
+                                   W_pad=W_pad, epilogue=epilogue)
         return _single_window_pallas(
             kernel, close_p, [], onehot_l, warm, t_real, T_pad=T_pad,
             W_pad=W_pad, P_real=P_real, T_real=T_real, interpret=interpret,
@@ -1627,7 +1804,7 @@ def _fused_mom_call(close, onehot_l, warm, t_real, *, windows: tuple,
     gather_idx = jnp.clip(t_row - w_col, 0, T_pad - 1)           # (W,T_pad)
     past_tbl = _pad_w(jnp.take(close_p, gather_idx, axis=1), W_pad)
     kernel = functools.partial(_mom_kernel, cost=cost, ppy=ppy,
-                               T_real=T_real)
+                               T_real=T_real, epilogue=epilogue)
     return _single_window_pallas(
         kernel, close_p, [past_tbl], onehot_l, warm, t_real, T_pad=T_pad,
         W_pad=W_pad, P_real=P_real, T_real=T_real, interpret=interpret,
@@ -1665,11 +1842,12 @@ def _extrema_table(src_p, windows: tuple, mode: str, warm_fill: float):
 @functools.partial(
     jax.jit,
     static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
-                     "ppy", "interpret", "table"))
+                     "ppy", "interpret", "table", "epilogue"))
 def _fused_don_call(close, hi_src, lo_src, onehot_w, warm, t_real, *,
                     windows: tuple, T_pad: int, W_pad: int, P_real: int,
                     T_real: int | None, cost: float, ppy: int,
-                    interpret: bool, table: str = "hbm"):
+                    interpret: bool, table: str = "hbm",
+                    epilogue: str = _EPILOGUE_DEFAULT):
     """Channel-extrema table prep + pallas call in one jit. Windows are
     static, so all distinct windows' rolling max/min come from one shared
     sparse table (:func:`_extrema_table`); max/min of exact prices is
@@ -1690,11 +1868,12 @@ def _fused_don_call(close, hi_src, lo_src, onehot_w, warm, t_real, *,
     (max/min and compares of raw prices are exact both ways). It measured
     a wash on-chip, so the shipped default stays ``"hbm"``
     (DESIGN.md "In-kernel table construction")."""
+    epilogue = _interp_epilogue(epilogue, T_pad, interpret)
     close_p = _pad_last(close, T_pad)
     if table == "inline":
         kernel = functools.partial(_don_kernel_inline, cost=cost, ppy=ppy,
                                    T_real=T_real, windows=windows,
-                                   W_pad=W_pad)
+                                   W_pad=W_pad, epilogue=epilogue)
         return _single_window_pallas(
             kernel, close_p, [], onehot_w, warm, t_real,
             T_pad=T_pad, W_pad=W_pad, P_real=P_real, T_real=T_real,
@@ -1715,7 +1894,7 @@ def _fused_don_call(close, hi_src, lo_src, onehot_w, warm, t_real, *,
     sig_tbl = _pad_w(jnp.where(c3 >= hi_prev, 1.0,
                                jnp.where(c3 <= lo_prev, -1.0, 0.0)), W_pad)
     kernel = functools.partial(_don_kernel, cost=cost, ppy=ppy,
-                               T_real=T_real)
+                               T_real=T_real, epilogue=epilogue)
     return _single_window_pallas(
         kernel, close_p, [sig_tbl], onehot_w, warm, t_real,
         T_pad=T_pad, W_pad=W_pad, P_real=P_real, T_real=T_real,
@@ -1734,10 +1913,73 @@ def _resolve_table(table: str | None, env_var: str, default: str) -> str:
     return table
 
 
+# (env var, shipped default) per table-substrate family; donchian stays
+# "hbm" by measurement (the inline rebuild A/B'd a wash on-chip — DESIGN.md
+# "In-kernel table construction").
+_TABLE_FAMILIES = {
+    "sma": ("DBX_SMA_TABLE", "inline"),
+    "boll": ("DBX_BOLL_TABLE", "inline"),
+    "mom": ("DBX_MOM_TABLE", "inline"),
+    "don": ("DBX_DON_TABLE", "hbm"),
+    "obv": ("DBX_OBV_TABLE", "inline"),
+}
+
+
+def _family_table(family: str, table: str | None) -> str:
+    """Resolve a wrapper's table substrate from the single source of truth.
+
+    Every sweep wrapper with a table knob MUST route through this (not a
+    literal (env, default) pair) so ``substrate_defaults()`` /
+    ``route_substrates()`` — and the observability surfaces built on them —
+    can never report a different substrate than the kernel serves."""
+    return _resolve_table(table, *_TABLE_FAMILIES[family])
+
+# Strategy name (rpc.compute registry key) -> table family, for the route
+# substrate counters. Strategies without an in-kernel table substrate
+# always stream the XLA-built table ("hbm", no knob).
+_STRATEGY_TABLE_FAMILY = {
+    "sma_crossover": "sma",
+    "bollinger": "boll",
+    "bollinger_touch": "boll",
+    "momentum": "mom",
+    "donchian": "don",
+    "donchian_hl": "don",
+    "obv_trend": "obv",
+}
+
+
+def substrate_defaults() -> dict:
+    """The live (env-resolved) kernel substrate defaults, host-side.
+
+    One stop for observability surfaces — the worker backend publishes
+    this as the ``dbx_fused_substrate_info`` gauge labels so a fleet
+    operator can read per-worker which epilogue / table / lane-block
+    substrate is serving without grepping logs (GetStats ``obs_json``,
+    ``/stats.json``, ``obs.dump``). Raises on invalid env values — the
+    same validation the sweep call would hit, surfaced at backend start.
+    """
+    out = {"epilogue": _resolve_epilogue(None),
+           "lanes_cap": str(resolve_lanes_cap())}
+    for fam, (env_var, default) in _TABLE_FAMILIES.items():
+        out[f"table_{fam}"] = _resolve_table(None, env_var, default)
+    return out
+
+
+def route_substrates(strategy: str) -> dict:
+    """``{"epilogue": ..., "table": ...}`` the named strategy's sweep would
+    run under right now (env-resolved defaults) — the label set for the
+    per-group ``dbx_fused_substrate_total`` route counter."""
+    fam = _STRATEGY_TABLE_FAMILY.get(strategy)
+    table = ("hbm" if fam is None
+             else _resolve_table(None, *_TABLE_FAMILIES[fam]))
+    return {"epilogue": _resolve_epilogue(None), "table": table}
+
+
 def fused_momentum_sweep(close, lookback, *, t_real=None, cost: float = 0.0,
                          periods_per_year: int = 252,
                          interpret: bool | None = None,
-                         table: str | None = None) -> Metrics:
+                         table: str | None = None,
+                         epilogue: str | None = None) -> Metrics:
     """Fused time-series momentum sweep: ``(N, T)`` closes x ``(P,)`` lanes.
 
     Matches ``run_sweep(..., "momentum")`` with an *exact* signal (the
@@ -1758,15 +2000,16 @@ def fused_momentum_sweep(close, lookback, *, t_real=None, cost: float = 0.0,
                            T_real=T if t_real is None else None,
                            cost=float(cost), ppy=int(periods_per_year),
                            interpret=bool(interpret),
-                           table=_resolve_table(table, "DBX_MOM_TABLE",
-                                                "inline"),
-                           lanes_env=resolve_lanes_cap())
+                           table=_family_table("mom", table),
+                           lanes_env=resolve_lanes_cap(),
+                           epilogue=_resolve_epilogue(epilogue))
 
 
 def fused_donchian_sweep(close, window, *, t_real=None, cost: float = 0.0,
                          periods_per_year: int = 252,
                          interpret: bool | None = None,
-                         table: str | None = None) -> Metrics:
+                         table: str | None = None,
+                         epilogue: str | None = None) -> Metrics:
     """Fused Donchian-breakout sweep: ``(N, T)`` closes x ``(P,)`` lanes.
 
     Matches ``run_sweep(..., "donchian")``: the channel extrema are exact
@@ -1789,14 +2032,15 @@ def fused_donchian_sweep(close, window, *, t_real=None, cost: float = 0.0,
                            T_real=T if t_real is None else None,
                            cost=float(cost), ppy=int(periods_per_year),
                            interpret=bool(interpret),
-                           table=_resolve_table(table, "DBX_DON_TABLE",
-                                                "hbm"))
+                           table=_family_table("don", table),
+                           epilogue=_resolve_epilogue(epilogue))
 
 
 def fused_donchian_hl_sweep(close, high, low, window, *, t_real=None,
                             cost: float = 0.0, periods_per_year: int = 252,
                             interpret: bool | None = None,
-                            table: str | None = None) -> Metrics:
+                            table: str | None = None,
+                            epilogue: str | None = None) -> Metrics:
     """Fused high/low-channel Donchian sweep: ``(N, T)`` panels x ``(P,)``.
 
     Matches ``run_sweep(..., "donchian_hl")`` — breakout when the close
@@ -1821,18 +2065,18 @@ def fused_donchian_hl_sweep(close, high, low, window, *, t_real=None,
                            T_real=T if t_real is None else None,
                            cost=float(cost), ppy=int(periods_per_year),
                            interpret=bool(interpret),
-                           table=_resolve_table(table, "DBX_DON_TABLE",
-                                                "hbm"))
+                           table=_family_table("don", table),
+                           epilogue=_resolve_epilogue(epilogue))
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
-                     "ppy", "interpret"))
+                     "ppy", "interpret", "epilogue"))
 def _fused_stoch_call(close, high, low, onehot_w, band_lanes, warm, t_real,
                       *, windows: tuple, T_pad: int, W_pad: int, P_real: int,
                       T_real: int | None, cost: float, ppy: int,
-                      interpret: bool):
+                      interpret: bool, epilogue: str = _EPILOGUE_DEFAULT):
     """%K table prep + the *Bollinger* kernel: the centered stochastic
     oscillator is just another z-score feeding the shared band machine
     (enter beyond ±band, exit at the 50 centerline: z_exit = 0).
@@ -1843,6 +2087,7 @@ def _fused_stoch_call(close, high, low, onehot_w, band_lanes, warm, t_real,
     ``models.stochastic`` path; the %K arithmetic replicates
     ``stochastic_k``'s float op order (flat channels fall back to the
     neutral 50)."""
+    epilogue = _interp_epilogue(epilogue, T_pad, interpret)
     close_p = _pad_last(close, T_pad)
     hi_tbl = _extrema_table(_pad_last(high, T_pad), windows, "max", 1e30)
     lo_tbl = _extrema_table(_pad_last(low, T_pad), windows, "min", -1e30)
@@ -1856,7 +2101,7 @@ def _fused_stoch_call(close, high, low, onehot_w, band_lanes, warm, t_real,
     z_table = _pad_w(jnp.where((t_row >= w_col - 1)[None], k_tbl, 0.0),
                      W_pad)
     kernel = functools.partial(_boll_kernel, cost=cost, ppy=ppy,
-                               z_exit=0.0, T_real=T_real)
+                               z_exit=0.0, T_real=T_real, epilogue=epilogue)
     return _band_machine_pallas(
         kernel, close_p, z_table, onehot_w, band_lanes, warm, t_real,
         T_pad=T_pad, W_pad=W_pad, P_real=P_real, T_real=T_real,
@@ -1865,7 +2110,8 @@ def _fused_stoch_call(close, high, low, onehot_w, band_lanes, warm, t_real,
 
 def fused_stochastic_sweep(close, high, low, window, band, *, t_real=None,
                            cost: float = 0.0, periods_per_year: int = 252,
-                           interpret: bool | None = None) -> Metrics:
+                           interpret: bool | None = None,
+                           epilogue: str | None = None) -> Metrics:
     """Fused stochastic-%K reversion sweep: ``(N, T)`` panels x ``(P,)``.
 
     ``window``/``band`` are flat per-combo arrays (:func:`product_grid`
@@ -1894,17 +2140,19 @@ def fused_stochastic_sweep(close, high, low, window, band, *, t_real=None,
                              P_real=window.shape[0],
                              T_real=T if t_real is None else None,
                              cost=float(cost), ppy=int(periods_per_year),
-                             interpret=bool(interpret))
+                             interpret=bool(interpret),
+                             epilogue=_resolve_epilogue(epilogue))
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
-                     "ppy", "interpret"))
+                     "ppy", "interpret", "epilogue"))
 def _fused_keltner_call(close, high, low, onehot_w, k_lanes, warm, t_real,
                         *, windows: tuple, T_pad: int, W_pad: int,
                         P_real: int, T_real: int | None, cost: float,
-                        ppy: int, interpret: bool):
+                        ppy: int, interpret: bool,
+                        epilogue: str = _EPILOGUE_DEFAULT):
     """Keltner z-table prep + the *Bollinger* kernel: the ATR-normalized
     deviation from the EMA midline feeds the shared band machine (enter
     beyond ±k ATRs, exit at the midline re-cross: z_exit = 0).
@@ -1916,6 +2164,7 @@ def _fused_keltner_call(close, high, low, onehot_w, k_lanes, warm, t_real,
     the generic path's NaN-filled rolling mean makes ``atr > eps`` False
     and the deviation falls back to exactly 0 — are forced to 0, as is the
     zero-ATR (constant-price) fallback."""
+    epilogue = _interp_epilogue(epilogue, T_pad, interpret)
     close_p = _pad_last(close, T_pad)
     high_p = _pad_last(high, T_pad)
     low_p = _pad_last(low, T_pad)
@@ -1934,7 +2183,7 @@ def _fused_keltner_call(close, high, low, onehot_w, k_lanes, warm, t_real,
     z_table = _pad_w(jnp.where(have, dev / (atr + _EPS), 0.0), W_pad)
 
     kernel = functools.partial(_boll_kernel, cost=cost, ppy=ppy,
-                               z_exit=0.0, T_real=T_real)
+                               z_exit=0.0, T_real=T_real, epilogue=epilogue)
     return _band_machine_pallas(
         kernel, close_p, z_table, onehot_w, k_lanes, warm, t_real,
         T_pad=T_pad, W_pad=W_pad, P_real=P_real, T_real=T_real,
@@ -1943,7 +2192,8 @@ def _fused_keltner_call(close, high, low, onehot_w, k_lanes, warm, t_real,
 
 def fused_keltner_sweep(close, high, low, window, k, *, t_real=None,
                         cost: float = 0.0, periods_per_year: int = 252,
-                        interpret: bool | None = None) -> Metrics:
+                        interpret: bool | None = None,
+                        epilogue: str | None = None) -> Metrics:
     """Fused Keltner-channel reversion sweep: ``(N, T)`` panels x ``(P,)``.
 
     ``window``/``k`` are flat per-combo arrays (:func:`product_grid`
@@ -1972,7 +2222,8 @@ def fused_keltner_sweep(close, high, low, window, k, *, t_real=None,
                                P_real=window.shape[0],
                                T_real=T if t_real is None else None,
                                cost=float(cost), ppy=int(periods_per_year),
-                               interpret=bool(interpret))
+                               interpret=bool(interpret),
+                               epilogue=_resolve_epilogue(epilogue))
 
 
 @functools.lru_cache(maxsize=8)
@@ -1995,11 +2246,11 @@ def _single_window_grid_setup(vals_bytes: bytes, warm_offset: float,
 @functools.partial(
     jax.jit,
     static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
-                     "ppy", "interpret"))
+                     "ppy", "interpret", "epilogue"))
 def _fused_rsi_call(close, onehot_p, band_lanes, warm, t_real, *,
                     windows: tuple, T_pad: int, W_pad: int, P_real: int,
                     T_real: int | None, cost: float, ppy: int,
-                    interpret: bool):
+                    interpret: bool, epilogue: str = _EPILOGUE_DEFAULT):
     """RSI table prep + the *Bollinger* kernel: ``rsi - 50`` is just another
     z-score feeding the shared band machine (enter beyond ±band, exit at the
     centerline), so the whole kernel is reused verbatim with z_exit=0.
@@ -2009,6 +2260,7 @@ def _fused_rsi_call(close, onehot_p, band_lanes, warm, t_real, *,
     ``models.rsi.rsi_index``'s formula per window, float-order modulo the
     scan algorithm.
     """
+    epilogue = _interp_epilogue(epilogue, T_pad, interpret)
     close_p = _pad_last(close, T_pad)
     diff = jnp.diff(close_p, axis=-1, prepend=close_p[..., :1])
     gains = jnp.maximum(diff, 0.0)
@@ -2026,7 +2278,7 @@ def _fused_rsi_call(close, onehot_p, band_lanes, warm, t_real, *,
     z_tbl = _pad_w(jnp.stack(rows, axis=1), W_pad)               # (N,W,T_pad)
 
     kernel = functools.partial(_boll_kernel, cost=cost, ppy=ppy,
-                               z_exit=0.0, T_real=T_real)
+                               z_exit=0.0, T_real=T_real, epilogue=epilogue)
     return _band_machine_pallas(
         kernel, close_p, z_tbl, onehot_p, band_lanes, warm, t_real,
         T_pad=T_pad, W_pad=W_pad, P_real=P_real, T_real=T_real,
@@ -2035,7 +2287,8 @@ def _fused_rsi_call(close, onehot_p, band_lanes, warm, t_real, *,
 
 def fused_rsi_sweep(close, period, band, *, t_real=None, cost: float = 0.0,
                     periods_per_year: int = 252,
-                    interpret: bool | None = None) -> Metrics:
+                    interpret: bool | None = None,
+                    epilogue: str | None = None) -> Metrics:
     """Fused RSI mean-reversion sweep: ``(N, T)`` closes x ``(P,)`` lanes.
 
     ``period``/``band`` are flat per-combo arrays (:func:`product_grid`
@@ -2056,7 +2309,8 @@ def fused_rsi_sweep(close, period, band, *, t_real=None, cost: float = 0.0,
                            W_pad=onehot_p.shape[0], P_real=period.shape[0],
                            T_real=T if t_real is None else None,
                            cost=float(cost), ppy=int(periods_per_year),
-                           interpret=bool(interpret))
+                           interpret=bool(interpret),
+                           epilogue=_resolve_epilogue(epilogue))
 
 
 @functools.lru_cache(maxsize=4)
@@ -2100,39 +2354,41 @@ def _ema_ladder(x, a):
     return B
 
 
-def _macd_kernel(r_ref, ema_ref, of_ref, os_ref, asig_ref, warm_ref, *refs,
-                 cost: float, ppy: int, T_real: int | None):
-    """MACD cell: two span-table selections give the macd line; the signal
+def _macd_kernel(r_ref, ema_ref, od_ref, asig_ref, warm_ref, *refs,
+                 cost: float, ppy: int, T_real: int | None, epilogue: str):
+    """MACD cell: one span-table selection gives the macd line; the signal
     line is a per-lane EMA (decay = 2/(signal_span+1)) evaluated with the
     in-kernel associative ladder; position = sign(macd - signal)."""
     tr, out_ref = _unpack_tr(refs, T_real)
     T_pad = r_ref.shape[1]
     r = r_ref[0]
     dn = (((0,), (0,)), ((), ()))
-    # Difference one-hot (+1 fast row, -1 slow row): one matmul yields the
-    # macd line directly — same trick as the SMA kernel, half the MXU work.
-    macd = jax.lax.dot_general(ema_ref[0], of_ref[:] - os_ref[:], dn,
+    # Difference one-hot (+1 fast row, -1 slow row), built HOST-side like
+    # the SMA selector: one matmul yields the macd line directly — half
+    # the MXU work and selector stream of separate f/s selections.
+    macd = jax.lax.dot_general(ema_ref[0], od_ref[:], dn,
                                preferred_element_type=jnp.float32,
                                precision=jax.lax.Precision.HIGHEST)
     a_sig = asig_ref[0, :][None, :]                  # (1, lanes)
     sig = _ema_ladder(macd, a_sig)
 
-    lanes = of_ref.shape[1]          # widest legal param block (launcher)
+    lanes = od_ref.shape[1]          # widest legal param block (launcher)
     t_idx = jax.lax.broadcasted_iota(jnp.int32, (T_pad, lanes), 0)
     warm = warm_ref[0, :][None, :]                   # slow + signal - 1
     valid = t_idx >= (warm.astype(jnp.int32) - 1)
     pos = jnp.where(valid, jnp.sign(macd - sig), 0.0)
-    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
+    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy,
+                                  epilogue=epilogue)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("spans", "T_pad", "W_pad", "P_real", "T_real", "cost",
-                     "ppy", "interpret"))
-def _fused_macd_call(close, onehot_f, onehot_s, a_sig, warm, t_real, *,
+                     "ppy", "interpret", "epilogue"))
+def _fused_macd_call(close, onehot_d, a_sig, warm, t_real, *,
                      spans: tuple, T_pad: int, W_pad: int, P_real: int,
                      T_real: int | None, cost: float, ppy: int,
-                     interpret: bool):
+                     interpret: bool, epilogue: str = _EPILOGUE_DEFAULT):
     """Distinct-span EMA table prep + pallas call in one jit.
 
     The EMA table is built from the *demeaned* close — ``macd`` is
@@ -2140,6 +2396,7 @@ def _fused_macd_call(close, onehot_f, onehot_s, a_sig, warm, t_real, *,
     demeaned series keeps the f32 error proportional to price deviations
     rather than price level. Returns still come from the raw series.
     """
+    epilogue = _interp_epilogue(epilogue, T_pad, interpret)
     close_p = _pad_last(close, T_pad)
     N = close.shape[0]
     close_dm = close_p - close_p[..., :1]
@@ -2156,7 +2413,7 @@ def _fused_macd_call(close, onehot_f, onehot_s, a_sig, warm, t_real, *,
     lanes = _widest_lanes(P_pad, 256)
     n_blocks = P_pad // lanes
     kernel = functools.partial(_macd_kernel, cost=cost, ppy=ppy,
-                               T_real=T_real)
+                               T_real=T_real, epilogue=epilogue)
     out = pl.pallas_call(
         kernel,
         grid=(N, n_blocks),
@@ -2164,8 +2421,6 @@ def _fused_macd_call(close, onehot_f, onehot_s, a_sig, warm, t_real, *,
             pl.BlockSpec((1, T_pad, 1), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, W_pad, T_pad), lambda i, j: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((W_pad, lanes), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((W_pad, lanes), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
@@ -2180,7 +2435,7 @@ def _fused_macd_call(close, onehot_f, onehot_s, a_sig, warm, t_real, *,
         out_shape=jax.ShapeDtypeStruct(
             (N, n_blocks, _METRIC_ROWS, lanes), jnp.float32),
         interpret=interpret,
-    )(_rets3(close_p), ema_tbl, onehot_f, onehot_s, a_sig, warm,
+    )(_rets3(close_p), ema_tbl, onehot_d, a_sig, warm,
       *_tr_args(t_real, T_real))
     return Metrics(*(
         jnp.reshape(out[:, :, k, :], (N, P_pad))[:, :P_real]
@@ -2189,7 +2444,8 @@ def _fused_macd_call(close, onehot_f, onehot_s, a_sig, warm, t_real, *,
 
 def fused_macd_sweep(close, fast, slow, signal, *, t_real=None,
                      cost: float = 0.0, periods_per_year: int = 252,
-                     interpret: bool | None = None) -> Metrics:
+                     interpret: bool | None = None,
+                     epilogue: str | None = None) -> Metrics:
     """Fused MACD signal-line crossover sweep: ``(N, T)`` x ``(P,)`` lanes.
 
     ``fast``/``slow``/``signal`` are flat per-combo span arrays
@@ -2207,17 +2463,18 @@ def fused_macd_sweep(close, fast, slow, signal, *, t_real=None,
     slow = np.asarray(slow)
     signal = np.asarray(signal)
     T = close.shape[1]
-    spans, onehot_f, onehot_s, a_sig, warm = _macd_grid_setup(
+    spans, onehot_d, a_sig, warm = _macd_grid_setup(
         fast.astype(np.float32).tobytes(),
         slow.astype(np.float32).tobytes(),
         signal.astype(np.float32).tobytes())
-    return _fused_macd_call(close, onehot_f, onehot_s, a_sig, warm,
+    return _fused_macd_call(close, onehot_d, a_sig, warm,
                             _t_real_col(t_real, close),
                             spans=spans, T_pad=_round_up(T, 128),
-                            W_pad=onehot_f.shape[0], P_real=fast.shape[0],
+                            W_pad=onehot_d.shape[0], P_real=fast.shape[0],
                             T_real=T if t_real is None else None,
                             cost=float(cost), ppy=int(periods_per_year),
-                            interpret=bool(interpret))
+                            interpret=bool(interpret),
+                            epilogue=_resolve_epilogue(epilogue))
 
 
 @functools.lru_cache(maxsize=4)
@@ -2233,18 +2490,20 @@ def _macd_grid_setup(fast_bytes: bytes, slow_bytes: bytes,
     _distinct_windows(signal, "signal spans")   # validate integrality only
     W_pad = _round_up(max(spans.shape[0], 1), 8)
     P_pad = _round_up(max(P, 1), _LANES)
-    oh_f = _window_onehot(spans, fast, W_pad, P_pad)
-    oh_s = _window_onehot(spans, slow, W_pad, P_pad)
+    # ONE difference selector (+1 fast row, -1 slow row), the SMA
+    # `_grid_setup` discipline: exact 0/±1 integers, half the stream.
+    oh_d = (_window_onehot(spans, fast, W_pad, P_pad)
+            - _window_onehot(spans, slow, W_pad, P_pad))
     a_sig = np.zeros((1, P_pad), np.float32)
     a_sig[0, :P] = 2.0 / (signal + 1.0)
     warm = np.ones((1, P_pad), np.float32)
     warm[0, :P] = slow + signal - 1.0
-    return (tuple(int(s) for s in spans), _const(oh_f),
-            _const(oh_s), _const(a_sig), _const(warm))
+    return (tuple(int(s) for s in spans), _const(oh_d),
+            _const(a_sig), _const(warm))
 
 
 def _obv_signal_tail(sma_tbl, r, obv, oh_ref, warm_ref, tr, out_ref, *,
-                     cost: float, ppy: int):
+                     cost: float, ppy: int, epilogue: str):
     """Shared OBV selection + metrics tail (both table substrates).
 
     One window-table selection gives the OBV rolling mean; position =
@@ -2264,19 +2523,20 @@ def _obv_signal_tail(sma_tbl, r, obv, oh_ref, warm_ref, tr, out_ref, *,
     warm = warm_ref[0, :][None, :]               # (1, lanes) = window
     valid = t_idx >= (warm.astype(jnp.int32) - 1)
     pos = jnp.where(valid, jnp.sign(obv - sma), 0.0)
-    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
+    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy,
+                                  epilogue=epilogue)
 
 
 def _obv_kernel(r_ref, obv_ref, sma_ref, oh_ref, warm_ref, *refs,
-                cost: float, ppy: int, T_real: int | None):
+                cost: float, ppy: int, T_real: int | None, epilogue: str):
     tr, out_ref = _unpack_tr(refs, T_real)
     _obv_signal_tail(sma_ref[0], r_ref[0], obv_ref[0], oh_ref, warm_ref,
-                     tr, out_ref, cost=cost, ppy=ppy)
+                     tr, out_ref, cost=cost, ppy=ppy, epilogue=epilogue)
 
 
 def _obv_kernel_inline(r_ref, obv_ref, cs_ref, oh_ref, warm_ref, *refs,
                        cost: float, ppy: int, T_real: int | None,
-                       windows: tuple, W_pad: int):
+                       windows: tuple, W_pad: int, epilogue: str):
     """OBV with the SMA-of-OBV table built in VMEM scratch from the OBV
     cumsum row (`_build_sma_scratch` — the SMA kernel's builder on a
     different series). Same division-lowering caveat as the SMA inline
@@ -2290,18 +2550,18 @@ def _obv_kernel_inline(r_ref, obv_ref, cs_ref, oh_ref, warm_ref, *refs,
         _build_sma_scratch(cs_ref[0], sma_scr, windows, W_pad)
 
     _obv_signal_tail(sma_scr[:], r_ref[0], obv_ref[0], oh_ref, warm_ref,
-                     tr, out_ref, cost=cost, ppy=ppy)
+                     tr, out_ref, cost=cost, ppy=ppy, epilogue=epilogue)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
-                     "ppy", "interpret", "table", "lanes_env"))
+                     "ppy", "interpret", "table", "lanes_env", "epilogue"))
 def _fused_obv_call(close, volume, onehot_w, warm, t_real, *,
                     windows: tuple, T_pad: int, W_pad: int, P_real: int,
                     T_real: int | None, cost: float, ppy: int,
                     interpret: bool, table: str = "hbm",
-                    lanes_env: int = 0):
+                    lanes_env: int = 0, epilogue: str = _EPILOGUE_DEFAULT):
     """OBV series + distinct-window SMA table prep + pallas call in one jit.
 
     The OBV accumulator is the SHARED ``rolling.obv_series`` (the same
@@ -2313,6 +2573,7 @@ def _fused_obv_call(close, volume, onehot_w, warm, t_real, *,
     from . import rolling
 
     N, T = close.shape
+    epilogue = _interp_epilogue(epilogue, T_pad, interpret)
     close_p = _pad_last(close, T_pad)
     vol_p = _pad_last(volume, T_pad)
     obv = rolling.obv_series(close_p, vol_p)                   # (N, T_pad)
@@ -2325,7 +2586,7 @@ def _fused_obv_call(close, volume, onehot_w, warm, t_real, *,
         cs = jnp.cumsum(obv, axis=1)[:, None, :]               # (N,1,T_pad)
         kernel = functools.partial(_obv_kernel_inline, cost=cost, ppy=ppy,
                                    T_real=T_real, windows=windows,
-                                   W_pad=W_pad)
+                                   W_pad=W_pad, epilogue=epilogue)
         table_arg = cs
         table_spec = pl.BlockSpec((1, 1, T_pad), lambda i, j: (i, 0, 0),
                                   memory_space=pltpu.VMEM)
@@ -2338,7 +2599,7 @@ def _fused_obv_call(close, volume, onehot_w, warm, t_real, *,
         # shift prep materialized W lane-minor (N, T_pad, 1) rows — a
         # 12.8x-class HBM blow-up that OOM'd at 500 tickers.
         kernel = functools.partial(_obv_kernel, cost=cost, ppy=ppy,
-                                   T_real=T_real)
+                                   T_real=T_real, epilogue=epilogue)
         table_arg = _sma_table(obv, windows, W_pad)
         table_spec = pl.BlockSpec((1, W_pad, T_pad), lambda i, j: (i, 0, 0),
                                   memory_space=pltpu.VMEM)
@@ -2374,7 +2635,8 @@ def _fused_obv_call(close, volume, onehot_w, warm, t_real, *,
 def fused_obv_sweep(close, volume, window, *, t_real=None, cost: float = 0.0,
                     periods_per_year: int = 252,
                     interpret: bool | None = None,
-                    table: str | None = None) -> Metrics:
+                    table: str | None = None,
+                    epilogue: str | None = None) -> Metrics:
     """Fused OBV-trend sweep: ``(N, T)`` closes+volumes x ``(P,)`` windows.
 
     ``window`` is a flat per-combo window array (:func:`product_grid`
@@ -2401,9 +2663,9 @@ def fused_obv_sweep(close, volume, window, *, t_real=None, cost: float = 0.0,
                            T_real=T if t_real is None else None,
                            cost=float(cost), ppy=int(periods_per_year),
                            interpret=bool(interpret),
-                           table=_resolve_table(table, "DBX_OBV_TABLE",
-                                                "inline"),
-                           lanes_env=resolve_lanes_cap())
+                           table=_family_table("obv", table),
+                           lanes_env=resolve_lanes_cap(),
+                           epilogue=_resolve_epilogue(epilogue))
 
 
 @functools.lru_cache(maxsize=4)
@@ -2421,7 +2683,7 @@ def _obv_grid_setup(window_bytes: bytes):
 
 
 def _trix_kernel(r_ref, ema_ref, oh_ref, asig_ref, warm_ref, *refs,
-                 cost: float, ppy: int, T_real: int | None):
+                 cost: float, ppy: int, T_real: int | None, epilogue: str):
     """TRIX cell: one span-table selection gives the triple-smoothed close;
     the one-bar rate of change is computed in-kernel (a ratio, so the price
     level cancels); the signal line is a per-lane EMA ladder; position =
@@ -2447,18 +2709,20 @@ def _trix_kernel(r_ref, ema_ref, oh_ref, asig_ref, warm_ref, *refs,
     warm = warm_ref[0, :][None, :]                   # 3*span + signal - 2
     valid = t_idx >= (warm.astype(jnp.int32) - 1)
     pos = jnp.where(valid, jnp.sign(trix - sig), 0.0)
-    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy)
+    out_ref[0, 0] = _metrics_tail(pos, r, t_idx, tr, cost=cost, ppy=ppy,
+                                  epilogue=epilogue)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("spans", "T_pad", "W_pad", "P_real", "T_real", "cost",
-                     "ppy", "interpret"))
+                     "ppy", "interpret", "epilogue"))
 def _fused_trix_call(close, onehot, a_sig, warm, t_real, *,
                      spans: tuple, T_pad: int, W_pad: int, P_real: int,
                      T_real: int | None, cost: float, ppy: int,
-                     interpret: bool):
+                     interpret: bool, epilogue: str = _EPILOGUE_DEFAULT):
     """Distinct-span triple-EMA table prep + pallas call in one jit."""
+    epilogue = _interp_epilogue(epilogue, T_pad, interpret)
     close_p = _pad_last(close, T_pad)
     N = close.shape[0]
     rows = []
@@ -2479,7 +2743,7 @@ def _fused_trix_call(close, onehot, a_sig, warm, t_real, *,
     lanes = _widest_lanes(P_pad, _LANES)
     n_blocks = P_pad // lanes
     kernel = functools.partial(_trix_kernel, cost=cost, ppy=ppy,
-                               T_real=T_real)
+                               T_real=T_real, epilogue=epilogue)
     out = pl.pallas_call(
         kernel,
         grid=(N, n_blocks),
@@ -2510,7 +2774,8 @@ def _fused_trix_call(close, onehot, a_sig, warm, t_real, *,
 
 def fused_trix_sweep(close, span, signal, *, t_real=None, cost: float = 0.0,
                      periods_per_year: int = 252,
-                     interpret: bool | None = None) -> Metrics:
+                     interpret: bool | None = None,
+                     epilogue: str | None = None) -> Metrics:
     """Fused TRIX signal-line crossover sweep: ``(N, T)`` x ``(P,)`` lanes.
 
     ``span``/``signal`` are flat per-combo span arrays (:func:`product_grid`
@@ -2536,7 +2801,8 @@ def fused_trix_sweep(close, span, signal, *, t_real=None, cost: float = 0.0,
                             W_pad=onehot.shape[0], P_real=span.shape[0],
                             T_real=T if t_real is None else None,
                             cost=float(cost), ppy=int(periods_per_year),
-                            interpret=bool(interpret))
+                            interpret=bool(interpret),
+                            epilogue=_resolve_epilogue(epilogue))
 
 
 @functools.lru_cache(maxsize=4)
@@ -2562,11 +2828,11 @@ def _trix_grid_setup(span_bytes: bytes, signal_bytes: bytes):
 @functools.partial(
     jax.jit,
     static_argnames=("windows", "T_pad", "W_pad", "P_real", "T_real", "cost",
-                     "ppy", "interpret"))
+                     "ppy", "interpret", "epilogue"))
 def _fused_vwap_call(close, volume, onehot_w, k_lanes, warm, t_real, *,
                      windows: tuple, T_pad: int, W_pad: int, P_real: int,
                      T_real: int | None, cost: float, ppy: int,
-                     interpret: bool):
+                     interpret: bool, epilogue: str = _EPILOGUE_DEFAULT):
     """VWAP-deviation z-table prep + the *Bollinger* kernel.
 
     ``models.vwap`` vectorized over the distinct-window axis: rolling VWAP =
@@ -2583,6 +2849,7 @@ def _fused_vwap_call(close, volume, onehot_w, k_lanes, warm, t_real, *,
     zero-volume-window fallback.
     """
     T = close.shape[1]
+    epilogue = _interp_epilogue(epilogue, T_pad, interpret)
     close_p = _pad_last(close, T_pad)
     vol_p = _pad_last(volume, T_pad)
     w_col, w_f, t_row, windowed_sum, windowed_sum3 = _cumsum_window_tools(
@@ -2606,7 +2873,7 @@ def _fused_vwap_call(close, volume, onehot_w, k_lanes, warm, t_real, *,
                      W_pad)
 
     kernel = functools.partial(_boll_kernel, cost=cost, ppy=ppy,
-                               z_exit=0.0, T_real=T_real)
+                               z_exit=0.0, T_real=T_real, epilogue=epilogue)
     return _band_machine_pallas(
         kernel, close_p, z_table, onehot_w, k_lanes, warm, t_real,
         T_pad=T_pad, W_pad=W_pad, P_real=P_real, T_real=T_real,
@@ -2615,7 +2882,8 @@ def _fused_vwap_call(close, volume, onehot_w, k_lanes, warm, t_real, *,
 
 def fused_vwap_sweep(close, volume, window, k, *, t_real=None,
                      cost: float = 0.0, periods_per_year: int = 252,
-                     interpret: bool | None = None) -> Metrics:
+                     interpret: bool | None = None,
+                     epilogue: str | None = None) -> Metrics:
     """Fused VWAP-deviation reversion sweep: ``(N, T)`` panels x ``(P,)``.
 
     ``window``/``k`` are flat per-combo arrays (:func:`product_grid` order);
@@ -2642,7 +2910,8 @@ def fused_vwap_sweep(close, volume, window, k, *, t_real=None,
                             T_pad=_round_up(T, 128), W_pad=onehot_w.shape[0],
                             P_real=P, T_real=T if t_real is None else None,
                             cost=float(cost), ppy=int(periods_per_year),
-                            interpret=bool(interpret))
+                            interpret=bool(interpret),
+                            epilogue=_resolve_epilogue(epilogue))
 
 
 @functools.lru_cache(maxsize=4)

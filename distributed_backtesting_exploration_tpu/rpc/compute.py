@@ -240,6 +240,23 @@ class JaxSweepBackend:
             for phase in ("cold", "warm")}
         self._kern_h: dict = {}    # (strategy, route, cold) -> Histogram
         self._seen_cold: set = set()
+        # Live fused-kernel substrate defaults (epilogue / table / lanes
+        # cap): an info-style gauge whose LABELS carry the values, so
+        # /metrics, /stats.json, GetStats obs_json and `obs.dump` all show
+        # per-worker which substrate is serving without reading logs
+        # (DESIGN.md "Roofline accounting"). Resolved once here — the same
+        # env validation the first sweep would hit, surfaced at backend
+        # construction instead of mid-batch.
+        from ..ops import fused as fused_ops
+
+        self._fused_ops = fused_ops
+        reg.gauge("dbx_fused_substrate_info",
+                  help="constant 1; labels carry the live fused-kernel "
+                       "substrate defaults (epilogue/table/lanes)",
+                  **fused_ops.substrate_defaults()).set(1)
+        # (strategy, epilogue, table) -> Counter: which substrate served
+        # each fused job group (the per-group twin of the info gauge).
+        self._substrate_counters: dict = {}
         # jit caches per input SHAPE, not just per program key: a cached
         # mesh fn hit with a new (rows, bars) signature recompiles for
         # seconds and must not be attributed as "warm" async launch.
@@ -279,6 +296,20 @@ class JaxSweepBackend:
                 kernel=f"{route}:{strategy}",
                 phase="compile" if cold else "execute")
         h.observe(dt)
+
+    def _observe_substrates(self, strategy: str) -> None:
+        """Count a fused group against the substrate set that served it
+        (``dbx_fused_substrate_total{kernel,epilogue,table}``)."""
+        subs = self._fused_ops.route_substrates(strategy)
+        key = (strategy, subs["epilogue"], subs["table"])
+        c = self._substrate_counters.get(key)
+        if c is None:
+            c = self._substrate_counters[key] = self._obs.counter(
+                "dbx_fused_substrate_total",
+                help="fused job groups served, by kernel and "
+                     "epilogue/table substrate",
+                kernel=strategy, **subs)
+        c.inc()
 
     @property
     def chips(self) -> int:
@@ -661,15 +692,17 @@ class JaxSweepBackend:
                     np.asarray(t_real, np.int32).reshape(-1, 1), n_pad),
                 row))
 
-        # The lanes cap must be part of the cache key: the fused runners
-        # read DBX_LANES_CAP (host-side, via resolve_lanes_cap) while this
-        # outer jit(shard_map) traces, so without it an in-process cap
-        # change would silently reuse the stale lane width on the mesh
-        # path — the same cache-key bug class the single-device path fixed
-        # by threading lanes_env as a jit static (dbxlint trace-time-env).
-        from ..ops.fused import resolve_lanes_cap
+        # Every env-resolved kernel substrate must be part of the cache
+        # key: the fused runners read DBX_LANES_CAP / DBX_EPILOGUE /
+        # DBX_*_TABLE (host-side, via their resolve helpers) while this
+        # outer jit(shard_map) traces, so without them an in-process
+        # substrate change would silently reuse the stale compiled
+        # program on the mesh path — the same cache-key bug class the
+        # single-device path fixed by threading each knob as a jit static
+        # (dbxlint trace-time-env).
+        from ..ops.fused import substrate_defaults
 
-        key = key + (ragged, resolve_lanes_cap())
+        key = key + (ragged,) + tuple(sorted(substrate_defaults().items()))
         fn = self._mesh_fns.get(key)
         if fn is None:
             from ..ops.metrics import Metrics
@@ -942,6 +975,7 @@ class JaxSweepBackend:
                               for f in spec.fields]
                     t_real = np.asarray(lengths, np.int32)
                 cost = group[0].cost
+                self._observe_substrates(group[0].strategy)
                 if self._mesh is not None:
                     run = spec.run
 
@@ -1190,6 +1224,7 @@ class JaxSweepBackend:
             log.info("walk-forward jobs %s (%s, P=%d) using the "
                      "fused-train route", [j.id for j, _ in good],
                      job0.strategy, sweep_mod.grid_size(grid))
+            self._observe_substrates(job0.strategy)
             if self._mesh is not None:
                 def runner(*blks):
                     r = walkforward.walk_forward_fused(
@@ -1405,6 +1440,7 @@ class JaxSweepBackend:
         if self.use_fused and demotion is None:
             from ..ops import fused
 
+            self._observe_substrates("pairs")
             plb = np.asarray(grid["lookback"])
             pze = np.asarray(grid["z_entry"])
             pzx = (np.asarray(grid["z_exit"]) if "z_exit" in grid else 0.0)

@@ -21,6 +21,22 @@ import jax  # noqa: E402  (flags must be set first)
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache shared across test processes/runs: the
+# suite's wall on the 1-core box is dominated by CPU compiles (SPMD
+# partitioning, interpret-mode pallas), and every entry is keyed by the HLO
+# hash so re-runs of unchanged kernels skip straight to execution (measured
+# cross-process hit on this box). Threshold configs are best-effort — names
+# have drifted across jax generations.
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("DBX_TEST_COMPILE_CACHE",
+                                 "/tmp/dbx_test_jax_cache"))
+for _opt, _val in (("jax_persistent_cache_min_compile_time_secs", 0.5),
+                   ("jax_persistent_cache_min_entry_size_bytes", 0)):
+    try:
+        jax.config.update(_opt, _val)
+    except Exception:  # pragma: no cover - older/newer jax
+        pass
+
 import pytest  # noqa: E402
 
 

@@ -1,6 +1,7 @@
 """Donchian breakout, traced-window extrema, trace utils, fused routing."""
 
 import logging
+import warnings
 
 import jax.numpy as jnp
 import numpy as np
@@ -8,7 +9,12 @@ import numpy as np
 from distributed_backtesting_exploration_tpu.models.base import get_strategy
 from distributed_backtesting_exploration_tpu.ops import rolling
 from distributed_backtesting_exploration_tpu.parallel import sweep
-from distributed_backtesting_exploration_tpu.utils import data, trace
+from distributed_backtesting_exploration_tpu.utils import data
+
+with warnings.catch_warnings():
+    # The deprecation shim over obs is exactly what this module exercises.
+    warnings.simplefilter("ignore", DeprecationWarning)
+    from distributed_backtesting_exploration_tpu.utils import trace
 
 
 def test_rolling_extrema_traced_matches_static():

@@ -235,3 +235,44 @@ def test_utils_trace_shim_warns_and_reexports():
     assert shim.timed is obs_trace.timed
     assert shim.StepTimer is obs_trace.StepTimer
     assert shim.device_profile is obs_trace.device_profile
+
+
+# ---------------------------------------------------------------------------
+# Fused-kernel substrate observability (worker backend)
+# ---------------------------------------------------------------------------
+
+def test_backend_publishes_substrate_info_and_route_counters(monkeypatch):
+    """A fleet operator must be able to read which epilogue/table/lane
+    substrate a worker serves from GetStats obs_json / /stats.json alone:
+    the backend publishes an info gauge at construction and counts every
+    fused group into dbx_fused_substrate_total."""
+    import numpy as np
+
+    from distributed_backtesting_exploration_tpu.rpc import (
+        backtesting_pb2 as pb, compute, wire)
+    from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+        synthetic_jobs)
+
+    monkeypatch.delenv("DBX_EPILOGUE", raising=False)
+    monkeypatch.delenv("DBX_SMA_TABLE", raising=False)
+    monkeypatch.delenv("DBX_LANES_CAP", raising=False)
+    backend = compute.JaxSweepBackend(use_fused=True, use_mesh=False)
+    summ = obs.get_registry().summaries(prefix="dbx_fused_substrate_info")
+    info = [k for k in summ if "epilogue=scan" in k]
+    assert info, f"substrate info gauge missing: {summ}"
+    assert any("table_sma=inline" in k and "lanes_cap=0" in k for k in info)
+
+    (rec,) = synthetic_jobs(1, 64, "sma_crossover",
+                            {"fast": np.asarray([3.0], np.float32),
+                             "slow": np.asarray([10.0], np.float32)},
+                            seed=5)
+    spec = pb.JobSpec(id=rec.id, strategy=rec.strategy, ohlcv=rec.ohlcv,
+                      grid=wire.grid_to_proto(rec.grid), cost=rec.cost,
+                      periods_per_year=252)
+    (done,) = backend.process([spec])
+    assert done.metrics   # the group really ran fused
+    summ = obs.get_registry().summaries(prefix="dbx_fused_substrate_total")
+    key = [k for k in summ
+           if "kernel=sma_crossover" in k and "epilogue=scan" in k
+           and "table=inline" in k]
+    assert key and summ[key[0]] >= 1

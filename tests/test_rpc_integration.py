@@ -496,18 +496,12 @@ def test_empty_queue_returns_empty_reply_not_error():
         srv.stop()
 
 
-def test_every_registered_strategy_travels_the_wire():
-    """Completeness: each strategy in the registry round-trips through the
-    worker backend (decode, grid materialization, routing, metric packing)
-    and matches the direct sweep on the same panels — no family is
-    CLI/RPC-only on paper."""
-    import jax.numpy as jnp
-
-    from distributed_backtesting_exploration_tpu.models import base, pairs
-    from distributed_backtesting_exploration_tpu.parallel import sweep
-    from distributed_backtesting_exploration_tpu.utils import data
-
-    grids = {
+# Wire-contract grid table for every registered strategy (+ the two-legged
+# pairs path). Tier-1 runs the four structurally distinct decode shapes
+# (test_representative_strategies_travel_the_wire); the full-registry loop
+# is its slow twin — each family costs a ~4s generic-path CPU compile and
+# the per-kernel fused/generic parity lives elsewhere in tier-1.
+_WIRE_GRIDS = {
         "sma_crossover": {"fast": np.float32([3, 5]),
                           "slow": np.float32([13.0])},
         "momentum": {"lookback": np.float32([5, 10])},
@@ -531,10 +525,18 @@ def test_every_registered_strategy_travels_the_wire():
                            "k": np.float32([1.0])},
         "pairs": {"lookback": np.float32([10.0]),
                   "z_entry": np.float32([1.0])},
-    }
-    # Pairs is the two-legged path (models/pairs.py), not a registry entry.
-    assert set(grids) - {"pairs"} == set(base.available_strategies()), (
-        "registry changed; extend this test's grid table")
+}
+
+
+def _assert_strategies_travel_the_wire(grids):
+    """Each strategy round-trips through the worker backend (decode, grid
+    materialization, routing, metric packing) and matches the direct sweep
+    on the same panels — no family is CLI/RPC-only on paper."""
+    import jax.numpy as jnp
+
+    from distributed_backtesting_exploration_tpu.models import base, pairs
+    from distributed_backtesting_exploration_tpu.parallel import sweep
+    from distributed_backtesting_exploration_tpu.utils import data
 
     backend = compute.JaxSweepBackend(use_fused=False)
     for strategy, grid in grids.items():
@@ -573,6 +575,28 @@ def test_every_registered_strategy_travels_the_wire():
                     np.asarray(getattr(want, name))[i],
                     rtol=2e-4, atol=2e-5,
                     err_msg=f"{strategy}/{name}")
+
+
+def test_representative_strategies_travel_the_wire():
+    """Tier-1 twin of the full-registry loop: the four structurally distinct
+    wire shapes — single-field close-only (sma), multi-valued multi-axis
+    grid ordering (bollinger), 3-param grid (macd), and the two-legged
+    ohlcv2 path (pairs). Also pins the registry against _WIRE_GRIDS so a new
+    strategy family can't dodge the slow completeness loop unnoticed."""
+    from distributed_backtesting_exploration_tpu.models import base
+
+    # Pairs is the two-legged path (models/pairs.py), not a registry entry.
+    assert set(_WIRE_GRIDS) - {"pairs"} == set(base.available_strategies()), (
+        "registry changed; extend _WIRE_GRIDS")
+    rep = ("sma_crossover", "bollinger", "macd", "pairs")
+    _assert_strategies_travel_the_wire({k: _WIRE_GRIDS[k] for k in rep})
+
+
+@pytest.mark.slow   # ~4s generic-path CPU compile per family, x14 families
+def test_every_registered_strategy_travels_the_wire():
+    rest = {k: v for k, v in _WIRE_GRIDS.items()
+            if k not in ("sma_crossover", "bollinger", "macd", "pairs")}
+    _assert_strategies_travel_the_wire(rest)
 
 
 def test_walkforward_jobs_over_the_wire_match_direct():

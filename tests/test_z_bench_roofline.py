@@ -1,0 +1,98 @@
+"""Tier-1 smoke for bench.py's roofline_stages scaffold.
+
+The per-stage attribution is the instrument every roofline decision in
+DESIGN.md is cut from; a silent bitrot there (stage kernel drifting from
+ops/fused.py, a renamed attribution key) would invalidate the next round's
+measurements without failing anything. This runs the REAL scaffold
+in-process on tiny shapes (CPU interpret mode) and asserts the attribution
+keys exist and every stage time is positive — a structure test, not a
+performance test.
+
+(Named ``test_z_*`` deliberately: tier-1 runs under a fixed wall budget
+that can truncate the alphabetical tail on slow boxes — additions must be
+the tests a truncation drops, never the seed suite.)
+"""
+
+import contextlib
+import io
+import json
+import os
+
+import pytest
+
+import bench
+
+_TINY_ENV = {
+    "DBX_BENCH_CPU": "1", "DBX_BENCH_TICKERS": "2", "DBX_BENCH_BARS": "64",
+    "DBX_BENCH_PARAMS": "8", "DBX_BENCH_ITERS": "1",
+    "DBX_BENCH_WARMUP": "0", "DBX_BENCH_CACHE": "",
+    "DBX_BENCH_CONFIGS": "roofline_stages",
+}
+
+
+@pytest.fixture(scope="module")
+def roofline():
+    """One tiny in-process roofline_stages run, shared by the module."""
+    prior = {k: os.environ.get(k) for k in _TINY_ENV}
+    prior["DBX_EPILOGUE"] = os.environ.pop("DBX_EPILOGUE", None)
+    os.environ.update(_TINY_ENV)
+    bench.ROOFLINE.clear()
+    buf = io.StringIO()
+    try:
+        with contextlib.redirect_stdout(buf):
+            bench.main()
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return json.loads(buf.getvalue().strip().splitlines()[-1])
+
+
+SMA_STAGE_KEYS = (
+    "prep_l128_s_per_sweep", "touch_l128_s_per_sweep",
+    "matmul_l128_s_per_sweep", "signal_l128_s_per_sweep",
+    "no_ladders_l128_s_per_sweep", "full_l128_s_per_sweep",
+    "full_ladder_l128_s_per_sweep", "table_hbm_s_per_sweep",
+    "table_inline_s_per_sweep", "epilogue_scan_s_per_sweep",
+    "epilogue_ladder_s_per_sweep",
+)
+BOLL_STAGE_KEYS = (
+    "prep_l128_s_per_sweep", "touch_l128_s_per_sweep",
+    "matmul_l128_s_per_sweep", "signal_l128_s_per_sweep",
+    "signal_ladder_l128_s_per_sweep", "no_ladders_l128_s_per_sweep",
+    "full_l128_s_per_sweep", "full_ladder_l128_s_per_sweep",
+    "epilogue_scan_s_per_sweep", "epilogue_ladder_s_per_sweep",
+)
+ATTRIBUTION_KEYS = (
+    "selection_matmul_pct", "signal_delta_pct", "reductions_delta_pct",
+    "ladders_delta_pct", "ladder_fallback_delta_pct",
+    "epilogue_scan_speedup", "epilogue_e2e_speedup",
+)
+
+
+def test_sma_stage_attribution_present(roofline):
+    stages = roofline["roofline"]["sma_stages"]
+    for key in SMA_STAGE_KEYS:
+        assert key in stages, key
+        assert stages[key] > 0.0, key
+    for key in ATTRIBUTION_KEYS + ("inline_table_speedup",):
+        assert key in stages, key
+    assert stages["epilogue_scan_speedup"] > 0.0
+    assert stages["inline_table_speedup"] > 0.0
+
+
+def test_bollinger_stage_attribution_present(roofline):
+    stages = roofline["roofline"]["bollinger_stages"]
+    for key in BOLL_STAGE_KEYS:
+        assert key in stages, key
+        assert stages[key] > 0.0, key
+    for key in ATTRIBUTION_KEYS + ("compose_delta_pct",
+                                   "compose_ladder_delta_pct"):
+        assert key in stages, key
+
+
+def test_roofline_rates_reported(roofline):
+    assert roofline["configs"]["roofline_stages_full"] > 0.0
+    assert roofline["configs"]["roofline_stages_boll_full"] > 0.0

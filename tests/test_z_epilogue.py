@@ -1,0 +1,281 @@
+"""Epilogue substrate parity: single-pass carry scan vs ladder fallback.
+
+The "scan" epilogue (default) replaces the metrics tail's two full-T shift
+ladders and the band machines' 3-state compose ladder with ONE sequential
+pass over T-blocks carrying state between blocks (ops/fused.py
+`_equity_scan` / `_compose3_path`). The contract these tests pin, per
+kernel, on CPU interpret mode:
+
+- Position paths are BIT-IDENTICAL across substrates (the compose scan is
+  pure selection — no float arithmetic), so every position-derived metric
+  (sharpe, sortino, volatility, hit_rate, n_trades, turnover) must be
+  bit-exact between substrates.
+- The equity-path metrics (max_drawdown, total_return, cagr) may differ by
+  the f32 summation-association class only (~1 ULP): the blocked cumsum
+  sums the same values in a different tree than the full-T ladder. They
+  must agree to tight float tolerance, never a knife-edge flip (flips come
+  from positions, which are exact).
+
+Covered for all 14 fused kernels, including unaligned T (padding rows in
+the final scan block), ragged per-ticker ``t_real``, and multi-T-block
+shapes (pinned ``scan:<B>`` schedules of 3 blocks per kernel plus a
+17-block deep-chain case on the flagship). The fused-vs-generic
+golden tests in test_fused.py run under the shipped scan default, gating
+the scan substrate against the semantics-defining path as well.
+
+(Named ``test_z_*`` deliberately: tier-1 runs under a fixed wall budget
+that can truncate the alphabetical tail on slow boxes — additions must be
+the tests a truncation drops, never the seed suite.)
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_backtesting_exploration_tpu.ops import fused
+from distributed_backtesting_exploration_tpu.utils import data
+
+# Metrics derived from positions / plain sums: bit-exact across substrates.
+EXACT_FIELDS = ("sharpe", "sortino", "volatility", "hit_rate", "n_trades",
+                "turnover")
+# Metrics through the equity path: blocked-vs-full summation order differs.
+PATH_FIELDS = ("max_drawdown", "total_return", "cagr")
+
+
+def _assert_substrate_parity(run, name, scan="scan:32"):
+    # "scan:32" pins a REAL multi-block schedule (~4 blocks at these T):
+    # the plain "scan" default re-blocks to a single block in interpret
+    # mode for test-wall economy (ops/fused.py `_interp_epilogue`), which
+    # would not drive the carries across block boundaries.
+    a = run(scan)
+    b = run("ladder")
+    for field in EXACT_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, field)), np.asarray(getattr(b, field)),
+            err_msg=f"{name}.{field} (position/sum metrics must be "
+                    "bit-exact across epilogue substrates)")
+    for field in PATH_FIELDS:
+        np.testing.assert_allclose(
+            np.asarray(getattr(a, field)), np.asarray(getattr(b, field)),
+            rtol=1e-5, atol=1e-6,
+            err_msg=f"{name}.{field} (equity-path metrics carry only "
+                    "f32 association rounding)")
+
+
+def _panel(n, T, seed):
+    ohlcv = data.synthetic_ohlcv(n, T, seed=seed)
+    return type(ohlcv)(*(jnp.asarray(f) for f in ohlcv))
+
+
+def _ragged_panel(n, T, lengths, seed):
+    """Panel honoring the ragged contract: bars at ``t >= t_real`` hold
+    the last real value (every serving path pads repeat-last —
+    `_stack_field_ragged` / `pad_and_stack`). The kernels' padding
+    discipline REQUIRES this: pad bars then earn exactly zero return, so
+    plain reductions over T_pad equal the unpadded ones. Junk data beyond
+    ``t_real`` is outside the contract (the substrates would read it
+    through different reductions)."""
+    ohlcv = data.synthetic_ohlcv(n, T, seed=seed)
+    fields = []
+    for f in ohlcv:
+        a = np.asarray(f).copy()
+        for i, t in enumerate(lengths):
+            a[i, t:] = a[i, t - 1]
+        fields.append(jnp.asarray(a))
+    return type(ohlcv)(*fields)
+
+
+_W = np.asarray([10.0, 17.0, 26.0], np.float32)
+_K = np.asarray([0.8, 1.5, 2.2], np.float32)
+
+
+def _kernel_cases(panel, t_real):
+    """One callable per fused kernel: (name, run(epilogue))."""
+    c, h, lo, v = panel.close, panel.high, panel.low, panel.volume
+    fa = np.asarray([3.0, 5.0, 8.0], np.float32)
+    sl = np.asarray([13.0, 21.0, 34.0], np.float32)
+    sig = np.asarray([4.0, 9.0, 6.0], np.float32)
+    kw = dict(t_real=t_real, cost=1e-3)
+    return [
+        ("sma", lambda e: fused.fused_sma_sweep(c, fa, sl, epilogue=e,
+                                                **kw)),
+        ("bollinger", lambda e: fused.fused_bollinger_sweep(
+            c, _W, _K, epilogue=e, **kw)),
+        ("bollinger_touch", lambda e: fused.fused_bollinger_touch_sweep(
+            c, _W, _K, epilogue=e, **kw)),
+        ("momentum", lambda e: fused.fused_momentum_sweep(
+            c, _W, epilogue=e, **kw)),
+        ("donchian", lambda e: fused.fused_donchian_sweep(
+            c, _W, epilogue=e, **kw)),
+        ("donchian_hl", lambda e: fused.fused_donchian_hl_sweep(
+            c, h, lo, _W, epilogue=e, **kw)),
+        ("rsi", lambda e: fused.fused_rsi_sweep(
+            c, _W, np.asarray([15.0, 20.0, 25.0], np.float32),
+            epilogue=e, **kw)),
+        ("stochastic", lambda e: fused.fused_stochastic_sweep(
+            c, h, lo, _W, np.asarray([20.0, 25.0, 30.0], np.float32),
+            epilogue=e, **kw)),
+        ("keltner", lambda e: fused.fused_keltner_sweep(
+            c, h, lo, _W, _K, epilogue=e, **kw)),
+        ("macd", lambda e: fused.fused_macd_sweep(
+            c, fa, sl, sig, epilogue=e, **kw)),
+        ("trix", lambda e: fused.fused_trix_sweep(
+            c, fa, sig, epilogue=e, **kw)),
+        ("vwap", lambda e: fused.fused_vwap_sweep(
+            c, v, _W, _K, epilogue=e, **kw)),
+        ("obv", lambda e: fused.fused_obv_sweep(
+            c, v, _W, epilogue=e, **kw)),
+    ]
+
+
+_UNIFORM = _panel(2, 96, seed=101)
+_CASE_NAMES = [n for n, _ in _kernel_cases(_UNIFORM, None)]
+
+
+# One uniform-history (t_real=None) spot check on the flagship pins the
+# scan epilogue's no-ragged-mask path; the ragged+unaligned
+# parametrization below walks ALL kernels — every additional uniform
+# repeat is interpret-mode wall (~4-7s each) for no new code path, and
+# tier-1 runs under a fixed budget.
+@pytest.mark.parametrize("name", ["sma"])
+def test_epilogue_parity_uniform(name):
+    cases = dict(_kernel_cases(_UNIFORM, None))
+    _assert_substrate_parity(cases[name], name)
+
+
+@pytest.mark.parametrize("name", _CASE_NAMES)
+def test_epilogue_parity_unaligned_T_ragged(name):
+    # T=84 (pad rows land inside the final scan blocks) + ragged
+    # per-ticker real lengths: the carries must freeze at each ticker's
+    # tr under the padding discipline. (Substrate-vs-substrate parity is
+    # assertion-by-construction, not golden values, so the smallest T
+    # that still crosses scan:32 block boundaries for every length is
+    # the right tier-1 shape.)
+    t_real = np.asarray([84, 64, 44], np.int32)
+    panel = _ragged_panel(3, 84, t_real, seed=103)
+    cases = dict(_kernel_cases(panel, t_real))
+    _assert_substrate_parity(cases[name], name)
+
+
+def test_epilogue_parity_pairs_ragged():
+    # The 14th kernel: pairs shares _metrics_pack and the band compose.
+    # (Ragged-only: the uniform flavor adds no substrate path beyond it,
+    # and tier-1 runs under a fixed wall budget.)
+    t_real = np.asarray([96, 64], np.int32)
+    closes = jnp.asarray(np.concatenate([
+        np.asarray(_ragged_panel(2, 96, t_real, seed=109).close),
+        np.asarray(_ragged_panel(2, 96, t_real, seed=110).close)]))
+    y, x = closes[:2], closes[2:]
+    lb = np.asarray([10.0, 20.0], np.float32)
+    ze = np.asarray([1.0, 1.5], np.float32)
+    _assert_substrate_parity(
+        lambda e: fused.fused_pairs_sweep(y, x, lb, ze, t_real=t_real,
+                                          cost=1e-3, epilogue=e),
+        "pairs_ragged")
+
+
+def test_scan_block_override_is_equivalent():
+    # "scan:<B>" pins the T-block size; positions are exact for any B, so
+    # the exact fields must match the default scan bit-for-bit and the
+    # path fields to association tolerance.
+    c = _UNIFORM.close
+    fa = np.asarray([3.0, 5.0], np.float32)
+    sl = np.asarray([13.0, 21.0], np.float32)
+    a = fused.fused_sma_sweep(c, fa, sl, cost=1e-3, epilogue="scan")
+    b = fused.fused_sma_sweep(c, fa, sl, cost=1e-3, epilogue="scan:64")
+    for field in EXACT_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, field)), np.asarray(getattr(b, field)),
+            err_msg=field)
+    for field in PATH_FIELDS:
+        np.testing.assert_allclose(
+            np.asarray(getattr(a, field)), np.asarray(getattr(b, field)),
+            rtol=1e-5, atol=1e-6, err_msg=field)
+
+
+def test_deep_block_chain_sma():
+    # The production TPU default is an 8-row block (17 chained carries
+    # at this T). Drive that depth once on the flagship kernel so long
+    # carry chains (not just one boundary crossing) are covered.
+    t_real = np.asarray([136, 90], np.int32)
+    panel = _ragged_panel(2, 136, t_real, seed=113)
+    fa = np.asarray([3.0, 5.0], np.float32)
+    sl = np.asarray([13.0, 21.0], np.float32)
+    _assert_substrate_parity(
+        lambda e: fused.fused_sma_sweep(panel.close, fa, sl, t_real=t_real,
+                                        cost=1e-3, epilogue=e),
+        "sma_deep", scan="scan:8")
+
+
+def test_single_block_scan_is_bit_identical_to_ladder():
+    # With T_pad inside ONE scan block the carry path degenerates
+    # (carry = 0, peak carry = -inf): every metric must be bit-identical
+    # to the ladder substrate except total_return/cagr, whose final-sum
+    # read differs in association even single-block (documented).
+    c = _panel(2, 64, seed=111).close
+    fa = np.asarray([3.0, 5.0], np.float32)
+    sl = np.asarray([13.0, 21.0], np.float32)
+    a = fused.fused_sma_sweep(c, fa, sl, cost=1e-3, epilogue="scan:64")
+    b = fused.fused_sma_sweep(c, fa, sl, cost=1e-3, epilogue="ladder")
+    for field in EXACT_FIELDS + ("max_drawdown",):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, field)), np.asarray(getattr(b, field)),
+            err_msg=field)
+
+
+def test_epilogue_env_default(monkeypatch):
+    # DBX_EPILOGUE routes the default exactly like the explicit argument.
+    c = _UNIFORM.close
+    fa = np.asarray([3.0, 5.0], np.float32)
+    sl = np.asarray([13.0, 21.0], np.float32)
+    explicit = fused.fused_sma_sweep(c, fa, sl, cost=1e-3,
+                                     epilogue="ladder")
+    monkeypatch.setenv("DBX_EPILOGUE", "ladder")
+    via_env = fused.fused_sma_sweep(c, fa, sl, cost=1e-3)
+    for field in explicit._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(explicit, field)),
+            np.asarray(getattr(via_env, field)), err_msg=field)
+
+
+def test_epilogue_rejects_bad_values(monkeypatch):
+    for bad in ("scans", "scan:7", "scan:0", "scan:-8", "scan:x", "lad"):
+        with pytest.raises(ValueError, match="epilogue"):
+            fused._resolve_epilogue(bad)
+        monkeypatch.setenv("DBX_EPILOGUE", bad)
+        with pytest.raises(ValueError, match="epilogue"):
+            fused.fused_sma_sweep(
+                jnp.ones((1, 64)) + jnp.arange(64.0),
+                np.asarray([3.0], np.float32),
+                np.asarray([10.0], np.float32))
+    monkeypatch.delenv("DBX_EPILOGUE")
+    assert fused._resolve_epilogue(None) == "scan"
+    assert fused._resolve_epilogue("scan:16") == "scan:16"
+
+
+def test_scan_block_schedule_bounds_unroll():
+    # The default schedule starts at one sublane tile and doubles until
+    # the unrolled block count fits the Mosaic program-size bound.
+    assert fused._scan_block(200, "scan") == 8
+    assert fused._scan_block(2048, "scan") == 8
+    assert fused._scan_block(2056, "scan") == 16
+    assert fused._scan_block(8192, "scan") == 32
+    assert fused._scan_block(8192, "scan:8") == 8
+
+
+def test_substrate_defaults_and_route_substrates(monkeypatch):
+    monkeypatch.delenv("DBX_EPILOGUE", raising=False)
+    monkeypatch.delenv("DBX_SMA_TABLE", raising=False)
+    d = fused.substrate_defaults()
+    assert d["epilogue"] == "scan"
+    assert d["table_sma"] == "inline"
+    assert d["table_don"] == "hbm"       # measured wash, default stays hbm
+    assert fused.route_substrates("sma_crossover") == {
+        "epilogue": "scan", "table": "inline"}
+    # strategies without a table knob always stream the XLA table
+    assert fused.route_substrates("keltner")["table"] == "hbm"
+    assert fused.route_substrates("pairs")["table"] == "hbm"
+    monkeypatch.setenv("DBX_EPILOGUE", "ladder")
+    monkeypatch.setenv("DBX_SMA_TABLE", "hbm")
+    d = fused.substrate_defaults()
+    assert d["epilogue"] == "ladder" and d["table_sma"] == "hbm"
