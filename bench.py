@@ -146,6 +146,14 @@ def main():
         sweep, walkforward)
     from distributed_backtesting_exploration_tpu.utils import data
 
+    # The e2e/dispatch configs push thousands of traced jobs (~5 spans
+    # each) through the in-process loop; the default 512-span ring would
+    # retain only the last ~100 jobs for the end-of-run "timeline"
+    # digest. Size it to hold a full config's spans (torn heads are
+    # dropped and counted by summarize_spans either way).
+    from distributed_backtesting_exploration_tpu import obs as _obs
+    _obs.configure_ring(32768)
+
     n_tickers = int(os.environ.get("DBX_BENCH_TICKERS", 500))
     n_bars = int(os.environ.get("DBX_BENCH_BARS", 1260))      # 5y daily
     n_params = int(os.environ.get("DBX_BENCH_PARAMS", 2000))
@@ -1311,6 +1319,8 @@ def main():
     # into BENCH JSON gives the roofline numbers their runtime
     # counterparts (metric names in DESIGN.md "Observability").
     from distributed_backtesting_exploration_tpu import obs as obs_mod
+    from distributed_backtesting_exploration_tpu.obs import (
+        timeline as timeline_mod)
 
     print(json.dumps({
         "metric": metric,
@@ -1323,6 +1333,13 @@ def main():
         # binding resource); see the roofline comment in main().
         "roofline": ROOFLINE,
         "obs": obs_mod.get_registry().summaries(prefix="dbx_"),
+        # Distributed-trace digest of the e2e configs: the dispatcher+
+        # worker loops run in-process, so the completed-span ring already
+        # holds every job's stitched timeline — critical-path stage
+        # attribution (queue-wait/dispatch/transport/decode/compile/
+        # execute/d2h/report) and straggler flags, no JSONL file needed.
+        # {} when no traced e2e config ran (kernel-only benches).
+        "timeline": timeline_mod.summarize_spans(obs_mod.recent_spans()),
     }))
 
 

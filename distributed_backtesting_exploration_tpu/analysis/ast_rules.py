@@ -1,6 +1,6 @@
 """dbxlint AST-layer rules.
 
-Four rules over parsed source, all sharing one scope model
+Five rules over parsed source, all sharing one scope model
 (:class:`_Scope`): a tree of function-like nodes (def / async def /
 lambda) with bare-name resolution walking lexically outward. Class bodies
 are transparent for scoping (names defined in a class body are NOT
@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import re
 
 from .core import Finding, LintContext, PyFile
 
@@ -545,3 +546,127 @@ class BlockingCallRule:
                     "loop): it stalls the shared thread pool or starves "
                     "the liveness heartbeat"))
         return out
+
+
+# ---------------------------------------------------------------------------
+# Rule 5: obs-cardinality
+# ---------------------------------------------------------------------------
+
+class ObsCardinalityRule:
+    """Metric label values derived from unbounded runtime data.
+
+    Every distinct label value is a NEW time series held forever by the
+    registry, carried in every ``/metrics`` scrape, every ``/stats.json``
+    snapshot, every GetStats ``obs_json`` payload and every BENCH obs
+    blob. A label fed from job ids, file paths, peer addresses, trace ids
+    or similar unbounded runtime data therefore grows the metric surface
+    without bound over a fleet run — exactly the data that belongs in
+    span/event ATTRS (the JSONL log and the span ring are per-event, not
+    per-series) or in a bounded label like ``method``/``pool``/``kernel``.
+
+    Detection is lexical + one assignment hop: a label value that is (or
+    is built from — f-strings, concatenation, ``str(...)``/``format``
+    wrappers) an identifier matching the unbounded-data vocabulary
+    (``*_id``, ``jid``, ``path``, ``addr``, ``peer``, ``trace`` ...), or
+    a local name assigned from one (``wid = self.worker_id``). Bounded
+    exceptions that are real design decisions (e.g. per-worker gauges
+    whose children are removed on worker exit) carry an inline
+    suppression with the justification.
+    """
+
+    name = "obs-cardinality"
+    doc = "metric label value derived from unbounded runtime data"
+
+    _METRIC_CALLS = {"counter", "gauge", "histogram", "gauge_fn"}
+    # Non-label kwargs of the registry constructors.
+    _SKIP_KWARGS = {"help", "buckets", "fn"}
+    _UNBOUNDED = re.compile(
+        r"(?:^|_)(?:id|ids|jid|uid|uuid|guid|key|token|path|paths|file|"
+        r"filename|dir|addr|address|peer|host|hostname|port|url|uri|"
+        r"target|trace|span)(?:$|_)")
+
+    def check(self, ctx: LintContext) -> list[Finding]:
+        out: list[Finding] = []
+        for pf in ctx.files:
+            module, scopes = _build_scopes(pf.tree)
+            for scope in [module] + scopes:
+                assigns = self._scope_assigns(scope)
+                for node in scope.own_nodes():
+                    if not (isinstance(node, ast.Call)
+                            and _terminal_name(node.func)
+                            in self._METRIC_CALLS):
+                        continue
+                    for kw in node.keywords:
+                        if kw.arg is None or kw.arg in self._SKIP_KWARGS:
+                            # **splats are opaque here; the registry's own
+                            # pass-through (`self.gauge(name, **labels)`)
+                            # and dict-built label sets are judged at
+                            # their construction site, not the splat.
+                            continue
+                        src = self._suspicious(kw.value, assigns)
+                        if src is not None:
+                            out.append(Finding(
+                                self.name, pf.rel, node.lineno,
+                                f"label `{kw.arg}` is fed from unbounded "
+                                f"runtime data (`{src}`): every distinct "
+                                "value becomes a permanent time series — "
+                                "use a bounded label set, or carry the id "
+                                "in span/event attrs instead"))
+        return out
+
+    @staticmethod
+    def _scope_assigns(scope: _Scope) -> dict:
+        """Simple ``name = expr`` bindings of this scope (last wins) —
+        the one-hop alias map (`wid = self.worker_id`). ``own_nodes``
+        yields in stack (reverse-source) order, so keep the binding with
+        the greatest line number, not the last one yielded."""
+        out: dict[str, ast.AST] = {}
+        lines: dict[str, int] = {}
+        for node in scope.own_nodes():
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) \
+                            and node.lineno >= lines.get(t.id, -1):
+                        lines[t.id] = node.lineno
+                        out[t.id] = node.value
+        return out
+
+    @classmethod
+    def _suspicious(cls, expr: ast.AST, assigns: dict,
+                    depth: int = 0) -> str | None:
+        """The offending identifier when ``expr`` derives from unbounded
+        runtime data, else None. Constants are always clean; containers
+        (f-strings, concatenation, str()/format calls) are scanned
+        recursively; a bare local name follows ONE assignment hop."""
+        if isinstance(expr, ast.Constant):
+            return None
+        if isinstance(expr, ast.Name):
+            if cls._UNBOUNDED.search(expr.id):
+                return expr.id
+            if depth == 0 and expr.id in assigns:
+                hit = cls._suspicious(assigns[expr.id], assigns, 1)
+                if hit is not None:
+                    return f"{expr.id} = {hit}"
+            return None
+        if isinstance(expr, ast.Attribute):
+            if cls._UNBOUNDED.search(expr.attr):
+                return _dotted(expr) or expr.attr
+            return None
+        if isinstance(expr, ast.JoinedStr):
+            for v in expr.values:
+                if isinstance(v, ast.FormattedValue):
+                    hit = cls._suspicious(v.value, assigns, depth)
+                    if hit is not None:
+                        return hit
+            return None
+        if isinstance(expr, ast.BinOp):
+            return (cls._suspicious(expr.left, assigns, depth)
+                    or cls._suspicious(expr.right, assigns, depth))
+        if isinstance(expr, ast.Call):
+            # str(x), "{}".format(x), "|".join(xs): judge the arguments.
+            for a in list(expr.args) + [k.value for k in expr.keywords]:
+                hit = cls._suspicious(a, assigns, depth)
+                if hit is not None:
+                    return hit
+            return None
+        return None

@@ -218,6 +218,7 @@ def all_rules() -> list:
         ast_rules.LockDisciplineRule(),
         ast_rules.ImportTimeConfigRule(),
         ast_rules.BlockingCallRule(),
+        ast_rules.ObsCardinalityRule(),
         jaxpr_rules.KernelHygieneRule(),
         proto_rules.ProtoDriftRule(),
     ]

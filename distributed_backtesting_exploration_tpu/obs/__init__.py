@@ -4,13 +4,17 @@ The one place every layer records into (DESIGN.md "Observability"):
 
 - :mod:`.registry` — process-local counters / gauges / fixed-bucket
   histograms, rendered as Prometheus text or JSON summaries;
-- :mod:`.trace` — the span API (phase attribution + nesting), ``timed``,
+- :mod:`.trace` — the span API (phase attribution + nesting + the
+  distributed ``trace_id``/``span_id``/``parent_id`` triple), ``timed``,
   ``StepTimer``, ``device_profile`` (absorbed from ``utils.trace``, which
   is now a deprecation shim);
 - :mod:`.events` — opt-in JSONL event log (``DBX_OBS_JSONL``) for
   post-mortem trace reconstruction;
 - :mod:`.http` — the ``/metrics`` + ``/stats.json`` HTTP surface;
-- :mod:`.dump` — ``python -m ...obs.dump`` pretty-printer / phase table.
+- :mod:`.dump` — ``python -m ...obs.dump`` pretty-printer / phase table;
+- :mod:`.timeline` — merge JSONL logs from any number of processes into
+  per-job lifecycle timelines with critical-path stage attribution
+  (``python -m ...obs.timeline``).
 """
 
 from . import events  # noqa: F401
@@ -18,4 +22,6 @@ from .http import MetricsServer, start_metrics_server  # noqa: F401
 from .registry import (  # noqa: F401
     LATENCY_BUCKETS_S, Counter, Gauge, Histogram, Registry, get_registry)
 from .trace import (  # noqa: F401
-    StepTimer, current_span, device_profile, span, timed, timer)
+    StepTimer, configure_ring, current_span, current_trace, device_profile,
+    emit_span, job_trace_pairs, new_span_id, new_trace_id, recent_spans,
+    span, timed, timer, trace_context)

@@ -1,10 +1,15 @@
-"""Phase-attribution viewer: ``python -m ...obs.dump <target>``.
+"""Phase-attribution viewer: ``python -m ...obs.dump <target>...``.
 
-``target`` is either a live endpoint (``http://host:port`` — its
+Each ``target`` is either a live endpoint (``http://host:port`` — its
 ``/stats.json`` is fetched) or a JSONL event-log path (``DBX_OBS_JSONL``
-output). Either way the output is a phase table: where wall-clock went,
-by span/histogram, share-ranked — the live counterpart of bench.py's
-roofline stage accounting.
+output; also acceptable via ``--jsonl``). All JSONL inputs aggregate
+into ONE phase table (a fleet writes one log per process); malformed
+lines are skipped and counted, and a run that parses ZERO events exits
+non-zero — an empty table from a typo'd path must not read as a healthy
+quiet fleet. The output is a phase table: where wall-clock went, by
+span/histogram, share-ranked — the live counterpart of bench.py's
+roofline stage accounting. For per-JOB lifecycle timelines and
+critical-path stage attribution, see :mod:`.timeline`.
 """
 
 from __future__ import annotations
@@ -14,24 +19,7 @@ import json
 import sys
 import urllib.request
 
-
-def _fmt_s(v: float) -> str:
-    if v >= 1.0:
-        return f"{v:.3f}s"
-    if v >= 1e-3:
-        return f"{v * 1e3:.2f}ms"
-    return f"{v * 1e6:.0f}us"
-
-
-def _table(rows: list[tuple], header: tuple) -> str:
-    rows = [tuple(str(c) for c in r) for r in rows]
-    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
-              for i, h in enumerate(header)]
-    def line(cells):
-        return "  ".join(c.ljust(w) for c, w in zip(cells, widths)).rstrip()
-    out = [line(header), line(tuple("-" * w for w in widths))]
-    out.extend(line(r) for r in rows)
-    return "\n".join(out)
+from .timeline import _fmt_s, _table, parse_events
 
 
 def _phase_rows(digests: dict) -> list[tuple]:
@@ -80,32 +68,29 @@ def render_snapshot(snap: dict) -> str:
     return "\n".join(out) if out else "(no metrics recorded)\n"
 
 
-def render_jsonl(path: str) -> str:
-    """Aggregate a span event log into the phase table."""
+def render_jsonl(paths) -> tuple[str, int, int]:
+    """Aggregate one or more span event logs into the phase table.
+
+    Returns ``(text, n_events, n_malformed)`` — malformed lines (torn
+    tails, truncated writes) are skipped and counted, never fatal and
+    never silent."""
+    if isinstance(paths, str):
+        paths = [paths]
+    events, malformed = parse_events(paths)
     agg: dict[str, dict] = {}
-    n_events = 0
-    with open(path, encoding="utf-8") as fh:
-        for line in fh:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                continue   # torn tail is diagnostic-grade, skip quietly
-            n_events += 1
-            if rec.get("ev") != "span":
-                continue
-            name = rec.get("name", "?")
-            if rec.get("parent"):
-                name = f"{rec['parent']}/{name}"
-            dur = float(rec.get("dur_s", 0.0))
-            d = agg.setdefault(name, {"count": 0, "sum": 0.0, "max": 0.0,
-                                      "durs": []})
-            d["count"] += 1
-            d["sum"] += dur
-            d["max"] = max(d["max"], dur)
-            d["durs"].append(dur)
+    for rec in events:
+        if rec.get("ev") != "span":
+            continue
+        name = rec.get("name", "?")
+        if rec.get("parent"):
+            name = f"{rec['parent']}/{name}"
+        dur = float(rec.get("dur_s", 0.0))
+        d = agg.setdefault(name, {"count": 0, "sum": 0.0, "max": 0.0,
+                                  "durs": []})
+        d["count"] += 1
+        d["sum"] += dur
+        d["max"] = max(d["max"], dur)
+        d["durs"].append(dur)
     digests = {}
     for name, d in agg.items():
         durs = sorted(d["durs"])
@@ -115,27 +100,48 @@ def render_jsonl(path: str) -> str:
             "p50": durs[len(durs) // 2],
             "p99": durs[min(len(durs) - 1, int(len(durs) * 0.99))]}
     rows = _phase_rows(digests)
-    head = f"{n_events} events, {len(agg)} span phases from {path}"
+    head = (f"{len(events)} events, {len(agg)} span phases from "
+            + ", ".join(paths))
+    if malformed:
+        head += f" ({malformed} malformed line(s) skipped)"
     if not rows:
-        return head + "\n(no span events)\n"
-    return head + "\n" + _table(rows, _PHASE_HEADER) + "\n"
+        return head + "\n(no span events)\n", len(events), malformed
+    return (head + "\n" + _table(rows, _PHASE_HEADER) + "\n",
+            len(events), malformed)
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="pretty-print a dbx obs endpoint or JSONL event log "
-                    "as a phase-attribution table")
-    ap.add_argument("target",
+        description="pretty-print dbx obs endpoints and/or JSONL event "
+                    "logs as a phase-attribution table")
+    ap.add_argument("targets", nargs="*", default=[],
                     help="http://host:port of a live /metrics server, or "
-                         "a JSONL event-log path")
+                         "JSONL event-log path(s)")
+    ap.add_argument("--jsonl", nargs="+", action="extend", default=[],
+                    metavar="PATH",
+                    help="additional JSONL event log(s); all JSONL inputs "
+                         "aggregate into one table")
     args = ap.parse_args(argv)
-    if args.target.startswith(("http://", "https://")):
-        url = args.target.rstrip("/") + "/stats.json"
+    urls = [t for t in args.targets
+            if t.startswith(("http://", "https://"))]
+    jsonl = [t for t in args.targets
+             if not t.startswith(("http://", "https://"))] + args.jsonl
+    if not urls and not jsonl:
+        ap.error("no targets: pass an endpoint URL and/or JSONL path(s)")
+    for target in urls:
+        url = target.rstrip("/") + "/stats.json"
         with urllib.request.urlopen(url, timeout=10) as resp:
             snap = json.loads(resp.read())
         sys.stdout.write(render_snapshot(snap))
-    else:
-        sys.stdout.write(render_jsonl(args.target))
+    if jsonl:
+        text, n_events, _malformed = render_jsonl(jsonl)
+        sys.stdout.write(text)
+        if not n_events:
+            # A zero-event run is a broken pipeline (wrong path, log never
+            # enabled), not a quiet fleet — fail loudly for CI wrappers.
+            print("obs.dump: no parseable events in "
+                  + ", ".join(jsonl), file=sys.stderr)
+            return 2
     return 0
 
 

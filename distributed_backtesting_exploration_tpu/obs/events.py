@@ -89,11 +89,20 @@ def enabled() -> bool:
 
 def emit(event: str, **payload) -> None:
     """Append one event line; no-op (one attribute read) when disabled."""
+    emit_record({"ev": event, **payload})
+
+
+def emit_record(rec: dict) -> None:
+    """Append one pre-built record (must carry ``ev``); the writer stamps
+    ``ts`` (wall clock at write) and ``pid`` — the timeline analyzer merges
+    logs from many processes and needs a per-process identity even when the
+    emitting layer (e.g. the compute backend) does not know its worker id.
+    """
     if not _env_checked:
         _check_env()
     if _fh is None:
         return
-    rec = {"ev": event, "ts": time.time(), **payload}
+    rec = {"ts": time.time(), "pid": os.getpid(), **rec}
     line = json.dumps(rec, separators=(",", ":"), default=str)
     with _lock:
         if _fh is None:

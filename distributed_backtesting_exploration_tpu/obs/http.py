@@ -12,11 +12,29 @@ import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from . import trace
 from .registry import Registry, get_registry
 
 log = logging.getLogger("dbx.obs.http")
 
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# Recent-span window shipped by /stats.json and GetStats obs_json: enough
+# to cover a poll-cycle of batches without bloating every scrape (the full
+# ring stays readable in-process via obs.recent_spans()).
+STATS_SPAN_WINDOW = 128
+
+
+def stats_payload(registry: Registry) -> dict:
+    """The ``/stats.json`` document: the registry snapshot plus the tail of
+    the process-wide completed-span ring under ``dbx_spans_recent`` —
+    shaped like a metric family (``{"type": "spans", "values": [...]}``) so
+    snapshot consumers that dispatch on ``type`` skip it untouched."""
+    snap = registry.snapshot()
+    snap["dbx_spans_recent"] = {"type": "spans",
+                                "values": trace.recent_spans(
+                                    STATS_SPAN_WINDOW)}
+    return snap
 
 
 class MetricsServer:
@@ -41,7 +59,10 @@ class MetricsServer:
                     body = reg.render_prometheus().encode()
                     ctype = PROMETHEUS_CONTENT_TYPE
                 elif path == "/stats.json":
-                    body = json.dumps(reg.snapshot()).encode()
+                    # default=str: ring span records carry arbitrary
+                    # span attrs, same guard as the JSONL event writer.
+                    body = json.dumps(stats_payload(reg),
+                                      default=str).encode()
                     ctype = "application/json"
                 elif path == "/healthz":
                     body, ctype = b"ok\n", "text/plain"

@@ -39,6 +39,13 @@ def _label_key(labels: dict) -> tuple:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
+def _escape_help(text: str) -> str:
+    """HELP-line escaping (exposition format v0.0.4): backslash and
+    newline only — a raw newline would terminate the HELP line mid-text
+    and feed the remainder to the scraper as a garbage sample line."""
+    return text.replace("\\", r"\\").replace("\n", r"\n")
+
+
 def _render_labels(key: tuple, extra: tuple = ()) -> str:
     pairs = list(key) + list(extra)
     if not pairs:
@@ -310,7 +317,7 @@ class Registry:
         lines: list[str] = []
         for name, kind, help_, children in self._families_snapshot():
             if help_:
-                lines.append(f"# HELP {name} {help_}")
+                lines.append(f"# HELP {name} {_escape_help(help_)}")
             lines.append(f"# TYPE {name} {kind}")
             for key, child in children:
                 if kind == "histogram":

@@ -1,0 +1,430 @@
+"""Per-job lifecycle timelines from merged JSONL span logs.
+
+``python -m ...obs.timeline --jsonl dispatcher.jsonl worker1.jsonl ...``
+
+The trace layer (:mod:`.trace`) gives every span a
+``(trace_id, span_id, parent_id)`` triple and the dispatcher mints one
+trace per job, so the JSONL event logs of any number of processes —
+dispatcher, workers, slice leaders — merge into one timeline per job:
+
+    queue-wait -> dispatch -> [transport] -> decode -> compile/execute
+    -> d2h -> [transport] -> report
+
+This module reconstructs those timelines, computes **critical-path stage
+attribution** (every instant of the job's end-to-end wall is charged to
+exactly one stage, so the stages sum to the measured e2e by
+construction), aggregates per-stage and per-worker totals, and flags
+**stragglers** — jobs whose time in some stage exceeds the fleet's p95
+for that stage.
+
+Attribution model: each span name maps to a stage with a priority;
+walking the job's e2e window, each instant is charged to the
+highest-priority span covering it (ties to the later-starting, i.e.
+innermost, span), and instants no span covers are charged to
+``transport`` — the wire/queue gaps between processes that no process
+can time directly. Generic envelope spans (``worker.submit``,
+``worker.collect``) act as low-priority fallbacks for their halves of
+the pipeline, so time inside submit but outside the decode span still
+lands in ``execute`` rather than vanishing into transport.
+
+Wall-clock timestamps (``t0``) anchor the merge: logs from one host
+share a clock; cross-host merging inherits NTP-grade skew, which shifts
+the transport buckets but never the in-process stage durations.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+# The canonical stage order of the job lifecycle (report tables and the
+# acceptance contract both use it).
+STAGES = ("queue_wait", "dispatch", "transport", "decode", "compile",
+          "execute", "d2h", "report")
+
+# span name -> (stage, priority). Priority 2 = stage-specific span wins
+# its interval outright; priority 1 = envelope fallback (charged only
+# where no specific span covers). The "job" span is the e2e window, not
+# a stage.
+SPAN_STAGE = {
+    "job.queue_wait": ("queue_wait", 2),
+    "job.dispatch": ("dispatch", 2),
+    "worker.decode": ("decode", 2),
+    "worker.compile": ("compile", 2),
+    "worker.execute": ("execute", 2),
+    "worker.d2h": ("d2h", 2),
+    "worker.report": ("report", 2),
+    "worker.submit": ("execute", 1),
+    "worker.collect": ("d2h", 1),
+    "worker.process": ("execute", 1),
+    "slice.run_group": ("execute", 1),
+    "slice.run_ts_group": ("execute", 1),
+}
+
+E2E_SPAN = "job"
+
+
+@dataclasses.dataclass
+class JobTimeline:
+    """All spans of one trace (one job), plus its identity anchors."""
+
+    trace_id: str
+    job_id: str = ""
+    worker: str = ""
+    e2e_t0: float = 0.0
+    e2e_dur: float = 0.0
+    spans: list = dataclasses.field(default_factory=list)
+
+    @property
+    def window(self) -> tuple[float, float]:
+        """The attribution window: the dispatcher's measured end-to-end
+        span when present, else the span cover (partial logs)."""
+        if self.e2e_dur > 0:
+            return (self.e2e_t0, self.e2e_t0 + self.e2e_dur)
+        if not self.spans:
+            return (0.0, 0.0)
+        return (min(s["t0"] for s in self.spans),
+                max(s["t0"] + s["dur_s"] for s in self.spans))
+
+
+def parse_events(paths) -> tuple[list[dict], int]:
+    """Merge JSONL files into one event list; malformed lines (torn tails,
+    truncated writes, non-JSON noise) are skipped AND counted — a
+    diagnostic log must never crash its own analyzer, but silent drops
+    would misread a corrupt log as a quiet fleet. An unreadable FILE is an
+    error (raises OSError): naming a wrong path is operator error, not log
+    corruption."""
+    events: list[dict] = []
+    malformed = 0
+    for path in paths:
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    malformed += 1
+                    continue
+                if not isinstance(rec, dict) or "ev" not in rec:
+                    malformed += 1
+                    continue
+                events.append(rec)
+    return events, malformed
+
+
+def _span_t0(rec: dict) -> float:
+    # t0 is stamped by the trace layer; older logs carry only the write
+    # timestamp — the span ENDED at ts, so start = ts - dur.
+    if "t0" in rec:
+        return float(rec["t0"])
+    return float(rec.get("ts", 0.0)) - float(rec.get("dur_s", 0.0))
+
+
+def reconstruct(events) -> dict[str, JobTimeline]:
+    """Group span events into one :class:`JobTimeline` per trace id.
+
+    A span carrying a ``traces`` list (one compute batch serving several
+    jobs) is fanned out to every listed trace — the batch's wall is part
+    of EACH job's timeline (the jobs shared the device; attribution is
+    wall-clock, not device-second, by design)."""
+    out: dict[str, JobTimeline] = {}
+    for rec in events:
+        if rec.get("ev") != "span":
+            continue
+        dur = float(rec.get("dur_s", 0.0))
+        t0 = _span_t0(rec)
+        # (trace_id, parent_id) per destination trace: a multi-job batch
+        # span stores its local stack parent in ``parent_id`` ("" when it
+        # is the context's outermost span) and each trace's REMOTE parent
+        # in its ``traces`` pair — losing the pair's half would leave the
+        # fanned-out copies parentless.
+        tids = []
+        if rec.get("trace_id"):
+            tids.append((rec["trace_id"], rec.get("parent_id", "")))
+        tids.extend((t, rec.get("parent_id") or p)
+                    for t, p in rec.get("traces", []) if t)
+        for tid, parent_id in tids:
+            tl = out.get(tid)
+            if tl is None:
+                tl = out[tid] = JobTimeline(trace_id=tid)
+            name = rec.get("name", "?")
+            tl.spans.append({
+                "name": name, "t0": t0, "dur_s": dur,
+                "span_id": rec.get("span_id", ""),
+                "parent_id": parent_id,
+                "pid": rec.get("pid"), "ok": rec.get("ok", True),
+                "worker": rec.get("worker", "")})
+            if name == E2E_SPAN:
+                tl.e2e_t0, tl.e2e_dur = t0, dur
+            if rec.get("job") and not tl.job_id:
+                tl.job_id = str(rec["job"])
+            if rec.get("worker") and name in (E2E_SPAN, "job.dispatch"):
+                tl.worker = str(rec["worker"])
+    for tl in out.values():
+        tl.spans.sort(key=lambda s: (s["t0"], -s["dur_s"]))
+    return out
+
+
+def critical_path(tl: JobTimeline) -> dict[str, float]:
+    """Charge every instant of the job's window to exactly one stage.
+
+    Boundary sweep over the clipped span intervals: per segment, the
+    highest-priority covering span's stage wins (ties to the later start
+    — the innermost span); uncovered segments are ``transport``. The
+    returned stage seconds therefore sum EXACTLY to the window length —
+    the property the acceptance check ("stages within 10% of measured
+    e2e") rides on; the 10% slack only absorbs clock jitter between the
+    dispatcher's two window timestamps and span timestamps taken on
+    other threads."""
+    lo, hi = tl.window
+    out = {s: 0.0 for s in STAGES}
+    if hi <= lo:
+        return out
+    ivals = []
+    for s in tl.spans:
+        staged = SPAN_STAGE.get(s["name"])
+        if staged is None:
+            continue
+        a = max(s["t0"], lo)
+        b = min(s["t0"] + s["dur_s"], hi)
+        if b > a:
+            ivals.append((a, b, staged[1], s["t0"], staged[0]))
+    points = sorted({lo, hi, *(a for a, *_ in ivals),
+                     *(b for _, b, *_ in ivals)})
+    for a, b in zip(points, points[1:]):
+        mid = (a + b) / 2
+        best = None
+        for ia, ib, prio, t0, stage in ivals:
+            if ia <= mid < ib:
+                key = (prio, t0)
+                if best is None or key > best[0]:
+                    best = (key, stage)
+        out[best[1] if best else "transport"] += b - a
+    return out
+
+
+def _quantile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    pos = q * (len(sorted_vals) - 1)
+    i = int(pos)
+    frac = pos - i
+    if i + 1 >= len(sorted_vals):
+        return sorted_vals[-1]
+    return sorted_vals[i] + (sorted_vals[i + 1] - sorted_vals[i]) * frac
+
+
+# Straggler flagging needs a population: with fewer jobs than this, p95
+# is within noise of the max and every run would "find" one straggler.
+MIN_STRAGGLER_JOBS = 8
+
+
+def summarize(timelines: dict[str, JobTimeline], *,
+              min_straggler_jobs: int = MIN_STRAGGLER_JOBS) -> dict:
+    """Fleet digest: per-stage totals/quantiles, per-worker attribution,
+    per-job stage seconds, and stragglers (jobs > p95 in a stage)."""
+    jobs = []
+    per_stage: dict[str, list] = {s: [] for s in STAGES}
+    per_worker: dict[str, dict] = {}
+    for tid, tl in sorted(timelines.items()):
+        stages = critical_path(tl)
+        lo, hi = tl.window
+        jobs.append({"trace_id": tid, "job": tl.job_id,
+                     "worker": tl.worker, "t0": lo,
+                     "e2e_s": round(hi - lo, 9),
+                     "measured_e2e_s": round(tl.e2e_dur, 9),
+                     "stages": {k: round(v, 9) for k, v in stages.items()},
+                     "spans": len(tl.spans)})
+        for k, v in stages.items():
+            per_stage[k].append(v)
+        w = per_worker.setdefault(tl.worker or "?",
+                                  {"jobs": 0, "e2e_s": 0.0,
+                                   **{s: 0.0 for s in STAGES}})
+        w["jobs"] += 1
+        w["e2e_s"] += hi - lo
+        for k, v in stages.items():
+            w[k] += v
+
+    stage_stats = {}
+    for k, vals in per_stage.items():
+        sv = sorted(vals)
+        stage_stats[k] = {
+            "total_s": round(sum(sv), 9),
+            "mean_s": round(sum(sv) / len(sv), 9) if sv else 0.0,
+            "p95_s": round(_quantile(sv, 0.95), 9),
+            "max_s": round(sv[-1], 9) if sv else 0.0}
+
+    stragglers = []
+    if len(jobs) >= min_straggler_jobs:
+        for stage in STAGES:
+            p95 = stage_stats[stage]["p95_s"]
+            if p95 <= 0:
+                continue
+            for j in jobs:
+                if j["stages"][stage] > p95:
+                    stragglers.append({
+                        "job": j["job"], "trace_id": j["trace_id"],
+                        "worker": j["worker"], "stage": stage,
+                        "seconds": j["stages"][stage], "p95_s": p95})
+    stragglers.sort(key=lambda s: -(s["seconds"] - s["p95_s"]))
+
+    return {"jobs": len(jobs),
+            "e2e_total_s": round(sum(j["e2e_s"] for j in jobs), 9),
+            "stages": stage_stats,
+            "workers": {k: {kk: (vv if kk == "jobs" else round(vv, 9))
+                            for kk, vv in v.items()}
+                        for k, v in sorted(per_worker.items())},
+            "stragglers": stragglers,
+            "per_job": jobs}
+
+
+def summarize_spans(spans, **kw) -> dict:
+    """Summarize in-memory span records (the obs ring) — bench.py's hook:
+    the e2e configs run dispatcher+worker in-process, so the completed
+    spans land in the ring without any JSONL file.
+
+    The ring is bounded, and eviction tears the OLDEST jobs first: a
+    job's earliest record (``job.queue_wait``, written at take time)
+    falls off while its later worker spans and e2e ``job`` span survive,
+    so the missing stages would be silently charged to transport. A
+    job's ring records are appended in completion order, so the presence
+    of its first-written span implies the rest survived too — timelines
+    missing ``job.queue_wait`` are dropped from the digest and counted
+    as ``torn_jobs`` instead of skewing the stage shares."""
+    timelines = reconstruct(spans)
+    torn = [t for t, tl in timelines.items()
+            if not any(s["name"] == "job.queue_wait" for s in tl.spans)]
+    for t in torn:
+        del timelines[t]
+    if not timelines:
+        return {}
+    out = summarize(timelines, **kw)
+    out.pop("per_job", None)   # BENCH JSON carries the digest, not N rows
+    if torn:
+        out["torn_jobs"] = len(torn)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Rendering + CLI
+# ---------------------------------------------------------------------------
+
+def _fmt_s(v: float) -> str:
+    if v >= 1.0:
+        return f"{v:.3f}s"
+    if v >= 1e-3:
+        return f"{v * 1e3:.2f}ms"
+    return f"{v * 1e6:.0f}us"
+
+
+def _table(rows: list[tuple], header: tuple) -> str:
+    rows = [tuple(str(c) for c in r) for r in rows]
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(header)]
+
+    def line(cells):
+        return "  ".join(c.ljust(w) for c, w in zip(cells, widths)).rstrip()
+
+    out = [line(header), line(tuple("-" * w for w in widths))]
+    out.extend(line(r) for r in rows)
+    return "\n".join(out)
+
+
+def render_text(summary: dict) -> str:
+    out = [f"{summary['jobs']} job(s), "
+           f"{_fmt_s(summary['e2e_total_s'])} end-to-end wall"]
+    rows = []
+    total = summary["e2e_total_s"] or 1.0
+    for stage in STAGES:
+        st = summary["stages"][stage]
+        if not st["total_s"]:
+            continue
+        rows.append((stage, _fmt_s(st["total_s"]), _fmt_s(st["mean_s"]),
+                     _fmt_s(st["p95_s"]), _fmt_s(st["max_s"]),
+                     f"{100.0 * st['total_s'] / total:.1f}%"))
+    out.append("")
+    out.append("== critical-path stage attribution ==")
+    out.append(_table(rows, ("stage", "total", "mean/job", "p95", "max",
+                             "share")))
+    if len(summary["workers"]) > 1 or "?" not in summary["workers"]:
+        out.append("")
+        out.append("== per worker ==")
+        wrows = [(w, v["jobs"], _fmt_s(v["e2e_s"]),
+                  _fmt_s(v["execute"] + v["compile"]),
+                  _fmt_s(v["transport"]), _fmt_s(v["report"]))
+                 for w, v in summary["workers"].items()]
+        out.append(_table(wrows, ("worker", "jobs", "e2e", "compute",
+                                  "transport", "report")))
+    if summary["stragglers"]:
+        out.append("")
+        out.append("== stragglers (stage time > fleet p95) ==")
+        srows = [(s["job"] or s["trace_id"][:12], s["stage"],
+                  _fmt_s(s["seconds"]), _fmt_s(s["p95_s"]), s["worker"])
+                 for s in summary["stragglers"][:20]]
+        out.append(_table(srows, ("job", "stage", "seconds", "p95",
+                                  "worker")))
+    for j in summary.get("per_job", []):
+        out.append("")
+        top = sorted(j["stages"].items(), key=lambda kv: -kv[1])
+        out.append(f"-- job {j['job'] or j['trace_id'][:12]} "
+                   f"(worker {j['worker'] or '?'}): "
+                   f"e2e {_fmt_s(j['e2e_s'])}, "
+                   + ", ".join(f"{k} {_fmt_s(v)}"
+                               for k, v in top if v > 0))
+    return "\n".join(out) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="obs.timeline",
+        description="merge JSONL span logs from any number of processes "
+                    "into per-job lifecycle timelines with critical-path "
+                    "stage attribution and straggler flags")
+    ap.add_argument("--jsonl", nargs="+", action="extend", default=[],
+                    required=True, metavar="PATH",
+                    help="JSONL event log(s) (DBX_OBS_JSONL output); "
+                         "repeatable, merged on trace ids")
+    ap.add_argument("--job", default=None,
+                    help="restrict to one job id (or trace-id prefix)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--min-straggler-jobs", type=int,
+                    default=MIN_STRAGGLER_JOBS,
+                    help="minimum fleet size before stragglers are "
+                         "flagged (p95 of a tiny sample is noise)")
+    args = ap.parse_args(argv)
+
+    events, malformed = parse_events(args.jsonl)
+    if malformed:
+        print(f"obs.timeline: skipped {malformed} malformed line(s)",
+              file=sys.stderr)
+    if not events:
+        print("obs.timeline: no parseable events in "
+              + ", ".join(args.jsonl), file=sys.stderr)
+        return 2
+    timelines = reconstruct(events)
+    if args.job:
+        timelines = {t: tl for t, tl in timelines.items()
+                     if tl.job_id == args.job or t.startswith(args.job)}
+        if not timelines:
+            print(f"obs.timeline: no trace matches --job {args.job}",
+                  file=sys.stderr)
+            return 2
+    if not timelines:
+        print("obs.timeline: events parsed but none carry trace ids "
+              "(pre-tracing logs?)", file=sys.stderr)
+        return 2
+    summary = summarize(timelines,
+                        min_straggler_jobs=args.min_straggler_jobs)
+    if args.format == "json":
+        print(json.dumps(summary, indent=2))
+    else:
+        sys.stdout.write(render_text(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

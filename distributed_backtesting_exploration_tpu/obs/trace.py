@@ -7,6 +7,24 @@ JSONL event log is configured — emits one event per span with its parent,
 so a post-mortem reader can rebuild the per-batch chain
 (``decode -> submit -> collect -> report``) from the log alone.
 
+Distributed traces (round 7): every span now carries a
+``(trace_id, span_id, parent_id)`` triple. The ambient trace is a
+``contextvars.ContextVar`` holding the remote parent(s) a span chain
+should join — the dispatcher mints one trace_id per job at enqueue time,
+ships it over the wire (``JobSpec.trace_id`` / ``parent_span_id``), and
+the worker adopts it with :func:`trace_context` so its local span chain
+becomes children of the dispatcher's dispatch span. A compute batch can
+serve SEVERAL jobs (several traces) at once; a multi-trace context makes
+spans carry a ``traces`` list of ``[trace_id, parent_span_id]`` pairs
+instead of one ``trace_id`` — the timeline analyzer (:mod:`.timeline`)
+fans such spans out to every listed trace.
+
+Completed spans land in three places: the ``dbx_span_seconds`` histogram
+(aggregate), the JSONL event log when configured (durable), and a bounded
+in-memory ring (:func:`recent_spans`) exported via ``/stats.json`` and
+GetStats ``obs_json`` so a live process can be asked "what just ran"
+without any log file.
+
 ``timed`` (log-only), ``StepTimer`` (running throughput meter) and
 ``device_profile`` (jax.profiler wrapper) move here from ``utils.trace``,
 which remains as a deprecation shim for one release.
@@ -14,8 +32,12 @@ which remains as a deprecation shim for one release.
 
 from __future__ import annotations
 
+import collections
 import contextlib
+import contextvars
+import itertools
 import logging
+import random
 import threading
 import time
 
@@ -26,11 +48,103 @@ log = logging.getLogger("dbx.trace")
 
 _tls = threading.local()
 
+# Ambient remote-trace context: a tuple of (trace_id, parent_span_id)
+# pairs the NEXT outermost span on this thread should join. Contextvars
+# are per-thread for plain threads, so the worker's control and compute
+# threads each set their own.
+_trace_ctx: contextvars.ContextVar[tuple] = contextvars.ContextVar(
+    "dbx_trace_ctx", default=())
+
+# ID minting: 128-bit trace ids / 64-bit span ids as lowercase hex.
+# random.getrandbits is ~3x cheaper than uuid4 and these ids only need
+# collision resistance within one fleet run, not global uniqueness.
+_rand = random.Random()
+
+
+def new_trace_id() -> str:
+    return f"{_rand.getrandbits(128):032x}"
+
+
+def new_span_id() -> str:
+    return f"{_rand.getrandbits(64):016x}"
+
 
 def current_span() -> str | None:
     """Name of the innermost active span on this thread, or None."""
     stack = getattr(_tls, "stack", None)
-    return stack[-1] if stack else None
+    return stack[-1][0] if stack else None
+
+
+def current_trace() -> str | None:
+    """The ambient trace id when exactly one trace is adopted, else None."""
+    pairs = _trace_ctx.get()
+    return pairs[0][0] if len(pairs) == 1 else None
+
+
+@contextlib.contextmanager
+def trace_context(trace_id, parent_span_id: str = ""):
+    """Adopt a remote trace for the duration of the block.
+
+    ``trace_id`` is either one id string (with its ``parent_span_id``) or
+    a list of ``(trace_id, parent_span_id)`` pairs — the multi-job batch
+    case. Pairs with empty trace ids are dropped (jobs enqueued by a
+    pre-tracing dispatcher); an all-empty context leaves spans untraced,
+    exactly the old behavior.
+    """
+    if isinstance(trace_id, str):
+        pairs = ((trace_id, parent_span_id or ""),) if trace_id else ()
+    else:
+        pairs = tuple((t, p or "") for t, p in trace_id if t)
+    token = _trace_ctx.set(pairs)
+    try:
+        yield
+    finally:
+        _trace_ctx.reset(token)
+
+
+def job_trace_pairs(jobs) -> list:
+    """``(trace_id, parent_span_id)`` pairs of a job batch (JobSpec or any
+    object exposing ``trace_id`` / ``parent_span_id``), traceless jobs
+    skipped — the argument :func:`trace_context` takes for a batch."""
+    out = []
+    for j in jobs:
+        tid = getattr(j, "trace_id", "")
+        if tid:
+            out.append((tid, getattr(j, "parent_span_id", "")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Bounded in-memory span ring
+# ---------------------------------------------------------------------------
+
+SPAN_RING_CAPACITY = 512
+
+_ring_lock = threading.Lock()
+_ring: collections.deque = collections.deque(maxlen=SPAN_RING_CAPACITY)
+
+
+def configure_ring(capacity: int) -> None:
+    """Resize (and clear) the completed-span ring. 0 disables it."""
+    global _ring
+    with _ring_lock:
+        _ring = collections.deque(maxlen=max(int(capacity), 0))
+
+
+def recent_spans(n: int | None = None) -> list[dict]:
+    """The last ``n`` (default: all retained) completed span records,
+    oldest first — the same dicts the JSONL event log would carry.
+
+    Copies only the requested tail under the ring lock: every span
+    completion appends under the same lock, so a stats scrape of a large
+    ring (bench sizes it to 32k) must not stall the hot path for a
+    full-ring copy."""
+    with _ring_lock:
+        if n is None:
+            return list(_ring)
+        if n <= 0:
+            return []
+        return list(itertools.islice(_ring, max(len(_ring) - n, 0), None))
 
 
 # Span histograms are get-or-create per distinct name; cache the children so
@@ -53,20 +167,85 @@ def _span_hist(name: str):
     return h
 
 
+def _record_span(name: str, t0_wall: float, dur: float, *, span_id: str,
+                 stack_parent, pairs: tuple, ok: bool = True,
+                 **attrs) -> dict:
+    """The one completed-span sink: histogram + ring + JSONL event.
+
+    ``stack_parent`` is the enclosing local span as ``(name, span_id)`` or
+    None; ``pairs`` the ambient (or explicit) remote-trace pairs. A nested
+    span parents onto its local enclosing span; only the OUTERMOST span of
+    a context parents onto the remote ``parent_span_id``.
+    """
+    _span_hist(name).observe(dur)
+    rec = {"ev": "span", "name": name, "t0": round(t0_wall, 6),
+           "dur_s": round(dur, 9), "span_id": span_id,
+           "parent": stack_parent[0] if stack_parent else None,
+           "thread": threading.current_thread().name, "ok": ok}
+    if len(pairs) == 1:
+        rec["trace_id"] = pairs[0][0]
+        rec["parent_id"] = (stack_parent[1] if stack_parent
+                            else pairs[0][1])
+    elif pairs:
+        rec["traces"] = [[t, p] for t, p in pairs]
+        rec["parent_id"] = stack_parent[1] if stack_parent else ""
+    elif stack_parent:
+        rec["parent_id"] = stack_parent[1]
+    rec.update(attrs)
+    with _ring_lock:
+        if _ring.maxlen:
+            _ring.append(rec)
+    if events.enabled():
+        events.emit_record(rec)
+    return rec
+
+
+def emit_span(name: str, t0_wall: float, dur_s: float, *,
+              trace_id: str = "", parent_id: str = "", pairs=None,
+              span_id: str | None = None, ok: bool = True,
+              **attrs) -> str:
+    """Record an already-measured span (histogram + ring + event log) and
+    return its span id.
+
+    The synthesized-span entry point for phases that are not ``with``
+    blocks — the dispatcher's queue-wait (enqueue ts -> dispatch ts) and
+    the job's end-to-end wall (enqueue ts -> completion recorded) exist
+    only as two timestamps, never as one open stack frame. ``pairs``
+    (a list of ``(trace_id, parent_span_id)``) overrides ``trace_id`` for
+    the multi-job-batch case. An enclosing local span on this thread, if
+    any, becomes the local parent — the compute backend emits its
+    compile/execute spans from inside the worker's submit span.
+    """
+    sid = span_id or new_span_id()
+    if pairs is None:
+        pairs = ((trace_id, parent_id),) if trace_id else ()
+    else:
+        pairs = tuple((t, p or "") for t, p in pairs if t)
+    stack = getattr(_tls, "stack", None)
+    _record_span(name, t0_wall, max(float(dur_s), 0.0), span_id=sid,
+                 stack_parent=stack[-1] if stack else None, pairs=pairs,
+                 ok=ok, **attrs)
+    return sid
+
+
 @contextlib.contextmanager
 def span(name: str, **attrs):
     """Time a named phase: ``with span("decode", jobs=32): ...``.
 
-    Durations land in ``dbx_span_seconds{span=name}``; when the JSONL
-    event log is configured each span also emits
-    ``{"ev": "span", "name", "dur_s", "parent", "thread", ...attrs}``.
-    Exceptions propagate; the span records either way (``ok`` marks it).
+    Durations land in ``dbx_span_seconds{span=name}``; the completed span
+    (with its ``trace_id``/``span_id``/``parent_id`` triple, ``t0`` wall
+    start, and ``dur_s``) goes to the in-memory ring and — when the JSONL
+    event log is configured — to the log. Exceptions propagate; the span
+    records either way (``ok`` marks it).
     """
     stack = getattr(_tls, "stack", None)
     if stack is None:
         stack = _tls.stack = []
     parent = stack[-1] if stack else None
-    stack.append(name)
+    sid = new_span_id()
+    stack.append((name, sid))
+    pairs = _trace_ctx.get()
+    t0_wall = time.time()
     t0 = time.perf_counter()
     ok = True
     try:
@@ -77,11 +256,8 @@ def span(name: str, **attrs):
     finally:
         dur = time.perf_counter() - t0
         stack.pop()
-        _span_hist(name).observe(dur)
-        if events.enabled():
-            events.emit("span", name=name, dur_s=round(dur, 9),
-                        parent=parent, thread=threading.current_thread().name,
-                        ok=ok, **attrs)
+        _record_span(name, t0_wall, dur, span_id=sid, stack_parent=parent,
+                     pairs=pairs, ok=ok, **attrs)
 
 
 @contextlib.contextmanager

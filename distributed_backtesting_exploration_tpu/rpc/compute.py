@@ -26,14 +26,20 @@ log = logging.getLogger("dbx.compute")
 
 
 class Completion:
-    """One finished job: id + packed DBXM metrics + compute seconds."""
+    """One finished job: id + packed DBXM metrics + compute seconds.
 
-    __slots__ = ("job_id", "metrics", "elapsed_s")
+    ``trace_id`` echoes the job's dispatcher-minted trace (JobSpec.trace_id)
+    so the report leg and the CompleteItem wire echo stay stitchable;
+    empty for jobs enqueued by a pre-tracing dispatcher."""
 
-    def __init__(self, job_id: str, metrics: bytes, elapsed_s: float):
+    __slots__ = ("job_id", "metrics", "elapsed_s", "trace_id")
+
+    def __init__(self, job_id: str, metrics: bytes, elapsed_s: float,
+                 trace_id: str = ""):
         self.job_id = job_id
         self.metrics = metrics
         self.elapsed_s = elapsed_s
+        self.trace_id = trace_id
 
 
 class ComputeBackend(Protocol):
@@ -272,12 +278,18 @@ class JaxSweepBackend:
                              if sk[0] != evicted}
 
     def _observe_submit(self, strategy: str, route: str, t0: float,
-                        cold_key=None) -> None:
+                        cold_key=None, group=None) -> None:
         """Record a group's submit-side wall (group start -> kernels
         launched, decode included) into
         ``dbx_kernel_submit_seconds{kernel=route:strategy}``. ``cold_key``
         marks the first submission of a compile signature as
-        phase="compile" (the jit compile-vs-execute split at group grain)."""
+        phase="compile" (the jit compile-vs-execute split at group grain).
+
+        With ``group`` given, the same interval is also emitted as a
+        ``worker.compile`` / ``worker.execute`` span joined to every job's
+        trace — the timeline analyzer's compile-vs-execute stage split
+        (the decode span nests inside this interval and wins attribution
+        for its sub-range)."""
         dt = time.perf_counter() - t0
         cold = False
         if cold_key is not None:
@@ -296,6 +308,13 @@ class JaxSweepBackend:
                 kernel=f"{route}:{strategy}",
                 phase="compile" if cold else "execute")
         h.observe(dt)
+        if group is not None:
+            pairs = obs.job_trace_pairs(group)
+            if pairs:
+                obs.emit_span("worker.compile" if cold else "worker.execute",
+                              time.time() - dt, dt, pairs=pairs,
+                              kernel=f"{route}:{strategy}",
+                              jobs=len(group))
 
     def _observe_substrates(self, strategy: str) -> None:
         """Count a fused group against the substrate set that served it
@@ -852,22 +871,29 @@ class JaxSweepBackend:
                 pending.append(self._submit_pairs_group(group, t0))
                 self._observe_submit(
                     "pairs", "pairs_wf" if group[0].wf_train > 0
-                    else "pairs", t0)
+                    else "pairs", t0, group=group)
                 continue
-            t_dec = time.perf_counter()
-            series = [data_mod.from_wire_bytes(j.ohlcv) for j in group]
-            self._h_decode.observe(time.perf_counter() - t_dec)
-            self._c_decode_bytes.inc(sum(len(j.ohlcv) for j in group))
+            # The decode span adopts the GROUP's traces (a batch can hold
+            # several groups; the batch-level context set by the worker
+            # loop would attribute one group's decode to every job).
+            with obs.trace_context(obs.job_trace_pairs(group)), \
+                    obs.span("worker.decode", jobs=len(group)):
+                t_dec = time.perf_counter()
+                series = [data_mod.from_wire_bytes(j.ohlcv) for j in group]
+                self._h_decode.observe(time.perf_counter() - t_dec)
+                self._c_decode_bytes.inc(sum(len(j.ohlcv) for j in group))
             lengths = [s.n_bars for s in series]
             if group[0].wf_train > 0:
                 pending.append(self._submit_walkforward_group(
                     group, series, lengths, t0))
-                self._observe_submit(group[0].strategy, "walkforward", t0)
+                self._observe_submit(group[0].strategy, "walkforward", t0,
+                                     group=group)
                 continue
             if group[0].best_returns:
                 pending.append(self._submit_best_returns_group(
                     group, series, lengths, t0))
-                self._observe_submit(group[0].strategy, "best_returns", t0)
+                self._observe_submit(group[0].strategy, "best_returns", t0,
+                                     group=group)
                 continue
             # JobSpec.grid carries per-parameter AXES; the cartesian product
             # is materialized worker-side (backtesting.proto JobSpec.grid).
@@ -898,7 +924,7 @@ class JaxSweepBackend:
                     self._observe_submit(
                         group[0].strategy, "timeshard", t0,
                         cold_key=("timeshard", len(group), t_max_g)
-                        + self._group_key(group[0], axes))
+                        + self._group_key(group[0], axes), group=group)
                     continue
                 # The group-level gate uses min(lengths) for the halo
                 # bound, so ONE short job in a ragged group would drag
@@ -929,7 +955,8 @@ class JaxSweepBackend:
                         group[0].strategy, "timeshard", t0,
                         cold_key=("timeshard", len(ok_idx),
                                   max(int(lengths[i]) for i in ok_idx))
-                        + self._group_key(group[0], axes))
+                        + self._group_key(group[0], axes),
+                        group=[group[i] for i in ok_idx])
                     rest = [i for i in range(len(group))
                             if i not in set(ok_idx)]
                     if not rest:
@@ -1038,7 +1065,7 @@ class JaxSweepBackend:
             self._observe_submit(
                 group[0].strategy, route, t0,
                 cold_key=(route, len(group), t_max_g)
-                + self._group_key(group[0], axes))
+                + self._group_key(group[0], axes), group=group)
             pending.append(self._finish_group(group, m, t0, len(group),
                                               group[0]))
         return pending
@@ -1315,31 +1342,34 @@ class JaxSweepBackend:
                     job0.wf_test, metric)
                 return (list(group), None, t0, 0, None)
         good, bad = [], []
-        t_dec = time.perf_counter()
-        for j in group:
-            if not j.ohlcv2:
-                log.error("pairs job %s has no second leg (ohlcv2); "
-                          "completing with empty metrics", j.id)
-                bad.append(j)
-                continue
-            y = data_mod.from_wire_bytes(j.ohlcv)
-            x = data_mod.from_wire_bytes(j.ohlcv2)
-            if y.n_bars != x.n_bars:
-                log.error("pairs job %s legs differ in length (%d vs %d); "
-                          "completing with empty metrics", j.id, y.n_bars,
-                          x.n_bars)
-                bad.append(j)
-                continue
-            if wf and y.n_bars < job0.wf_train + job0.wf_test:
-                log.error(
-                    "pairs walk-forward job %s needs >= %d bars (train %d "
-                    "+ test %d), has %d; completing with empty metrics",
-                    j.id, job0.wf_train + job0.wf_test, job0.wf_train,
-                    job0.wf_test, y.n_bars)
-                bad.append(j)
-                continue
-            good.append((j, y, x))
-        self._h_decode.observe(time.perf_counter() - t_dec)
+        with obs.trace_context(obs.job_trace_pairs(group)), \
+                obs.span("worker.decode", jobs=len(group)):
+            t_dec = time.perf_counter()
+            for j in group:
+                if not j.ohlcv2:
+                    log.error("pairs job %s has no second leg (ohlcv2); "
+                              "completing with empty metrics", j.id)
+                    bad.append(j)
+                    continue
+                y = data_mod.from_wire_bytes(j.ohlcv)
+                x = data_mod.from_wire_bytes(j.ohlcv2)
+                if y.n_bars != x.n_bars:
+                    log.error("pairs job %s legs differ in length (%d vs "
+                              "%d); completing with empty metrics", j.id,
+                              y.n_bars, x.n_bars)
+                    bad.append(j)
+                    continue
+                if wf and y.n_bars < job0.wf_train + job0.wf_test:
+                    log.error(
+                        "pairs walk-forward job %s needs >= %d bars "
+                        "(train %d + test %d), has %d; completing with "
+                        "empty metrics",
+                        j.id, job0.wf_train + job0.wf_test, job0.wf_train,
+                        job0.wf_test, y.n_bars)
+                    bad.append(j)
+                    continue
+                good.append((j, y, x))
+            self._h_decode.observe(time.perf_counter() - t_dec)
         self._c_decode_bytes.inc(
             sum(len(j.ohlcv) + len(j.ohlcv2) for j in group))
         if not good:
@@ -1542,7 +1572,15 @@ class JaxSweepBackend:
         out: list[Completion] = []
         for group, stacked, t0, n_real, extra in pending:
             t_wait = time.perf_counter()
-            host = None if stacked is None else np.asarray(stacked)
+            if stacked is None:
+                host = None
+            else:
+                # The blocking device drain, traced per group: the d2h
+                # stage of each job's timeline (the worker.collect span
+                # above it covers the whole pending entry).
+                with obs.trace_context(obs.job_trace_pairs(group)), \
+                        obs.span("worker.d2h", jobs=len(group)):
+                    host = np.asarray(stacked)
             if host is not None:
                 # The blocking d2h drain: everything after here is host-side
                 # packing. Combo credit counts only real jobs (mesh pad rows
@@ -1590,7 +1628,8 @@ class JaxSweepBackend:
                         blob = wire.metrics_to_bytes(row)
                 else:
                     blob = b""   # validated-bad job: complete, no result
-                out.append(Completion(job.id, blob, per_job))
+                out.append(Completion(job.id, blob, per_job,
+                                      trace_id=job.trace_id))
         return out
 
     def process(self, jobs) -> list[Completion]:
@@ -1700,7 +1739,8 @@ class InstantBackend:
             Metrics(*(np.zeros(1, np.float32) for _ in Metrics._fields)))
         for job in jobs:
             self.seen.append(job.id)
-            out.append(Completion(job.id, empty, 0.0))
+            out.append(Completion(job.id, empty, 0.0,
+                                  trace_id=job.trace_id))
         return out
 
 
@@ -1716,5 +1756,6 @@ class SleepBackend:
         out = []
         for job in jobs:
             time.sleep(self.delay_s)
-            out.append(Completion(job.id, b"", self.delay_s))
+            out.append(Completion(job.id, b"", self.delay_s,
+                                  trace_id=job.trace_id))
         return out

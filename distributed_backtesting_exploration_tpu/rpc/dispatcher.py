@@ -83,6 +83,14 @@ class JobRecord:
     # DBXP block — best combo by rank_metric + its net-return series — so
     # `aggregate --portfolio` can compose the true fleet book.
     best_returns: bool = False
+    # Distributed tracing (proto JobSpec.trace_id): minted at enqueue time
+    # (JobQueue.enqueue_many) and journaled, so a job keeps ONE trace id
+    # across dispatcher restarts. enqueue_ts (wall clock) anchors the
+    # queue-wait and end-to-end spans; deliberately NOT journaled — a
+    # restart restarts the queue-wait clock rather than attributing the
+    # outage to the queue.
+    trace_id: str = ""
+    enqueue_ts: float = 0.0
 
     @property
     def combos(self) -> int:
@@ -111,6 +119,8 @@ class JobRecord:
             rec["topk"] = [self.top_k, self.rank_metric]
         if self.best_returns:
             rec["ret"] = [True, self.rank_metric]
+        if self.trace_id:
+            rec["trace"] = self.trace_id
         return rec
 
     @staticmethod
@@ -130,7 +140,8 @@ class JobRecord:
             wf_train=int(wf[0]), wf_test=int(wf[1]), wf_metric=str(wf[2]),
             top_k=int(topk[0]),
             rank_metric=str(topk[1]) or str((rec.get("ret") or [0, ""])[1]),
-            best_returns=bool((rec.get("ret") or [False])[0]))
+            best_returns=bool((rec.get("ret") or [False])[0]),
+            trace_id=str(rec.get("trace", "")))
 
 
 @dataclasses.dataclass
@@ -371,6 +382,15 @@ class JobQueue:
                 # would desynchronize the id<->index mirror from the C
                 # intern table (enforced on both substrates).
                 raise ValueError(f"job id contains NUL: {rec.id[:64]!r}")
+        # Trace minting happens HERE — before the journal append — so the
+        # id a restart restores is the id the first run's spans carried.
+        # enqueue_ts is re-stamped per process (see JobRecord).
+        now = time.time()
+        for rec in recs:
+            if not rec.trace_id:
+                rec.trace_id = obs.new_trace_id()
+            if not rec.enqueue_ts:
+                rec.enqueue_ts = now
         if journal and self._journal.enabled:
             # enabled-guarded: journal_form b64-encodes the payload, which
             # the no-op journal would throw away. Journal BEFORE the state
@@ -592,17 +612,42 @@ class JobQueue:
         with self._lock:
             return set(self._completed_ids)
 
+    def job_trace(self, jid: str) -> tuple[str, float]:
+        """``(trace_id, enqueue_ts)`` of a known job, ``("", 0.0)`` for
+        unknown ids — the completion handlers' lookup for closing the
+        job's end-to-end span (the queue's record is authoritative; the
+        wire echo on CompleteItem is advisory)."""
+        with self._lock:
+            rec = self._records.get(jid)
+            return (rec.trace_id, rec.enqueue_ts) if rec else ("", 0.0)
+
     # -- recovery ----------------------------------------------------------
 
     def requeue_expired(self) -> list[str]:
         """Re-queue jobs whose lease deadline passed (front of the queue)."""
         with self._lock:
-            return self._state.requeue_expired()
+            jids = self._state.requeue_expired()
+            self._restart_queue_wait(jids)
+            return jids
 
     def requeue_worker(self, worker_id: str) -> list[str]:
         """Re-queue every job leased to a (pruned) worker."""
         with self._lock:
-            return self._state.requeue_worker(worker_id)
+            jids = self._state.requeue_worker(worker_id)
+            self._restart_queue_wait(jids)
+            return jids
+
+    def _restart_queue_wait(self, jids: list[str]) -> None:
+        # A requeued job re-enters the pending pool NOW: restart its
+        # queue-wait clock (same semantics as a journal restore) so the
+        # re-dispatch's queue_wait span covers the second wait — not the
+        # failed first attempt's whole lifetime, which would override
+        # the attempt's own spans in timeline attribution.
+        now = time.time()
+        for jid in jids:
+            rec = self._records.get(jid)
+            if rec is not None:
+                rec.enqueue_ts = now
 
     # -- observability -----------------------------------------------------
 
@@ -836,8 +881,13 @@ class Dispatcher(service.DispatcherServicer):
 
     def obs_summary(self) -> dict:
         """The extended-stats payload: registry summaries (histogram
-        digests + counters/gauges), as carried by GetStats ``obs_json``."""
-        return self.obs.summaries(prefix="dbx_")
+        digests + counters/gauges) plus the tail of the completed-span
+        ring under ``dbx_spans_recent``, as carried by GetStats
+        ``obs_json`` — the same window ``/stats.json`` ships."""
+        out = self.obs.summaries(prefix="dbx_")
+        out["dbx_spans_recent"] = obs.recent_spans(
+            obs.http.STATS_SPAN_WINDOW)
+        return out
 
     # -- RPC handlers ------------------------------------------------------
 
@@ -848,11 +898,27 @@ class Dispatcher(service.DispatcherServicer):
                      request.worker_id, request.chips)
         per_chip = request.jobs_per_chip or self.default_jobs_per_chip
         n = max(request.chips, 1) * max(per_chip, 1)
+        t_disp0 = time.time()
         taken = self.queue.take(n, request.worker_id)
         if taken:
             self._c_dispatched.inc(len(taken))
         reply = pb.JobsReply()
+        now = time.time()
         for rec, payload in taken:
+            # Per-job trace stitching: close the queue-wait span (enqueue
+            # -> this take) and open/close the dispatch span (take +
+            # payload materialization); the dispatch span's id rides the
+            # JobSpec so the worker's chain parents onto it. Both are
+            # root-level spans of the job's trace.
+            parent_sid = ""
+            if rec.trace_id and rec.enqueue_ts:
+                obs.emit_span("job.queue_wait", rec.enqueue_ts,
+                              t_disp0 - rec.enqueue_ts,
+                              trace_id=rec.trace_id, job=rec.id)
+                parent_sid = obs.emit_span(
+                    "job.dispatch", t_disp0, now - t_disp0,
+                    trace_id=rec.trace_id, job=rec.id,
+                    worker=request.worker_id)
             reply.jobs.append(pb.JobSpec(
                 id=rec.id, strategy=rec.strategy, ohlcv=payload,
                 grid=wire.grid_to_proto(rec.grid), cost=rec.cost,
@@ -861,7 +927,8 @@ class Dispatcher(service.DispatcherServicer):
                 wf_train=rec.wf_train, wf_test=rec.wf_test,
                 wf_metric=rec.wf_metric,
                 top_k=rec.top_k, rank_metric=rec.rank_metric,
-                best_returns=rec.best_returns))
+                best_returns=rec.best_returns,
+                trace_id=rec.trace_id, parent_span_id=parent_sid))
         if taken:
             log.info("dispatched %d jobs to %s", len(taken), request.worker_id)
         return reply
@@ -893,6 +960,16 @@ class Dispatcher(service.DispatcherServicer):
                             self.MAX_RESIDENT_RESULTS, evicted)
                     self.results_evicted += 1
 
+    def _close_job_trace(self, jid: str, worker_id: str) -> None:
+        """Emit the job's end-to-end span (enqueue ts -> completion
+        recorded) — the wall the timeline analyzer's per-stage critical
+        path must account for. First completion only ("new"); dups would
+        re-close an already-closed trace."""
+        tid, ets = self.queue.job_trace(jid)
+        if tid and ets:
+            obs.emit_span("job", ets, time.time() - ets, trace_id=tid,
+                          job=jid, worker=worker_id)
+
     def _complete_one(self, jid: str, worker_id: str, metrics: bytes,
                       elapsed_s: float) -> str:
         # Same persist-then-journal order as CompleteJobs (see there).
@@ -902,6 +979,8 @@ class Dispatcher(service.DispatcherServicer):
             return outcome
         if metrics:
             self._record_result(jid, metrics)
+        if outcome == "new":
+            self._close_job_trace(jid, worker_id)
         log.info("job %s completed by %s in %.3fs", jid, worker_id, elapsed_s)
         if outcome == "new" or (outcome == "dup" and metrics):
             # Journal metric-carrying dups too: the redelivery of a
@@ -942,6 +1021,13 @@ class Dispatcher(service.DispatcherServicer):
             if outcome == "unknown":
                 reply.unknown_ids.append(item.id)
                 continue
+            if outcome == "new":
+                # Close the e2e span NOW: the state machine just recorded
+                # the completion, which is the trace's end regardless of
+                # whether the result block persists below — a persist
+                # failure redelivers the batch as "dup", which would
+                # never close it.
+                self._close_job_trace(item.id, request.worker_id)
             if item.metrics:
                 try:
                     self._record_result(item.id, item.metrics)
@@ -987,7 +1073,7 @@ class Dispatcher(service.DispatcherServicer):
         s = self.queue.stats()
         self._pending_stats.s = s
         try:
-            obs_json = json.dumps(self.obs_summary())
+            obs_json = json.dumps(self.obs_summary(), default=str)
         finally:
             self._pending_stats.s = None
         return pb.StatsReply(workers_alive=self.peers.alive(),

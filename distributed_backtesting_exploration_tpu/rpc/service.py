@@ -11,6 +11,14 @@ only the server->worker leg, reference ``src/server/main.rs:212`` /
 ``src/worker/main.rs:49``; with binary OHLCV blocks both directions carry
 bulk payloads — jobs down, metric matrices up — so symmetric compression is
 the right default).
+
+Distributed-trace propagation rides IN the messages (``JobSpec.trace_id``
+/ ``parent_span_id``, ``CompleteItem.trace_id``), not in gRPC metadata:
+this hand-written stub layer registers plain unary handlers with no
+interceptor chain, the worker's native channel codec re-serializes the
+same protos across the compute boundary, and the journal persists them —
+one carrier, visible to dbxlint's proto-drift rule, instead of a metadata
+side-channel each hop would have to re-implement.
 """
 
 from __future__ import annotations

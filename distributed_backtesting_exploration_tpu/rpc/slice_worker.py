@@ -216,7 +216,12 @@ class SliceWorker:
         from . import backtesting_pb2 as pb
 
         batch = pb.CompleteBatch(worker_id=self.worker_id, items=items)
-        with obs.span("worker.report", jobs=len(items)), \
+        # Adopt the dispatcher-minted traces stamped on the items: the
+        # group's trace_context has already exited by report time, and
+        # without it the report span would carry no trace ids (the RPC
+        # wall would read as transport in obs.timeline).
+        with obs.trace_context(obs.job_trace_pairs(items)), \
+                obs.span("worker.report", jobs=len(items)), \
                 obs.timer(self._h_rpc["CompleteJobs"]):
             self._stub.CompleteJobs(batch, timeout=10.0)
         self.jobs_completed += len(items)
@@ -398,7 +403,8 @@ class SliceWorker:
                 # Validated-bad kinds: complete with empty blocks (see
                 # _group_jobs) — no broadcast round needed.
                 self._complete([pb.CompleteItem(id=j.id, metrics=b"",
-                                                elapsed_s=0.0)
+                                                elapsed_s=0.0,
+                                                trace_id=j.trace_id)
                                 for j in bad])
             # One broadcast round per group; followers need no counts in
             # advance — they simply process the control stream.
@@ -437,7 +443,12 @@ class SliceWorker:
                             "over %d chips)", [j.id for j in group],
                             strat, bars, self.chips)
                         t0 = time.perf_counter()
-                        _, m = self._run_group(msg, rows.reshape(-1))
+                        # Join the group's dispatcher-minted traces: the
+                        # slice.run_ts_group span (and the report span in
+                        # _complete) stitches onto each job's dispatch
+                        # span like the single-host worker's chain.
+                        with obs.trace_context(obs.job_trace_pairs(group)):
+                            _, m = self._run_group(msg, rows.reshape(-1))
                         # The group runs as ONE sharded program, so
                         # per-job wall time does not exist; elapsed_s is
                         # the group wall divided evenly (sums correctly
@@ -450,7 +461,8 @@ class SliceWorker:
                                 id=job.id,
                                 metrics=wire.metrics_to_bytes(Metrics(
                                     *(np.asarray(f)[i] for f in m))),
-                                elapsed_s=per_job)
+                                elapsed_s=per_job,
+                                trace_id=job.trace_id)
                             for i, job in enumerate(group)])
                         continue
                     log.warning(
@@ -469,12 +481,14 @@ class SliceWorker:
                        "cost": cost, "ppy": ppy, "bars": bars,
                        "n_pad": n_pad}
                 t0 = time.perf_counter()
-                _, m = self._run_group(msg, rows.reshape(-1))
+                with obs.trace_context(obs.job_trace_pairs(group)):
+                    _, m = self._run_group(msg, rows.reshape(-1))
                 per_job = (time.perf_counter() - t0) / len(group)
                 items = []
                 for i, job in enumerate(group):
                     blob = wire.metrics_to_bytes(
                         Metrics(*(np.asarray(f)[i] for f in m)))
                     items.append(pb.CompleteItem(
-                        id=job.id, metrics=blob, elapsed_s=per_job))
+                        id=job.id, metrics=blob, elapsed_s=per_job,
+                        trace_id=job.trace_id))
                 self._complete(items)

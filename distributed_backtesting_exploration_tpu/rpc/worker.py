@@ -123,15 +123,19 @@ def _decode_batch(data: bytes):
 
 
 def _encode_completion(c: compute.Completion) -> bytes:
+    # trace_id rides the channel envelope too: a completion crossing the
+    # native MPMC queue must come out stitchable (proto CompleteRequest is
+    # the envelope, so the wire field doubles as the channel field).
     return pb.CompleteRequest(
         id=c.job_id, metrics=c.metrics,
-        elapsed_s=c.elapsed_s).SerializeToString()
+        elapsed_s=c.elapsed_s, trace_id=c.trace_id).SerializeToString()
 
 
 def _decode_completion(data: bytes) -> compute.Completion:
     req = pb.CompleteRequest()
     req.ParseFromString(data)
-    return compute.Completion(req.id, req.metrics, req.elapsed_s)
+    return compute.Completion(req.id, req.metrics, req.elapsed_s,
+                              trace_id=req.trace_id)
 
 
 class Worker:
@@ -226,7 +230,12 @@ class Worker:
                 return
             self._busy.set()
             try:
-                with obs.span("worker.process", jobs=len(batch)):
+                # Adopt the batch's dispatcher-minted traces: the process
+                # span (and everything the backend spans beneath it) joins
+                # each job's trace as a child of its dispatch span.
+                with obs.trace_context(obs.job_trace_pairs(batch)), \
+                        obs.span("worker.process", jobs=len(batch),
+                                 worker=self.worker_id):
                     for completion in self.backend.process(batch):
                         self._out.put(completion)
             except Exception:
@@ -282,8 +291,12 @@ class Worker:
             # worker.report): submit covers decode + H2D + kernel launch,
             # collect the device drain + d2h wait, report the completion
             # RPC — the decode->compute->report attribution the JSONL
-            # event log reconstructs per batch.
-            with obs.span("worker.submit", jobs=len(batch)):
+            # event log reconstructs per batch. The trace context adopts
+            # every job's dispatcher-minted (trace_id, dispatch span) pair
+            # so the chain stitches cross-process.
+            with obs.trace_context(obs.job_trace_pairs(batch)), \
+                    obs.span("worker.submit", jobs=len(batch),
+                             worker=self.worker_id):
                 return (self.backend.submit(batch), batch)
         except Exception:
             log.exception("backend failed submitting a %d-job batch; jobs "
@@ -293,7 +306,9 @@ class Worker:
     def _collect_into_out(self, pending) -> None:
         handle, batch = pending
         try:
-            with obs.span("worker.collect", jobs=len(batch)):
+            with obs.trace_context(obs.job_trace_pairs(batch)), \
+                    obs.span("worker.collect", jobs=len(batch),
+                             worker=self.worker_id):
                 for completion in self.backend.collect(handle):
                     self._out.put(completion)
         except Exception:
@@ -315,18 +330,28 @@ class Worker:
         # Fresh timer epoch: the rate is "since the worker STARTED", not
         # since it was constructed (a harness may build workers long
         # before running them).
+        # The per-worker label set is a deliberate, BOUNDED exception to
+        # the obs-cardinality rule: one process hosts a handful of workers
+        # and every uuid-labeled child is removed in this method's finally
+        # (lifecycle hygiene below), so the series count tracks LIVE
+        # workers, not all workers ever seen.
+        # dbxlint: disable=obs-cardinality -- lifecycle-managed: removed in run()'s finally
         self._jobs_rate = obs.StepTimer(self.obs.gauge(
             "dbx_worker_jobs_per_sec",
             help="accepted completions/s since worker start",
             worker=self.worker_id))
         wid = self.worker_id
         self._gauges = {
+            # dbxlint: disable=obs-cardinality -- lifecycle-managed: removed in run()'s finally
             "in": self.obs.gauge("dbx_worker_channel_depth", worker=wid,
                                  channel="in"),
+            # dbxlint: disable=obs-cardinality -- lifecycle-managed: removed in run()'s finally
             "out": self.obs.gauge("dbx_worker_channel_depth", worker=wid,
                                   channel="out"),
+            # dbxlint: disable=obs-cardinality -- lifecycle-managed: removed in run()'s finally
             "deferred": self.obs.gauge("dbx_worker_deferred_completions",
                                        worker=wid),
+            # dbxlint: disable=obs-cardinality -- lifecycle-managed: removed in run()'s finally
             "busy": self.obs.gauge("dbx_worker_busy", worker=wid)}
         self.obs.add_collector(f"worker-{wid}", self._collect_gauges)
         self._compute_thread = threading.Thread(
@@ -486,14 +511,20 @@ class Worker:
         once its attempts are exhausted — the lease re-queues the job)."""
         req = pb.CompleteBatch(worker_id=self.worker_id, items=[
             pb.CompleteItem(id=c.job_id, metrics=c.metrics,
-                            elapsed_s=c.elapsed_s) for _, c in chunk])
+                            elapsed_s=c.elapsed_s, trace_id=c.trace_id)
+            for _, c in chunk])
         try:
             # Timeout stays under the dispatcher's default 10 s prune window:
             # only ONE batch RPC can delay the next heartbeat (status_overdue
             # yields between chunks), so 8 s bounds the worst heartbeat gap.
             # A link too slow to move a chunk in 8 s fails the attempt; items
             # park for retry and, if attempts exhaust, leases re-queue them.
-            with obs.span("worker.report", jobs=len(chunk)), \
+            # The report span joins each completion's trace (no remote
+            # parent — the dispatch span parented the compute chain; the
+            # report leg is a root-level stage of the job's timeline).
+            with obs.trace_context([(c.trace_id, "") for _, c in chunk]), \
+                    obs.span("worker.report", jobs=len(chunk),
+                             worker=self.worker_id), \
                     obs.timer(self._h_rpc["CompleteJobs"]):
                 reply = stub.CompleteJobs(req, timeout=8.0)
             self._log_reconnected()

@@ -1,0 +1,38 @@
+"""Seeded obs-cardinality violations: metric labels fed from unbounded
+runtime data (job ids, file paths, peer addresses). The lint engine never
+imports this module — AST only."""
+
+from distributed_backtesting_exploration_tpu import obs
+
+
+class FleetRecorder:
+    def __init__(self, worker_id):
+        self.worker_id = worker_id
+
+    def publish(self, reg):
+        wid = "bootstrap"
+        wid = self.worker_id
+        # one-hop alias of an unbounded attribute: flagged — the LAST
+        # binding wins; the earlier literal must not launder it
+        reg.gauge("fx_worker_busy", worker=wid).set(1)
+        endpoint = self.worker_id
+        endpoint = "pool-a"
+        # rebound to a literal before use: NOT flagged (last wins)
+        reg.gauge("fx_pool_up", pool=endpoint).set(1)
+
+
+def record(reg, job_id, path, peer_addr, lineno):
+    reg.counter("fx_jobs_total", job=job_id).inc()            # flagged: param
+    reg.histogram("fx_read_seconds", file=path).observe(0.1)  # flagged: path
+    reg.gauge("fx_peer_up", peer=peer_addr).set(1)            # flagged: addr
+    # f-string built from unbounded data: flagged
+    reg.counter("fx_sites_total", site=f"{path}:{lineno}").inc()
+    # bounded literals and non-matching names: NOT flagged
+    reg.counter("fx_ok_total", method="RequestJobs").inc()
+    strategy = "sma_crossover"
+    reg.counter("fx_by_kernel_total", kernel=strategy).inc()
+
+
+def suppressed(reg, job_id):
+    # dbxlint: disable=obs-cardinality -- demo: suppression carries a why
+    reg.counter("fx_sup_total", job=job_id).inc()

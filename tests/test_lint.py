@@ -79,6 +79,54 @@ def test_blocking_call_flags_sleep_in_servicer_handler():
     assert "SlowDispatcher.RequestJobs" in findings[0].message
 
 
+def test_obs_cardinality_flags_unbounded_label_values():
+    """The seeded fixture plants a param-named id, a path, a peer address,
+    an f-string built from a path, and a one-hop alias of an unbounded
+    attribute (`wid = self.worker_id`) — all flagged; bounded literals and
+    non-matching names are not, and the suppressed site counts as
+    suppressed."""
+    findings, suppressed = _lint_fixture("obs_cardinality.py",
+                                         ast_rules.ObsCardinalityRule())
+    assert suppressed == 1
+    assert [(f.rule, f.path, f.line) for f in findings] == [
+        ("obs-cardinality", "obs_cardinality.py",
+         _fixture_line("obs_cardinality.py", 'worker=wid')),
+        ("obs-cardinality", "obs_cardinality.py",
+         _fixture_line("obs_cardinality.py", 'job=job_id')),
+        ("obs-cardinality", "obs_cardinality.py",
+         _fixture_line("obs_cardinality.py", 'file=path')),
+        ("obs-cardinality", "obs_cardinality.py",
+         _fixture_line("obs_cardinality.py", 'peer=peer_addr')),
+        ("obs-cardinality", "obs_cardinality.py",
+         _fixture_line("obs_cardinality.py", 'site=f"{path}')),
+    ]
+    alias = findings[0]
+    assert "wid = self.worker_id" in alias.message
+    # Last binding wins in BOTH directions: `wid` above was first bound
+    # to a literal, and `endpoint` (unbounded first, literal last) must
+    # not be flagged.
+    assert _fixture_line("obs_cardinality.py", "pool=endpoint") \
+        not in [f.line for f in findings]
+    assert not any("fx_ok_total" in f.message
+                   or "fx_by_kernel_total" in f.message for f in findings)
+
+
+def test_obs_cardinality_ignores_splats_and_bounded_loops(tmp_path):
+    """**label splats are opaque (judged at construction, not the splat)
+    and loop variables over literal tuples don't match the unbounded
+    vocabulary — the package's method=m / phase=phase idiom stays clean."""
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "def wire(reg, labels):\n"
+        "    reg.gauge('fx_info', **labels).set(1)\n"
+        "    hs = {m: reg.histogram('fx_rpc_seconds', method=m)\n"
+        "          for m in ('RequestJobs', 'CompleteJobs')}\n"
+        "    return hs\n")
+    findings, _, _ = core.lint_path(str(mod),
+                                    [ast_rules.ObsCardinalityRule()])
+    assert findings == []
+
+
 def _load_bad_kernels():
     spec = importlib.util.spec_from_file_location(
         "dbxlint_fixture_bad_kernel", os.path.join(FIXTURES, "bad_kernel.py"))

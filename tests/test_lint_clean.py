@@ -25,7 +25,8 @@ def test_package_lints_clean():
     # The gate must actually have run every registered rule.
     assert set(result["rules"]) == {
         "trace-time-env", "lock-discipline", "import-time-config",
-        "blocking-call", "kernel-hygiene", "proto-drift"}
+        "blocking-call", "obs-cardinality", "kernel-hygiene",
+        "proto-drift"}
 
 
 def test_cli_module_entrypoint_is_wired():

@@ -1,4 +1,5 @@
-"""The obs layer: registry semantics, spans, rendering, endpoint, dump CLI."""
+"""The obs layer: registry semantics, spans, rendering, endpoint, dump CLI,
+distributed-trace plumbing, and the timeline analyzer."""
 
 import json
 import math
@@ -8,7 +9,8 @@ import urllib.request
 import pytest
 
 from distributed_backtesting_exploration_tpu import obs
-from distributed_backtesting_exploration_tpu.obs import dump, events
+from distributed_backtesting_exploration_tpu.obs import (
+    dump, events, timeline)
 
 
 # ---------------------------------------------------------------------------
@@ -89,6 +91,25 @@ def test_prometheus_rendering():
     assert "dbx_h_seconds_count 1" in txt
 
 
+def test_prometheus_escaping_hostile_label_and_help_values():
+    """Backslash, double-quote, and newline in label values (and backslash/
+    newline in HELP text) must be escaped per the text exposition format —
+    emitted raw they terminate the sample line mid-value and the scrape
+    fails to parse."""
+    reg = obs.Registry()
+    hostile = 'C:\\data\n"quoted"'
+    reg.counter("dbx_esc_total", help="line one\nline two \\ backslash",
+                path_kind=hostile).inc()
+    txt = reg.render_prometheus()
+    assert ('dbx_esc_total{path_kind='
+            '"C:\\\\data\\n\\"quoted\\""} 1.0') in txt
+    assert "# HELP dbx_esc_total line one\\nline two \\\\ backslash" in txt
+    # No raw newline survives inside any line: every line is one sample
+    # or one comment, never a torn continuation.
+    for line in txt.splitlines():
+        assert line.startswith(("#", "dbx_")), line
+
+
 def test_registry_thread_safety():
     reg = obs.Registry()
     c = reg.counter("dbx_mt_total")
@@ -147,6 +168,63 @@ def test_span_records_on_exception(tmp_path):
     assert rec["name"] == "boom" and rec["ok"] is False
 
 
+def test_span_trace_ids_context_and_ring(tmp_path):
+    """Every span carries a (trace_id, span_id, parent_id) triple: nested
+    spans parent locally, the outermost span of a trace_context adopts the
+    remote parent, and completed spans land in the bounded ring with the
+    same record the JSONL log gets."""
+    path = str(tmp_path / "t.jsonl")
+    events.configure(path)
+    tid = obs.new_trace_id()
+    try:
+        with obs.trace_context(tid, parent_span_id="remote-parent"):
+            assert obs.current_trace() == tid
+            with obs.span("outer_t"):
+                with obs.span("inner_t"):
+                    pass
+        assert obs.current_trace() is None
+    finally:
+        events.configure(None)
+    recs = {r["name"]: r for r in map(json.loads, open(path))}
+    outer, inner = recs["outer_t"], recs["inner_t"]
+    assert outer["trace_id"] == inner["trace_id"] == tid
+    assert outer["parent_id"] == "remote-parent"
+    assert inner["parent_id"] == outer["span_id"]
+    assert outer["span_id"] != inner["span_id"]
+    assert "t0" in outer and "pid" in outer
+    # The ring holds the same records (minus the writer-stamped ts/pid).
+    ring = {r["name"]: r for r in obs.recent_spans()
+            if r["name"] in ("outer_t", "inner_t")}
+    assert ring["outer_t"]["span_id"] == outer["span_id"]
+
+    # Multi-trace context (one batch, several jobs): spans carry a
+    # `traces` pair list instead of a single trace_id.
+    pairs = [(obs.new_trace_id(), "p1"), (obs.new_trace_id(), "p2")]
+    with obs.trace_context(pairs):
+        assert obs.current_trace() is None
+        with obs.span("multi_t"):
+            pass
+    multi = next(r for r in reversed(obs.recent_spans())
+                 if r["name"] == "multi_t")
+    assert multi["traces"] == [list(p) for p in pairs]
+    assert "trace_id" not in multi
+
+
+def test_stats_payload_ships_recent_spans():
+    from distributed_backtesting_exploration_tpu.obs import http as obs_http
+
+    with obs.span("payload_probe"):
+        pass
+    reg = obs.Registry()
+    payload = obs_http.stats_payload(reg)
+    fam = payload["dbx_spans_recent"]
+    assert fam["type"] == "spans"
+    assert any(r["name"] == "payload_probe" for r in fam["values"])
+    # dump's snapshot renderer must skip the spans family, not crash.
+    assert "payload_probe" not in dump.render_snapshot(
+        {"dbx_spans_recent": fam})
+
+
 # ---------------------------------------------------------------------------
 # HTTP endpoint + dump CLI (the tier-1 smoke of the tooling)
 # ---------------------------------------------------------------------------
@@ -183,6 +261,88 @@ def test_metrics_endpoint_and_dump_cli(tmp_path, capsys):
     assert dump.main([path]) == 0
     out = capsys.readouterr().out
     assert "phase_a" in out and "phase_a/phase_b" in out and "share" in out
+
+
+def test_dump_and_timeline_cli_multi_input_malformed_and_empty(tmp_path,
+                                                               capsys):
+    """The CI/tooling contract of BOTH CLIs: several --jsonl inputs merge,
+    malformed lines are skipped AND counted, and zero parseable events
+    exits non-zero (a typo'd path must not render as a healthy quiet
+    fleet)."""
+    tid = obs.new_trace_id()
+    a = tmp_path / "a.jsonl"
+    b = tmp_path / "b.jsonl"
+    a.write_text(
+        json.dumps({"ev": "span", "name": "job.queue_wait", "t0": 10.0,
+                    "dur_s": 1.0, "trace_id": tid, "span_id": "s1",
+                    "job": "j1"}) + "\n"
+        + json.dumps({"ev": "span", "name": "job", "t0": 10.0,
+                      "dur_s": 4.0, "trace_id": tid, "span_id": "s0",
+                      "job": "j1", "worker": "w0"}) + "\n"
+        + "{torn line\n")
+    b.write_text(
+        json.dumps({"ev": "span", "name": "worker.process", "t0": 12.0,
+                    "dur_s": 1.5, "trace_id": tid, "span_id": "s2",
+                    "parent_id": "d1"}) + "\n"
+        + "not json at all\n")
+
+    rc = dump.main([str(a), "--jsonl", str(b)])
+    out = capsys.readouterr()
+    assert rc == 0
+    assert "job.queue_wait" in out.out and "worker.process" in out.out
+    assert "2 malformed line(s) skipped" in out.out
+
+    rc = timeline.main(["--jsonl", str(a), str(b), "--format", "json"])
+    out = capsys.readouterr()
+    assert rc == 0
+    assert "skipped 2 malformed line(s)" in out.err
+    summary = json.loads(out.out)
+    assert summary["jobs"] == 1
+    job = summary["per_job"][0]
+    assert job["job"] == "j1" and job["worker"] == "w0"
+    # Critical path partitions the 4s e2e window: 1s queue-wait, 1.5s
+    # execute (worker.process fallback), the rest transport.
+    assert job["stages"]["queue_wait"] == pytest.approx(1.0)
+    assert job["stages"]["execute"] == pytest.approx(1.5)
+    assert job["stages"]["transport"] == pytest.approx(1.5)
+    assert sum(job["stages"].values()) == pytest.approx(job["e2e_s"])
+
+    # --job filter: a non-matching id exits non-zero.
+    assert timeline.main(["--jsonl", str(a), "--job", "nope"]) == 2
+    capsys.readouterr()
+
+    # Zero parseable events -> non-zero exit for both CLIs.
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("garbage\n{more garbage\n")
+    assert dump.main([str(empty)]) == 2
+    assert timeline.main(["--jsonl", str(empty)]) == 2
+    capsys.readouterr()
+
+
+def test_timeline_straggler_flagging():
+    """Jobs whose stage time exceeds the fleet p95 are flagged once the
+    fleet is big enough; below min_straggler_jobs the p95 of a tiny
+    sample flags nobody."""
+    spans = []
+    for i in range(10):
+        tid = f"{i:032x}"
+        dur = 5.0 if i == 9 else 1.0   # job 9: 5x the fleet's execute
+        spans.append({"ev": "span", "name": "job", "t0": 0.0,
+                      "dur_s": dur + 1.0, "trace_id": tid, "span_id": "r",
+                      "job": f"job{i}", "worker": f"w{i % 2}"})
+        spans.append({"ev": "span", "name": "worker.execute", "t0": 0.5,
+                      "dur_s": dur, "trace_id": tid, "span_id": "e"})
+    tls = timeline.reconstruct(spans)
+    assert len(tls) == 10
+    s = timeline.summarize(tls)
+    flagged = {x["job"] for x in s["stragglers"]
+               if x["stage"] == "execute"}
+    assert flagged == {"job9"}
+    # Per-worker attribution covers both workers.
+    assert set(s["workers"]) == {"w0", "w1"}
+    # A 3-job fleet flags nothing (p95 of a tiny sample is noise).
+    tiny = timeline.reconstruct(spans[:6])
+    assert timeline.summarize(tiny)["stragglers"] == []
 
 
 def test_event_log_env_opt_in_is_lazy(tmp_path, monkeypatch):
