@@ -981,7 +981,26 @@ def main():
     # Reported as JOBS/s per worker count; the 1->2->4 scaling curve (or
     # its absence) localizes the saturation point (DESIGN.md "Control-plane
     # ceiling"). The reference's one perf fact is jobs/s through its loop.
-    def run_e2e_local(n_workers, n_jobs):
+    def _worker_wire_bytes():
+        """Sum of the workers' serialized request/reply proto bytes (the
+        dbx_worker_wire_bytes_total counters, shared registry) — the
+        instrument behind every wire_bytes_per_job column."""
+        from distributed_backtesting_exploration_tpu import obs as obs_mod
+
+        reg = obs_mod.get_registry()
+        return sum(
+            reg.counter("dbx_worker_wire_bytes_total",
+                        method=m, direction=d).value
+            for m in ("RequestJobs", "CompleteJobs", "FetchPayload")
+            for d in ("request", "reply"))
+
+    def run_e2e_local(n_workers, n_jobs, *, job_recs=None, dedupe=True,
+                      name=None):
+        """The loopback control-plane drain. ``job_recs`` (a factory
+        seed -> record list) overrides the default distinct-panel
+        synthetic workload — the dedupe A/B passes a shared-panel
+        factory; ``dedupe`` toggles dispatch-by-digest on the
+        dispatcher. Returns (jobs/s, wire bytes/job)."""
         import tempfile
         import threading
 
@@ -993,10 +1012,15 @@ def main():
         from distributed_backtesting_exploration_tpu.rpc.worker import Worker
 
         lgrid = {"fast": np.arange(5.0, 9.0, dtype=np.float32)}
+        if job_recs is None:
+            def job_recs(n, seed):
+                return synthetic_jobs(n, 32, "sma_crossover", lgrid,
+                                      seed=seed)
+        name = name or f"e2e_local_w{n_workers}"
         queue = JobQueue()
         with tempfile.TemporaryDirectory() as results_dir:
             disp = Dispatcher(queue, PeerRegistry(prune_window_s=30.0),
-                              results_dir=results_dir)
+                              results_dir=results_dir, panel_dedupe=dedupe)
             srv = DispatcherServer(disp, bind="localhost:0",
                                    prune_interval_s=0.5).start()
             workers = [Worker(f"localhost:{srv.port}", InstantBackend(),
@@ -1008,8 +1032,7 @@ def main():
                        for w in workers]
 
             def drain(n, seed):
-                for rec in synthetic_jobs(n, 32, "sma_crossover", lgrid,
-                                          seed=seed):
+                for rec in job_recs(n, seed):
                     queue.enqueue(rec)
                 deadline = time.monotonic() + 300.0
                 while not queue.drained:
@@ -1022,9 +1045,11 @@ def main():
                 for t in threads:
                     t.start()
                 drain(max(n_jobs // 4, 64), seed=300)   # channel warm-up
+                wire0 = _worker_wire_bytes()
                 t0 = time.perf_counter()
                 drain(n_jobs, seed=301)
                 elapsed = time.perf_counter() - t0
+                wire_per_job = (_worker_wire_bytes() - wire0) / n_jobs
             finally:
                 for w in workers:
                     w.stop()
@@ -1032,15 +1057,61 @@ def main():
                     t.join(timeout=30)
                 srv.stop()
         rate = n_jobs / elapsed
-        print(f"bench[e2e_local_w{n_workers}]: {n_jobs} instant jobs, "
-              f"{n_workers} worker(s), substrate={queue.substrate} -> "
-              f"{rate:.0f} jobs/s", file=sys.stderr)
-        rates[f"e2e_local_w{n_workers}"] = rate
+        print(f"bench[{name}]: {n_jobs} instant jobs, "
+              f"{n_workers} worker(s), substrate={queue.substrate}, "
+              f"dedupe={'on' if dedupe else 'off'} -> {rate:.0f} jobs/s, "
+              f"{wire_per_job:.0f} wire B/job", file=sys.stderr)
+        rates[name] = rate
+        return rate, wire_per_job
 
     if enabled("e2e_local"):
         n_local_jobs = int(os.environ.get("DBX_BENCH_LOCAL_JOBS", 1500))
-        for n_workers in (1, 2, 4):
-            run_e2e_local(n_workers, n_local_jobs)
+        wcounts = tuple(int(x) for x in os.environ.get(
+            "DBX_BENCH_LOCAL_WORKERS", "1,2,4").split(","))
+        wire_cols = {}
+        for n_workers in wcounts:
+            _, wb = run_e2e_local(n_workers, n_local_jobs)
+            wire_cols[f"w{n_workers}"] = round(wb, 1)
+        # Dispatch-by-digest A/B on the workload the feature exists for:
+        # many jobs sharing ONE panel (a grid sweep re-ships the same
+        # OHLC bytes in every job). Dedupe-on ships the panel once per
+        # worker and digest-only afterwards — the jobs/s delta is exactly
+        # the per-job payload marshalling the control-plane ceiling
+        # measured as its floor.
+        from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+            JobRecord)
+        from distributed_backtesting_exploration_tpu.utils import (
+            data as dd_data)
+
+        dd_bars = int(os.environ.get("DBX_BENCH_DEDUPE_BARS", 4096))
+        dd_jobs_n = max(n_local_jobs // 2, 48)
+        dd_series = dd_data.synthetic_ohlcv(1, dd_bars, seed=500)
+        dd_blob = dd_data.to_wire_bytes(
+            type(dd_series)(*(np.asarray(f[0]) for f in dd_series)))
+        dd_grid = {"fast": np.arange(5.0, 9.0, dtype=np.float32)}
+
+        def dd_recs(n, seed):
+            return [JobRecord(id=f"dd-{seed}-{i}",
+                              strategy="sma_crossover", grid=dd_grid,
+                              ohlcv=dd_blob) for i in range(n)]
+
+        r_on, wb_on = run_e2e_local(1, dd_jobs_n, job_recs=dd_recs,
+                                    dedupe=True,
+                                    name="e2e_local_dedupe_on")
+        r_off, wb_off = run_e2e_local(1, dd_jobs_n, job_recs=dd_recs,
+                                      dedupe=False,
+                                      name="e2e_local_dedupe_off")
+        ROOFLINE["e2e_local"] = {
+            "wire_bytes_per_job": wire_cols,
+            "dedupe": {
+                "panel_bytes": len(dd_blob),
+                "jobs": dd_jobs_n,
+                "jobs_per_s_on": round(r_on, 1),
+                "jobs_per_s_off": round(r_off, 1),
+                "dedupe_speedup": round(r_on / max(r_off, 1e-9), 3),
+                "wire_bytes_per_job_on": round(wb_on, 1),
+                "wire_bytes_per_job_off": round(wb_off, 1),
+                "wire_reduction": round(wb_off / max(wb_on, 1e-9), 1)}}
 
     # --- direct_dispatch: the dispatcher-attributable ceiling -------------
     # e2e_local_w* runs dispatcher AND workers as threads of ONE Python
@@ -1080,39 +1151,46 @@ def main():
                                           seed=seed):
                     queue.enqueue(rec)
                 done = 0
+                wire = 0
                 while done < n:
-                    reply = stub.RequestJobs(pb.JobsRequest(
-                        worker_id="direct", chips=1, jobs_per_chip=batch))
+                    req = pb.JobsRequest(
+                        worker_id="direct", chips=1, jobs_per_chip=batch)
+                    reply = stub.RequestJobs(req)
                     if not reply.jobs:
                         break
-                    stub.CompleteJobs(pb.CompleteBatch(
+                    wire += req.ByteSize() + reply.ByteSize()
+                    creq = pb.CompleteBatch(
                         worker_id="direct",
                         items=[pb.CompleteItem(id=j.id, metrics=b"",
                                                elapsed_s=0.0)
-                               for j in reply.jobs]))
+                               for j in reply.jobs])
+                    crep = stub.CompleteJobs(creq)
+                    wire += creq.ByteSize() + crep.ByteSize()
                     done += len(reply.jobs)
-                return done
+                return done, wire
 
             try:
                 cycle(max(n_jobs // 4, 64), seed=400)   # warm the channel
                 t0 = time.perf_counter()
-                done = cycle(n_jobs, seed=401)
+                done, wire = cycle(n_jobs, seed=401)
                 elapsed = time.perf_counter() - t0
             finally:
                 channel.close()
                 srv.stop()
         rate = done / elapsed
+        wire_per_job = wire / max(done, 1)
         name = f"direct_dispatch_b{batch}"
         print(f"bench[{name}]: {done} inline jobs, bare client cycle, "
               f"batch {batch}, substrate={queue.substrate} -> "
-              f"{rate:.0f} jobs/s", file=sys.stderr)
+              f"{rate:.0f} jobs/s, {wire_per_job:.0f} wire B/job",
+              file=sys.stderr)
         rates[name] = rate
-        return rate
+        return rate, wire_per_job
 
     if enabled("direct_dispatch"):
         dd_jobs = int(os.environ.get("DBX_BENCH_LOCAL_JOBS", 1500))
-        r32 = run_direct_dispatch(32, dd_jobs)
-        run_direct_dispatch(128, dd_jobs)
+        r32, wb32 = run_direct_dispatch(32, dd_jobs)
+        _, wb128 = run_direct_dispatch(128, dd_jobs)
         # Regression floor: DESIGN.md measured ~5.9k jobs/s at batch 32 on
         # this 1-core box; 2k leaves 3x headroom for a loaded machine
         # while still catching an order-of-magnitude regression.
@@ -1122,7 +1200,9 @@ def main():
                   "(DESIGN.md measured ~5.9k)", file=sys.stderr)
         ROOFLINE["direct_dispatch_floor"] = {
             "batch32_jobs_per_s": round(r32, 1), "floor": 2000,
-            "floor_ok": bool(r32 >= 2000)}
+            "floor_ok": bool(r32 >= 2000),
+            "wire_bytes_per_job": {"b32": round(wb32, 1),
+                                   "b128": round(wb128, 1)}}
 
     # --- queue_machine: the state machine alone, both substrates ----------
     # (VERDICT r4 weak #5 / next #7: the native DbxJobQueue driven per job

@@ -583,7 +583,7 @@ class ObsCardinalityRule:
     _UNBOUNDED = re.compile(
         r"(?:^|_)(?:id|ids|jid|uid|uuid|guid|key|token|path|paths|file|"
         r"filename|dir|addr|address|peer|host|hostname|port|url|uri|"
-        r"target|trace|span)(?:$|_)")
+        r"target|trace|span|digest|digests|blake2b|checksum|hash)(?:$|_)")
 
     def check(self, ctx: LintContext) -> list[Finding]:
         out: list[Finding] = []

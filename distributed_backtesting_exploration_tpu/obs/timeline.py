@@ -40,9 +40,15 @@ import json
 import sys
 
 # The canonical stage order of the job lifecycle (report tables and the
-# acceptance contract both use it).
-STAGES = ("queue_wait", "dispatch", "transport", "decode", "compile",
-          "execute", "d2h", "report")
+# acceptance contract both use it). `panel_cache_hit` is the
+# dispatch-by-digest pseudo-stage: a worker serving a panel from its
+# digest cache emits its decode span with a truthy `cache_hit` attr, and
+# that window is charged here — without it the (near-zero) hit window
+# would read as decode work that never happened, and timelines from
+# workers that skip the span entirely would silently mis-charge the gap
+# to transport.
+STAGES = ("queue_wait", "dispatch", "transport", "panel_cache_hit",
+          "decode", "compile", "execute", "d2h", "report")
 
 # span name -> (stage, priority). Priority 2 = stage-specific span wins
 # its interval outright; priority 1 = envelope fallback (charged only
@@ -56,6 +62,11 @@ SPAN_STAGE = {
     "worker.execute": ("execute", 2),
     "worker.d2h": ("d2h", 2),
     "worker.report": ("report", 2),
+    # The digest-miss recovery RPC (can fire inside the decode window on
+    # the compute-thread race leg): network wall, charged to transport —
+    # same priority as the specific spans, so innermost-wins beats the
+    # enclosing decode span over the fetch's own interval.
+    "worker.payload_fetch": ("transport", 2),
     "worker.submit": ("execute", 1),
     "worker.collect": ("d2h", 1),
     "worker.process": ("execute", 1),
@@ -157,7 +168,8 @@ def reconstruct(events) -> dict[str, JobTimeline]:
                 "span_id": rec.get("span_id", ""),
                 "parent_id": parent_id,
                 "pid": rec.get("pid"), "ok": rec.get("ok", True),
-                "worker": rec.get("worker", "")})
+                "worker": rec.get("worker", ""),
+                "cache_hit": bool(rec.get("cache_hit", False))})
             if name == E2E_SPAN:
                 tl.e2e_t0, tl.e2e_dur = t0, dur
             if rec.get("job") and not tl.job_id:
@@ -189,10 +201,18 @@ def critical_path(tl: JobTimeline) -> dict[str, float]:
         staged = SPAN_STAGE.get(s["name"])
         if staged is None:
             continue
+        stage, prio = staged[0], staged[1]
+        if s["name"] == "worker.decode" and s.get("cache_hit"):
+            # Dispatch by digest: every panel of this group came from the
+            # worker's digest cache — the window is a cache HIT, not
+            # decode work. (d2h spans also carry cache_hit — the group's
+            # panel upload was device-cached — but the result drain they
+            # time is real work and stays attributed to d2h.)
+            stage = "panel_cache_hit"
         a = max(s["t0"], lo)
         b = min(s["t0"] + s["dur_s"], hi)
         if b > a:
-            ivals.append((a, b, staged[1], s["t0"], staged[0]))
+            ivals.append((a, b, prio, s["t0"], stage))
     points = sorted({lo, hi, *(a for a, *_ in ivals),
                      *(b for _, b, *_ in ivals)})
     for a, b in zip(points, points[1:]):
@@ -304,6 +324,13 @@ def summarize_spans(spans, **kw) -> dict:
         return {}
     out = summarize(timelines, **kw)
     out.pop("per_job", None)   # BENCH JSON carries the digest, not N rows
+    n_strag = len(out["stragglers"])
+    if n_strag > 50:
+        # Same digest-not-rows discipline: stragglers are sorted worst
+        # first, so the tail past 50 is noise a 400 KB BENCH blob would
+        # otherwise carry; the total survives as a count.
+        out["stragglers"] = out["stragglers"][:50]
+        out["stragglers_total"] = n_strag
     if torn:
         out["torn_jobs"] = len(torn)
     return out

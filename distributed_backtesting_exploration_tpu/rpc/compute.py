@@ -11,6 +11,8 @@ the stub's isolation suggested (SURVEY.md §4).
 from __future__ import annotations
 
 import logging
+import os
+import threading
 import time
 from typing import Callable, Iterable, NamedTuple, Protocol
 
@@ -23,6 +25,118 @@ from ..parallel._shardmap_compat import shard_map
 from ..utils import data as data_mod
 
 log = logging.getLogger("dbx.compute")
+
+_DEFAULT_CACHE_MB = 256
+
+
+def cache_max_bytes() -> int:
+    """Worker panel-cache budget (per level), read lazily — import-time
+    env capture would pin the knob before tests/operators can set it."""
+    return int(float(os.environ.get("DBX_PANEL_CACHE_MB",
+                                    _DEFAULT_CACHE_MB)) * 1024 * 1024)
+
+
+class PanelCache:
+    """Two-level digest-keyed panel cache (dispatch by digest, worker side).
+
+    The dispatcher content-addresses every panel (``JobSpec.panel_digest``)
+    and, once a worker generation has received the bytes, ships
+    digest-only jobs. This cache is what makes that hit cheap end to end:
+
+    - **host level**: decoded :class:`~..utils.data.OHLCV` panels — a hit
+      skips the wire decode entirely;
+    - **device level**: the panel's stacked ``(5, T)`` field block already
+      resident on the accelerator — a hit additionally skips the
+      host->device transfer (group stacking then runs device-side).
+
+    Each level is LRU-bounded by approximate bytes (``DBX_PANEL_CACHE_MB``,
+    default 256 per level). Eviction is not an error: the worker recovers
+    a digest-only miss through the dispatcher's ``FetchPayload`` RPC.
+    Thread-safe — the worker's control thread probes/fills the host level
+    while the compute thread serves from both.
+    """
+
+    def __init__(self, max_bytes: int | None = None,
+                 registry: "obs.Registry | None" = None):
+        from .panel_store import ByteLRU
+
+        self.max_bytes = (cache_max_bytes() if max_bytes is None
+                          else int(max_bytes))
+        self._lock = threading.Lock()
+        # Both levels ride the ONE eviction/accounting implementation the
+        # dispatcher's blob store uses (panel_store.ByteLRU); only the
+        # pricing differs (decoded array nbytes vs caller-supplied device
+        # block size).
+        self._series = ByteLRU(self.max_bytes, self._nbytes)
+        self._device = ByteLRU(self.max_bytes)   # put() passes nbytes
+        reg = registry or obs.get_registry()
+        self._c_hits = {
+            lvl: reg.counter("dbx_panel_cache_hits_total",
+                             help="panel-cache hits by level "
+                                  "(host=decode skipped, device=h2d "
+                                  "skipped too)", level=lvl)
+            for lvl in ("host", "device")}
+        self._c_misses = {
+            lvl: reg.counter("dbx_panel_cache_misses_total",
+                             help="panel-cache misses by level",
+                             level=lvl)
+            for lvl in ("host", "device")}
+        self._g_bytes = reg.gauge(
+            "dbx_panel_cache_bytes",
+            help="approximate bytes resident in the worker panel cache "
+                 "(host + device levels)")
+
+    @staticmethod
+    def _nbytes(arrays) -> int:
+        return int(sum(getattr(a, "nbytes", 0) for a in arrays))
+
+    def _publish_bytes(self) -> None:
+        self._g_bytes.set(self._series.bytes + self._device.bytes)
+
+    def contains_series(self, digest: str) -> bool:
+        """Non-counting probe (the control thread's pre-dispatch check —
+        a probe must not inflate the hit rate the compute path reports)."""
+        with self._lock:
+            return digest in self._series
+
+    def get_series(self, digest: str):
+        with self._lock:
+            s = self._series.get(digest)
+        if s is not None:
+            self._c_hits["host"].inc()
+        else:
+            self._c_misses["host"].inc()
+        return s
+
+    def put_series(self, digest: str, series) -> None:
+        with self._lock:
+            self._series.put(digest, series)
+            self._publish_bytes()
+
+    def get_device(self, digest: str):
+        with self._lock:
+            d = self._device.get(digest)
+        if d is not None:
+            self._c_hits["device"].inc()
+        else:
+            self._c_misses["device"].inc()
+        return d
+
+    def put_device(self, digest: str, block, nbytes: int) -> None:
+        """Cache a device-resident field block. ``nbytes`` is passed in
+        (not read off the array): a just-launched device_put's .nbytes is
+        known host-side without forcing a sync."""
+        with self._lock:
+            self._device.put(digest, block, nbytes)
+            self._publish_bytes()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"host_panels": len(self._series),
+                    "host_bytes": self._series.bytes,
+                    "device_panels": len(self._device),
+                    "device_bytes": self._device.bytes,
+                    "max_bytes": self.max_bytes}
 
 
 class Completion:
@@ -267,6 +381,12 @@ class JaxSweepBackend:
         # mesh fn hit with a new (rows, bars) signature recompiles for
         # seconds and must not be attributed as "warm" async launch.
         self._seen_shapes: set = set()
+        # Dispatch by digest (worker half): decoded-panel + device-block
+        # cache keyed by JobSpec.panel_digest, and the FetchPayload hook
+        # the Worker installs (compute-thread recovery for the
+        # evicted-between-poll-and-decode race).
+        self.panel_cache = PanelCache(registry=reg)
+        self.payload_fetcher: Callable[[str], bytes] | None = None
 
     def _evict_mesh_fn(self) -> None:
         """FIFO-evict the oldest compiled mesh fn AND its shape-signature
@@ -797,9 +917,101 @@ class JaxSweepBackend:
             ", ".join(Metrics._fields))
         return False
 
-    def _finish_group(self, jobs, m, t0, n_real, job0):
+    def _resolve_series(self, job, *, leg2: bool = False):
+        """One leg's decoded panel: host cache -> inline bytes ->
+        FetchPayload (the second chance for a panel evicted between the
+        control thread's pre-dispatch probe and this decode). Returns
+        ``(series, cache_hit)``. An unresolvable digest raises — the
+        worker loop logs it and leaves the lease to requeue the batch
+        (by then the dispatcher has forgotten the delivery, so the
+        re-dispatch ships full bytes): miss -> fetch -> full job, never a
+        failed job."""
+        digest = job.panel_digest2 if leg2 else job.panel_digest
+        raw = job.ohlcv2 if leg2 else job.ohlcv
+        if digest:
+            s = self.panel_cache.get_series(digest)
+            if s is not None:
+                return s, True
+        if not raw and digest and self.payload_fetcher is not None:
+            # The recovery RPC gets its OWN span: it can run inside the
+            # decode window (compute-thread race leg), and a 30s network
+            # stall must read as transport in timeline attribution, not
+            # as decode work (obs.timeline maps worker.payload_fetch ->
+            # transport, innermost-wins over the enclosing decode span).
+            t0_wall, t0 = time.time(), time.perf_counter()
+            raw = self.payload_fetcher(digest)
+            obs.emit_span("worker.payload_fetch", t0_wall,
+                          time.perf_counter() - t0,
+                          pairs=obs.job_trace_pairs([job]),
+                          digest=digest, ok=bool(raw))
+        if not raw:
+            raise ValueError(
+                f"job {job.id}: digest-only payload "
+                f"{digest[:16] if digest else '?'} is in no cache and not "
+                "fetchable; leaving the lease to requeue it")
+        s = data_mod.from_wire_bytes(raw)
+        if digest:
+            self.panel_cache.put_series(digest, s)
+        return s, False
+
+    def _decode_group(self, group):
+        """Cache-aware group decode (leg 1 — the pairs path drives
+        :meth:`_resolve_series` per leg itself) under the traced
+        ``worker.decode`` span. The span's ``cache_hit`` attr is True
+        when EVERY panel came from the digest cache (decode skipped) —
+        obs.timeline charges such windows to the ``panel_cache_hit``
+        pseudo-stage instead of mis-reading a span-less gap as
+        transport."""
+        pairs = obs.job_trace_pairs(group)
+        t0_wall = time.time()
+        t_dec = time.perf_counter()
+        series = []
+        hits = 0
+        for j in group:
+            s, hit = self._resolve_series(j)
+            series.append(s)
+            hits += 1 if hit else 0
+        dur = time.perf_counter() - t_dec
+        self._h_decode.observe(dur)
+        self._c_decode_bytes.inc(sum(len(j.ohlcv) for j in group))
+        obs.emit_span("worker.decode", t0_wall, dur, pairs=pairs,
+                      jobs=len(group), cache_hit=hits == len(group),
+                      cache_hits=hits)
+        return series, hits
+
+    def _uniform_field_arrays(self, group, series, fields):
+        """Per-field ``(n, T)`` arrays for a uniform-length group, plus an
+        ``h2d_cache_hit`` flag. With content digests on every job and no
+        mesh, each panel is cached on DEVICE as its ``(5, T)`` field block
+        keyed by digest: a hit builds the group stack device-side — no
+        host->device copy at all; a miss uploads once and primes the
+        cache. Digestless jobs (hand-built specs, pre-dedupe dispatchers)
+        and mesh workers (whose arrays must device_put with an explicit
+        sharding) keep the host ``np.stack`` path."""
+        digests = [j.panel_digest for j in group]
+        if self._mesh is not None or not all(digests):
+            return [np.stack([np.asarray(getattr(s, f)) for s in series])
+                    for f in fields], False
+        import jax.numpy as jnp
+
+        rows, all_hit = [], True
+        for d, s in zip(digests, series):
+            blk = self.panel_cache.get_device(d)
+            if blk is None:
+                all_hit = False
+                host = np.stack([np.asarray(f, np.float32) for f in s])
+                blk = self._jax.device_put(host)
+                self.panel_cache.put_device(d, blk, host.nbytes)
+            rows.append(blk)
+        idx = [data_mod.OHLCV._fields.index(f) for f in fields]
+        return [jnp.stack([r[i] for r in rows]) for i in idx], all_hit
+
+    def _finish_group(self, jobs, m, t0, n_real, job0, *,
+                      h2d_hit: bool = False):
         """Shared tail of every sweep submit path: optional on-device top-k
-        reduction, then the stacked async result copy."""
+        reduction, then the stacked async result copy. ``h2d_hit`` rides
+        the pending entry so collect's d2h span can report that the
+        submit-side panel upload was served from the device cache."""
         topk = None
         if job0.top_k > 0 and job0.wf_train == 0:
             metric = job0.rank_metric or "sharpe"
@@ -808,7 +1020,7 @@ class JaxSweepBackend:
             P = wire.grid_n_combos(job0.grid)
             idx, m = _topk_reduce(m, metric, min(int(job0.top_k), P))
             topk = (idx, metric)
-        return (jobs, _start_result_copy(m), t0, n_real, topk)
+        return (jobs, _start_result_copy(m), t0, n_real, topk, h2d_hit)
 
     def submit(self, jobs) -> list:
         """Dispatch a batch: decode, transfer, launch kernels, start the
@@ -840,8 +1052,12 @@ class JaxSweepBackend:
             grid = wire.grid_from_proto(job.grid)
             key = (job.strategy,
                    tuple(sorted((k, v.tobytes()) for k, v in grid.items())),
-                   len(job.ohlcv).bit_length(),
-                   len(job.ohlcv2).bit_length(),   # 0 for single-asset jobs
+                   # Digest-only dispatches ship no bytes; the stamped
+                   # panel_bytes_len keeps them in the same length bucket
+                   # as their full-payload twins.
+                   (len(job.ohlcv) or job.panel_bytes_len).bit_length(),
+                   (len(job.ohlcv2)
+                    or job.panel_bytes_len2).bit_length(),   # 0 single-asset
                    job.cost, job.periods_per_year,
                    job.wf_train, job.wf_test, job.wf_metric,
                    job.top_k, job.rank_metric, job.best_returns)
@@ -875,13 +1091,10 @@ class JaxSweepBackend:
                 continue
             # The decode span adopts the GROUP's traces (a batch can hold
             # several groups; the batch-level context set by the worker
-            # loop would attribute one group's decode to every job).
-            with obs.trace_context(obs.job_trace_pairs(group)), \
-                    obs.span("worker.decode", jobs=len(group)):
-                t_dec = time.perf_counter()
-                series = [data_mod.from_wire_bytes(j.ohlcv) for j in group]
-                self._h_decode.observe(time.perf_counter() - t_dec)
-                self._c_decode_bytes.inc(sum(len(j.ohlcv) for j in group))
+            # loop would attribute one group's decode to every job); a
+            # digest-cache hit skips the decode and the span says so
+            # (`cache_hit` attr).
+            series, _ = self._decode_group(group)
             lengths = [s.n_bars for s in series]
             if group[0].wf_train > 0:
                 pending.append(self._submit_walkforward_group(
@@ -981,6 +1194,7 @@ class JaxSweepBackend:
                         "time-shardable (%s); falling through to the "
                         "generic path", [j.id for j in group],
                         group[0].strategy, t_max_g, ts_reason)
+            h2d_hit = False
             if fused_ok:
                 # Repeat-last padding + per-ticker lengths: the kernels'
                 # padding discipline makes pad bars earn zero return and
@@ -990,9 +1204,8 @@ class JaxSweepBackend:
                 # +volume for the channel/VWAP families) reach the device.
                 spec = self._FUSED_STRATEGIES[group[0].strategy]
                 if len(set(int(x) for x in lengths)) == 1:
-                    arrays = [np.stack([np.asarray(getattr(s, f))
-                                        for s in series])
-                              for f in spec.fields]
+                    arrays, h2d_hit = self._uniform_field_arrays(
+                        group, series, spec.fields)
                     t_real = None
                 else:
                     # Column-wise stack (pad_and_stack would also pad the
@@ -1067,7 +1280,7 @@ class JaxSweepBackend:
                 cold_key=(route, len(group), t_max_g)
                 + self._group_key(group[0], axes), group=group)
             pending.append(self._finish_group(group, m, t0, len(group),
-                                              group[0]))
+                                              group[0], h2d_hit=h2d_hit))
         return pending
 
     def _submit_best_returns_group(self, group, series, lengths, t0):
@@ -1342,34 +1555,41 @@ class JaxSweepBackend:
                     job0.wf_test, metric)
                 return (list(group), None, t0, 0, None)
         good, bad = [], []
-        with obs.trace_context(obs.job_trace_pairs(group)), \
-                obs.span("worker.decode", jobs=len(group)):
-            t_dec = time.perf_counter()
-            for j in group:
-                if not j.ohlcv2:
-                    log.error("pairs job %s has no second leg (ohlcv2); "
-                              "completing with empty metrics", j.id)
-                    bad.append(j)
-                    continue
-                y = data_mod.from_wire_bytes(j.ohlcv)
-                x = data_mod.from_wire_bytes(j.ohlcv2)
-                if y.n_bars != x.n_bars:
-                    log.error("pairs job %s legs differ in length (%d vs "
-                              "%d); completing with empty metrics", j.id,
-                              y.n_bars, x.n_bars)
-                    bad.append(j)
-                    continue
-                if wf and y.n_bars < job0.wf_train + job0.wf_test:
-                    log.error(
-                        "pairs walk-forward job %s needs >= %d bars "
-                        "(train %d + test %d), has %d; completing with "
-                        "empty metrics",
-                        j.id, job0.wf_train + job0.wf_test, job0.wf_train,
-                        job0.wf_test, y.n_bars)
-                    bad.append(j)
-                    continue
-                good.append((j, y, x))
-            self._h_decode.observe(time.perf_counter() - t_dec)
+        trace_pairs = obs.job_trace_pairs(group)
+        t0_wall = time.time()
+        t_dec = time.perf_counter()
+        hits = 0
+        for j in group:
+            if not j.ohlcv2 and not j.panel_digest2:
+                log.error("pairs job %s has no second leg (ohlcv2); "
+                          "completing with empty metrics", j.id)
+                bad.append(j)
+                continue
+            y, hit_y = self._resolve_series(j)
+            x, hit_x = self._resolve_series(j, leg2=True)
+            if y.n_bars != x.n_bars:
+                log.error("pairs job %s legs differ in length (%d vs "
+                          "%d); completing with empty metrics", j.id,
+                          y.n_bars, x.n_bars)
+                bad.append(j)
+                continue
+            if wf and y.n_bars < job0.wf_train + job0.wf_test:
+                log.error(
+                    "pairs walk-forward job %s needs >= %d bars "
+                    "(train %d + test %d), has %d; completing with "
+                    "empty metrics",
+                    j.id, job0.wf_train + job0.wf_test, job0.wf_train,
+                    job0.wf_test, y.n_bars)
+                bad.append(j)
+                continue
+            hits += 1 if (hit_y and hit_x) else 0
+            good.append((j, y, x))
+        dur = time.perf_counter() - t_dec
+        self._h_decode.observe(dur)
+        obs.emit_span("worker.decode", t0_wall, dur, pairs=trace_pairs,
+                      jobs=len(group),
+                      cache_hit=bool(good) and hits == len(good),
+                      cache_hits=hits)
         self._c_decode_bytes.inc(
             sum(len(j.ohlcv) + len(j.ohlcv2) for j in group))
         if not good:
@@ -1570,16 +1790,24 @@ class JaxSweepBackend:
         from ..ops.metrics import Metrics
 
         out: list[Completion] = []
-        for group, stacked, t0, n_real, extra in pending:
+        for entry in pending:
+            # Entries are 5-tuples from the legacy paths and 6-tuples from
+            # _finish_group (the trailing h2d_hit flag).
+            group, stacked, t0, n_real, extra = entry[:5]
+            h2d_hit = bool(entry[5]) if len(entry) > 5 else False
             t_wait = time.perf_counter()
             if stacked is None:
                 host = None
             else:
                 # The blocking device drain, traced per group: the d2h
                 # stage of each job's timeline (the worker.collect span
-                # above it covers the whole pending entry).
+                # above it covers the whole pending entry). cache_hit here
+                # reports that the SUBMIT-side panel upload was served
+                # from the device digest cache (no h2d for this group's
+                # panels); the drain itself is real work either way.
                 with obs.trace_context(obs.job_trace_pairs(group)), \
-                        obs.span("worker.d2h", jobs=len(group)):
+                        obs.span("worker.d2h", jobs=len(group),
+                                 cache_hit=h2d_hit):
                     host = np.asarray(stacked)
             if host is not None:
                 # The blocking d2h drain: everything after here is host-side

@@ -42,6 +42,7 @@ from typing import Mapping
 import numpy as np
 
 from . import backtesting_pb2 as pb
+from . import panel_store as panel_store_mod
 from . import service, wire
 from .journal import Journal
 from .. import obs
@@ -91,6 +92,15 @@ class JobRecord:
     # outage to the queue.
     trace_id: str = ""
     enqueue_ts: float = 0.0
+    # Content addresses (proto JobSpec.panel_digest/panel_digest2): the
+    # blake2b-128 hex digest of each leg's DBX1 bytes, stamped at enqueue
+    # (inline payloads) or first materialization (file-backed — a later
+    # "digest" journal event merges into the enqueue record on replay).
+    # Journaled so a restart keeps dispatching by the SAME address the
+    # first run delivered; the blob store repopulates lazily from the
+    # payload source.
+    panel_digest: str = ""
+    panel_digest2: str = ""
 
     @property
     def combos(self) -> int:
@@ -121,6 +131,10 @@ class JobRecord:
             rec["ret"] = [True, self.rank_metric]
         if self.trace_id:
             rec["trace"] = self.trace_id
+        if self.panel_digest:
+            rec["pdig"] = self.panel_digest
+        if self.panel_digest2:
+            rec["pdig2"] = self.panel_digest2
         return rec
 
     @staticmethod
@@ -141,7 +155,9 @@ class JobRecord:
             top_k=int(topk[0]),
             rank_metric=str(topk[1]) or str((rec.get("ret") or [0, ""])[1]),
             best_returns=bool((rec.get("ret") or [False])[0]),
-            trace_id=str(rec.get("trace", "")))
+            trace_id=str(rec.get("trace", "")),
+            panel_digest=str(rec.get("pdig", "")),
+            panel_digest2=str(rec.get("pdig2", "")))
 
 
 @dataclasses.dataclass
@@ -334,6 +350,14 @@ class JobQueue:
                 state = None
         self.substrate = "native" if state is not None else "python"
         self._state = state if state is not None else _PyQueueState()
+        # Content-addressed blob store of materialized DBX1 panels: hot
+        # panels and requeued jobs never touch disk (or re-transcode CSV)
+        # twice, and FetchPayload serves cache-missing workers from it.
+        # digest -> job id of SOME record carrying that digest (last
+        # stamped wins): the lazy-repopulation index — an evicted blob
+        # re-materializes from that record's source.
+        self.panel_store = panel_store_mod.PanelStore()
+        self._digest_jobs: dict[str, str] = {}
         # Python-side mirror of completed ids (the native core keeps only
         # counts): maintained on every "new" completion + restore, read by
         # observers (chaos tests, operators) via completed_ids().
@@ -391,6 +415,14 @@ class JobQueue:
                 rec.trace_id = obs.new_trace_id()
             if not rec.enqueue_ts:
                 rec.enqueue_ts = now
+            # Content-address inline payloads HERE — before the journal
+            # append — so the digest a restart restores is the address the
+            # first run delivered to workers. File-backed payloads stamp at
+            # first materialization (take) via a "digest" journal event.
+            if rec.ohlcv is not None and not rec.panel_digest:
+                rec.panel_digest = self.panel_store.put(rec.ohlcv)
+            if rec.ohlcv2 is not None and not rec.panel_digest2:
+                rec.panel_digest2 = self.panel_store.put(rec.ohlcv2)
         if journal and self._journal.enabled:
             # enabled-guarded: journal_form b64-encodes the payload, which
             # the no-op journal would throw away. Journal BEFORE the state
@@ -405,6 +437,13 @@ class JobQueue:
         with self._lock:
             for rec in recs:
                 self._records[rec.id] = rec
+                # Lazy-repopulation index: restored records arrive with
+                # journaled digests but an empty store; FetchPayload and
+                # take() re-materialize through this map.
+                if rec.panel_digest:
+                    self._digest_jobs[rec.panel_digest] = rec.id
+                if rec.panel_digest2:
+                    self._digest_jobs[rec.panel_digest2] = rec.id
             self._state.enqueue_n([rec.id for rec in recs],
                                   [float(rec.combos) for rec in recs])
 
@@ -437,6 +476,10 @@ class JobQueue:
                     r = JobRecord.from_journal(rec)
                     self._records[jid] = r
                     self._state.register(jid, float(r.combos))
+                    if r.panel_digest:
+                        self._digest_jobs.setdefault(r.panel_digest, jid)
+                    if r.panel_digest2:
+                        self._digest_jobs.setdefault(r.panel_digest2, jid)
         self.known_paths |= {rec["path"] for rec in state.jobs.values()
                              if rec.get("path")}
         self.known_pairings.update(
@@ -478,6 +521,7 @@ class JobQueue:
             good: list[tuple[str, JobRecord, bytes]] = []
             failed: list[tuple[str, str, Exception]] = []  # id, path, err
             resolved: set[str] = set()   # leased, failed, or completed
+            stamped: list[tuple[str, JobRecord]] = []  # first-materialized
             try:
                 # Inside the try: a journal error here must still reach
                 # the push-back handler / _in_take decrement below, or
@@ -487,31 +531,49 @@ class JobQueue:
                               "desync) -> failed", j)
                     self._journal.append("fail", id=j,
                                          reason="no job record")
-                for jid, rec in zip(jids, recs):
-                    payload = rec.ohlcv
+                for jid, stored in zip(jids, recs):
+                    rec = stored
+                    payload = stored.ohlcv
                     try:
                         if payload is None:
-                            if rec.path is None:
-                                raise ValueError(
-                                    "job has neither payload nor path")
-                            payload = _read_payload(rec.path)
-                        if rec.ohlcv2 is None and rec.path2 is not None:
+                            # Store-first materialization: a hot panel or
+                            # a requeued/retried job never re-reads (or
+                            # re-transcodes) the file. The digest stamps
+                            # the STORED record on first materialization
+                            # and is journaled below, so restarts keep the
+                            # address stable.
+                            payload, d = self._materialize(
+                                stored.panel_digest, stored.path)
+                            if d != stored.panel_digest:
+                                stored.panel_digest = d
+                                stamped.append((jid, stored))
+                        if stored.ohlcv2 is None and stored.path2 is not None:
                             # File-backed second leg (pairs --data2):
                             # materialize at dispatch time like leg 1,
                             # onto a COPY handed to the caller — the
                             # stored record stays slim, and RequestJobs
                             # reads rec.ohlcv2 either way.
-                            rec = dataclasses.replace(
-                                rec, ohlcv2=_read_payload(rec.path2))
+                            blob2, d2 = self._materialize(
+                                stored.panel_digest2, stored.path2)
+                            if d2 != stored.panel_digest2:
+                                stored.panel_digest2 = d2
+                                stamped.append((jid, stored))
+                            rec = dataclasses.replace(stored, ohlcv2=blob2)
                     except (OSError, ValueError) as e:
                         # Leg 1 read fine -> the unreadable file was leg 2.
                         failed.append((
                             jid,
-                            rec.path2 if payload is not None else rec.path,
+                            stored.path2 if payload is not None
+                            else stored.path,
                             e))
                         continue
                     good.append((jid, rec, payload))
                 with self._lock:
+                    for jid, r in stamped:
+                        if r.panel_digest:
+                            self._digest_jobs[r.panel_digest] = jid
+                        if r.panel_digest2:
+                            self._digest_jobs[r.panel_digest2] = jid
                     committed = self._state.take_commit_n(
                         [jid for jid, _, _ in good], worker_id,
                         self.lease_s)
@@ -529,6 +591,15 @@ class JobQueue:
                     log.error("job %s: unreadable %s (%s) -> failed",
                               jid, path, e)
                     self._journal.append("fail", id=jid, reason=str(e))
+                # Durable digest stamps (first materialization only — one
+                # event per job, merged into its enqueue record on replay
+                # and at compaction): a restarted dispatcher keeps
+                # addressing the panel a prior run already delivered.
+                for jid, r in dict(stamped).items():
+                    self._journal.append(
+                        "digest", id=jid, pdig=r.panel_digest,
+                        **({"pdig2": r.panel_digest2}
+                           if r.panel_digest2 else {}))
                 out.extend((rec, payload)
                            for ok, (_, rec, payload) in zip(committed, good)
                            if ok)
@@ -547,6 +618,61 @@ class JobQueue:
                 with self._lock:
                     self._in_take -= len(jids)
         return out
+
+    def _materialize(self, digest: str, path: str | None) -> tuple[bytes,
+                                                                   str]:
+        """One leg's payload bytes + content digest, blob store first.
+
+        Only reads (and CSV/Parquet-transcodes) ``path`` when the store
+        cannot serve ``digest`` — the second and every later take of a hot
+        panel, and every requeue/retry, never touch disk again. The
+        returned digest is always the digest OF THE RETURNED BYTES (a file
+        whose content changed between materializations re-addresses; the
+        caller re-stamps and journals)."""
+        if digest:
+            blob = self.panel_store.get(digest)
+            if blob is not None:
+                return blob, digest
+        if path is None:
+            raise ValueError("job has neither payload nor path")
+        blob = _read_payload(path)
+        return blob, self.panel_store.put(blob)
+
+    def payload_for_digest(self, digest: str) -> bytes | None:
+        """Serve a FetchPayload request: blob store first, then lazy
+        re-materialization from the indexed record's source (inline bytes
+        or file — the restart path: journaled digests arrive before any
+        blob does). None when the digest is not servable at all (store
+        evicted AND source gone or changed) — the dispatcher then forgets
+        it was delivered so the next dispatch ships full bytes."""
+        if not digest:
+            return None
+        blob = self.panel_store.get(digest)
+        if blob is not None:
+            return blob
+        with self._lock:
+            jid = self._digest_jobs.get(digest)
+            rec = self._records.get(jid) if jid else None
+        if rec is None:
+            return None
+        for inline, path, d in ((rec.ohlcv, rec.path, rec.panel_digest),
+                                (rec.ohlcv2, rec.path2,
+                                 rec.panel_digest2)):
+            if d != digest:
+                continue
+            if inline is not None:
+                self.panel_store.put(inline, digest)
+                return inline
+            if path is not None:
+                try:
+                    blob = _read_payload(path)
+                except (OSError, ValueError):
+                    return None
+                if panel_store_mod.panel_digest(blob) != digest:
+                    return None   # source changed under the address
+                self.panel_store.put(blob, digest)
+                return blob
+        return None
 
     def complete(self, jid: str, worker_id: str) -> str:
         """Record a completion (idempotent). Returns ``"new"`` for a first
@@ -795,13 +921,32 @@ class Dispatcher(service.DispatcherServicer):
     # n_params x 9 float32s; 4096 blocks of a 2k-param grid ~ 300 MB).
     MAX_RESIDENT_RESULTS = 4096
 
+    # Per-worker delivered-digest sets are bounded: past this many digests
+    # the set is cleared (the worker merely re-receives full bytes once per
+    # panel) instead of growing one entry per panel forever.
+    MAX_DELIVERED_DIGESTS = 1 << 16
+
     def __init__(self, queue: JobQueue, peers: PeerRegistry | None = None, *,
                  default_jobs_per_chip: int = 1,
                  results_dir: str | None = None,
-                 registry: "obs.Registry | None" = None):
+                 registry: "obs.Registry | None" = None,
+                 panel_dedupe: bool | None = None):
         self.queue = queue
         self.peers = peers or PeerRegistry()
         self.default_jobs_per_chip = default_jobs_per_chip
+        # Dispatch by digest: send a panel's bytes to a worker generation
+        # ONCE; every later job carrying the same digest ships digest-only
+        # and the worker serves its cache (miss -> FetchPayload). The env
+        # knob is read lazily per Dispatcher, not at import.
+        if panel_dedupe is None:
+            panel_dedupe = os.environ.get("DBX_PANEL_DEDUPE", "1") != "0"
+        self.panel_dedupe = panel_dedupe
+        # worker_id -> digests this worker's CURRENT registration has been
+        # sent in full. Reset when a worker (re-)registers — a restarted
+        # worker starts with an empty cache and must never wedge on a
+        # phantom hit; dropped when the peer is pruned.
+        self._delivered: dict[str, set[str]] = {}
+        self._delivered_lock = threading.Lock()
         self.results_dir = results_dir
         self.results: dict[str, bytes] = {}
         self.results_evicted = 0
@@ -821,7 +966,7 @@ class Dispatcher(service.DispatcherServicer):
                                   help="dispatcher RPC handler wall",
                                   method=m)
             for m in ("RequestJobs", "SendStatus", "CompleteJob",
-                      "CompleteJobs", "GetStats")}
+                      "CompleteJobs", "GetStats", "FetchPayload")}
         self._c_dispatched = self.obs.counter(
             "dbx_jobs_dispatched_total", help="jobs handed to workers")
         self._c_completions = {
@@ -837,6 +982,25 @@ class Dispatcher(service.DispatcherServicer):
         self._c_requeued_lease = self.obs.counter(
             "dbx_requeued_jobs_total",
             help="jobs re-queued by recovery", reason="lease_expired")
+        # Dispatch-by-digest accounting: full vs digest-only payload legs
+        # and the wire bytes digest-only dispatch did NOT ship (the panel
+        # lengths of every deduped leg).
+        self._c_payloads = {
+            mode: self.obs.counter(
+                "dbx_dispatch_payloads_total",
+                help="payload legs dispatched, by transport mode",
+                mode=mode)
+            for mode in ("full", "digest_only")}
+        self._c_bytes_saved = self.obs.counter(
+            "dbx_dispatch_bytes_saved_total",
+            help="payload bytes NOT shipped thanks to digest-only "
+                 "dispatch")
+        self._c_fetches = {
+            outcome: self.obs.counter(
+                "dbx_payload_fetches_total",
+                help="FetchPayload requests served, by outcome",
+                outcome=outcome)
+            for outcome in ("hit", "gone")}
         # Thread-local: concurrent GetStats calls on the gRPC pool must
         # each lend their OWN snapshot to the collector, not race on one
         # shared slot.
@@ -878,6 +1042,16 @@ class Dispatcher(service.DispatcherServicer):
             s["backtests_per_sec"])
         reg.gauge("dbx_workers_alive").set(self.peers.alive())
         reg.gauge("dbx_results_evicted").set(self.results_evicted)
+        ps = self.queue.panel_store.stats()
+        reg.gauge("dbx_panel_store_bytes",
+                  help="bytes resident in the content-addressed panel "
+                       "store").set(ps["bytes"])
+        reg.gauge("dbx_panel_store_panels",
+                  help="distinct panels resident in the store").set(
+            ps["panels"])
+        reg.gauge("dbx_panel_store_evictions",
+                  help="LRU evictions from the panel store").set(
+            ps["evictions"])
 
     def obs_summary(self) -> dict:
         """The extended-stats payload: registry summaries (histogram
@@ -889,13 +1063,68 @@ class Dispatcher(service.DispatcherServicer):
             obs.http.STATS_SPAN_WINDOW)
         return out
 
+    # -- dispatch-by-digest bookkeeping ------------------------------------
+
+    def forget_worker(self, worker_id: str) -> None:
+        """Drop a pruned worker's delivered-digest set (its next
+        registration starts cacheless anyway)."""
+        with self._delivered_lock:
+            self._delivered.pop(worker_id, None)
+
+    def _forget_digest(self, digest: str) -> None:
+        """Erase every record of having delivered ``digest``: after an
+        unservable FetchPayload the next dispatch must ship full bytes,
+        never point at the phantom address again."""
+        with self._delivered_lock:
+            for s in self._delivered.values():
+                s.discard(digest)
+
+    def _payload_leg(self, delivered: set | None, digest: str,
+                     payload: bytes) -> bytes:
+        """One leg's wire bytes: empty (digest-only dispatch) when this
+        worker generation already received the digest in full, the full
+        bytes (marked delivered) otherwise. ``delivered`` is None when
+        dedupe is disabled. Mutates the per-worker set without the
+        delivered lock: the set is only ever replaced under the lock, and
+        add/discard from concurrent RPCs of one worker are atomic under
+        the GIL (worst case a panel ships in full twice)."""
+        if not digest or not payload:
+            return payload
+        if delivered is not None and digest in delivered:
+            self._c_payloads["digest_only"].inc()
+            self._c_bytes_saved.inc(len(payload))
+            return b""
+        if delivered is not None:
+            if len(delivered) >= self.MAX_DELIVERED_DIGESTS:
+                delivered.clear()
+            delivered.add(digest)
+        self._c_payloads["full"].inc()
+        return payload
+
     # -- RPC handlers ------------------------------------------------------
 
     @_timed_rpc("RequestJobs")
     def RequestJobs(self, request: pb.JobsRequest, context) -> pb.JobsReply:
-        if self.peers.touch(request.worker_id, chips=request.chips):
+        is_new = self.peers.touch(request.worker_id, chips=request.chips)
+        if is_new:
             log.info("new worker %s with %d chips",
                      request.worker_id, request.chips)
+        with self._delivered_lock:
+            if is_new:
+                # A (re-)registering worker starts cacheless: a stale
+                # delivered set would dispatch digest-only panels the new
+                # process never saw (FetchPayload would recover, but the
+                # reset keeps the common restart case on the fast path).
+                self._delivered[request.worker_id] = set()
+            # Capability-gated: only workers that declared they resolve
+            # digest-only payloads (JobsRequest.accepts_digest_only) ever
+            # get bytes withheld — an older worker binary (proto3 default
+            # false) always receives full payloads and cannot wedge on an
+            # empty ohlcv it has no FetchPayload to recover.
+            delivered = (self._delivered.setdefault(request.worker_id,
+                                                    set())
+                         if (self.panel_dedupe
+                             and request.accepts_digest_only) else None)
         per_chip = request.jobs_per_chip or self.default_jobs_per_chip
         n = max(request.chips, 1) * max(per_chip, 1)
         t_disp0 = time.time()
@@ -919,16 +1148,24 @@ class Dispatcher(service.DispatcherServicer):
                     "job.dispatch", t_disp0, now - t_disp0,
                     trace_id=rec.trace_id, job=rec.id,
                     worker=request.worker_id)
+            payload2 = rec.ohlcv2 or b""
             reply.jobs.append(pb.JobSpec(
-                id=rec.id, strategy=rec.strategy, ohlcv=payload,
+                id=rec.id, strategy=rec.strategy,
+                ohlcv=self._payload_leg(delivered, rec.panel_digest,
+                                        payload),
                 grid=wire.grid_to_proto(rec.grid), cost=rec.cost,
                 periods_per_year=rec.periods_per_year,
-                ohlcv2=rec.ohlcv2 or b"",
+                ohlcv2=self._payload_leg(delivered, rec.panel_digest2,
+                                         payload2),
                 wf_train=rec.wf_train, wf_test=rec.wf_test,
                 wf_metric=rec.wf_metric,
                 top_k=rec.top_k, rank_metric=rec.rank_metric,
                 best_returns=rec.best_returns,
-                trace_id=rec.trace_id, parent_span_id=parent_sid))
+                trace_id=rec.trace_id, parent_span_id=parent_sid,
+                panel_digest=rec.panel_digest,
+                panel_bytes_len=len(payload),
+                panel_digest2=rec.panel_digest2,
+                panel_bytes_len2=len(payload2)))
         if taken:
             log.info("dispatched %d jobs to %s", len(taken), request.worker_id)
         return reply
@@ -1082,6 +1319,27 @@ class Dispatcher(service.DispatcherServicer):
             k: (int(v) if k != "backtests_per_sec" else v)
             for k, v in s.items()})
 
+    @_timed_rpc("FetchPayload")
+    def FetchPayload(self, request: pb.PayloadRequest,
+                     context) -> pb.PayloadReply:
+        """Panel-cache miss recovery: serve a digest's bytes from the blob
+        store (lazy re-materialization behind it). An unservable digest
+        returns an EMPTY payload and is erased from every delivered set,
+        so the job's next dispatch ships full bytes — miss -> fetch ->
+        full job, never a failed job."""
+        self.peers.touch(request.worker_id)
+        blob = self.queue.payload_for_digest(request.digest)
+        if blob is None:
+            self._forget_digest(request.digest)
+            self._c_fetches["gone"].inc()
+            log.warning(
+                "FetchPayload %s from %s: digest not servable (store "
+                "evicted and source gone); forgetting its deliveries",
+                request.digest[:16], request.worker_id)
+            return pb.PayloadReply(digest=request.digest)
+        self._c_fetches["hit"].inc()
+        return pb.PayloadReply(digest=request.digest, payload=blob)
+
 
 class DispatcherServer:
     """Owns the grpc.Server plus the prune/requeue maintenance thread.
@@ -1137,6 +1395,7 @@ class DispatcherServer:
                 held = d.queue.requeue_worker(wid)
                 d._c_pruned.inc()
                 d._c_requeued_prune.inc(len(held))
+                d.forget_worker(wid)
                 log.warning("pruned silent worker %s; requeued %d jobs",
                             wid, len(held))
             expired = d.queue.requeue_expired()

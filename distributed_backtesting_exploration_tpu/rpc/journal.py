@@ -177,6 +177,17 @@ class Journal:
             ev = rec.get("ev")
             if ev == "enqueue":
                 state.jobs[rec["id"]] = rec
+            elif ev == "digest":
+                # Content-address stamp from a file-backed job's first
+                # materialization: merged into the enqueue record, so a
+                # restart keeps dispatching by the same digest and
+                # compaction folds the stamp into the rewritten enqueue
+                # line (no separate event survives).
+                job = state.jobs.get(rec.get("id"))
+                if job is not None:
+                    for k in ("pdig", "pdig2"):
+                        if rec.get(k):
+                            job[k] = rec[k]
             elif ev == "complete":
                 if rec["id"] not in state.completed:
                     state.terminal_events.append(rec)

@@ -1,0 +1,165 @@
+"""Content-addressed panel blob store (dispatch by digest, dispatcher side).
+
+DESIGN.md's measured control-plane ceiling pins the dispatch floor on
+per-job payload marshalling — yet a grid sweep ships the SAME OHLC panel
+bytes in every job of the sweep, re-reads file-backed payloads from disk
+at every take (including requeues), and the worker re-decodes and
+re-uploads them every time. The fix is the TPU-serving shape: keep hot
+state resident and address it by handle. This module is the dispatcher's
+half — a bounded LRU store of materialized DBX1 panel bytes keyed by
+their blake2b-128 content digest:
+
+- ``panel_digest()`` is THE digest function of the whole feature (the
+  dispatcher stamps it on :class:`~.dispatcher.JobRecord` and the wire
+  ``JobSpec.panel_digest``; the worker's cache keys on the same hex
+  string — one implementation so they cannot drift);
+- hot panels and requeued jobs never touch disk twice (`take`
+  materializes through the store);
+- ``FetchPayload`` serves cache-missing workers straight from the store.
+
+Bounded by bytes (``DBX_PANEL_STORE_MB``, default 256): eviction drops
+the least-recently-used blob. An evicted digest is not an error — the
+job record still knows its source (inline bytes or path), so the store
+repopulates lazily, and a worker fetching an unservable digest gets an
+empty reply and falls back to full-bytes dispatch.
+
+:class:`ByteLRU` is the one eviction/accounting implementation shared by
+this store and BOTH levels of the worker's
+:class:`~.compute.PanelCache`, so their semantics cannot drift.
+
+Thread-safe: takes run on the gRPC pool, FetchPayload on another thread.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import os
+import threading
+
+from .. import obs
+
+_DEFAULT_STORE_MB = 256
+
+
+def panel_digest(data: bytes) -> str:
+    """blake2b-128 hex digest of a panel's wire bytes — the content
+    address carried by ``JobSpec.panel_digest`` and every cache key.
+    16 bytes of blake2b is collision-resistant far beyond any fleet's
+    panel count and hashes >1 GB/s, so stamping at enqueue is free
+    relative to the journal fsync it rides with."""
+    return hashlib.blake2b(data, digest_size=16).hexdigest()
+
+
+def store_max_bytes() -> int:
+    """The store bound, read lazily (import-time env capture would pin
+    the knob before tests/operators can set it)."""
+    return int(float(os.environ.get("DBX_PANEL_STORE_MB",
+                                    _DEFAULT_STORE_MB)) * 1024 * 1024)
+
+
+class ByteLRU:
+    """Byte-bounded LRU map of ``digest -> value``.
+
+    NOT itself thread-safe — every owner wraps calls in its own lock.
+    ``nbytes_of`` prices a value once at insert (``put`` can override
+    with an explicit ``nbytes`` for values whose size is cheaper known
+    by the caller, e.g. a just-launched device array). Entries larger
+    than the whole bound are indexed-then-evicted — callers always get
+    a valid insert, the LRU just will not retain it.
+    """
+
+    def __init__(self, max_bytes: int, nbytes_of=len):
+        self.max_bytes = int(max_bytes)
+        self._nbytes_of = nbytes_of
+        self._entries: collections.OrderedDict = collections.OrderedDict()
+        self.bytes = 0
+        self.evictions = 0
+
+    def get(self, key):
+        entry = self._entries.get(key)
+        if entry is None:
+            return None
+        self._entries.move_to_end(key)
+        return entry[0]
+
+    def put(self, key, value, nbytes: int | None = None) -> None:
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self.bytes -= old[1]
+        nb = int(self._nbytes_of(value) if nbytes is None else nbytes)
+        self._entries[key] = (value, nb)
+        self.bytes += nb
+        while self.bytes > self.max_bytes and self._entries:
+            _, (_, ev_nb) = self._entries.popitem(last=False)
+            self.bytes -= ev_nb
+            self.evictions += 1
+
+    def __contains__(self, key) -> bool:
+        return key in self._entries
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+class PanelStore:
+    """Bounded LRU map of ``digest -> DBX1 bytes``.
+
+    ``put`` stores a reference to the caller's (immutable) bytes object —
+    no copy; for inline job payloads the "store" therefore costs only the
+    index entry while the record already pins the bytes. Accounting still
+    charges the blob's full length against the bound: the bound is about
+    what the store RETAINS for digest-only dispatch, not process RSS.
+    """
+
+    def __init__(self, max_bytes: int | None = None,
+                 registry: "obs.Registry | None" = None):
+        self._lock = threading.Lock()
+        self._lru = ByteLRU(store_max_bytes() if max_bytes is None
+                            else int(max_bytes))
+        reg = registry or obs.get_registry()
+        self._c_hits = reg.counter(
+            "dbx_panel_store_hits_total",
+            help="panel-store lookups served from memory")
+        self._c_misses = reg.counter(
+            "dbx_panel_store_misses_total",
+            help="panel-store lookups that fell through to the source")
+
+    @property
+    def max_bytes(self) -> int:
+        return self._lru.max_bytes
+
+    @max_bytes.setter
+    def max_bytes(self, v: int) -> None:
+        self._lru.max_bytes = int(v)
+
+    @property
+    def evictions(self) -> int:
+        return self._lru.evictions
+
+    def put(self, data: bytes, digest: str | None = None) -> str:
+        """Insert (or refresh) a blob; returns its digest."""
+        d = digest or panel_digest(data)
+        with self._lock:
+            self._lru.put(d, data)
+        return d
+
+    def get(self, digest: str) -> bytes | None:
+        """The blob for ``digest`` (LRU-touched), or None after eviction."""
+        with self._lock:
+            blob = self._lru.get(digest)
+        if blob is not None:
+            self._c_hits.inc()
+        else:
+            self._c_misses.inc()
+        return blob
+
+    def __contains__(self, digest: str) -> bool:
+        with self._lock:
+            return digest in self._lru
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"panels": len(self._lru), "bytes": self._lru.bytes,
+                    "evictions": self._lru.evictions,
+                    "max_bytes": self._lru.max_bytes}

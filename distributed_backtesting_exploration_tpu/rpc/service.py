@@ -37,6 +37,7 @@ _METHODS = (
     ("CompleteJob", pb.CompleteRequest, pb.Ack),
     ("CompleteJobs", pb.CompleteBatch, pb.CompleteBatchReply),
     ("GetStats", pb.StatsRequest, pb.StatsReply),
+    ("FetchPayload", pb.PayloadRequest, pb.PayloadReply),
 )
 
 
@@ -57,6 +58,10 @@ class DispatcherServicer:
         raise NotImplementedError
 
     def GetStats(self, request: pb.StatsRequest, context) -> pb.StatsReply:
+        raise NotImplementedError
+
+    def FetchPayload(self, request: pb.PayloadRequest,
+                     context) -> pb.PayloadReply:
         raise NotImplementedError
 
 

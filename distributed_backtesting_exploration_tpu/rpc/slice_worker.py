@@ -142,9 +142,15 @@ class SliceWorker:
                 m: reg.histogram("dbx_worker_rpc_seconds",
                                  help="worker-side RPC wall (incl. wire)",
                                  method=m)
-                for m in ("RequestJobs", "CompleteJobs")}
+                for m in ("RequestJobs", "CompleteJobs", "FetchPayload")}
             self._c_jobs_in = reg.counter(
                 "dbx_worker_jobs_received_total", help="jobs received")
+            # Dispatch-by-digest (leader side): decoded panels keyed by
+            # content digest, so digest-only re-deliveries skip the wire
+            # AND the decode; misses recover via FetchPayload.
+            from .compute import PanelCache
+
+            self._panel_cache = PanelCache(registry=reg)
             log.info("slice worker %s: leader of %d processes, %d chips",
                      self.worker_id, jax.process_count(), self.chips)
 
@@ -156,7 +162,8 @@ class SliceWorker:
         with obs.timer(self._h_rpc["RequestJobs"]):
             reply = self._stub.RequestJobs(pb.JobsRequest(
                 worker_id=self.worker_id, chips=self.chips,
-                jobs_per_chip=self._jobs_per_chip), timeout=10.0)
+                jobs_per_chip=self._jobs_per_chip,
+                accepts_digest_only=True), timeout=10.0)
         jobs = list(reply.jobs)
         if jobs:
             self._c_jobs_in.inc(len(jobs))
@@ -186,7 +193,8 @@ class SliceWorker:
         for job in jobs:
             unsupported = (
                 "pairs (two-legged)" if (job.strategy == "pairs"
-                                         or job.ohlcv2) else
+                                         or job.ohlcv2
+                                         or job.panel_digest2) else
                 "walk-forward" if job.wf_train > 0 else
                 "top-k reduction" if job.top_k > 0 else
                 # best_returns must be triaged too: running it as a plain
@@ -203,7 +211,13 @@ class SliceWorker:
                     job.id, unsupported)
                 bad.append(job)
                 continue
-            series = data_mod.from_wire_bytes(job.ohlcv)
+            series = self._resolve_series(job)
+            if series is None:
+                # Unresolvable digest-only payload: leave the job leased
+                # (never complete it wrong) — the lease requeues it and
+                # the dispatcher, having forgotten the phantom delivery,
+                # re-dispatches full bytes.
+                continue
             key = (job.strategy,
                    tuple(sorted((k, v.tobytes()) for k, v in
                                 wire.grid_from_proto(job.grid).items())),
@@ -211,6 +225,42 @@ class SliceWorker:
             groups.setdefault(key, []).append(job)
             decoded[job.id] = series
         return groups, decoded, bad
+
+    def _resolve_series(self, job):
+        """Digest-aware decode (leader side): host panel cache -> inline
+        bytes -> FetchPayload. None when a digest-only panel cannot be
+        fetched — the caller leaves the job leased for requeue."""
+        from ..utils import data as data_mod
+
+        if job.panel_digest:
+            s = self._panel_cache.get_series(job.panel_digest)
+            if s is not None:
+                return s
+        raw = job.ohlcv
+        if not raw and job.panel_digest:
+            raw = self._fetch_payload(job.panel_digest)
+        if not raw:
+            log.error("slice worker: job %s payload unavailable (digest "
+                      "%s); leaving it leased for requeue", job.id,
+                      job.panel_digest[:16] or "?")
+            return None
+        s = data_mod.from_wire_bytes(raw)
+        if job.panel_digest:
+            self._panel_cache.put_series(job.panel_digest, s)
+        return s
+
+    def _fetch_payload(self, digest: str) -> bytes:
+        from . import backtesting_pb2 as pb
+
+        try:
+            with obs.timer(self._h_rpc["FetchPayload"]):
+                reply = self._stub.FetchPayload(pb.PayloadRequest(
+                    worker_id=self.worker_id, digest=digest), timeout=10.0)
+        except Exception:
+            log.exception("slice worker: FetchPayload %s failed",
+                          digest[:16])
+            return b""
+        return reply.payload
 
     def _complete(self, items) -> None:
         from . import backtesting_pb2 as pb

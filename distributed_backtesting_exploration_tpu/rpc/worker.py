@@ -105,6 +105,13 @@ class _Channel:
         return self._pq.qsize()
 
 
+def _pb_size(msg) -> int:
+    """Serialized size of a proto message; 0 for test doubles that stand
+    in for replies without implementing ByteSize."""
+    size = getattr(msg, "ByteSize", None)
+    return size() if callable(size) else 0
+
+
 _BATCH_SENTINEL = b"S"
 
 
@@ -179,11 +186,26 @@ class Worker:
             m: self.obs.histogram("dbx_worker_rpc_seconds",
                                   help="worker-side RPC wall (incl. wire)",
                                   method=m)
-            for m in ("RequestJobs", "SendStatus", "CompleteJobs")}
+            for m in ("RequestJobs", "SendStatus", "CompleteJobs",
+                      "FetchPayload")}
         self._c_rpc_errors = {
             m: self.obs.counter("dbx_worker_rpc_errors_total",
                                 help="failed worker RPC attempts", method=m)
-            for m in ("RequestJobs", "SendStatus", "CompleteJobs")}
+            for m in ("RequestJobs", "SendStatus", "CompleteJobs",
+                      "FetchPayload")}
+        # Wire accounting (serialized proto bytes, pre-compression): the
+        # bench's `wire_bytes_per_job` column and the dispatch-by-digest
+        # A/B read these deltas.
+        self._c_wire = {
+            (m, d): self.obs.counter(
+                "dbx_worker_wire_bytes_total",
+                help="serialized proto bytes over worker RPCs",
+                method=m, direction=d)
+            for m in ("RequestJobs", "CompleteJobs", "FetchPayload")
+            for d in ("request", "reply")}
+        self._c_fetches = self.obs.counter(
+            "dbx_worker_payload_fetches_total",
+            help="FetchPayload recoveries for digest-only jobs")
         self._c_polls = self.obs.counter(
             "dbx_worker_polls_total", help="RequestJobs polls sent")
         self._c_idle_polls = self.obs.counter(
@@ -327,6 +349,12 @@ class Worker:
             self.target, options=service.default_channel_options(),
             compression=grpc.Compression.Gzip)
         stub = service.DispatcherStub(channel)
+        if getattr(self.backend, "panel_cache", None) is not None:
+            # Compute-thread recovery hook for the evicted-between-poll-
+            # and-decode race (gRPC channels are thread-safe); the primary
+            # resolution happens in _poll_jobs on this thread.
+            self.backend.payload_fetcher = (
+                lambda digest: self._fetch_payload(stub, digest))
         # Fresh timer epoch: the rate is "since the worker STARTED", not
         # since it was constructed (a harness may build workers long
         # before running them).
@@ -386,6 +414,11 @@ class Worker:
                 time.sleep(min(self.poll_interval_s, 0.05))
             self._shutdown(stub)
         finally:
+            if getattr(self.backend, "panel_cache", None) is not None:
+                # The fetcher closes over THIS run's channel/stub; a
+                # backend outliving the worker loop must not keep (or
+                # call) a hook bound to a closed channel.
+                self.backend.payload_fetcher = None
             channel.close()
             # Lifecycle hygiene: a long-lived process constructing many
             # Workers (bench's control-plane saturation config) must not
@@ -444,24 +477,97 @@ class Worker:
         if self._in.full():
             return None
         self._c_polls.inc()
+        req = pb.JobsRequest(
+            worker_id=self.worker_id, chips=self.backend.chips,
+            jobs_per_chip=self.jobs_per_chip,
+            # Digest-only dispatch is safe for ANY backend this worker
+            # hosts: backends with a panel cache resolve digests, and
+            # payload-less fakes (instant/sleep) never read ohlcv at all.
+            accepts_digest_only=True)
         try:
             with obs.timer(self._h_rpc["RequestJobs"]):
-                reply = stub.RequestJobs(pb.JobsRequest(
-                    worker_id=self.worker_id, chips=self.backend.chips,
-                    jobs_per_chip=self.jobs_per_chip), timeout=30.0)
+                reply = stub.RequestJobs(req, timeout=30.0)
             self._log_reconnected()
         except grpc.RpcError as e:
             self._c_rpc_errors["RequestJobs"].inc()
             self._log_disconnected(e)
             return None
+        self._c_wire[("RequestJobs", "request")].inc(_pb_size(req))
+        self._c_wire[("RequestJobs", "reply")].inc(_pb_size(reply))
         jobs = list(reply.jobs)
         if jobs:
             log.info("received %d jobs", len(jobs))
             self._c_jobs_in.inc(len(jobs))
+            self._resolve_payloads(stub, jobs)
             self._in.put(jobs)
         else:
             self._c_idle_polls.inc()
         return jobs
+
+    def _resolve_payloads(self, stub, jobs) -> None:
+        """Dispatch-by-digest intake: a digest-only job whose panel is not
+        already in the backend's cache fetches the bytes by content
+        address BEFORE the batch crosses to the compute thread (miss ->
+        fetch -> full job). An unfetchable digest leaves the job
+        payloadless — the backend then errors the batch loudly and the
+        lease requeues it, by which point the dispatcher has forgotten the
+        phantom delivery and re-dispatches full bytes. Backends without a
+        panel cache (instant/sleep fakes) never decode, so their
+        digest-only jobs need no bytes at all."""
+        cache = getattr(self.backend, "panel_cache", None)
+        if cache is None:
+            return
+        # Per-batch blob memo: one reply can carry MANY digest-only jobs
+        # of one panel (jobs_per_chip > 1 on a shared-panel sweep, where
+        # the dispatcher marks the digest delivered at the batch's FIRST
+        # job) — the bytes must cross once per batch, not once per job.
+        # Seed it with bytes already riding sibling jobs, then fetch each
+        # remaining digest at most once.
+        blobs: dict[str, bytes] = {}
+        for job in jobs:
+            if job.panel_digest and job.ohlcv:
+                blobs.setdefault(job.panel_digest, job.ohlcv)
+            if job.panel_digest2 and job.ohlcv2:
+                blobs.setdefault(job.panel_digest2, job.ohlcv2)
+        for job in jobs:
+            for digest, has_raw, field in (
+                    (job.panel_digest, bool(job.ohlcv), "ohlcv"),
+                    (job.panel_digest2, bool(job.ohlcv2), "ohlcv2")):
+                if not digest or has_raw or cache.contains_series(digest):
+                    continue
+                blob = blobs.get(digest)
+                if blob is None:
+                    blob = self._fetch_payload(stub, digest)
+                    if blob:
+                        blobs[digest] = blob
+                if blob:
+                    setattr(job, field, blob)
+
+    def _fetch_payload(self, stub, digest: str) -> bytes:
+        """One FetchPayload attempt; empty bytes when the dispatcher
+        cannot serve the digest (or the RPC fails) — the caller degrades
+        to the lease-requeue path, never a failed job."""
+        req = pb.PayloadRequest(worker_id=self.worker_id, digest=digest)
+        try:
+            with obs.timer(self._h_rpc["FetchPayload"]):
+                reply = stub.FetchPayload(req, timeout=30.0)
+            self._log_reconnected()
+        except grpc.RpcError as e:
+            self._c_rpc_errors["FetchPayload"].inc()
+            self._log_disconnected(e)
+            return b""
+        self._c_wire[("FetchPayload", "request")].inc(_pb_size(req))
+        self._c_wire[("FetchPayload", "reply")].inc(_pb_size(reply))
+        if not reply.payload:
+            # Not a recovery — don't count it as one (the dispatcher's
+            # dbx_payload_fetches_total{outcome="gone"} carries the
+            # degraded-period signal).
+            log.warning("payload fetch for digest %s came back empty; "
+                        "affected jobs will be re-dispatched with full "
+                        "bytes", digest[:16])
+            return b""
+        self._c_fetches.inc()
+        return reply.payload
 
     # Retry due-times for failed completion RPCs. Attempts are spread over
     # due windows with heartbeats flowing in between — nothing here ever
@@ -528,6 +634,8 @@ class Worker:
                     obs.timer(self._h_rpc["CompleteJobs"]):
                 reply = stub.CompleteJobs(req, timeout=8.0)
             self._log_reconnected()
+            self._c_wire[("CompleteJobs", "request")].inc(_pb_size(req))
+            self._c_wire[("CompleteJobs", "reply")].inc(_pb_size(reply))
             self.jobs_completed += reply.accepted
             self._jobs_rate.add(reply.accepted)
             for jid in reply.unknown_ids:

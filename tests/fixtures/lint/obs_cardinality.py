@@ -27,6 +27,14 @@ def record(reg, job_id, path, peer_addr, lineno):
     reg.gauge("fx_peer_up", peer=peer_addr).set(1)            # flagged: addr
     # f-string built from unbounded data: flagged
     reg.counter("fx_sites_total", site=f"{path}:{lineno}").inc()
+
+
+def record_panel(reg, panel_digest):
+    # content digests are the canonical unbounded vocabulary of the panel
+    # cache: one time series per distinct panel, forever — flagged
+    reg.counter("fx_panel_hits_total", panel=panel_digest).inc()
+    # bounded cache-level label: NOT flagged
+    reg.counter("fx_cache_hits_total", level="host").inc()
     # bounded literals and non-matching names: NOT flagged
     reg.counter("fx_ok_total", method="RequestJobs").inc()
     strategy = "sma_crossover"

@@ -99,6 +99,8 @@ def test_obs_cardinality_flags_unbounded_label_values():
          _fixture_line("obs_cardinality.py", 'peer=peer_addr')),
         ("obs-cardinality", "obs_cardinality.py",
          _fixture_line("obs_cardinality.py", 'site=f"{path}')),
+        ("obs-cardinality", "obs_cardinality.py",
+         _fixture_line("obs_cardinality.py", 'panel=panel_digest')),
     ]
     alias = findings[0]
     assert "wid = self.worker_id" in alias.message
@@ -109,6 +111,9 @@ def test_obs_cardinality_flags_unbounded_label_values():
         not in [f.line for f in findings]
     assert not any("fx_ok_total" in f.message
                    or "fx_by_kernel_total" in f.message for f in findings)
+    # Digest vocabulary (dispatch-by-digest round): content digests are
+    # unbounded; the bounded cache-level label is not.
+    assert not any("fx_cache_hits_total" in f.message for f in findings)
 
 
 def test_obs_cardinality_ignores_splats_and_bounded_loops(tmp_path):

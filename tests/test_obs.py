@@ -345,6 +345,43 @@ def test_timeline_straggler_flagging():
     assert timeline.summarize(tiny)["stragglers"] == []
 
 
+def test_timeline_panel_cache_hit_pseudo_stage():
+    """Dispatch-by-digest attribution: a decode span with a truthy
+    `cache_hit` attr charges its window to the `panel_cache_hit`
+    pseudo-stage (not decode, and never silently to transport); a d2h
+    span's cache_hit flag is informational only — the result drain it
+    times is real work and stays d2h. Stage seconds still sum exactly to
+    the e2e window."""
+    tid = obs.new_trace_id()
+    spans = [
+        {"ev": "span", "name": "job", "t0": 0.0, "dur_s": 4.0,
+         "trace_id": tid, "span_id": "s0", "job": "j1", "worker": "w0"},
+        {"ev": "span", "name": "job.queue_wait", "t0": 0.0, "dur_s": 1.0,
+         "trace_id": tid, "span_id": "s1", "job": "j1"},
+        # The digest-cache hit window: decode span, cache_hit=True.
+        {"ev": "span", "name": "worker.decode", "t0": 1.5, "dur_s": 0.5,
+         "trace_id": tid, "span_id": "s2", "cache_hit": True,
+         "cache_hits": 1},
+        {"ev": "span", "name": "worker.execute", "t0": 2.0, "dur_s": 1.0,
+         "trace_id": tid, "span_id": "s3"},
+        {"ev": "span", "name": "worker.d2h", "t0": 3.0, "dur_s": 0.5,
+         "trace_id": tid, "span_id": "s4", "cache_hit": True},
+    ]
+    tls = timeline.reconstruct(spans)
+    stages = timeline.critical_path(tls[tid])
+    assert stages["panel_cache_hit"] == pytest.approx(0.5)
+    assert stages["decode"] == 0.0
+    assert stages["d2h"] == pytest.approx(0.5)   # drain stays d2h
+    assert stages["execute"] == pytest.approx(1.0)
+    assert sum(stages.values()) == pytest.approx(4.0)
+
+    # Without the attr the same window is ordinary decode work.
+    spans[2] = dict(spans[2], cache_hit=False)
+    stages = timeline.critical_path(timeline.reconstruct(spans)[tid])
+    assert stages["decode"] == pytest.approx(0.5)
+    assert stages["panel_cache_hit"] == 0.0
+
+
 def test_event_log_env_opt_in_is_lazy(tmp_path, monkeypatch):
     """DBX_OBS_JSONL is consulted at FIRST USE, not import (dbxlint
     import-time-config): setting it after import but before first use

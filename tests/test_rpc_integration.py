@@ -1187,3 +1187,161 @@ def test_trace_stitching_across_processes(tmp_path):
         stages = timeline.critical_path(tl)
         assert sum(stages.values()) == pytest.approx(tl.e2e_dur, rel=0.10)
         assert stages["execute"] > 0 and stages["report"] >= 0
+
+
+def _shared_panel_jobs(n, n_bars=96, seed=11, grid=None):
+    """N sma jobs all carrying the SAME panel bytes — the multi-job-per-
+    panel workload dispatch-by-digest exists for."""
+    from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+        JobRecord)
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    series = data.synthetic_ohlcv(1, n_bars, seed=seed)
+    one = type(series)(*(np.asarray(f[0]) for f in series))
+    blob = data.to_wire_bytes(one)
+    return one, [JobRecord(id=f"dig-{seed}-{i}", strategy="sma_crossover",
+                           grid=grid or GRID, cost=1e-3, ohlcv=blob)
+                 for i in range(n)]
+
+
+def test_dispatch_by_digest_cache_hits_and_matching_results(tmp_path):
+    """The dispatch-by-digest tentpole end to end: jobs sharing ONE panel
+    ship the bytes once (every later delivery is digest-only), the
+    worker's two-level cache serves the repeats — decode AND h2d skipped,
+    asserted via the spans' cache_hit attrs — and the stored results
+    still match the direct sweep."""
+    import jax.numpy as jnp
+
+    from distributed_backtesting_exploration_tpu import obs
+    from distributed_backtesting_exploration_tpu.models import base
+    from distributed_backtesting_exploration_tpu.parallel import sweep
+
+    one, recs = _shared_panel_jobs(4)
+    queue = JobQueue()
+    for rec in recs:
+        queue.enqueue(rec)
+    # Content-addressed: four jobs, ONE stored panel.
+    assert queue.panel_store.stats()["panels"] == 1
+    assert len({r.panel_digest for r in recs}) == 1
+
+    disp, srv = _server(queue, results_dir=str(tmp_path / "results"))
+    backend = compute.JaxSweepBackend(use_fused=True)
+    digest_only0 = disp._c_payloads["digest_only"].value
+    saved0 = disp._c_bytes_saved.value
+    host_hits0 = backend.panel_cache._c_hits["host"].value
+    dev_hits0 = backend.panel_cache._c_hits["device"].value
+    try:
+        # jobs_per_chip=1 -> one job per poll, so deliveries 2..4 are
+        # digest-only and hit the cache delivery 1 primed.
+        w, t = _run_worker(f"localhost:{srv.port}", backend,
+                           jobs_per_chip=1)
+        _wait(lambda: queue.drained, msg="queue drained")
+        t.join(timeout=10)
+    finally:
+        srv.stop()
+    assert w.jobs_completed == 4
+    assert queue.stats()["jobs_failed"] == 0
+    assert disp._c_payloads["digest_only"].value - digest_only0 >= 3
+    assert disp._c_bytes_saved.value - saved0 >= 3 * len(recs[0].ohlcv)
+    assert backend.panel_cache._c_hits["host"].value - host_hits0 >= 3
+    assert backend.panel_cache._c_hits["device"].value - dev_hits0 >= 3
+    # The spans say so too (obs.timeline's panel_cache_hit pseudo-stage
+    # and the h2d-skip report key on these attrs).
+    ring = obs.recent_spans()
+    assert any(s.get("name") == "worker.decode" and s.get("cache_hit")
+               for s in ring), "no cache_hit decode span reached the ring"
+    assert any(s.get("name") == "worker.d2h" and s.get("cache_hit")
+               for s in ring), "no device-cache-hit d2h span in the ring"
+
+    # Digest-only dispatch must not change a single metric bit vs the
+    # directly-computed sweep.
+    panel = type(one)(*(jnp.asarray(f)[None, :] for f in one))
+    want = sweep.jit_sweep(
+        panel, base.get_strategy("sma_crossover"),
+        sweep.product_grid(**dict(sorted(GRID.items()))), cost=1e-3)
+    for rec in recs:
+        got = wire.metrics_from_bytes(
+            (tmp_path / "results" / f"{rec.id}.dbxm").read_bytes())
+        for name in want._fields:
+            np.testing.assert_allclose(
+                np.asarray(getattr(got, name)),
+                np.asarray(getattr(want, name))[0], rtol=2e-4, atol=2e-5,
+                err_msg=name)
+
+
+def test_digest_only_miss_recovers_via_fetch_payload(tmp_path, monkeypatch):
+    """Third leg of graceful degradation: a worker whose cache cannot
+    retain anything (DBX_PANEL_CACHE_MB=0) receives digest-only jobs,
+    misses, and recovers the bytes by content address over FetchPayload —
+    every job still completes; none fail, none wedge."""
+    monkeypatch.setenv("DBX_PANEL_CACHE_MB", "0")
+    _, recs = _shared_panel_jobs(4, seed=12)
+    queue = JobQueue()
+    for rec in recs:
+        queue.enqueue(rec)
+    disp, srv = _server(queue, results_dir=str(tmp_path / "results"))
+    backend = compute.JaxSweepBackend(use_fused=True)
+    assert backend.panel_cache.max_bytes == 0
+    fetch_hits0 = disp._c_fetches["hit"].value
+    try:
+        w, t = _run_worker(f"localhost:{srv.port}", backend,
+                           jobs_per_chip=1)
+        _wait(lambda: queue.drained, msg="queue drained")
+        t.join(timeout=10)
+    finally:
+        srv.stop()
+    assert w.jobs_completed == 4
+    assert queue.stats()["jobs_failed"] == 0
+    # Deliveries 2..4 were digest-only; each recovered via FetchPayload.
+    assert disp._c_fetches["hit"].value - fetch_hits0 >= 3
+    assert len(list((tmp_path / "results").glob("*.dbxm"))) == 4
+
+
+def test_digest_only_requires_worker_capability_flag(tmp_path):
+    """Rolling-upgrade safety: a client that does NOT set
+    JobsRequest.accepts_digest_only (an older worker binary, proto3
+    default false) always receives full payload bytes — even for a panel
+    the dispatcher already delivered to it — because it has no
+    FetchPayload to recover an empty ohlcv with."""
+    import grpc
+
+    from distributed_backtesting_exploration_tpu.rpc import service
+
+    _, recs = _shared_panel_jobs(3, seed=13)
+    queue = JobQueue()
+    for rec in recs:
+        queue.enqueue(rec)
+    disp, srv = _server(queue, results_dir=str(tmp_path / "results"))
+    channel = grpc.insecure_channel(f"localhost:{srv.port}",
+                                    options=service.default_channel_options())
+    stub = service.DispatcherStub(channel)
+    try:
+        got = []
+        for _ in range(3):
+            reply = stub.RequestJobs(pb.JobsRequest(
+                worker_id="legacy", chips=1, jobs_per_chip=1))
+            got.extend(reply.jobs)
+        assert len(got) == 3
+        # Every delivery ships the full panel; digests still ride along
+        # (harmless to a reader that ignores unknown fields).
+        assert all(j.ohlcv == recs[0].ohlcv for j in got)
+        assert all(j.panel_digest == recs[0].panel_digest for j in got)
+        for j in got:
+            disp.CompleteJob(pb.CompleteRequest(
+                id=j.id, worker_id="legacy"), None)
+        # The capable path on the SAME dispatcher still dedupes.
+        _, recs2 = _shared_panel_jobs(2, seed=14)
+        for rec in recs2:
+            queue.enqueue(rec)
+        full = []
+        for _ in range(2):
+            reply = stub.RequestJobs(pb.JobsRequest(
+                worker_id="capable", chips=1, jobs_per_chip=1,
+                accepts_digest_only=True))
+            full.extend(reply.jobs)
+        assert len(full) == 2
+        assert full[0].ohlcv == recs2[0].ohlcv
+        assert full[1].ohlcv == b"" and full[1].panel_digest
+    finally:
+        channel.close()
+        srv.stop()

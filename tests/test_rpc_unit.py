@@ -1165,3 +1165,119 @@ def test_best_returns_unknown_metric_completes_empty():
                       best_returns=True, rank_metric="not_a_metric")
     comps = compute.JaxSweepBackend(use_fused=False).process([spec])
     assert len(comps) == 1 and comps[0].metrics == b""
+
+
+# ---------------------------------------------------------------------------
+# Dispatch by digest: content-addressed panel store (dispatcher side)
+# ---------------------------------------------------------------------------
+
+def test_file_backed_job_redispatches_after_source_deleted(tmp_path,
+                                                           qfactory):
+    """Regression for the requeue re-read bug: a file-backed (CSV) job used
+    to re-read AND re-transcode its source on every dispatch — with the
+    content-addressed blob store, a requeued job dispatches from memory
+    even after the source file is deleted post-first-materialization."""
+    import os
+
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    csv_path = tmp_path / "t.csv"
+    series = data.synthetic_ohlcv(1, 16, seed=5)
+    csv_path.write_bytes(
+        data.to_csv_bytes(type(series)(*(f[0] for f in series))))
+    q = qfactory(lease_s=60.0)
+    rec = JobRecord(id="f1", strategy="sma_crossover",
+                    grid={"fast": np.asarray([5.0], np.float32)},
+                    path=str(csv_path))
+    q.enqueue(rec)
+    assert rec.panel_digest == ""          # file-backed: stamped at take
+    taken = q.take(1, "w1")
+    assert len(taken) == 1
+    first_payload = taken[0][1]
+    digest = rec.panel_digest
+    assert digest and first_payload[:4] == b"DBX1"
+
+    # Lease abandoned, source deleted: the redispatch must come from the
+    # store, not the (gone) file, under the SAME content address.
+    assert q.requeue_worker("w1") == ["f1"]
+    os.remove(csv_path)
+    taken2 = q.take(1, "w2")
+    assert len(taken2) == 1
+    assert taken2[0][1] == first_payload
+    assert rec.panel_digest == digest
+    # And FetchPayload's backing lookup serves it too.
+    assert q.payload_for_digest(digest) == first_payload
+
+
+def test_panel_digest_journaled_and_restored(tmp_path, qfactory):
+    """The digest stamped at first materialization survives a restart (a
+    "digest" journal event merges into the enqueue record on replay), so
+    a restarted dispatcher keeps addressing the panel the first run
+    delivered; the empty store repopulates lazily from the source."""
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    csv_path = tmp_path / "t.csv"
+    series = data.synthetic_ohlcv(1, 16, seed=6)
+    csv_path.write_bytes(
+        data.to_csv_bytes(type(series)(*(f[0] for f in series))))
+    jpath = str(tmp_path / "journal.jsonl")
+    q = qfactory(Journal(jpath))
+    rec = JobRecord(id="f1", strategy="sma_crossover",
+                    grid={"fast": np.asarray([5.0], np.float32)},
+                    path=str(csv_path))
+    q.enqueue(rec)
+    (payload,) = [p for _, p in q.take(1, "w1")]
+    assert rec.panel_digest
+    q.requeue_worker("w1")
+
+    q2 = qfactory()
+    assert q2.restore(jpath) == 1
+    (taken,) = q2.take(1, "w2")
+    assert taken[0].panel_digest == rec.panel_digest
+    assert taken[1] == payload
+    # Inline payloads journal their digest with the enqueue record.
+    q3 = qfactory(Journal(str(tmp_path / "j2.jsonl")))
+    inline = _mk_jobs(1)[0]
+    q3.enqueue(inline)
+    assert inline.panel_digest
+    q4 = qfactory()
+    assert q4.restore(str(tmp_path / "j2.jsonl")) == 1
+    (taken4,) = q4.take(1, "w1")
+    assert taken4[0].panel_digest == inline.panel_digest
+
+
+def test_panel_store_lru_bound_and_unservable_digest(tmp_path):
+    """The store honors its byte bound (LRU eviction), and an evicted
+    digest whose source is also gone is reported unservable (None) — the
+    FetchPayload leg that makes the dispatcher forget the delivery."""
+    from distributed_backtesting_exploration_tpu.rpc.panel_store import (
+        PanelStore, panel_digest)
+
+    store = PanelStore(max_bytes=64)
+    d1 = store.put(b"a" * 40)
+    d2 = store.put(b"b" * 40)           # evicts the first blob
+    assert store.get(d2) == b"b" * 40
+    assert store.get(d1) is None
+    assert store.stats()["evictions"] == 1
+    assert store.stats()["bytes"] <= 64
+    assert d1 == panel_digest(b"a" * 40)
+
+    # Queue-level: digest known, store evicted, file gone -> unservable.
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    csv_path = tmp_path / "t.csv"
+    series = data.synthetic_ohlcv(1, 16, seed=7)
+    csv_path.write_bytes(
+        data.to_csv_bytes(type(series)(*(f[0] for f in series))))
+    q = JobQueue()
+    rec = JobRecord(id="f1", strategy="sma_crossover",
+                    grid={"fast": np.asarray([5.0], np.float32)},
+                    path=str(csv_path))
+    q.enqueue(rec)
+    q.take(1, "w1")
+    q.panel_store.max_bytes = 0
+    q.panel_store.put(b"x")             # force the eviction sweep
+    import os
+
+    os.remove(csv_path)
+    assert q.payload_for_digest(rec.panel_digest) is None

@@ -96,3 +96,55 @@ def test_bollinger_stage_attribution_present(roofline):
 def test_roofline_rates_reported(roofline):
     assert roofline["configs"]["roofline_stages_full"] > 0.0
     assert roofline["configs"]["roofline_stages_boll_full"] > 0.0
+
+
+_LOCAL_ENV = {
+    "DBX_BENCH_CPU": "1", "DBX_BENCH_CACHE": "",
+    "DBX_BENCH_CONFIGS": "e2e_local,direct_dispatch",
+    # Tiny-but-real loopback runs: one worker count, few jobs, a small
+    # shared panel for the dedupe A/B — structure smoke, not performance.
+    "DBX_BENCH_LOCAL_JOBS": "48", "DBX_BENCH_LOCAL_WORKERS": "1",
+    "DBX_BENCH_DEDUPE_BARS": "256",
+}
+
+
+@pytest.fixture(scope="module")
+def local_bench():
+    """One tiny in-process e2e_local + direct_dispatch run (loopback gRPC,
+    instant backend — no kernels, no compiles), shared by the module."""
+    prior = {k: os.environ.get(k) for k in _LOCAL_ENV}
+    os.environ.update(_LOCAL_ENV)
+    bench.ROOFLINE.clear()
+    buf = io.StringIO()
+    try:
+        with contextlib.redirect_stdout(buf):
+            bench.main()
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return json.loads(buf.getvalue().strip().splitlines()[-1])
+
+
+def test_wire_bytes_per_job_keys_present(local_bench):
+    """Transport savings are a first-class bench column: e2e_local and
+    direct_dispatch_floor both record wire_bytes_per_job, and the dedupe
+    A/B records its jobs/s + wire columns (the dispatch-by-digest
+    acceptance numbers ride these keys)."""
+    e2e = local_bench["roofline"]["e2e_local"]
+    assert e2e["wire_bytes_per_job"]["w1"] > 0.0
+    dd = e2e["dedupe"]
+    for key in ("panel_bytes", "jobs_per_s_on", "jobs_per_s_off",
+                "dedupe_speedup", "wire_bytes_per_job_on",
+                "wire_bytes_per_job_off", "wire_reduction"):
+        assert key in dd, key
+    assert dd["jobs_per_s_on"] > 0.0 and dd["jobs_per_s_off"] > 0.0
+    # Digest-only dispatch must actually shrink the wire even at smoke
+    # scale (the >=10x acceptance bar is asserted on the real-size run).
+    assert dd["wire_bytes_per_job_on"] < dd["wire_bytes_per_job_off"]
+
+    floor = local_bench["roofline"]["direct_dispatch_floor"]
+    assert floor["wire_bytes_per_job"]["b32"] > 0.0
+    assert floor["wire_bytes_per_job"]["b128"] > 0.0
