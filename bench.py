@@ -1269,6 +1269,77 @@ def main():
                 print(f"bench[queue_machine_native_cabi]: skipped ({e})",
                       file=sys.stderr)
 
+    # --- streaming_append: O(ΔT) live-bar serving A/B ---------------------
+    # ROADMAP item 1's acceptance instrument: the same appended ΔT-bar
+    # slice priced two ways — (A) the recurrent form advancing a carry
+    # checkpoint (streaming.recurrent.append_step, the AppendBars serving
+    # path) vs (B) today's cost model, a full scan-form reprice of the
+    # whole (T+ΔT)-bar panel. Both run in-process on fixed shapes with
+    # the compile walls warmed out, so the ratio is pure steady-state
+    # work; `append_speedup` is the >=50x acceptance number at the
+    # headline T=8192 / ΔT=16 (knobs DBX_BENCH_STREAM_T / _DT). The wire
+    # columns record what AppendBars ships (one DBX1 ΔT slice) vs what a
+    # full re-dispatch would (the whole extended panel).
+    if enabled("streaming_append"):
+        from distributed_backtesting_exploration_tpu.streaming import (
+            recurrent as stream_rc)
+
+        s_T = int(os.environ.get("DBX_BENCH_STREAM_T", 8192))
+        s_DT = int(os.environ.get("DBX_BENCH_STREAM_DT", 16))
+        s_iters = max(min(iters, 10), 3)
+        sgrid = {k: np.asarray(v) for k, v in sweep.product_grid(
+            fast=np.arange(5.0, 13.0, dtype=np.float32),
+            slow=np.arange(30.0, 46.0, 4.0, dtype=np.float32)).items()}
+        s_combos = int(sgrid["fast"].size)
+        s_close = np.asarray(data.synthetic_ohlcv(
+            1, s_T + s_DT * (s_iters + 1), seed=77).close)
+
+        carry0 = stream_rc.build_carry("sma_crossover",
+                                       {"close": s_close[:, :s_T]}, sgrid)
+        # Warm both forms: the A/B must time steady-state work, not jit.
+        np.asarray(stream_rc.finalize(stream_rc.append_step(
+            carry0, {"close": s_close[:, s_T:s_T + s_DT]})).sharpe)
+        np.asarray(stream_rc.finalize(stream_rc.build_carry(
+            "sma_crossover", {"close": s_close[:, :s_T + s_DT]},
+            sgrid)).sharpe)
+
+        t0 = time.perf_counter()
+        c = carry0
+        for i in range(s_iters):
+            lo = s_T + i * s_DT
+            c = stream_rc.append_step(
+                c, {"close": s_close[:, lo:lo + s_DT]})
+            np.asarray(stream_rc.finalize(c).sharpe)   # the served result
+        t_append = (time.perf_counter() - t0) / s_iters
+
+        # Full reprice at a FIXED (T+ΔT) length per update: same compiled
+        # shape every iteration (a per-update growing length would time
+        # recompiles, not work).
+        t0 = time.perf_counter()
+        for _ in range(s_iters):
+            np.asarray(stream_rc.finalize(stream_rc.build_carry(
+                "sma_crossover", {"close": s_close[:, :s_T + s_DT]},
+                sgrid)).sharpe)
+        t_full = (time.perf_counter() - t0) / s_iters
+
+        wire_full = 8 + 4 * 5 * (s_T + s_DT)     # DBX1: magic+T+5 f32[T]
+        wire_delta = 8 + 4 * 5 * s_DT
+        speedup = t_full / max(t_append, 1e-9)
+        ROOFLINE["streaming_append"] = {
+            "bars_base": s_T, "delta_bars": s_DT, "updates": s_iters,
+            "combos": s_combos,
+            "append_s_per_update": round(t_append, 6),
+            "full_reprice_s_per_update": round(t_full, 6),
+            "append_speedup": round(speedup, 2),
+            "wire_bytes_full": wire_full,
+            "wire_bytes_delta": wire_delta,
+            "wire_reduction": round(wire_full / wire_delta, 1)}
+        rates["streaming_append"] = 1.0 / max(t_append, 1e-9)
+        print(f"bench[streaming_append]: T={s_T} dT={s_DT} "
+              f"P={s_combos}: append {t_append * 1e3:.2f} ms/update vs "
+              f"full reprice {t_full * 1e3:.1f} ms -> {speedup:.1f}x "
+              f"(wire {wire_full}B -> {wire_delta}B)", file=sys.stderr)
+
     # --- configs[4]: walk-forward (12 refit windows x grid) ---------------
     if enabled("walkforward"):
         train = n_bars // 2 - 30

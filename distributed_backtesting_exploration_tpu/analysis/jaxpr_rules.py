@@ -219,4 +219,40 @@ class KernelHygieneRule:
                 lambda *arrs, _run=run, _g=grid: _run(*arrs, _g, 0.0, 252,
                                                       None),
                 arrays, path=rel, line=line))
+        findings.extend(self._check_append_steps(ctx, suffix))
+        return findings
+
+    def _check_append_steps(self, ctx: LintContext,
+                            suffix: str) -> list[Finding]:
+        """The streaming ``_append_step`` recurrent kernels are
+        registered kernels too — every fused strategy with a streaming
+        family (plus pairs, which routes outside ``_FUSED_STRATEGIES``)
+        traces its append step under the active epilogue substrate, so
+        no fused code path serves untraced. Probe inputs come from
+        ``streaming.recurrent.hygiene_probe`` (tiny carry + ΔT slice)."""
+        from ..rpc.compute import JaxSweepBackend
+        from ..streaming import recurrent
+
+        findings: list[Finding] = []
+        names = sorted(set(JaxSweepBackend._FUSED_STRATEGIES) | {"pairs"})
+        try:
+            src, line = (inspect.getsourcefile(recurrent.append_step),
+                         inspect.getsourcelines(recurrent.append_step)[1])
+            rel = os.path.relpath(src, ctx.root)
+        except (OSError, TypeError):
+            rel, line = "streaming/recurrent.py", 0
+        for strategy in names:
+            if not recurrent.supports_strategy(strategy):
+                continue
+            label = f"{strategy}._append_step{suffix}"
+            try:
+                fn, args = recurrent.hygiene_probe(strategy)
+            except Exception as e:   # a probe that cannot build is a
+                findings.append(Finding(  # finding, never a crashed run
+                    self.name, rel, line,
+                    f"kernel `{label}`: hygiene probe failed to build "
+                    f"tiny inputs: {e!r}"))
+                continue
+            findings.extend(check_traced(label, fn, args, path=rel,
+                                         line=line))
         return findings

@@ -48,7 +48,7 @@ import sys
 # workers that skip the span entirely would silently mis-charge the gap
 # to transport.
 STAGES = ("queue_wait", "dispatch", "transport", "panel_cache_hit",
-          "decode", "compile", "execute", "d2h", "report")
+          "carry_hit", "decode", "compile", "execute", "d2h", "report")
 
 # span name -> (stage, priority). Priority 2 = stage-specific span wins
 # its interval outright; priority 1 = envelope fallback (charged only
@@ -67,6 +67,12 @@ SPAN_STAGE = {
     # same priority as the specific spans, so innermost-wins beats the
     # enclosing decode span over the fetch's own interval.
     "worker.payload_fetch": ("transport", 2),
+    # Streaming appends: the whole carry advance/rebuild window. With a
+    # truthy `carry_hit` attr it charges to the `carry_hit` pseudo-stage
+    # (the streaming twin of panel_cache_hit — an O(ΔT) advance is not
+    # execute work at full-reprice scale); a checkpoint-miss full reprice
+    # stays execute.
+    "worker.append": ("execute", 2),
     "worker.submit": ("execute", 1),
     "worker.collect": ("d2h", 1),
     "worker.process": ("execute", 1),
@@ -169,7 +175,8 @@ def reconstruct(events) -> dict[str, JobTimeline]:
                 "parent_id": parent_id,
                 "pid": rec.get("pid"), "ok": rec.get("ok", True),
                 "worker": rec.get("worker", ""),
-                "cache_hit": bool(rec.get("cache_hit", False))})
+                "cache_hit": bool(rec.get("cache_hit", False)),
+                "carry_hit": bool(rec.get("carry_hit", False))})
             if name == E2E_SPAN:
                 tl.e2e_t0, tl.e2e_dur = t0, dur
             if rec.get("job") and not tl.job_id:
@@ -209,6 +216,10 @@ def critical_path(tl: JobTimeline) -> dict[str, float]:
             # panel upload was device-cached — but the result drain they
             # time is real work and stays attributed to d2h.)
             stage = "panel_cache_hit"
+        if s["name"] == "worker.append" and s.get("carry_hit"):
+            # Streaming append served from the carry checkpoint: the
+            # O(ΔT) advance window, not full-reprice execute work.
+            stage = "carry_hit"
         a = max(s["t0"], lo)
         b = min(s["t0"] + s["dur_s"], hi)
         if b > a:

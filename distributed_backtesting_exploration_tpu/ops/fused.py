@@ -326,6 +326,60 @@ def _equity_scan(net, block: int):
     return mdd[0], 1.0 + carry[0]
 
 
+def _cumsum_last(x):
+    """Inclusive prefix sum over the LAST axis as a Hillis–Steele
+    shift-doubling ladder — the host-XLA twin of :func:`_cumsum0`.
+    NOT ``jnp.cumsum``: its ``associative_scan`` lowering compiles a
+    deeply recursive slice graph, and the blocked equity advance emits
+    one prefix op PER BLOCK — hundreds of them at long-context shapes
+    turned a tiny jit into a multi-minute XLA-CPU compile (the
+    ``ema_ladder`` lesson, re-learned host-side)."""
+    T = x.shape[-1]
+    s = 1
+    while s < T:
+        pad = jnp.zeros(x.shape[:-1] + (s,), x.dtype)
+        x = x + jnp.concatenate([pad, x[..., :-s]], axis=-1)
+        s *= 2
+    return x
+
+
+def _cummax_last(x):
+    """Inclusive running max over the LAST axis (shift ladder, see
+    :func:`_cumsum_last`)."""
+    T = x.shape[-1]
+    s = 1
+    while s < T:
+        pad = jnp.full(x.shape[:-1] + (s,), -jnp.inf, x.dtype)
+        x = jnp.maximum(x, jnp.concatenate([pad, x[..., :-s]], axis=-1))
+        s *= 2
+    return x
+
+
+def _equity_advance(net, block: int, cum, peak, mdd):
+    """Recurrent form of :func:`_equity_scan`, over the LAST axis.
+
+    Advances the ``(cumulative net, running peak, max drawdown)`` carry
+    across a ``(..., D)`` net-return slice in T-blocks of ``block`` bars
+    — the exact carry threading `_equity_scan` uses between its blocks,
+    exposed as a standalone step so a streaming append
+    (``streaming.recurrent``) can continue a finished sweep's equity
+    state in O(ΔT). Block boundaries are the only association
+    difference vs a cold full-length scan (the PR-3 f32 budget);
+    ``cum``/``peak``/``mdd`` initialize to ``0 / -inf / 0`` exactly as
+    `_equity_scan` seeds them, so the scan form is literally one call
+    covering the whole panel."""
+    D = net.shape[-1]
+    for s, e in _spans(D, block):
+        cs = _cumsum_last(net[..., s:e])
+        eq = (1.0 + cum)[..., None] + cs
+        pk = jnp.maximum(_cummax_last(eq), peak[..., None])
+        dd = (pk - eq) / jnp.maximum(pk, _EPS)
+        mdd = jnp.maximum(mdd, jnp.max(dd, axis=-1))
+        cum = cum + cs[..., -1]
+        peak = pk[..., -1]
+    return cum, peak, mdd
+
+
 def _unpack_tr(refs, T_real):
     """Shared ragged-vs-uniform ref plumbing for all sweep kernels: with a
     static ``T_real`` the refs are just ``(out_ref,)``; in ragged mode an
@@ -642,11 +696,43 @@ def _fused_call(close, onehot_d, warm, t_real, *, windows: tuple,
         for k in range(9)))
 
 
+def _check_carry_out_args(carry_out: bool, t_real) -> None:
+    """Argument-only carry_out validation, hoisted to every wrapper's
+    entry so an invalid call raises BEFORE the kernel sweep runs (the
+    sweep is seconds of work at real shapes; the check is free)."""
+    if carry_out and t_real is not None:
+        raise ValueError(
+            "carry_out=True supports uniform full-history panels only "
+            "(a streaming checkpoint summarizes ONE panel state; ragged "
+            "groups checkpoint per panel)")
+
+
+def _carry_out_tail(metrics, strategy: str, fields: dict, grid: dict, *,
+                    t_real, cost, ppy, epilogue):
+    """The shared ``carry_out=True`` tail of every public sweep wrapper:
+    return ``(metrics, carry)`` where the carry is the streaming
+    checkpoint (``streaming.recurrent.StreamCarry``) of this sweep —
+    the scan-form pass that makes every later ΔT-bar append O(ΔT)
+    (``streaming.recurrent.append_step``). The carry is built by the
+    generic-model scan form (the kernels' rounding twin on CPU, the
+    documented knife-edge class on TPU); the kernel metrics are returned
+    untouched alongside it. Argument validation lives in
+    `_check_carry_out_args`, hoisted to the wrappers' entries."""
+    del t_real   # validated (None) at wrapper entry
+    from ..streaming import recurrent
+
+    carry = recurrent.build_carry(
+        strategy, fields, grid, cost=float(cost),
+        periods_per_year=int(ppy), epilogue=epilogue)
+    return metrics, carry
+
+
 def fused_sma_sweep(close, fast, slow, *, t_real=None, cost: float = 0.0,
                     periods_per_year: int = 252,
                     interpret: bool | None = None,
                     table: str | None = None,
-                    epilogue: str | None = None) -> Metrics:
+                    epilogue: str | None = None,
+                    carry_out: bool = False) -> Metrics:
     """Fused SMA-crossover sweep: ``(N, T)`` closes x ``(P,)`` param lanes.
 
     ``fast``/``slow`` are the *flat* per-combo window arrays (use
@@ -670,7 +756,10 @@ def fused_sma_sweep(close, fast, slow, *, t_real=None, cost: float = 0.0,
     ``epilogue`` picks the metrics-tail substrate (env ``DBX_EPILOGUE``,
     default ``"scan"`` — the single-pass carry scan; ``"ladder"`` keeps
     the O(T log T) shift-ladder fallback, see `_equity_scan`).
+    ``carry_out=True`` additionally returns the streaming checkpoint of
+    this sweep (see `_carry_out_tail`) as ``(metrics, carry)``.
     """
+    _check_carry_out_args(carry_out, t_real)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     close = jnp.asarray(close, jnp.float32)
@@ -682,15 +771,21 @@ def fused_sma_sweep(close, fast, slow, *, t_real=None, cost: float = 0.0,
     windows, onehot_d, warm = _grid_setup(
         fast.astype(np.float32).tobytes(), slow.astype(np.float32).tobytes())
     table = _family_table("sma", table)
-    return _fused_call(close, onehot_d, warm,
-                       _t_real_col(t_real, close),
-                       windows=windows,
-                       T_pad=_round_up(T, 8), W_pad=onehot_d.shape[0],
-                       P_real=P, T_real=T if t_real is None else None,
-                       cost=float(cost), ppy=int(periods_per_year),
-                       interpret=bool(interpret), table=table,
-                       lanes_env=resolve_lanes_cap(),
-                       epilogue=_resolve_epilogue(epilogue))
+    m = _fused_call(close, onehot_d, warm,
+                    _t_real_col(t_real, close),
+                    windows=windows,
+                    T_pad=_round_up(T, 8), W_pad=onehot_d.shape[0],
+                    P_real=P, T_real=T if t_real is None else None,
+                    cost=float(cost), ppy=int(periods_per_year),
+                    interpret=bool(interpret), table=table,
+                    lanes_env=resolve_lanes_cap(),
+                    epilogue=_resolve_epilogue(epilogue))
+    if not carry_out:
+        return m
+    return _carry_out_tail(m, "sma_crossover", {"close": close},
+                           {"fast": fast, "slow": slow}, t_real=t_real,
+                           cost=cost, ppy=periods_per_year,
+                           epilogue=epilogue)
 
 
 def _prefix_compose3(pm, p0, pp):
@@ -1099,10 +1194,17 @@ def _bollinger_family_sweep(close, window, k, *, machine: str, z_exit: float,
                             t_real, cost: float, periods_per_year: int,
                             interpret: bool | None,
                             table: str | None = None,
-                            epilogue: str | None = None) -> Metrics:
+                            epilogue: str | None = None,
+                            carry_out: bool = False) -> Metrics:
     """Shared prep for both Bollinger-family wrappers (one z-table/grid
     pipeline, the ``machine`` picks the cell; ``table`` picks the z-table
     substrate — env ``DBX_BOLL_TABLE`` or ``"inline"``)."""
+    _check_carry_out_args(carry_out, t_real)
+    if carry_out and machine == "hysteresis" and float(z_exit) != 0.0:
+        raise ValueError(
+            "carry_out=True requires z_exit=0 for the bollinger machine "
+            "(the streaming family follows models.bollinger, which exits "
+            "at the rolling mean)")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     close = jnp.asarray(close, jnp.float32)
@@ -1114,18 +1216,24 @@ def _bollinger_family_sweep(close, window, k, *, machine: str, z_exit: float,
         window.astype(np.float32).tobytes(), k.tobytes())
     # T_pad is a lane multiple (128): T sits on the table's minor axis AND
     # on the working tiles' sublane axis.
-    return _fused_boll_call(close, onehot_w, k_lanes, warm,
-                            _t_real_col(t_real, close),
-                            windows=windows,
-                            T_pad=_round_up(T, 128), W_pad=onehot_w.shape[0],
-                            P_real=window.shape[0],
-                            T_real=T if t_real is None else None,
-                            cost=float(cost), ppy=int(periods_per_year),
-                            z_exit=float(z_exit), machine=machine,
-                            interpret=bool(interpret),
-                            table=_family_table("boll", table),
-                            lanes_env=resolve_lanes_cap(),
-                            epilogue=_resolve_epilogue(epilogue))
+    m = _fused_boll_call(close, onehot_w, k_lanes, warm,
+                         _t_real_col(t_real, close),
+                         windows=windows,
+                         T_pad=_round_up(T, 128), W_pad=onehot_w.shape[0],
+                         P_real=window.shape[0],
+                         T_real=T if t_real is None else None,
+                         cost=float(cost), ppy=int(periods_per_year),
+                         z_exit=float(z_exit), machine=machine,
+                         interpret=bool(interpret),
+                         table=_family_table("boll", table),
+                         lanes_env=resolve_lanes_cap(),
+                         epilogue=_resolve_epilogue(epilogue))
+    if not carry_out:
+        return m
+    return _carry_out_tail(
+        m, "bollinger" if machine == "hysteresis" else "bollinger_touch",
+        {"close": close}, {"window": window, "k": k}, t_real=t_real,
+        cost=cost, ppy=periods_per_year, epilogue=epilogue)
 
 
 def fused_bollinger_touch_sweep(close, window, k, *, t_real=None,
@@ -1133,7 +1241,8 @@ def fused_bollinger_touch_sweep(close, window, k, *, t_real=None,
                                 periods_per_year: int = 252,
                                 interpret: bool | None = None,
                                 table: str | None = None,
-                                epilogue: str | None = None) -> Metrics:
+                                epilogue: str | None = None,
+                                carry_out: bool = False) -> Metrics:
     """Fused band-touch sweep: the path-free Bollinger variant.
 
     Same z-table and grid layout as :func:`fused_bollinger_sweep`, but the
@@ -1146,7 +1255,7 @@ def fused_bollinger_touch_sweep(close, window, k, *, t_real=None,
     return _bollinger_family_sweep(
         close, window, k, machine="touch", z_exit=0.0, t_real=t_real,
         cost=cost, periods_per_year=periods_per_year, interpret=interpret,
-        table=table, epilogue=epilogue)
+        table=table, epilogue=epilogue, carry_out=carry_out)
 
 
 def fused_bollinger_sweep(close, window, k, *, t_real=None,
@@ -1154,7 +1263,8 @@ def fused_bollinger_sweep(close, window, k, *, t_real=None,
                           cost: float = 0.0, periods_per_year: int = 252,
                           interpret: bool | None = None,
                           table: str | None = None,
-                          epilogue: str | None = None) -> Metrics:
+                          epilogue: str | None = None,
+                          carry_out: bool = False) -> Metrics:
     """Fused Bollinger mean-reversion sweep: ``(N, T)`` closes x ``(P,)`` lanes.
 
     ``window``/``k`` are flat per-combo arrays (:func:`product_grid` order);
@@ -1167,7 +1277,8 @@ def fused_bollinger_sweep(close, window, k, *, t_real=None,
     return _bollinger_family_sweep(
         close, window, k, machine="hysteresis", z_exit=z_exit,
         t_real=t_real, cost=cost, periods_per_year=periods_per_year,
-        interpret=interpret, table=table, epilogue=epilogue)
+        interpret=interpret, table=table, epilogue=epilogue,
+        carry_out=carry_out)
 
 
 
@@ -1400,7 +1511,8 @@ def fused_pairs_sweep(y_close, x_close, lookback, z_entry, *, t_real=None,
                       z_exit=0.0,
                       cost: float = 0.0, periods_per_year: int = 252,
                       interpret: bool | None = None,
-                      epilogue: str | None = None) -> Metrics:
+                      epilogue: str | None = None,
+                      carry_out: bool = False) -> Metrics:
     """Fused rolling-OLS pairs sweep: ``(N, T)`` pair legs x ``(P,)`` lanes.
 
     ``lookback``/``z_entry`` are flat per-combo arrays (:func:`product_grid`
@@ -1417,6 +1529,7 @@ def fused_pairs_sweep(y_close, x_close, lookback, z_entry, *, t_real=None,
     8.33 vs 7.96 M/s. ``bench.py --verify`` re-quantifies and BUDGETS both
     every round.)
     """
+    _check_carry_out_args(carry_out, t_real)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     y_close = jnp.asarray(y_close, jnp.float32)
@@ -1432,15 +1545,21 @@ def fused_pairs_sweep(y_close, x_close, lookback, z_entry, *, t_real=None,
         lookback.tobytes(), z_entry.tobytes(), z_exit_arr.tobytes())
     # T_pad is a lane multiple (128): T sits on the tables' minor axis AND on
     # the working tiles' sublane axis, so 128 satisfies both constraints.
-    return _fused_pairs_call(y_close, x_close, onehot_w, k_lanes, zx_lanes,
-                             warm, _t_real_col(t_real, y_close),
-                             windows=windows,
-                             T_pad=_round_up(T, 128), W_pad=onehot_w.shape[0],
-                             P_real=P, T_real=T if t_real is None else None,
-                             cost=float(cost),
-                             ppy=int(periods_per_year),
-                             interpret=bool(interpret),
-                             epilogue=_resolve_epilogue(epilogue))
+    m = _fused_pairs_call(y_close, x_close, onehot_w, k_lanes, zx_lanes,
+                          warm, _t_real_col(t_real, y_close),
+                          windows=windows,
+                          T_pad=_round_up(T, 128), W_pad=onehot_w.shape[0],
+                          P_real=P, T_real=T if t_real is None else None,
+                          cost=float(cost),
+                          ppy=int(periods_per_year),
+                          interpret=bool(interpret),
+                          epilogue=_resolve_epilogue(epilogue))
+    if not carry_out:
+        return m
+    return _carry_out_tail(
+        m, "pairs", {"close": y_close, "close2": x_close},
+        {"lookback": lookback, "z_entry": z_entry, "z_exit": z_exit_arr},
+        t_real=t_real, cost=cost, ppy=periods_per_year, epilogue=epilogue)
 
 
 @functools.lru_cache(maxsize=4)
@@ -1979,7 +2098,8 @@ def fused_momentum_sweep(close, lookback, *, t_real=None, cost: float = 0.0,
                          periods_per_year: int = 252,
                          interpret: bool | None = None,
                          table: str | None = None,
-                         epilogue: str | None = None) -> Metrics:
+                         epilogue: str | None = None,
+                         carry_out: bool = False) -> Metrics:
     """Fused time-series momentum sweep: ``(N, T)`` closes x ``(P,)`` lanes.
 
     Matches ``run_sweep(..., "momentum")`` with an *exact* signal (the
@@ -1987,6 +2107,7 @@ def fused_momentum_sweep(close, lookback, *, t_real=None, cost: float = 0.0,
     f32 reduction tolerance. ``table`` picks the past-close-table substrate
     (env ``DBX_MOM_TABLE``): both are exact, see :func:`_fused_mom_call`.
     """
+    _check_carry_out_args(carry_out, t_real)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     close = jnp.asarray(close, jnp.float32)
@@ -1994,22 +2115,29 @@ def fused_momentum_sweep(close, lookback, *, t_real=None, cost: float = 0.0,
     T = close.shape[1]
     windows, onehot_l, warm = _single_window_grid_setup(
         lookback.astype(np.float32).tobytes(), 1.0, "lookbacks")
-    return _fused_mom_call(close, onehot_l, warm, _t_real_col(t_real, close),
-                           windows=windows, T_pad=_round_up(T, 128),
-                           W_pad=onehot_l.shape[0], P_real=lookback.shape[0],
-                           T_real=T if t_real is None else None,
-                           cost=float(cost), ppy=int(periods_per_year),
-                           interpret=bool(interpret),
-                           table=_family_table("mom", table),
-                           lanes_env=resolve_lanes_cap(),
-                           epilogue=_resolve_epilogue(epilogue))
+    m = _fused_mom_call(close, onehot_l, warm, _t_real_col(t_real, close),
+                        windows=windows, T_pad=_round_up(T, 128),
+                        W_pad=onehot_l.shape[0], P_real=lookback.shape[0],
+                        T_real=T if t_real is None else None,
+                        cost=float(cost), ppy=int(periods_per_year),
+                        interpret=bool(interpret),
+                        table=_family_table("mom", table),
+                        lanes_env=resolve_lanes_cap(),
+                        epilogue=_resolve_epilogue(epilogue))
+    if not carry_out:
+        return m
+    return _carry_out_tail(m, "momentum", {"close": close},
+                           {"lookback": lookback}, t_real=t_real,
+                           cost=cost, ppy=periods_per_year,
+                           epilogue=epilogue)
 
 
 def fused_donchian_sweep(close, window, *, t_real=None, cost: float = 0.0,
                          periods_per_year: int = 252,
                          interpret: bool | None = None,
                          table: str | None = None,
-                         epilogue: str | None = None) -> Metrics:
+                         epilogue: str | None = None,
+                         carry_out: bool = False) -> Metrics:
     """Fused Donchian-breakout sweep: ``(N, T)`` closes x ``(P,)`` lanes.
 
     Matches ``run_sweep(..., "donchian")``: the channel extrema are exact
@@ -2018,6 +2146,7 @@ def fused_donchian_sweep(close, window, *, t_real=None, cost: float = 0.0,
     ``table`` picks the sign-table substrate (env ``DBX_DON_TABLE``): both
     are exact, see :func:`_fused_don_call`.
     """
+    _check_carry_out_args(carry_out, t_real)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     close = jnp.asarray(close, jnp.float32)
@@ -2025,22 +2154,28 @@ def fused_donchian_sweep(close, window, *, t_real=None, cost: float = 0.0,
     T = close.shape[1]
     windows, onehot_w, warm = _single_window_grid_setup(
         window.astype(np.float32).tobytes(), 1.0, "windows")
-    return _fused_don_call(close, close, close, onehot_w, warm,
-                           _t_real_col(t_real, close),
-                           windows=windows, T_pad=_round_up(T, 128),
-                           W_pad=onehot_w.shape[0], P_real=window.shape[0],
-                           T_real=T if t_real is None else None,
-                           cost=float(cost), ppy=int(periods_per_year),
-                           interpret=bool(interpret),
-                           table=_family_table("don", table),
-                           epilogue=_resolve_epilogue(epilogue))
+    m = _fused_don_call(close, close, close, onehot_w, warm,
+                        _t_real_col(t_real, close),
+                        windows=windows, T_pad=_round_up(T, 128),
+                        W_pad=onehot_w.shape[0], P_real=window.shape[0],
+                        T_real=T if t_real is None else None,
+                        cost=float(cost), ppy=int(periods_per_year),
+                        interpret=bool(interpret),
+                        table=_family_table("don", table),
+                        epilogue=_resolve_epilogue(epilogue))
+    if not carry_out:
+        return m
+    return _carry_out_tail(m, "donchian", {"close": close},
+                           {"window": window}, t_real=t_real, cost=cost,
+                           ppy=periods_per_year, epilogue=epilogue)
 
 
 def fused_donchian_hl_sweep(close, high, low, window, *, t_real=None,
                             cost: float = 0.0, periods_per_year: int = 252,
                             interpret: bool | None = None,
                             table: str | None = None,
-                            epilogue: str | None = None) -> Metrics:
+                            epilogue: str | None = None,
+                            carry_out: bool = False) -> Metrics:
     """Fused high/low-channel Donchian sweep: ``(N, T)`` panels x ``(P,)``.
 
     Matches ``run_sweep(..., "donchian_hl")`` — breakout when the close
@@ -2049,6 +2184,7 @@ def fused_donchian_hl_sweep(close, high, low, window, *, t_real=None,
     are exact, so breakouts and the latch path are bit-identical to the
     generic scan; metrics carry f32 tolerance.
     """
+    _check_carry_out_args(carry_out, t_real)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     close = jnp.asarray(close, jnp.float32)
@@ -2058,15 +2194,21 @@ def fused_donchian_hl_sweep(close, high, low, window, *, t_real=None,
     T = close.shape[1]
     windows, onehot_w, warm = _single_window_grid_setup(
         window.astype(np.float32).tobytes(), 1.0, "windows")
-    return _fused_don_call(close, high, low, onehot_w, warm,
-                           _t_real_col(t_real, close),
-                           windows=windows, T_pad=_round_up(T, 128),
-                           W_pad=onehot_w.shape[0], P_real=window.shape[0],
-                           T_real=T if t_real is None else None,
-                           cost=float(cost), ppy=int(periods_per_year),
-                           interpret=bool(interpret),
-                           table=_family_table("don", table),
-                           epilogue=_resolve_epilogue(epilogue))
+    m = _fused_don_call(close, high, low, onehot_w, warm,
+                        _t_real_col(t_real, close),
+                        windows=windows, T_pad=_round_up(T, 128),
+                        W_pad=onehot_w.shape[0], P_real=window.shape[0],
+                        T_real=T if t_real is None else None,
+                        cost=float(cost), ppy=int(periods_per_year),
+                        interpret=bool(interpret),
+                        table=_family_table("don", table),
+                        epilogue=_resolve_epilogue(epilogue))
+    if not carry_out:
+        return m
+    return _carry_out_tail(
+        m, "donchian_hl", {"close": close, "high": high, "low": low},
+        {"window": window}, t_real=t_real, cost=cost,
+        ppy=periods_per_year, epilogue=epilogue)
 
 
 @functools.partial(
@@ -2111,7 +2253,8 @@ def _fused_stoch_call(close, high, low, onehot_w, band_lanes, warm, t_real,
 def fused_stochastic_sweep(close, high, low, window, band, *, t_real=None,
                            cost: float = 0.0, periods_per_year: int = 252,
                            interpret: bool | None = None,
-                           epilogue: str | None = None) -> Metrics:
+                           epilogue: str | None = None,
+                           carry_out: bool = False) -> Metrics:
     """Fused stochastic-%K reversion sweep: ``(N, T)`` panels x ``(P,)``.
 
     ``window``/``band`` are flat per-combo arrays (:func:`product_grid`
@@ -2120,6 +2263,7 @@ def fused_stochastic_sweep(close, high, low, window, band, *, t_real=None,
     CPU interpret mode; the usual MXU knife-edge caveat on TPU. The second
     fused kernel consuming the high/low columns (after the HL-Donchian).
     """
+    _check_carry_out_args(carry_out, t_real)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     close = jnp.asarray(close, jnp.float32)
@@ -2133,15 +2277,21 @@ def fused_stochastic_sweep(close, high, low, window, band, *, t_real=None,
     # the k slot (padded lanes get band = +inf and never enter).
     windows, onehot_w, band_lanes, warm = _boll_grid_setup(
         window.astype(np.float32).tobytes(), band.tobytes())
-    return _fused_stoch_call(close, high, low, onehot_w, band_lanes, warm,
-                             _t_real_col(t_real, close),
-                             windows=windows, T_pad=_round_up(T, 128),
-                             W_pad=onehot_w.shape[0],
-                             P_real=window.shape[0],
-                             T_real=T if t_real is None else None,
-                             cost=float(cost), ppy=int(periods_per_year),
-                             interpret=bool(interpret),
-                             epilogue=_resolve_epilogue(epilogue))
+    m = _fused_stoch_call(close, high, low, onehot_w, band_lanes, warm,
+                          _t_real_col(t_real, close),
+                          windows=windows, T_pad=_round_up(T, 128),
+                          W_pad=onehot_w.shape[0],
+                          P_real=window.shape[0],
+                          T_real=T if t_real is None else None,
+                          cost=float(cost), ppy=int(periods_per_year),
+                          interpret=bool(interpret),
+                          epilogue=_resolve_epilogue(epilogue))
+    if not carry_out:
+        return m
+    return _carry_out_tail(
+        m, "stochastic", {"close": close, "high": high, "low": low},
+        {"window": window, "band": band}, t_real=t_real, cost=cost,
+        ppy=periods_per_year, epilogue=epilogue)
 
 
 @functools.partial(
@@ -2193,7 +2343,8 @@ def _fused_keltner_call(close, high, low, onehot_w, k_lanes, warm, t_real,
 def fused_keltner_sweep(close, high, low, window, k, *, t_real=None,
                         cost: float = 0.0, periods_per_year: int = 252,
                         interpret: bool | None = None,
-                        epilogue: str | None = None) -> Metrics:
+                        epilogue: str | None = None,
+                        carry_out: bool = False) -> Metrics:
     """Fused Keltner-channel reversion sweep: ``(N, T)`` panels x ``(P,)``.
 
     ``window``/``k`` are flat per-combo arrays (:func:`product_grid`
@@ -2204,6 +2355,7 @@ def fused_keltner_sweep(close, high, low, window, k, *, t_real=None,
     crossings can resolve differently; quantified by ``bench.py
     --verify``).
     """
+    _check_carry_out_args(carry_out, t_real)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     close = jnp.asarray(close, jnp.float32)
@@ -2215,15 +2367,21 @@ def fused_keltner_sweep(close, high, low, window, k, *, t_real=None,
 
     windows, onehot_w, k_lanes, warm = _boll_grid_setup(
         window.astype(np.float32).tobytes(), k.tobytes())
-    return _fused_keltner_call(close, high, low, onehot_w, k_lanes, warm,
-                               _t_real_col(t_real, close),
-                               windows=windows, T_pad=_round_up(T, 128),
-                               W_pad=onehot_w.shape[0],
-                               P_real=window.shape[0],
-                               T_real=T if t_real is None else None,
-                               cost=float(cost), ppy=int(periods_per_year),
-                               interpret=bool(interpret),
-                               epilogue=_resolve_epilogue(epilogue))
+    m = _fused_keltner_call(close, high, low, onehot_w, k_lanes, warm,
+                            _t_real_col(t_real, close),
+                            windows=windows, T_pad=_round_up(T, 128),
+                            W_pad=onehot_w.shape[0],
+                            P_real=window.shape[0],
+                            T_real=T if t_real is None else None,
+                            cost=float(cost), ppy=int(periods_per_year),
+                            interpret=bool(interpret),
+                            epilogue=_resolve_epilogue(epilogue))
+    if not carry_out:
+        return m
+    return _carry_out_tail(
+        m, "keltner", {"close": close, "high": high, "low": low},
+        {"window": window, "k": k}, t_real=t_real, cost=cost,
+        ppy=periods_per_year, epilogue=epilogue)
 
 
 @functools.lru_cache(maxsize=8)
@@ -2288,13 +2446,15 @@ def _fused_rsi_call(close, onehot_p, band_lanes, warm, t_real, *,
 def fused_rsi_sweep(close, period, band, *, t_real=None, cost: float = 0.0,
                     periods_per_year: int = 252,
                     interpret: bool | None = None,
-                    epilogue: str | None = None) -> Metrics:
+                    epilogue: str | None = None,
+                    carry_out: bool = False) -> Metrics:
     """Fused RSI mean-reversion sweep: ``(N, T)`` closes x ``(P,)`` lanes.
 
     ``period``/``band`` are flat per-combo arrays (:func:`product_grid`
     order); periods must be integral bar counts. Matches
     ``run_sweep(..., "rsi")`` (``models.rsi``) to f32 tolerance.
     """
+    _check_carry_out_args(carry_out, t_real)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     close = jnp.asarray(close, jnp.float32)
@@ -2303,14 +2463,20 @@ def fused_rsi_sweep(close, period, band, *, t_real=None, cost: float = 0.0,
     T = close.shape[1]
     windows, onehot_p, band_lanes, warm = _rsi_grid_setup(
         period.astype(np.float32).tobytes(), band.tobytes())
-    return _fused_rsi_call(close, onehot_p, band_lanes, warm,
-                           _t_real_col(t_real, close),
-                           windows=windows, T_pad=_round_up(T, 128),
-                           W_pad=onehot_p.shape[0], P_real=period.shape[0],
-                           T_real=T if t_real is None else None,
-                           cost=float(cost), ppy=int(periods_per_year),
-                           interpret=bool(interpret),
-                           epilogue=_resolve_epilogue(epilogue))
+    m = _fused_rsi_call(close, onehot_p, band_lanes, warm,
+                        _t_real_col(t_real, close),
+                        windows=windows, T_pad=_round_up(T, 128),
+                        W_pad=onehot_p.shape[0], P_real=period.shape[0],
+                        T_real=T if t_real is None else None,
+                        cost=float(cost), ppy=int(periods_per_year),
+                        interpret=bool(interpret),
+                        epilogue=_resolve_epilogue(epilogue))
+    if not carry_out:
+        return m
+    return _carry_out_tail(m, "rsi", {"close": close},
+                           {"period": period, "band": band}, t_real=t_real,
+                           cost=cost, ppy=periods_per_year,
+                           epilogue=epilogue)
 
 
 @functools.lru_cache(maxsize=4)
@@ -2445,7 +2611,8 @@ def _fused_macd_call(close, onehot_d, a_sig, warm, t_real, *,
 def fused_macd_sweep(close, fast, slow, signal, *, t_real=None,
                      cost: float = 0.0, periods_per_year: int = 252,
                      interpret: bool | None = None,
-                     epilogue: str | None = None) -> Metrics:
+                     epilogue: str | None = None,
+                     carry_out: bool = False) -> Metrics:
     """Fused MACD signal-line crossover sweep: ``(N, T)`` x ``(P,)`` lanes.
 
     ``fast``/``slow``/``signal`` are flat per-combo span arrays
@@ -2456,6 +2623,7 @@ def fused_macd_sweep(close, fast, slow, signal, *, t_real=None,
     / ``_ema_ladder`` here), so they are rounding twins; the only residual
     divergence class is the MXU selection matmul for the macd line.
     """
+    _check_carry_out_args(carry_out, t_real)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     close = jnp.asarray(close, jnp.float32)
@@ -2467,14 +2635,20 @@ def fused_macd_sweep(close, fast, slow, signal, *, t_real=None,
         fast.astype(np.float32).tobytes(),
         slow.astype(np.float32).tobytes(),
         signal.astype(np.float32).tobytes())
-    return _fused_macd_call(close, onehot_d, a_sig, warm,
-                            _t_real_col(t_real, close),
-                            spans=spans, T_pad=_round_up(T, 128),
-                            W_pad=onehot_d.shape[0], P_real=fast.shape[0],
-                            T_real=T if t_real is None else None,
-                            cost=float(cost), ppy=int(periods_per_year),
-                            interpret=bool(interpret),
-                            epilogue=_resolve_epilogue(epilogue))
+    m = _fused_macd_call(close, onehot_d, a_sig, warm,
+                         _t_real_col(t_real, close),
+                         spans=spans, T_pad=_round_up(T, 128),
+                         W_pad=onehot_d.shape[0], P_real=fast.shape[0],
+                         T_real=T if t_real is None else None,
+                         cost=float(cost), ppy=int(periods_per_year),
+                         interpret=bool(interpret),
+                         epilogue=_resolve_epilogue(epilogue))
+    if not carry_out:
+        return m
+    return _carry_out_tail(
+        m, "macd", {"close": close},
+        {"fast": fast, "slow": slow, "signal": signal}, t_real=t_real,
+        cost=cost, ppy=periods_per_year, epilogue=epilogue)
 
 
 @functools.lru_cache(maxsize=4)
@@ -2636,7 +2810,8 @@ def fused_obv_sweep(close, volume, window, *, t_real=None, cost: float = 0.0,
                     periods_per_year: int = 252,
                     interpret: bool | None = None,
                     table: str | None = None,
-                    epilogue: str | None = None) -> Metrics:
+                    epilogue: str | None = None,
+                    carry_out: bool = False) -> Metrics:
     """Fused OBV-trend sweep: ``(N, T)`` closes+volumes x ``(P,)`` windows.
 
     ``window`` is a flat per-combo window array (:func:`product_grid`
@@ -2648,6 +2823,7 @@ def fused_obv_sweep(close, volume, window, *, t_real=None, cost: float = 0.0,
     table substrate (env ``DBX_OBV_TABLE``; the inline variant carries
     the SMA kernel's division-lowering caveat, `_obv_kernel_inline`).
     """
+    _check_carry_out_args(carry_out, t_real)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     close = jnp.asarray(close, jnp.float32)
@@ -2656,16 +2832,22 @@ def fused_obv_sweep(close, volume, window, *, t_real=None, cost: float = 0.0,
     T = close.shape[1]
     windows, onehot_w, warm = _obv_grid_setup(
         window.astype(np.float32).tobytes())
-    return _fused_obv_call(close, volume, onehot_w, warm,
-                           _t_real_col(t_real, close),
-                           windows=windows, T_pad=_round_up(T, 128),
-                           W_pad=onehot_w.shape[0], P_real=window.shape[0],
-                           T_real=T if t_real is None else None,
-                           cost=float(cost), ppy=int(periods_per_year),
-                           interpret=bool(interpret),
-                           table=_family_table("obv", table),
-                           lanes_env=resolve_lanes_cap(),
-                           epilogue=_resolve_epilogue(epilogue))
+    m = _fused_obv_call(close, volume, onehot_w, warm,
+                        _t_real_col(t_real, close),
+                        windows=windows, T_pad=_round_up(T, 128),
+                        W_pad=onehot_w.shape[0], P_real=window.shape[0],
+                        T_real=T if t_real is None else None,
+                        cost=float(cost), ppy=int(periods_per_year),
+                        interpret=bool(interpret),
+                        table=_family_table("obv", table),
+                        lanes_env=resolve_lanes_cap(),
+                        epilogue=_resolve_epilogue(epilogue))
+    if not carry_out:
+        return m
+    return _carry_out_tail(m, "obv_trend",
+                           {"close": close, "volume": volume},
+                           {"window": window}, t_real=t_real, cost=cost,
+                           ppy=periods_per_year, epilogue=epilogue)
 
 
 @functools.lru_cache(maxsize=4)
@@ -2775,7 +2957,8 @@ def _fused_trix_call(close, onehot, a_sig, warm, t_real, *,
 def fused_trix_sweep(close, span, signal, *, t_real=None, cost: float = 0.0,
                      periods_per_year: int = 252,
                      interpret: bool | None = None,
-                     epilogue: str | None = None) -> Metrics:
+                     epilogue: str | None = None,
+                     carry_out: bool = False) -> Metrics:
     """Fused TRIX signal-line crossover sweep: ``(N, T)`` x ``(P,)`` lanes.
 
     ``span``/``signal`` are flat per-combo span arrays (:func:`product_grid`
@@ -2786,6 +2969,7 @@ def fused_trix_sweep(close, span, signal, *, t_real=None, cost: float = 0.0,
     cancels the price level, so the only residual divergence class is the
     MXU selection matmul for the triple-smoothed close.
     """
+    _check_carry_out_args(carry_out, t_real)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     close = jnp.asarray(close, jnp.float32)
@@ -2795,14 +2979,20 @@ def fused_trix_sweep(close, span, signal, *, t_real=None, cost: float = 0.0,
     spans, onehot, a_sig, warm = _trix_grid_setup(
         span.astype(np.float32).tobytes(),
         signal.astype(np.float32).tobytes())
-    return _fused_trix_call(close, onehot, a_sig, warm,
-                            _t_real_col(t_real, close),
-                            spans=spans, T_pad=_round_up(T, 128),
-                            W_pad=onehot.shape[0], P_real=span.shape[0],
-                            T_real=T if t_real is None else None,
-                            cost=float(cost), ppy=int(periods_per_year),
-                            interpret=bool(interpret),
-                            epilogue=_resolve_epilogue(epilogue))
+    m = _fused_trix_call(close, onehot, a_sig, warm,
+                         _t_real_col(t_real, close),
+                         spans=spans, T_pad=_round_up(T, 128),
+                         W_pad=onehot.shape[0], P_real=span.shape[0],
+                         T_real=T if t_real is None else None,
+                         cost=float(cost), ppy=int(periods_per_year),
+                         interpret=bool(interpret),
+                         epilogue=_resolve_epilogue(epilogue))
+    if not carry_out:
+        return m
+    return _carry_out_tail(m, "trix", {"close": close},
+                           {"span": span, "signal": signal}, t_real=t_real,
+                           cost=cost, ppy=periods_per_year,
+                           epilogue=epilogue)
 
 
 @functools.lru_cache(maxsize=4)
@@ -2883,7 +3073,8 @@ def _fused_vwap_call(close, volume, onehot_w, k_lanes, warm, t_real, *,
 def fused_vwap_sweep(close, volume, window, k, *, t_real=None,
                      cost: float = 0.0, periods_per_year: int = 252,
                      interpret: bool | None = None,
-                     epilogue: str | None = None) -> Metrics:
+                     epilogue: str | None = None,
+                     carry_out: bool = False) -> Metrics:
     """Fused VWAP-deviation reversion sweep: ``(N, T)`` panels x ``(P,)``.
 
     ``window``/``k`` are flat per-combo arrays (:func:`product_grid` order);
@@ -2893,6 +3084,7 @@ def fused_vwap_sweep(close, volume, window, k, *, t_real=None,
     TPU the MXU z-selection matmul shares the knife-edge caveat of the other
     band-machine kernels for |z - k| ~ 1e-7 relative.
     """
+    _check_carry_out_args(carry_out, t_real)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     close = jnp.asarray(close, jnp.float32)
@@ -2904,14 +3096,21 @@ def fused_vwap_sweep(close, volume, window, k, *, t_real=None,
 
     windows, onehot_w, k_lanes, warm = _vwap_grid_setup(
         window.astype(np.float32).tobytes(), k.tobytes())
-    return _fused_vwap_call(close, volume, onehot_w, k_lanes, warm,
-                            _t_real_col(t_real, close),
-                            windows=windows,
-                            T_pad=_round_up(T, 128), W_pad=onehot_w.shape[0],
-                            P_real=P, T_real=T if t_real is None else None,
-                            cost=float(cost), ppy=int(periods_per_year),
-                            interpret=bool(interpret),
-                            epilogue=_resolve_epilogue(epilogue))
+    m = _fused_vwap_call(close, volume, onehot_w, k_lanes, warm,
+                         _t_real_col(t_real, close),
+                         windows=windows,
+                         T_pad=_round_up(T, 128), W_pad=onehot_w.shape[0],
+                         P_real=P, T_real=T if t_real is None else None,
+                         cost=float(cost), ppy=int(periods_per_year),
+                         interpret=bool(interpret),
+                         epilogue=_resolve_epilogue(epilogue))
+    if not carry_out:
+        return m
+    return _carry_out_tail(m, "vwap_reversion",
+                           {"close": close, "volume": volume},
+                           {"window": window, "k": k}, t_real=t_real,
+                           cost=cost, ppy=periods_per_year,
+                           epilogue=epilogue)
 
 
 @functools.lru_cache(maxsize=4)

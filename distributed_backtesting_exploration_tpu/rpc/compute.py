@@ -387,6 +387,19 @@ class JaxSweepBackend:
         # evicted-between-poll-and-decode race).
         self.panel_cache = PanelCache(registry=reg)
         self.payload_fetcher: Callable[[str], bytes] | None = None
+        # Streaming appends (JobSpec.append_*): digest-keyed carry
+        # checkpoints so an appended ΔT-bar slice advances a finished
+        # sweep in O(ΔT) instead of repricing T bars (streaming/).
+        from ..streaming import CarryStore
+
+        self.carry_store = CarryStore(registry=reg)
+        self._c_append = {
+            outcome: reg.counter(
+                "dbx_worker_append_total",
+                help="streaming append jobs served, by outcome "
+                     "(carry_hit=O(ΔT) advance, full_reprice=checkpoint "
+                     "miss fallback)", outcome=outcome)
+            for outcome in ("carry_hit", "full_reprice")}
 
     def _evict_mesh_fn(self) -> None:
         """FIFO-evict the oldest compiled mesh fn AND its shape-signature
@@ -954,6 +967,109 @@ class JaxSweepBackend:
             self.panel_cache.put_series(digest, s)
         return s, False
 
+    def _resolve_append_series(self, job):
+        """Extended panel for an append job: digest cache -> splice (the
+        cached BASE panel + ``JobSpec.append_delta`` — the delta-only
+        dispatch fast path, no full panel on the wire) -> inline bytes ->
+        FetchPayload. Returns ``(series, cache_hit)``."""
+        digest = job.panel_digest
+        if (digest and not job.ohlcv and job.append_delta
+                and job.append_parent_digest
+                and not self.panel_cache.contains_series(digest)):
+            base = self.panel_cache.get_series(job.append_parent_digest)
+            if base is not None and base.n_bars == int(job.append_base_len):
+                delta = data_mod.from_wire_bytes(job.append_delta)
+                s = data_mod.OHLCV(*(
+                    np.concatenate([np.asarray(b), np.asarray(d)])
+                    for b, d in zip(base, delta)))
+                self.panel_cache.put_series(digest, s)
+                return s, True
+        return self._resolve_series(job)
+
+    def _submit_append_job(self, job):
+        """One streaming append job: advance the base panel's carry
+        checkpoint by the appended slice (O(ΔT)); a missing/stale
+        checkpoint falls back to a full scan-form rebuild over the
+        extended panel (degraded, never a failed job). Either way the
+        NEW checkpoint is stored under the extended panel's digest, so
+        the next append in the chain hits."""
+        from ..parallel import sweep as sweep_mod
+        from ..streaming import recurrent
+
+        t0 = time.perf_counter()
+        t0_wall = time.time()
+        trace_pairs = obs.job_trace_pairs([job])
+        if (not recurrent.supports_strategy(job.strategy)
+                or job.strategy == "pairs"):
+            # Validated-bad, the malformed-pairs discipline: the AppendBars
+            # wire carries ONE panel, so two-legged strategies (and any
+            # family without a streaming spec) complete loudly empty
+            # instead of requeue-looping through leases.
+            log.error("append job %s: strategy %r is not streamable over "
+                      "AppendBars; completing with empty metrics", job.id,
+                      job.strategy)
+            return ([job], None, t0, 0, None)
+        axes = wire.grid_from_proto(job.grid)
+        grid = {k: np.asarray(v)
+                for k, v in sweep_mod.product_grid(**axes).items()}
+        cost = float(job.cost)
+        ppy = int(job.periods_per_year or 252)
+        skey = recurrent.stream_key(job.strategy, grid, cost, ppy)
+        series, _ = self._resolve_append_series(job)
+        fields = {
+            f: np.asarray(getattr(series, f), np.float32)[None, :]
+            for f in recurrent.stream_fields(job.strategy)}
+        base_len = int(job.append_base_len)
+        hit = False
+        try:
+            carry = (self.carry_store.get((job.panel_digest, skey))
+                     if job.panel_digest else None)
+            if carry is not None and carry.n_bars == series.n_bars:
+                # Retried delivery of an already-advanced append: serve
+                # the stored checkpoint, don't advance twice.
+                hit = True
+            else:
+                carry = None
+                if 0 < base_len < series.n_bars:
+                    base_carry = self.carry_store.get(
+                        (job.append_parent_digest, skey))
+                    if (base_carry is not None
+                            and base_carry.n_bars == base_len):
+                        carry = recurrent.append_step(
+                            base_carry,
+                            {f: v[:, base_len:]
+                             for f, v in fields.items()})
+                        hit = True
+                if carry is None:
+                    carry = recurrent.build_carry(
+                        job.strategy, fields, grid, cost=cost,
+                        periods_per_year=ppy)
+        except (ValueError, KeyError) as e:
+            # Validated-bad (a grid the family cannot price, an empty
+            # axis, ...): complete loudly empty — requeue-looping through
+            # leases would never fix a malformed spec.
+            log.error("append job %s: %s; completing with empty metrics",
+                      job.id, e)
+            return ([job], None, t0, 0, None)
+        if job.panel_digest:
+            self.carry_store.put((job.panel_digest, skey), carry)
+        m = recurrent.finalize(carry)
+        self._c_append["carry_hit" if hit else "full_reprice"].inc()
+        # The append span carries the hit flag: obs.timeline charges hit
+        # windows to the `carry_hit` pseudo-stage (the streaming twin of
+        # panel_cache_hit), full reprices stay execute.
+        obs.emit_span("worker.append", t0_wall,
+                      time.perf_counter() - t0, pairs=trace_pairs,
+                      job=job.id, carry_hit=hit, bars=series.n_bars,
+                      delta_bars=series.n_bars - base_len)
+        # Histogram only (no group=): an execute envelope span over the
+        # SAME interval would tie worker.append at equal priority in
+        # timeline attribution, and the tie-break (later t0) is clock
+        # jitter — a served O(ΔT) append must never read as phantom
+        # execute work.
+        self._observe_submit(job.strategy, "append", t0)
+        return ([job], _start_result_copy(m), t0, 1, None)
+
     def _decode_group(self, group):
         """Cache-aware group decode (leg 1 — the pairs path drives
         :meth:`_resolve_series` per leg itself) under the traced
@@ -1039,6 +1155,12 @@ class JaxSweepBackend:
         from ..parallel import sweep as sweep_mod
 
         jobs = list(jobs)
+        # Streaming append jobs peel off first: each advances (or
+        # rebuilds) its own carry checkpoint — O(ΔT) work per job, no
+        # batching needed or wanted (the carry is per-panel state).
+        stream_pending = [self._submit_append_job(j) for j in jobs
+                          if j.append_parent_digest]
+        jobs = [j for j in jobs if not j.append_parent_digest]
         # Group stackable jobs: same strategy, grid, cost (and walk-forward
         # windowing). Mixed history lengths stack fine — both the fused
         # kernels (per-ticker t_real) and the generic path (pad_and_stack +
@@ -1063,7 +1185,7 @@ class JaxSweepBackend:
                    job.top_k, job.rank_metric, job.best_returns)
             groups.setdefault(key, []).append(job)
 
-        pending = []
+        pending = stream_pending
         for group in groups.values():
             t0 = time.perf_counter()
             if not self._topk_request_ok(group):

@@ -101,6 +101,19 @@ class JobRecord:
     # payload source.
     panel_digest: str = ""
     panel_digest2: str = ""
+    # Streaming append jobs (proto AppendBars / JobSpec.append_*): the
+    # base panel's content address, its bar count, and the appended
+    # ΔT-bar DBX1 slice. The record carries NO full payload — the
+    # extended panel materializes through the delta chain
+    # (``JobQueue._splice_from_chain``), so enqueue records and journal
+    # growth stay O(ΔT) per append.
+    append_parent: str = ""
+    append_base_len: int = 0
+    delta: bytes | None = None
+    # Routing-affinity bookkeeping (NOT journaled): how many times take()
+    # deferred this job hoping the base-holding worker polls next. One
+    # deferral max — then any worker serves it (full reprice fallback).
+    affinity_skips: int = 0
 
     @property
     def combos(self) -> int:
@@ -135,6 +148,12 @@ class JobRecord:
             rec["pdig"] = self.panel_digest
         if self.panel_digest2:
             rec["pdig2"] = self.panel_digest2
+        if self.append_parent:
+            # The delta payload itself is journaled once as the chain's
+            # `delta` event (keyed by pdig); the enqueue record carries
+            # only the O(1) linkage.
+            rec["apdig"] = self.append_parent
+            rec["abase"] = self.append_base_len
         return rec
 
     @staticmethod
@@ -157,7 +176,9 @@ class JobRecord:
             best_returns=bool((rec.get("ret") or [False])[0]),
             trace_id=str(rec.get("trace", "")),
             panel_digest=str(rec.get("pdig", "")),
-            panel_digest2=str(rec.get("pdig2", "")))
+            panel_digest2=str(rec.get("pdig2", "")),
+            append_parent=str(rec.get("apdig", "")),
+            append_base_len=int(rec.get("abase", 0)))
 
 
 @dataclasses.dataclass
@@ -311,6 +332,18 @@ class _PyQueueState:
         return live_pending == 0 and not self._leases
 
 
+# Strategies AppendBars accepts: the streaming families that fit a
+# one-panel wire (``streaming.recurrent._STREAM_FAMILIES`` minus pairs,
+# whose second leg cannot ride an AppendRequest). A LITERAL set — the
+# dispatcher process must not import the jax-backed streaming package
+# just to validate a name; tests/test_streaming.py pins it against the
+# real registry so the two cannot drift.
+STREAMABLE_STRATEGIES = frozenset({
+    "sma_crossover", "momentum", "bollinger", "bollinger_touch",
+    "obv_trend", "stochastic", "vwap_reversion", "keltner", "rsi",
+    "macd", "trix", "donchian", "donchian_hl"})
+
+
 class JobQueue:
     """Thread-safe FIFO of JobRecords with leases and a durable journal.
 
@@ -358,6 +391,12 @@ class JobQueue:
         # re-materializes from that record's source.
         self.panel_store = panel_store_mod.PanelStore()
         self._digest_jobs: dict[str, str] = {}
+        # Streaming append chain: extended-panel digest -> (parent digest,
+        # delta bytes, base bar count). Populated by append_bars() and by
+        # journal replay (`delta` events); an evicted extended panel
+        # re-materializes by walking parents back to a payload source and
+        # re-splicing (deterministic, so digests stay stable).
+        self._delta_chain: dict[str, tuple[str, bytes, int]] = {}
         # Python-side mirror of completed ids (the native core keeps only
         # counts): maintained on every "new" completion + restore, read by
         # observers (chaos tests, operators) via completed_ids().
@@ -378,6 +417,12 @@ class JobQueue:
         # through that window or an observer could tear the dispatcher down
         # with a job mid-dispatch.
         self._in_take = 0
+        # Affinity-deferred append jobs, held OUT of the FIFO so the next
+        # take() serves them FIRST (front of line — a tail re-push would
+        # park a latency-critical live update behind the whole batch
+        # backlog). Journaled-pending either way, so a crash loses
+        # nothing.
+        self._affinity_held: list[str] = []
 
     # Native substrate cap (cpp/dbx_core.h DBX_JOBQ_MAX_ID); enforced at
     # intake on BOTH substrates so behavior cannot diverge at the edge.
@@ -457,6 +502,14 @@ class JobQueue:
         command line after a crash must not duplicate completed jobs).
         """
         state = Journal.replay(journal_path)
+        with self._lock:
+            # Chain BEFORE jobs: a restored append job's first take
+            # materializes through it.
+            for ndig, rec in state.deltas.items():
+                self._delta_chain[ndig] = (
+                    str(rec.get("pdig", "")),
+                    base64.b64decode(rec.get("delta_b64", "")),
+                    int(rec.get("base_len", 0)))
         n = 0
         for jid in state.pending:
             self.enqueue(JobRecord.from_journal(state.jobs[jid]),
@@ -480,6 +533,17 @@ class JobQueue:
                         self._digest_jobs.setdefault(r.panel_digest, jid)
                     if r.panel_digest2:
                         self._digest_jobs.setdefault(r.panel_digest2, jid)
+        with self._lock:
+            # Rehydrate restored append jobs' delta bytes from the chain:
+            # without them a post-restart dispatch would ship empty
+            # ohlcv AND empty append_delta to a base-holding worker,
+            # forcing a full-panel FetchPayload — undoing the O(ΔT) wire
+            # saving the delta-only leg exists for.
+            for rec in self._records.values():
+                if rec.append_parent and rec.delta is None:
+                    link = self._delta_chain.get(rec.panel_digest)
+                    if link is not None:
+                        rec.delta = link[1]
         self.known_paths |= {rec["path"] for rec in state.jobs.values()
                              if rec.get("path")}
         self.known_pairings.update(
@@ -490,7 +554,8 @@ class JobQueue:
 
     # -- dispatch ----------------------------------------------------------
 
-    def take(self, n: int, worker_id: str) -> list[tuple[JobRecord, bytes]]:
+    def take(self, n: int, worker_id: str,
+             admit=None) -> list[tuple[JobRecord, bytes]]:
         """Pop up to ``n`` jobs, lease them to ``worker_id``, return payloads.
 
         Batched against the state machine: ONE ``take_begin_n`` crossing
@@ -501,11 +566,48 @@ class JobQueue:
         batch-wide). Per-job crossings made the native substrate slower
         than the dict fallback (DESIGN.md's 42k-vs-85k row); one crossing
         per RPC is the fix.
+
+        ``admit`` is the streaming-affinity hook (``rec -> bool``,
+        consulted only for append jobs): a False verdict defers the job —
+        held OUT of the FIFO (front of line: the NEXT take() call, from
+        any worker, sees held jobs before the FIFO) — so a worker
+        holding the job's base panel gets first claim at the O(ΔT) path
+        without the job losing its place behind a batch backlog. The
+        callback bounds its own deferrals (``JobRecord.affinity_skips``);
+        a held job is served to ANYONE on the next attempt, so affinity
+        can delay a job by at most one poll round, never starve it.
         """
         out: list[tuple[JobRecord, bytes]] = []
+        deferred: list[str] = []
+        try:
+            return self._take_inner(n, worker_id, admit, out, deferred)
+        finally:
+            if deferred:
+                with self._lock:
+                    # Held OUT of the FIFO, counted as in-take: `drained`
+                    # must not flicker True with a live job in neither
+                    # pending nor leased, and the next take() drains the
+                    # held list before popping the FIFO.
+                    self._affinity_held.extend(deferred)
+
+    def _take_inner(self, n, worker_id, admit, out, deferred):
+        first = True
         while len(out) < n:
             with self._lock:
-                jids = self._state.take_begin_n(n - len(out))
+                jids = []
+                if first:
+                    # Previously deferred append jobs go first — they
+                    # were at (or near) the FIFO head when deferred.
+                    first = False
+                    k = min(len(self._affinity_held), n - len(out))
+                    if k:
+                        jids = self._affinity_held[:k]
+                        self._affinity_held = self._affinity_held[k:]
+                        # Already counted in _in_take while held; the
+                        # per-iteration accounting below re-counts every
+                        # id in `jids`, so release the held count here.
+                        self._in_take -= k
+                jids += self._state.take_begin_n(n - len(out) - len(jids))
                 if not jids:
                     break
                 # A popped id with no record is a state/record desync
@@ -517,7 +619,22 @@ class JobQueue:
                     self._state.fail(j)
                 jids = [j for j in jids if j not in desynced]
                 recs = [self._records[j] for j in jids]
-                self._in_take += len(jids)
+                n_deferred0 = len(deferred)
+                if admit is not None:
+                    kept_j, kept_r = [], []
+                    for j, r in zip(jids, recs):
+                        # ONE admit call per rec: the callback counts its
+                        # own deferrals on the record.
+                        if r.append_parent and not admit(r):
+                            deferred.append(j)
+                        else:
+                            kept_j.append(j)
+                            kept_r.append(r)
+                    jids, recs = kept_j, kept_r
+                # Deferred ids count as in-take for as long as they sit
+                # in _affinity_held (neither pending nor leased); the
+                # count releases when a later take() re-serves them.
+                self._in_take += len(jids) + len(deferred) - n_deferred0
             good: list[tuple[str, JobRecord, bytes]] = []
             failed: list[tuple[str, str, Exception]] = []  # id, path, err
             resolved: set[str] = set()   # leased, failed, or completed
@@ -634,17 +751,74 @@ class JobQueue:
             if blob is not None:
                 return blob, digest
         if path is None:
+            if digest:
+                # Streaming append jobs carry no payload source of their
+                # own: the extended panel rebuilds from the delta chain.
+                blob = self._splice_from_chain(digest)
+                if blob is not None:
+                    return blob, digest
             raise ValueError("job has neither payload nor path")
         blob = _read_payload(path)
         return blob, self.panel_store.put(blob)
 
+    def _splice_from_chain(self, digest: str) -> bytes | None:
+        """Rebuild an extended panel from its journaled append chain:
+        walk parents down to the nearest servable payload source, then
+        splice every delta back up, storing each level — so the NEXT
+        lookup anywhere on the chain is a store hit. Iterative with a
+        visited-set guard (content digests cannot cycle by construction,
+        but a corrupted journal must degrade, not hang): an arbitrarily
+        long live stream stays servable after a restart. None when the
+        chain is broken (no ancestor has a payload source) — the caller
+        degrades exactly like an evicted ordinary digest."""
+        chain: list[tuple[str, bytes]] = []
+        seen: set[str] = set()
+        d = digest
+        base = None
+        while True:
+            if d in seen:
+                log.error("append chain for %s cycles at %s; unservable",
+                          digest[:16], d[:16])
+                return None
+            seen.add(d)
+            with self._lock:
+                link = self._delta_chain.get(d)
+            if link is None:
+                return None          # broken before any payload source
+            parent, delta, _base_len = link
+            chain.append((d, delta))
+            base = self._payload_from_source(parent)
+            if base is not None:
+                break
+            d = parent
+        for d, delta in reversed(chain):
+            try:
+                base = data_mod.splice_wire_bytes(base, delta)
+            except ValueError as e:
+                log.error("append chain for %s does not splice (%s); "
+                          "unservable", digest[:16], e)
+                return None
+            self.panel_store.put(base, d)
+        return base
+
     def payload_for_digest(self, digest: str) -> bytes | None:
         """Serve a FetchPayload request: blob store first, then lazy
-        re-materialization from the indexed record's source (inline bytes
-        or file — the restart path: journaled digests arrive before any
-        blob does). None when the digest is not servable at all (store
-        evicted AND source gone or changed) — the dispatcher then forgets
-        it was delivered so the next dispatch ships full bytes."""
+        re-materialization from the indexed record's source (inline bytes,
+        file, or the streaming delta chain — the restart path: journaled
+        digests arrive before any blob does). None when the digest is not
+        servable at all (store evicted AND source gone or changed) — the
+        dispatcher then forgets it was delivered so the next dispatch
+        ships full bytes."""
+        blob = self._payload_from_source(digest)
+        if blob is not None:
+            return blob
+        # Append jobs have no payload source of their own — the extended
+        # panel rebuilds from the journaled delta chain.
+        return self._splice_from_chain(digest)
+
+    def _payload_from_source(self, digest: str) -> bytes | None:
+        """Store + record-source half of :meth:`payload_for_digest` (NO
+        chain fallback — the chain walk calls this per ancestor)."""
         if not digest:
             return None
         blob = self.panel_store.get(digest)
@@ -673,6 +847,68 @@ class JobQueue:
                 self.panel_store.put(blob, digest)
                 return blob
         return None
+
+    def append_bars(self, parent_digest: str, base_len: int, delta: bytes,
+                    *, strategy: str, grid, cost: float = 0.0,
+                    periods_per_year: int = 252
+                    ) -> tuple[JobRecord | None, str, str, int]:
+        """Streaming live-bar ingest (the AppendBars RPC's queue half):
+        splice ``delta`` onto the stored base panel, journal the chain
+        link, and enqueue one repricing job for the extended panel.
+
+        Returns ``(record, outcome, new_digest, new_len)`` — record None
+        with a reject outcome (``unsupported_strategy`` /
+        ``base_missing`` / ``bad_delta`` / ``base_len_mismatch``) when
+        nothing was enqueued. Journal order: the ``delta`` event lands
+        BEFORE the job's enqueue record, so a restored append job always
+        finds its chain; a crash in between merely leaves a harmless
+        orphan link.
+        """
+        if strategy not in STREAMABLE_STRATEGIES:
+            # Reject synchronously — enqueueing would burn a dispatch
+            # round trip only for the worker to complete it loudly empty
+            # (pairs cannot stream over a one-panel wire; unknown
+            # families have no carry).
+            return None, "unsupported_strategy", "", 0
+        base = self.payload_for_digest(parent_digest)
+        if base is None:
+            return None, "base_missing", "", 0
+        base_series = data_mod.from_wire_bytes(base)
+        if base_len and base_len != base_series.n_bars:
+            # Stale feed guard, checked BEFORE any splice work: the
+            # caller believes a different history length than the stored
+            # base — appending would silently misalign every later bar.
+            # Reject near-free; the caller re-syncs off the reply's
+            # digest/new_len.
+            return None, "base_len_mismatch", "", base_series.n_bars
+        try:
+            d_series = data_mod.from_wire_bytes(delta)
+            if d_series.n_bars < 1:
+                raise ValueError("empty delta slice")
+        except ValueError:
+            return None, "bad_delta", "", 0
+        # One decode each + one encode (splice_wire_bytes would re-decode
+        # both blobs — the live-serving hot path skips that).
+        blob = data_mod.to_wire_bytes(data_mod.OHLCV(*(
+            np.concatenate([np.asarray(b), np.asarray(d)])
+            for b, d in zip(base_series, d_series))))
+        ndig = self.panel_store.put(blob)
+        new_len = base_series.n_bars + d_series.n_bars
+        if self._journal.enabled:
+            self._journal.append(
+                "delta", ndig=ndig, pdig=parent_digest,
+                base_len=base_series.n_bars,
+                delta_b64=base64.b64encode(delta).decode("ascii"))
+        with self._lock:
+            self._delta_chain[ndig] = (parent_digest, delta,
+                                       base_series.n_bars)
+        rec = JobRecord(
+            id=str(uuid.uuid4()), strategy=strategy, grid=grid,
+            cost=float(cost), periods_per_year=int(periods_per_year),
+            panel_digest=ndig, append_parent=parent_digest,
+            append_base_len=base_series.n_bars, delta=delta)
+        self.enqueue(rec)
+        return rec, "extended", ndig, new_len
 
     def complete(self, jid: str, worker_id: str) -> str:
         """Record a completion (idempotent). Returns ``"new"`` for a first
@@ -966,7 +1202,8 @@ class Dispatcher(service.DispatcherServicer):
                                   help="dispatcher RPC handler wall",
                                   method=m)
             for m in ("RequestJobs", "SendStatus", "CompleteJob",
-                      "CompleteJobs", "GetStats", "FetchPayload")}
+                      "CompleteJobs", "GetStats", "FetchPayload",
+                      "AppendBars")}
         self._c_dispatched = self.obs.counter(
             "dbx_jobs_dispatched_total", help="jobs handed to workers")
         self._c_completions = {
@@ -1001,6 +1238,20 @@ class Dispatcher(service.DispatcherServicer):
                 help="FetchPayload requests served, by outcome",
                 outcome=outcome)
             for outcome in ("hit", "gone")}
+        # Streaming appends (AppendBars): accepted extensions vs the
+        # reject reasons, plus the delta-only dispatch leg (an append job
+        # shipped as ΔT bars because the polling worker holds the base).
+        self._c_appends = {
+            outcome: self.obs.counter(
+                "dbx_stream_appends_total",
+                help="AppendBars requests, by outcome",
+                outcome=outcome)
+            for outcome in ("extended", "base_missing", "bad_delta",
+                            "base_len_mismatch", "unsupported_strategy")}
+        self._c_payloads["delta"] = self.obs.counter(
+            "dbx_dispatch_payloads_total",
+            help="payload legs dispatched, by transport mode",
+            mode="delta")
         # Thread-local: concurrent GetStats calls on the gRPC pool must
         # each lend their OWN snapshot to the collector, not race on one
         # shared slot.
@@ -1101,6 +1352,56 @@ class Dispatcher(service.DispatcherServicer):
         self._c_payloads["full"].inc()
         return payload
 
+    def _append_leg(self, delivered: set | None, rec: JobRecord,
+                    payload: bytes) -> bytes:
+        """An append job's ``ohlcv`` leg: EMPTY (delta-only dispatch — the
+        worker splices ``JobSpec.append_delta`` onto its cached base) when
+        this worker generation holds the base or the extended panel
+        itself; the full extended bytes otherwise. Either way the
+        extended digest is marked delivered so follow-on appends chain
+        delta-only."""
+        if delivered is None:
+            self._c_payloads["full"].inc()
+            return payload
+        has_base = (rec.append_parent in delivered
+                    or rec.panel_digest in delivered)
+        if len(delivered) >= self.MAX_DELIVERED_DIGESTS:
+            delivered.clear()
+            has_base = False
+        delivered.add(rec.panel_digest)
+        if has_base:
+            self._c_payloads["delta"].inc()
+            self._c_bytes_saved.inc(max(len(payload)
+                                        - len(rec.delta or b""), 0))
+            return b""
+        self._c_payloads["full"].inc()
+        return payload
+
+    def _affinity_admit(self, worker_id: str, delivered: set | None):
+        """The take() affinity hook for this poll: defer an append job
+        (once) when ANOTHER live worker holds its base panel and this one
+        does not — the holder advances the carry in O(ΔT); everyone else
+        would full-reprice. Never starves: a job is deferred at most once
+        (affinity_skips), and only while some other worker actually holds
+        the base."""
+        def admit(rec: JobRecord) -> bool:
+            if rec.affinity_skips >= 1:
+                return True
+            if delivered is not None and (
+                    rec.append_parent in delivered
+                    or rec.panel_digest in delivered):
+                return True
+            with self._delivered_lock:
+                holder = any(
+                    rec.append_parent in digests
+                    for wid, digests in self._delivered.items()
+                    if wid != worker_id)
+            if not holder:
+                return True
+            rec.affinity_skips += 1
+            return False
+        return admit
+
     # -- RPC handlers ------------------------------------------------------
 
     @_timed_rpc("RequestJobs")
@@ -1128,7 +1429,9 @@ class Dispatcher(service.DispatcherServicer):
         per_chip = request.jobs_per_chip or self.default_jobs_per_chip
         n = max(request.chips, 1) * max(per_chip, 1)
         t_disp0 = time.time()
-        taken = self.queue.take(n, request.worker_id)
+        taken = self.queue.take(n, request.worker_id,
+                                admit=self._affinity_admit(
+                                    request.worker_id, delivered))
         if taken:
             self._c_dispatched.inc(len(taken))
         reply = pb.JobsReply()
@@ -1149,10 +1452,13 @@ class Dispatcher(service.DispatcherServicer):
                     trace_id=rec.trace_id, job=rec.id,
                     worker=request.worker_id)
             payload2 = rec.ohlcv2 or b""
+            leg1 = (self._append_leg(delivered, rec, payload)
+                    if rec.append_parent else
+                    self._payload_leg(delivered, rec.panel_digest,
+                                      payload))
             reply.jobs.append(pb.JobSpec(
                 id=rec.id, strategy=rec.strategy,
-                ohlcv=self._payload_leg(delivered, rec.panel_digest,
-                                        payload),
+                ohlcv=leg1,
                 grid=wire.grid_to_proto(rec.grid), cost=rec.cost,
                 periods_per_year=rec.periods_per_year,
                 ohlcv2=self._payload_leg(delivered, rec.panel_digest2,
@@ -1165,7 +1471,10 @@ class Dispatcher(service.DispatcherServicer):
                 panel_digest=rec.panel_digest,
                 panel_bytes_len=len(payload),
                 panel_digest2=rec.panel_digest2,
-                panel_bytes_len2=len(payload2)))
+                panel_bytes_len2=len(payload2),
+                append_parent_digest=rec.append_parent,
+                append_base_len=rec.append_base_len,
+                append_delta=rec.delta or b""))
         if taken:
             log.info("dispatched %d jobs to %s", len(taken), request.worker_id)
         return reply
@@ -1339,6 +1648,34 @@ class Dispatcher(service.DispatcherServicer):
             return pb.PayloadReply(digest=request.digest)
         self._c_fetches["hit"].inc()
         return pb.PayloadReply(digest=request.digest, payload=blob)
+
+    @_timed_rpc("AppendBars")
+    def AppendBars(self, request: pb.AppendRequest,
+                   context) -> pb.AppendReply:
+        """Streaming live-bar ingest: extend a content-addressed panel by
+        a ΔT-bar DBX1 slice and enqueue one repricing job on the extended
+        panel (see ``JobQueue.append_bars`` for the journal/chain
+        semantics). A rejected append is an explicit ok=false reply with
+        the reason — the caller re-syncs; nothing is enqueued and nothing
+        fails dispatcher-side."""
+        self.peers.touch(request.worker_id)
+        grid = wire.grid_from_proto(request.job.grid)
+        rec, outcome, ndig, new_len = self.queue.append_bars(
+            request.panel_digest, int(request.base_len), request.delta,
+            strategy=request.job.strategy, grid=grid,
+            cost=request.job.cost,
+            periods_per_year=request.job.periods_per_year or 252)
+        self._c_appends[outcome].inc()
+        if rec is None:
+            log.warning("AppendBars %s from %s rejected: %s",
+                        request.panel_digest[:16], request.worker_id,
+                        outcome)
+            return pb.AppendReply(ok=False, detail=outcome,
+                                  panel_digest=ndig, new_len=new_len)
+        log.info("AppendBars %s -> %s (%d bars): job %s",
+                 request.panel_digest[:16], ndig[:16], new_len, rec.id)
+        return pb.AppendReply(ok=True, job_id=rec.id, panel_digest=ndig,
+                              new_len=new_len)
 
 
 class DispatcherServer:

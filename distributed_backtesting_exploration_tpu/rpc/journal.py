@@ -33,6 +33,12 @@ class ReplayState:
     failed: set = field(default_factory=set)        # job ids
     corrupt_lines: int = 0                          # interior decode failures
     total_lines: int = 0                            # non-empty lines seen
+    # Streaming append chain: extended-panel digest -> its `delta` event
+    # (parent digest, base length, delta payload). Restarts rebuild
+    # extended panels by replaying the chain instead of re-journaling
+    # O(T) payloads per append (last event per digest wins — the splice
+    # is deterministic, so duplicates are identical anyway).
+    deltas: dict = field(default_factory=dict)
     # Raw complete/fail records in order, first occurrence per id — they
     # carry worker ids and failure reasons that the id sets drop, and
     # compaction must not erase that post-mortem record.
@@ -120,16 +126,32 @@ class Journal:
         before = state.total_lines
         if (not state.completed and not state.failed
                 and not state.corrupt_lines
-                and before == len(state.jobs)):
+                and before == len(state.jobs) + len(state.deltas)):
             return (before, before)   # nothing to shrink: skip the rewrite
         done = state.completed | state.failed
         tmp = f"{path}.compact.{os.getpid()}"
         after = 0
         with open(tmp, "w", encoding="utf-8") as fh:
+            # Append-chain links first (each ~ΔT bars): materializing a
+            # restored append job needs its chain, and chain nodes can be
+            # shared by several jobs (or by future appends), so they
+            # survive compaction whole.
+            for rec in state.deltas.values():
+                fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
+                after += 1
+            # Streaming chain ROOTS — parent digests that are not
+            # themselves rebuilt by a delta event — must keep their
+            # payloads even on completed jobs: every extended panel in
+            # the chain re-materializes from a root + the ΔT deltas, so
+            # slimming a root would orphan the whole chain after restart.
+            chain_roots = ({r.get("pdig") for r in state.deltas.values()}
+                           - set(state.deltas))
             for jid, rec in state.jobs.items():
                 if jid in done:
+                    keep = ({"ohlcv_b64"}
+                            if rec.get("pdig") in chain_roots else set())
                     rec = {k: v for k, v in rec.items()
-                           if k not in Journal._PAYLOAD_KEYS}
+                           if k not in Journal._PAYLOAD_KEYS or k in keep}
                 fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
                 after += 1
             for rec in state.terminal_events:
@@ -188,6 +210,12 @@ class Journal:
                     for k in ("pdig", "pdig2"):
                         if rec.get(k):
                             job[k] = rec[k]
+            elif ev == "delta":
+                # Streaming append-chain link (AppendBars): keyed by the
+                # EXTENDED panel's digest so materialization can walk
+                # parents back to a journaled payload source.
+                if rec.get("ndig"):
+                    state.deltas[rec["ndig"]] = rec
             elif ev == "complete":
                 if rec["id"] not in state.completed:
                     state.terminal_events.append(rec)

@@ -95,6 +95,12 @@ class ByteLRU:
             self.bytes -= ev_nb
             self.evictions += 1
 
+    def pop(self, key) -> None:
+        """Drop one entry (no error if absent); accounting follows."""
+        entry = self._entries.pop(key, None)
+        if entry is not None:
+            self.bytes -= entry[1]
+
     def __contains__(self, key) -> bool:
         return key in self._entries
 

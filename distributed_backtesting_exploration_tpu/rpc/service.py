@@ -38,6 +38,7 @@ _METHODS = (
     ("CompleteJobs", pb.CompleteBatch, pb.CompleteBatchReply),
     ("GetStats", pb.StatsRequest, pb.StatsReply),
     ("FetchPayload", pb.PayloadRequest, pb.PayloadReply),
+    ("AppendBars", pb.AppendRequest, pb.AppendReply),
 )
 
 
@@ -62,6 +63,10 @@ class DispatcherServicer:
 
     def FetchPayload(self, request: pb.PayloadRequest,
                      context) -> pb.PayloadReply:
+        raise NotImplementedError
+
+    def AppendBars(self, request: pb.AppendRequest,
+                   context) -> pb.AppendReply:
         raise NotImplementedError
 
 

@@ -535,6 +535,15 @@ class Worker:
                     (job.panel_digest2, bool(job.ohlcv2), "ohlcv2")):
                 if not digest or has_raw or cache.contains_series(digest):
                     continue
+                if (field == "ohlcv" and job.append_parent_digest
+                        and job.append_delta
+                        and cache.contains_series(
+                            job.append_parent_digest)):
+                    # Delta-only append dispatch: the compute path splices
+                    # the cached base + append_delta itself; fetching the
+                    # full extended panel here would undo the O(ΔT) wire
+                    # saving.
+                    continue
                 blob = blobs.get(digest)
                 if blob is None:
                     blob = self._fetch_payload(stub, digest)

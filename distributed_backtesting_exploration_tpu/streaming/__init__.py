@@ -1,0 +1,20 @@
+"""Streaming backtests: persistable carry checkpoints + O(ΔT) appends.
+
+The scan-form/recurrent-form duality (PAPERS.md "Compiler-First State
+Space Duality and Portable O(1) Autoregressive Caching") applied to the
+sweep engine: the cold sweep runs the scan form over the full T-bar
+panel once and leaves behind a per-(panel_digest, strategy, param-block)
+:class:`~.recurrent.StreamCarry`; every appended ΔT-bar slice then
+advances that carry with the recurrent form (:func:`~.recurrent
+.append_step`) in O(ΔT) work and O(1) state — no full reprice. The
+carry is digest-keyed and device-resident like a KV cache
+(:class:`~.store.CarryStore`, the streaming twin of the worker's
+PanelCache), with a host-serialized level that survives device-level
+eviction.
+"""
+
+from .recurrent import (  # noqa: F401
+    StreamCarry, append_step, build_carry, carry_from_bytes,
+    carry_to_bytes, finalize, stream_fields, stream_key,
+    supports_strategy, tail_bars)
+from .store import CarryStore, carry_cache_max_bytes  # noqa: F401

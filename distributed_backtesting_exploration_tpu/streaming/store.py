@@ -1,0 +1,115 @@
+"""Digest-keyed carry-checkpoint cache (the streaming twin of PanelCache).
+
+Two levels, mirroring the worker panel cache's shape so the eviction and
+accounting semantics cannot drift (both ride ``panel_store.ByteLRU``):
+
+- **device level**: the live :class:`~.recurrent.StreamCarry` with its
+  jax arrays resident — a hit advances in O(ΔT) with zero host work;
+- **host level**: the serialized checkpoint bytes
+  (:func:`~.recurrent.carry_to_bytes`) — survives device-level eviction;
+  a hit deserializes and re-primes the device level. Restoring is
+  lossless, so an append after evict+restore bit-matches an append to
+  the never-evicted carry (tested).
+
+Keys are ``(panel_digest, stream_key)`` — the content address of the
+panel state the carry summarizes plus the parameter-block digest
+(:func:`~.recurrent.stream_key`), so a checkpoint can never serve a
+different grid/cost/strategy than it was built for. Bounded per level by
+``DBX_CARRY_CACHE_MB`` (default 64). Eviction of both levels is not an
+error: the worker falls back to a full reprice and re-checkpoints.
+
+Thread-safe: the worker control thread may probe while the compute
+thread serves.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from .. import obs
+from ..rpc.panel_store import ByteLRU
+from . import recurrent
+
+_DEFAULT_CARRY_MB = 64
+
+
+def carry_cache_max_bytes() -> int:
+    """Per-level carry-cache budget, read lazily (import-time env capture
+    would pin the knob before tests/operators can set it)."""
+    return int(float(os.environ.get("DBX_CARRY_CACHE_MB",
+                                    _DEFAULT_CARRY_MB)) * 1024 * 1024)
+
+
+class CarryStore:
+    """Two-level LRU of ``(panel_digest, stream_key) -> StreamCarry``."""
+
+    def __init__(self, max_bytes: int | None = None,
+                 registry: "obs.Registry | None" = None):
+        self.max_bytes = (carry_cache_max_bytes() if max_bytes is None
+                          else int(max_bytes))
+        self._lock = threading.Lock()
+        self._device = ByteLRU(self.max_bytes)    # put() passes nbytes
+        self._host = ByteLRU(self.max_bytes)      # serialized bytes
+        reg = registry or obs.get_registry()
+        self._c_hits = {
+            lvl: reg.counter("dbx_carry_cache_hits_total",
+                             help="carry-checkpoint cache hits by level "
+                                  "(device=resident carry, host="
+                                  "deserialized checkpoint)", level=lvl)
+            for lvl in ("host", "device")}
+        self._c_misses = {
+            lvl: reg.counter("dbx_carry_cache_misses_total",
+                             help="carry-checkpoint cache misses by level",
+                             level=lvl)
+            for lvl in ("host", "device")}
+        self._g_bytes = reg.gauge(
+            "dbx_carry_cache_bytes",
+            help="approximate bytes resident in the carry cache "
+                 "(device + host levels)")
+
+    def _publish_bytes(self) -> None:
+        self._g_bytes.set(self._device.bytes + self._host.bytes)
+
+    def get(self, key) -> "recurrent.StreamCarry | None":
+        with self._lock:
+            carry = self._device.get(key)
+        if carry is not None:
+            self._c_hits["device"].inc()
+            return carry
+        self._c_misses["device"].inc()
+        with self._lock:
+            blob = self._host.get(key)
+        if blob is None:
+            self._c_misses["host"].inc()
+            return None
+        self._c_hits["host"].inc()
+        carry = recurrent.carry_from_bytes(blob)
+        with self._lock:
+            # Re-prime the device level so the next append skips the
+            # deserialize too.
+            self._device.put(key, carry, carry.nbytes)
+            self._publish_bytes()
+        return carry
+
+    def put(self, key, carry: "recurrent.StreamCarry") -> None:
+        blob = recurrent.carry_to_bytes(carry)
+        with self._lock:
+            self._device.put(key, carry, carry.nbytes)
+            self._host.put(key, blob)
+            self._publish_bytes()
+
+    def evict_device(self, key) -> None:
+        """Drop the device-resident copy only (tests + memory pressure
+        hooks); the host checkpoint keeps the state restorable."""
+        with self._lock:
+            self._device.pop(key)
+            self._publish_bytes()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"device_carries": len(self._device),
+                    "device_bytes": self._device.bytes,
+                    "host_carries": len(self._host),
+                    "host_bytes": self._host.bytes,
+                    "max_bytes": self.max_bytes}

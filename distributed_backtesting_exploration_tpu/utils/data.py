@@ -208,6 +208,23 @@ def from_wire_bytes(data: bytes) -> OHLCV:
     return OHLCV(*fields)
 
 
+def splice_wire_bytes(base: bytes, delta: bytes) -> bytes:
+    """Extend a DBX1 panel by a DBX1 delta slice: per-field concatenation.
+
+    The streaming-append primitive (AppendBars): deterministic, so
+    replaying a journaled ``delta`` chain after a dispatcher restart
+    reconstructs byte-identical extended panels — and hence the same
+    content digests the first run stamped.
+    """
+    b = from_wire_bytes(base)
+    d = from_wire_bytes(delta)
+    if d.n_bars < 1:
+        raise ValueError("empty delta slice")
+    return to_wire_bytes(OHLCV(*(
+        np.concatenate([np.asarray(bf), np.asarray(df)])
+        for bf, df in zip(b, d))))
+
+
 def pad_and_stack(
     series: Sequence[OHLCV], *, lane_multiple: int = 128
 ) -> tuple[OHLCV, np.ndarray, np.ndarray]:

@@ -382,6 +382,39 @@ def test_timeline_panel_cache_hit_pseudo_stage():
     assert stages["panel_cache_hit"] == 0.0
 
 
+def test_timeline_carry_hit_pseudo_stage():
+    """Streaming-append attribution: a worker.append span with a truthy
+    `carry_hit` attr charges its window to the `carry_hit` pseudo-stage
+    (the O(ΔT) advance); a checkpoint-miss full reprice — same span name,
+    no flag — stays execute. Stage seconds still sum exactly to the e2e
+    window, and the BENCH straggler digest path is unaffected (the stage
+    participates in summarize like any other)."""
+    tid = obs.new_trace_id()
+    spans = [
+        {"ev": "span", "name": "job", "t0": 0.0, "dur_s": 3.0,
+         "trace_id": tid, "span_id": "s0", "job": "a1", "worker": "w0"},
+        {"ev": "span", "name": "job.queue_wait", "t0": 0.0, "dur_s": 1.0,
+         "trace_id": tid, "span_id": "s1", "job": "a1"},
+        {"ev": "span", "name": "worker.append", "t0": 1.5, "dur_s": 0.25,
+         "trace_id": tid, "span_id": "s2", "carry_hit": True},
+        {"ev": "span", "name": "worker.report", "t0": 2.5, "dur_s": 0.5,
+         "trace_id": tid, "span_id": "s3"},
+    ]
+    tls = timeline.reconstruct(spans)
+    stages = timeline.critical_path(tls[tid])
+    assert stages["carry_hit"] == pytest.approx(0.25)
+    assert stages["execute"] == 0.0
+    assert sum(stages.values()) == pytest.approx(3.0)
+    summary = timeline.summarize(tls)
+    assert summary["stages"]["carry_hit"]["total_s"] == pytest.approx(0.25)
+
+    # A full reprice (no carry_hit flag) is ordinary execute work.
+    spans[2] = dict(spans[2], carry_hit=False)
+    stages = timeline.critical_path(timeline.reconstruct(spans)[tid])
+    assert stages["execute"] == pytest.approx(0.25)
+    assert stages["carry_hit"] == 0.0
+
+
 def test_event_log_env_opt_in_is_lazy(tmp_path, monkeypatch):
     """DBX_OBS_JSONL is consulted at FIRST USE, not import (dbxlint
     import-time-config): setting it after import but before first use

@@ -1345,3 +1345,278 @@ def test_digest_only_requires_worker_capability_flag(tmp_path):
     finally:
         channel.close()
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Streaming appends (AppendBars): O(ΔT) live-bar serving
+# ---------------------------------------------------------------------------
+
+def _stream_setup(n_bars=160, base_bars=128, seed=42):
+    """One full synthetic history + its base/delta DBX1 slices."""
+    from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+        JobRecord)
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    full = data.synthetic_ohlcv(1, n_bars, seed=seed)
+    def cut(lo, hi):
+        return data.to_wire_bytes(
+            type(full)(*(np.asarray(f[0, lo:hi]) for f in full)))
+    rec = JobRecord(id=f"stream-base-{seed}", strategy="sma_crossover",
+                    grid=GRID, ohlcv=cut(0, base_bars))
+    return full, rec, cut
+
+
+def _cold_stream_metrics(full, n_bars):
+    """The cold streaming sweep over the first n_bars — the parity
+    target every append result must match."""
+    from distributed_backtesting_exploration_tpu.parallel import sweep
+    from distributed_backtesting_exploration_tpu.streaming import (
+        recurrent as rc)
+
+    grid = {k: np.asarray(v) for k, v in sweep.product_grid(
+        **dict(sorted(GRID.items()))).items()}
+    return rc.finalize(rc.build_carry(
+        "sma_crossover",
+        {"close": np.asarray(full.close)[:, :n_bars]}, grid))
+
+
+def _append(stub, digest, base_len, delta):
+    tmpl = pb.JobSpec(strategy="sma_crossover",
+                      grid=wire.grid_to_proto(GRID), cost=0.0,
+                      periods_per_year=252)
+    return stub.AppendBars(pb.AppendRequest(
+        worker_id="feed", panel_digest=digest, base_len=base_len,
+        delta=delta, job=tmpl))
+
+
+def test_append_bars_stream_serves_carry_hits_and_matches_cold(tmp_path):
+    """The streaming tentpole end to end: a cold sweep leaves no
+    checkpoint, so append #1 full-reprices (graceful, not failed) AND
+    stores the carry; append #2 advances it in O(ΔT) — asserted via the
+    carry-cache counters, the worker append outcomes, the delta-only
+    dispatch counter, and the carry_hit span — and both append results
+    match the cold streaming sweep at their lengths."""
+    import grpc
+
+    from distributed_backtesting_exploration_tpu import obs
+    from distributed_backtesting_exploration_tpu.rpc import service
+
+    full, rec, cut = _stream_setup()
+    queue = JobQueue()
+    queue.enqueue(rec)
+    disp, srv = _server(queue, results_dir=str(tmp_path / "results"))
+    backend = compute.JaxSweepBackend(use_fused=True)
+    hit0 = backend._c_append["carry_hit"].value
+    miss0 = backend._c_append["full_reprice"].value
+    delta_mode0 = disp._c_payloads["delta"].value
+    channel = grpc.insecure_channel(f"localhost:{srv.port}",
+                                    options=service.default_channel_options())
+    stub = service.DispatcherStub(channel)
+    try:
+        w, t = _run_worker(f"localhost:{srv.port}", backend,
+                           max_idle_polls=None)
+        _wait(lambda: queue.drained, msg="base job drained")
+        r1 = _append(stub, rec.panel_digest, 128, cut(128, 144))
+        assert r1.ok and r1.new_len == 144
+        _wait(lambda: queue.drained, msg="append 1 drained")
+        r2 = _append(stub, r1.panel_digest, 144, cut(144, 160))
+        assert r2.ok and r2.new_len == 160
+        _wait(lambda: queue.drained, msg="append 2 drained")
+        w.stop()
+        t.join(timeout=10)
+    finally:
+        channel.close()
+        srv.stop()
+    assert queue.stats()["jobs_failed"] == 0
+    assert disp._c_appends["extended"].value == 2
+    # Append 1: no checkpoint anywhere -> full reprice; append 2: the
+    # stored carry advances.
+    assert backend._c_append["full_reprice"].value - miss0 == 1
+    assert backend._c_append["carry_hit"].value - hit0 == 1
+    # The worker held the base panel, so at least one append shipped
+    # delta-only (empty ohlcv + append_delta).
+    assert disp._c_payloads["delta"].value - delta_mode0 >= 1
+    ring = obs.recent_spans()
+    assert any(s.get("name") == "worker.append" and s.get("carry_hit")
+               for s in ring), "no carry_hit append span in the ring"
+
+    for reply, n_bars in ((r1, 144), (r2, 160)):
+        got = wire.metrics_from_bytes(
+            (tmp_path / "results" / f"{reply.job_id}.dbxm").read_bytes())
+        want = _cold_stream_metrics(full, n_bars)
+        for name in want._fields:
+            np.testing.assert_allclose(
+                np.asarray(getattr(got, name)),
+                np.asarray(getattr(want, name))[0], rtol=2e-5, atol=2e-6,
+                err_msg=f"{n_bars}:{name}")
+
+
+def test_append_bars_restart_replays_delta_chain(tmp_path):
+    """Dispatcher restart mid-stream: the journal's `delta` events rebuild
+    the append chain, the NEXT append extends the chain's tip (the store
+    re-splices lazily), and a fresh worker — no checkpoint — degrades to
+    a full reprice, never a failed job."""
+    import grpc
+
+    from distributed_backtesting_exploration_tpu.rpc import service
+    from distributed_backtesting_exploration_tpu.rpc.journal import Journal
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    jpath = str(tmp_path / "stream.jsonl")
+    full, rec, cut = _stream_setup(seed=43)
+    queue = JobQueue(Journal(jpath))
+    queue.enqueue(rec)
+    disp, srv = _server(queue, results_dir=str(tmp_path / "res1"))
+    channel = grpc.insecure_channel(f"localhost:{srv.port}",
+                                    options=service.default_channel_options())
+    stub = service.DispatcherStub(channel)
+    try:
+        backend = compute.JaxSweepBackend(use_fused=True)
+        w, t = _run_worker(f"localhost:{srv.port}", backend,
+                           max_idle_polls=None)
+        _wait(lambda: queue.drained, msg="base drained")
+        r1 = _append(stub, rec.panel_digest, 128, cut(128, 144))
+        assert r1.ok
+        _wait(lambda: queue.drained, msg="append 1 drained")
+        w.stop()
+        t.join(timeout=10)
+    finally:
+        channel.close()
+        srv.stop()
+
+    # Restart: fresh queue replays the journal (empty panel store, but
+    # the delta chain knows how to rebuild the extended panel).
+    queue2 = JobQueue(Journal(jpath))
+    queue2.restore(jpath)
+    blob = queue2.payload_for_digest(r1.panel_digest)
+    assert blob is not None
+    assert data.from_wire_bytes(blob).n_bars == 144
+
+    disp2, srv2 = _server(queue2, results_dir=str(tmp_path / "res2"))
+    channel2 = grpc.insecure_channel(
+        f"localhost:{srv2.port}", options=service.default_channel_options())
+    stub2 = service.DispatcherStub(channel2)
+    try:
+        backend2 = compute.JaxSweepBackend(use_fused=True)
+        miss0 = backend2._c_append["full_reprice"].value
+        w2, t2 = _run_worker(f"localhost:{srv2.port}", backend2,
+                             max_idle_polls=None)
+        r2 = _append(stub2, r1.panel_digest, 144, cut(144, 160))
+        assert r2.ok and r2.new_len == 160
+        _wait(lambda: queue2.drained, msg="post-restart append drained")
+        w2.stop()
+        t2.join(timeout=10)
+        # Fresh worker, no checkpoint: degraded full reprice, zero fails.
+        assert backend2._c_append["full_reprice"].value - miss0 == 1
+        assert queue2.stats()["jobs_failed"] == 0
+        got = wire.metrics_from_bytes(
+            (tmp_path / "res2" / f"{r2.job_id}.dbxm").read_bytes())
+        want = _cold_stream_metrics(full, 160)
+        for name in want._fields:
+            np.testing.assert_allclose(
+                np.asarray(getattr(got, name)),
+                np.asarray(getattr(want, name))[0], rtol=2e-5, atol=2e-6,
+                err_msg=name)
+    finally:
+        channel2.close()
+        srv2.stop()
+
+
+def test_append_bars_reject_outcomes():
+    """Stale or malformed appends are explicit ok=false replies with the
+    reason — nothing enqueued, nothing failed."""
+    _, rec, cut = _stream_setup(seed=44)
+    queue = JobQueue()
+    queue.enqueue(rec)
+    disp = Dispatcher(queue, PeerRegistry())
+    try:
+        tmpl = pb.JobSpec(strategy="sma_crossover",
+                          grid=wire.grid_to_proto(GRID))
+        r = disp.AppendBars(pb.AppendRequest(
+            worker_id="feed", panel_digest="ffff" * 8, base_len=128,
+            delta=cut(128, 144), job=tmpl), None)
+        assert not r.ok and r.detail == "base_missing"
+        r = disp.AppendBars(pb.AppendRequest(
+            worker_id="feed", panel_digest=rec.panel_digest, base_len=99,
+            delta=cut(128, 144), job=tmpl), None)
+        assert not r.ok and r.detail == "base_len_mismatch"
+        assert r.new_len == 128   # the real base length, for re-sync
+        r = disp.AppendBars(pb.AppendRequest(
+            worker_id="feed", panel_digest=rec.panel_digest, base_len=128,
+            delta=b"garbage", job=tmpl), None)
+        assert not r.ok and r.detail == "bad_delta"
+        # Non-streamable strategies reject synchronously too (pairs
+        # cannot ride a one-panel wire) — no job burns a dispatch round
+        # trip only to complete empty.
+        r = disp.AppendBars(pb.AppendRequest(
+            worker_id="feed", panel_digest=rec.panel_digest, base_len=128,
+            delta=cut(128, 144),
+            job=pb.JobSpec(strategy="pairs",
+                           grid=wire.grid_to_proto(GRID))), None)
+        assert not r.ok and r.detail == "unsupported_strategy"
+        assert queue.stats()["jobs_pending"] == 1   # only the base job
+        assert disp._c_appends["base_missing"].value == 1
+        assert disp._c_appends["base_len_mismatch"].value == 1
+        assert disp._c_appends["bad_delta"].value == 1
+        assert disp._c_appends["unsupported_strategy"].value == 1
+    finally:
+        disp.close()
+
+
+def test_append_affinity_routes_to_base_holder(tmp_path):
+    """RequestJobs affinity: an append job is deferred (once) from a
+    worker that does NOT hold the base while another live worker does;
+    the holder then receives it delta-only (empty ohlcv + append_delta).
+    The deferral is bounded — a second poll from the non-holder would be
+    served the job in full."""
+    import grpc
+
+    from distributed_backtesting_exploration_tpu.rpc import service
+
+    _, rec, cut = _stream_setup(seed=45)
+    queue = JobQueue()
+    queue.enqueue(rec)
+    disp, srv = _server(queue, prune_window_s=60.0,
+                        results_dir=str(tmp_path / "results"))
+    channel = grpc.insecure_channel(f"localhost:{srv.port}",
+                                    options=service.default_channel_options())
+    stub = service.DispatcherStub(channel)
+    try:
+        def poll(worker):
+            return list(stub.RequestJobs(pb.JobsRequest(
+                worker_id=worker, chips=1, jobs_per_chip=4,
+                accepts_digest_only=True)).jobs)
+
+        # holder takes (and completes) the base job: its delivered set
+        # now contains the base digest.
+        base_jobs = poll("holder")
+        assert len(base_jobs) == 1 and base_jobs[0].ohlcv
+        disp.CompleteJobs(pb.CompleteBatch(
+            worker_id="holder",
+            items=[pb.CompleteItem(id=base_jobs[0].id)]), None)
+
+        r = _append(stub, rec.panel_digest, 128, cut(128, 144))
+        assert r.ok
+        # The non-holder polls first: the append job is deferred to give
+        # the base holder first claim.
+        assert poll("other") == []
+        got = poll("holder")
+        assert len(got) == 1
+        job = got[0]
+        assert job.append_parent_digest == rec.panel_digest
+        assert job.append_base_len == 128
+        assert job.ohlcv == b"" and job.append_delta   # delta-only
+        disp.CompleteJobs(pb.CompleteBatch(
+            worker_id="holder",
+            items=[pb.CompleteItem(id=job.id)]), None)
+
+        # Bounded deferral: with the holder gone silent, a SECOND append
+        # reaches the non-holder on its second poll, full bytes.
+        r2 = _append(stub, r.panel_digest, 144, cut(144, 160))
+        assert r2.ok
+        assert poll("other") == []            # deferred once
+        job2 = poll("other")
+        assert len(job2) == 1 and job2[0].ohlcv   # then served, in full
+    finally:
+        channel.close()
+        srv.stop()

@@ -1281,3 +1281,147 @@ def test_panel_store_lru_bound_and_unservable_digest(tmp_path):
 
     os.remove(csv_path)
     assert q.payload_for_digest(rec.panel_digest) is None
+
+
+# ---------------------------------------------------------------------------
+# Streaming appends: queue half (chain, journal, affinity)
+# ---------------------------------------------------------------------------
+
+def _stream_base(n_bars=64, seed=21):
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    full = data.synthetic_ohlcv(1, n_bars + 16, seed=seed)
+
+    def cut(lo, hi):
+        return data.to_wire_bytes(
+            type(full)(*(np.asarray(f[0, lo:hi]) for f in full)))
+
+    rec = JobRecord(id="sb", strategy="sma_crossover",
+                    grid=parse_grid("fast=3:5,slow=10:14:2"),
+                    ohlcv=cut(0, n_bars))
+    return rec, cut
+
+
+def test_append_bars_chain_journal_and_compaction(tmp_path, qfactory):
+    """append_bars journals an O(ΔT) `delta` event (never the extended
+    panel), the chain survives replay AND compaction, and a restarted
+    queue re-materializes the extended panel bit-identically — same
+    content digest — even with an empty panel store."""
+    from distributed_backtesting_exploration_tpu.rpc import panel_store
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    jp = str(tmp_path / "s.jsonl")
+    rec, cut = _stream_base()
+    q = qfactory(Journal(jp))
+    q.enqueue(rec)
+    arec, outcome, ndig, new_len = q.append_bars(
+        rec.panel_digest, 64, cut(64, 72), strategy="sma_crossover",
+        grid=rec.grid)
+    assert outcome == "extended" and new_len == 72
+    assert arec.append_parent == rec.panel_digest
+    assert arec.ohlcv is None and arec.path is None
+    # Journal growth is O(ΔT): no line carries the 72-bar extended panel.
+    extended = data.splice_wire_bytes(cut(0, 64), cut(64, 72))
+    assert panel_store.panel_digest(extended) == ndig
+    import base64 as b64
+    blob64 = b64.b64encode(extended).decode()
+    with open(jp) as fh:
+        assert all(blob64 not in line for line in fh)
+
+    # Drain the base job so compaction has something to fold; the append
+    # job stays pending.
+    got = q.take(1, "w")
+    assert [r.id for r, _ in got] == [rec.id]
+    q.complete_batch([rec.id], "w")
+
+    Journal.compact(jp)
+    q2 = qfactory(None)
+    assert q2.restore(jp) == 1            # the pending append job
+    # Store empty after restart: payload_for_digest rebuilds via chain.
+    blob = q2.payload_for_digest(ndig)
+    assert blob == extended
+    # take() of the restored append job materializes through the chain.
+    taken = q2.take(1, "w2")
+    assert len(taken) == 1
+    trec, payload = taken[0]
+    assert trec.append_parent == rec.panel_digest and payload == extended
+
+
+def test_append_bars_base_gone_is_explicit_reject(qfactory):
+    rec, cut = _stream_base(seed=22)
+    q = qfactory(None)
+    q.enqueue(rec)
+    _, outcome, _, _ = q.append_bars(
+        "00" * 16, 64, cut(64, 72), strategy="sma_crossover",
+        grid=rec.grid)
+    assert outcome == "base_missing"
+    assert q.stats()["jobs_pending"] == 1   # nothing new enqueued
+
+
+def test_take_admit_defers_then_serves(qfactory):
+    """The affinity hook's contract: a rejected append job is held OUT of
+    the batch (and the FIFO) for that call, re-queued afterwards, and an
+    admit that keeps rejecting cannot lose the job — while ordinary jobs
+    are never consulted."""
+    rec, cut = _stream_base(seed=23)
+    q = qfactory(None)
+    q.enqueue(rec)
+    _, outcome, ndig, _ = q.append_bars(
+        rec.panel_digest, 64, cut(64, 72), strategy="sma_crossover",
+        grid=rec.grid)
+    assert outcome == "extended"
+
+    consulted = []
+
+    def deny(r):
+        consulted.append(r.id)
+        r.affinity_skips += 1
+        return False
+
+    got = q.take(4, "w", admit=deny)
+    # The ordinary base job is served without consulting admit; the
+    # append job was deferred.
+    assert [r.id for r, _ in got] == [rec.id]
+    assert len(consulted) == 1
+    # Deferred, not lost: a later take (any admit verdict) serves it.
+    got2 = q.take(4, "w", admit=lambda r: True)
+    assert len(got2) == 1 and got2[0][0].panel_digest == ndig
+    q.complete_batch([rec.id, got2[0][0].id], "w")
+    assert q.drained
+
+
+def test_append_chain_long_stream_survives_restart(tmp_path, qfactory):
+    """A long live stream (many chained appends) must stay servable after
+    a restart: the chain walk is iterative, so payload reconstruction
+    works at any chain length and re-stores every level on the way up."""
+    from distributed_backtesting_exploration_tpu.utils import data
+
+    jp = str(tmp_path / "long.jsonl")
+    n0, dt, links = 48, 4, 12
+    full = data.synthetic_ohlcv(1, n0 + dt * links, seed=31)
+
+    def cut(lo, hi):
+        return data.to_wire_bytes(
+            type(full)(*(np.asarray(f[0, lo:hi]) for f in full)))
+
+    rec = JobRecord(id="long-base", strategy="sma_crossover",
+                    grid=parse_grid("fast=3:5,slow=10:14:2"),
+                    ohlcv=cut(0, n0))
+    q = qfactory(Journal(jp))
+    q.enqueue(rec)
+    dig, L = rec.panel_digest, n0
+    for _ in range(links):
+        arec, outcome, dig, L = q.append_bars(
+            dig, L, cut(L, L + dt), strategy="sma_crossover",
+            grid=rec.grid)
+        assert outcome == "extended"
+
+    q2 = qfactory(Journal(jp))
+    q2.restore(jp)
+    blob = q2.payload_for_digest(dig)
+    assert blob is not None
+    assert data.from_wire_bytes(blob).n_bars == n0 + dt * links
+    # Restored append jobs keep their delta bytes (delta-only dispatch
+    # works across restarts, not just in the first process).
+    restored = [r for r in q2._records.values() if r.append_parent]
+    assert restored and all(r.delta for r in restored)

@@ -148,3 +148,51 @@ def test_wire_bytes_per_job_keys_present(local_bench):
     floor = local_bench["roofline"]["direct_dispatch_floor"]
     assert floor["wire_bytes_per_job"]["b32"] > 0.0
     assert floor["wire_bytes_per_job"]["b128"] > 0.0
+
+
+_STREAM_ENV = {
+    "DBX_BENCH_CPU": "1", "DBX_BENCH_CACHE": "",
+    "DBX_BENCH_CONFIGS": "streaming_append",
+    # Tiny-but-real in-process A/B: base history past the carry's tail
+    # (the partial-tail recurrent head — the serving path), few updates.
+    "DBX_BENCH_STREAM_T": "192", "DBX_BENCH_STREAM_DT": "8",
+    "DBX_BENCH_ITERS": "2",
+}
+
+
+@pytest.fixture(scope="module")
+def stream_bench():
+    """One tiny in-process streaming_append run, shared by the module."""
+    prior = {k: os.environ.get(k) for k in _STREAM_ENV}
+    os.environ.update(_STREAM_ENV)
+    bench.ROOFLINE.clear()
+    buf = io.StringIO()
+    try:
+        with contextlib.redirect_stdout(buf):
+            bench.main()
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return json.loads(buf.getvalue().strip().splitlines()[-1])
+
+
+def test_streaming_append_keys_present(stream_bench):
+    """The streaming A/B's acceptance numbers (append_speedup at the
+    headline T=8192/ΔT=16, and the delta-vs-full wire columns) ride
+    these BENCH JSON keys — a renamed key would silently invalidate the
+    next round's measurement."""
+    sa = stream_bench["roofline"]["streaming_append"]
+    for key in ("bars_base", "delta_bars", "updates", "combos",
+                "append_s_per_update", "full_reprice_s_per_update",
+                "append_speedup", "wire_bytes_full", "wire_bytes_delta",
+                "wire_reduction"):
+        assert key in sa, key
+    assert sa["append_s_per_update"] > 0.0
+    assert sa["full_reprice_s_per_update"] > 0.0
+    assert sa["append_speedup"] > 0.0
+    # The wire saving is structural (ΔT vs T+ΔT bars), true at any scale.
+    assert sa["wire_bytes_delta"] < sa["wire_bytes_full"]
+    assert stream_bench["configs"]["streaming_append"] > 0.0
