@@ -1340,6 +1340,256 @@ def main():
               f"full reprice {t_full * 1e3:.1f} ms -> {speedup:.1f}x "
               f"(wire {wire_full}B -> {wire_delta}B)", file=sys.stderr)
 
+    # --- e2e_local_tenants: 3-tenant adversarial fairness A/B -------------
+    # ROADMAP item 5's acceptance instrument: a whale tenant's oversized
+    # grid sweep (many jobs x many combos) must not blow up a small
+    # tenant's p95 queue wait. Two loopback drains with the SAME small-
+    # tenant workload — (solo) the two small tenants without the whale,
+    # (contended) the whale's whole backlog enqueued AHEAD of them — and
+    # per-tenant p95 queue_wait measured through the PR 4 timeline
+    # profiler (per-job critical-path stage attribution over the span
+    # ring), tenants keyed by job-id prefix. Under the WFQ pop the whale
+    # only interleaves at its combo-weighted share, so the ratio stays
+    # near 1; the pre-tenancy FIFO would make it backlog/backlog (~5x
+    # at the default sizes).
+    def run_tenant_pass(tag, tenant_jobs, *, jobs_per_chip=8):
+        import tempfile
+        import threading
+
+        from distributed_backtesting_exploration_tpu.rpc.compute import (
+            InstantBackend)
+        from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+            Dispatcher, DispatcherServer, JobQueue, PeerRegistry)
+        from distributed_backtesting_exploration_tpu.rpc.worker import (
+            Worker)
+
+        queue = JobQueue()
+        n_total = 0
+        with tempfile.TemporaryDirectory() as results_dir:
+            disp = Dispatcher(queue, PeerRegistry(prune_window_s=30.0),
+                              results_dir=results_dir)
+            srv = DispatcherServer(disp, bind="localhost:0",
+                                   prune_interval_s=0.5).start()
+            worker = Worker(f"localhost:{srv.port}", InstantBackend(),
+                            worker_id=f"tenant-bench-{tag}",
+                            poll_interval_s=0.001, status_interval_s=0.5,
+                            jobs_per_chip=jobs_per_chip)
+            wt = threading.Thread(target=worker.run, daemon=True)
+            try:
+                wt.start()
+                t0 = time.perf_counter()
+                for recs in tenant_jobs:
+                    for rec in recs:
+                        queue.enqueue(rec)
+                    n_total += len(recs)
+                deadline = time.monotonic() + 600.0
+                while not queue.drained:
+                    if time.monotonic() > deadline:
+                        sys.exit(f"bench[e2e_local_tenants:{tag}]: drain "
+                                 f"wedged for 600s — stats={queue.stats()}")
+                    time.sleep(0.002)
+                elapsed = time.perf_counter() - t0
+            finally:
+                worker.stop()
+                wt.join(timeout=30)
+                srv.stop()
+        return n_total / elapsed
+
+    if enabled("e2e_local_tenants"):
+        from distributed_backtesting_exploration_tpu import obs as obs_mod
+        from distributed_backtesting_exploration_tpu.obs import (
+            timeline as tl_mod)
+        from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+            JobRecord)
+        from distributed_backtesting_exploration_tpu.utils import (
+            data as t_data)
+
+        n_small = int(os.environ.get("DBX_BENCH_TENANT_SMALL_JOBS", 64))
+        n_whale = int(os.environ.get("DBX_BENCH_TENANT_WHALE_JOBS", 512))
+        whale_combos = int(os.environ.get(
+            "DBX_BENCH_TENANT_WHALE_COMBOS", 64))
+        t_series = t_data.synthetic_ohlcv(1, 32, seed=910)
+        t_blob = t_data.to_wire_bytes(
+            type(t_series)(*(np.asarray(f[0]) for f in t_series)))
+        small_grid = {"fast": np.arange(5.0, 9.0, dtype=np.float32)}
+        whale_grid = {"fast": np.arange(
+            5.0, 5.0 + whale_combos, dtype=np.float32)}
+
+        def tenant_recs(tag, tenant, n, grid):
+            return [JobRecord(id=f"{tag}:{tenant}-{i}",
+                              strategy="sma_crossover", grid=grid,
+                              ohlcv=t_blob, tenant=tenant)
+                    for i in range(n)]
+
+        def tenant_p95(tag, tenant):
+            tls = tl_mod.reconstruct(obs_mod.recent_spans())
+            # Same torn-job discipline as timeline.summarize_spans: ring
+            # eviction tears a job's queue_wait head span first, and a
+            # torn timeline's queue_wait stage reads ~0 — keeping it
+            # would silently deflate the fairness p95 at scaled-up
+            # whale sizes.
+            tls = {t: tl for t, tl in tls.items()
+                   if any(s["name"] == "job.queue_wait"
+                          for s in tl.spans)}
+            per_job = (tl_mod.summarize(
+                tls, min_straggler_jobs=1 << 30)["per_job"]
+                if tls else [])
+            waits = sorted(j["stages"]["queue_wait"] for j in per_job
+                           if j["job"].startswith(f"{tag}:{tenant}-"))
+            if not waits:
+                # Honest-numbers policy: a fairness bar must never pass
+                # on zero measurements (ring eviction at scaled-up whale
+                # sizes tears the small tenants' spans FIRST).
+                sys.exit(f"bench[e2e_local_tenants]: no surviving "
+                         f"queue_wait timelines for {tag}:{tenant} — "
+                         "span ring too small for this job count")
+            return tl_mod._quantile(waits, 0.95), len(waits)
+
+        r_solo = run_tenant_pass("solo", [
+            tenant_recs("solo", "small_a", n_small, small_grid),
+            tenant_recs("solo", "small_b", n_small, small_grid)])
+        solo = {t: tenant_p95("solo", t) for t in ("small_a", "small_b")}
+        p95_solo = max(v[0] for v in solo.values())
+        r_cont = run_tenant_pass("cont", [
+            # Adversarial order: the whale's WHOLE sweep lands first.
+            tenant_recs("cont", "whale", n_whale, whale_grid),
+            tenant_recs("cont", "small_a", n_small, small_grid),
+            tenant_recs("cont", "small_b", n_small, small_grid)])
+        cont = {t: tenant_p95("cont", t)
+                for t in ("whale", "small_a", "small_b")}
+        per_tenant = {t: round(v[0], 6) for t, v in cont.items()}
+        p95_cont = max(per_tenant["small_a"], per_tenant["small_b"])
+        ratio = p95_cont / max(p95_solo, 1e-9)
+        ROOFLINE["e2e_local_tenants"] = {
+            # Sample counts per p95 (no silent caps: the quantiles above
+            # are only as good as the timelines that survived the ring).
+            "tenant_queue_wait_samples": {
+                **{f"solo_{t}": v[1] for t, v in solo.items()},
+                **{f"contended_{t}": v[1] for t, v in cont.items()}},
+            "small_jobs": n_small, "whale_jobs": n_whale,
+            "small_combos_per_job": int(small_grid["fast"].size),
+            "whale_combos_per_job": whale_combos,
+            "tenant_p95_queue_wait_solo": round(p95_solo, 6),
+            "tenant_p95_queue_wait_contended": round(p95_cont, 6),
+            "fairness_ratio": round(ratio, 3),
+            "fairness_ok": bool(ratio <= 2.0),
+            "per_tenant_p95_contended": per_tenant,
+            "jobs_per_s_solo": round(r_solo, 1),
+            "jobs_per_s_contended": round(r_cont, 1)}
+        rates["e2e_local_tenants"] = r_cont
+        print(f"bench[e2e_local_tenants]: whale {n_whale}x{whale_combos} "
+              f"combos vs 2x{n_small} small jobs: small p95 queue_wait "
+              f"{p95_solo * 1e3:.1f}ms solo -> {p95_cont * 1e3:.1f}ms "
+              f"contended = {ratio:.2f}x (bar: <= 2x)", file=sys.stderr)
+
+    # --- scenario_sweep: digest-seeded synthetic-panel generation ---------
+    # The scenario workload's two facts: (a) generator throughput — a
+    # (digest, params) spec replaces shipping/storing a whole panel, so
+    # the generation rate IS the workload's ingest ceiling; (b) the e2e
+    # dispatcher path — scenario jobs materialize through the panel
+    # store at first take and drain like ordinary content-addressed
+    # jobs. Reproducibility (same spec -> same digest) is asserted here
+    # too: it is the property that makes the spec a valid wire unit.
+    if enabled("scenario_sweep"):
+        import dataclasses as dc
+        import tempfile
+        import threading
+
+        from distributed_backtesting_exploration_tpu import (
+            scenarios as scn_mod)
+        from distributed_backtesting_exploration_tpu.rpc import (
+            backtesting_pb2 as s_pb)
+        from distributed_backtesting_exploration_tpu.rpc.compute import (
+            InstantBackend)
+        from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+            Dispatcher, DispatcherServer, JobQueue, PeerRegistry,
+            scenario_jobs, synthetic_jobs)
+        from distributed_backtesting_exploration_tpu.rpc.panel_store \
+            import panel_digest
+        from distributed_backtesting_exploration_tpu.rpc.worker import (
+            Worker)
+        from distributed_backtesting_exploration_tpu.utils import (
+            data as s_data)
+
+        s_bars = int(os.environ.get("DBX_BENCH_SCENARIO_BARS", 2048))
+        s_n = int(os.environ.get("DBX_BENCH_SCENARIO_N", 32))
+        s_series = s_data.synthetic_ohlcv(1, s_bars, seed=900)
+        s_blob = s_data.to_wire_bytes(
+            type(s_series)(*(np.asarray(f[0]) for f in s_series)))
+        params0 = scn_mod.ScenarioParams(block=16, regimes=3,
+                                         vol_scale=2.0, shock=0.01)
+        # Warm the generator jit: the rate must time steady-state work.
+        scn_mod.scenario_panel_bytes(s_blob, params0)
+        t0 = time.perf_counter()
+        blobs = [scn_mod.scenario_panel_bytes(
+            s_blob, dc.replace(params0, seed=i)) for i in range(s_n)]
+        gen_elapsed = time.perf_counter() - t0
+        redo = scn_mod.scenario_panel_bytes(s_blob,
+                                            dc.replace(params0, seed=0))
+        deterministic = redo == blobs[0]
+        spec_bytes = 32 + s_pb.ScenarioSpec(
+            base_digest=panel_digest(s_blob), n_bars=s_bars, block=16,
+            regimes=3, vol_scale=2.0, shock=0.01,
+            seed=s_n).ByteSize()
+
+        # e2e: the sweep as DISPATCHER work — one real job carries the
+        # base panel, the scenario jobs ride as specs and materialize
+        # through the panel store at first take.
+        queue = JobQueue()
+        base_rec = synthetic_jobs(1, 16, "sma_crossover",
+                                  {"fast": np.asarray([3.0], np.float32)},
+                                  seed=901)[0]
+        base_rec.ohlcv = s_blob
+        queue.enqueue(base_rec)
+        for rec in scenario_jobs(base_rec.panel_digest, s_n,
+                                 "sma_crossover",
+                                 {"fast": np.arange(5.0, 9.0,
+                                                    dtype=np.float32)},
+                                 params=params0.to_dict()):
+            queue.enqueue(rec)
+        with tempfile.TemporaryDirectory() as results_dir:
+            disp = Dispatcher(queue, PeerRegistry(prune_window_s=30.0),
+                              results_dir=results_dir)
+            srv = DispatcherServer(disp, bind="localhost:0",
+                                   prune_interval_s=0.5).start()
+            worker = Worker(f"localhost:{srv.port}", InstantBackend(),
+                            worker_id="scenario-bench",
+                            poll_interval_s=0.001, status_interval_s=0.5,
+                            jobs_per_chip=8)
+            wt = threading.Thread(target=worker.run, daemon=True)
+            try:
+                wt.start()
+                t0 = time.perf_counter()
+                deadline = time.monotonic() + 600.0
+                while not queue.drained:
+                    if time.monotonic() > deadline:
+                        sys.exit("bench[scenario_sweep]: drain wedged for "
+                                 f"600s — stats={queue.stats()}")
+                    time.sleep(0.002)
+                e2e_rate = (s_n + 1) / (time.perf_counter() - t0)
+            finally:
+                worker.stop()
+                wt.join(timeout=30)
+                srv.stop()
+
+        ROOFLINE["scenario_sweep"] = {
+            "panels": s_n, "bars": s_bars,
+            "gen_s_per_panel": round(gen_elapsed / s_n, 6),
+            "panels_per_s": round(s_n / gen_elapsed, 2),
+            "bar_rate": round(s_n * s_bars / gen_elapsed, 1),
+            "digest_deterministic": bool(deterministic),
+            "panel_bytes": len(blobs[0]),
+            "spec_bytes": spec_bytes,
+            "spec_wire_reduction": round(len(blobs[0])
+                                         / max(spec_bytes, 1), 1),
+            "jobs_per_s_e2e": round(e2e_rate, 1)}
+        rates["scenario_sweep"] = s_n / gen_elapsed
+        print(f"bench[scenario_sweep]: {s_n} panels x {s_bars} bars "
+              f"generated at {s_n / gen_elapsed:.1f} panels/s "
+              f"(deterministic={deterministic}), spec {spec_bytes}B vs "
+              f"panel {len(blobs[0])}B, e2e {e2e_rate:.0f} jobs/s",
+              file=sys.stderr)
+
     # --- configs[4]: walk-forward (12 refit windows x grid) ---------------
     if enabled("walkforward"):
         train = n_bars // 2 - 30
@@ -1448,8 +1698,9 @@ def main():
                  "momentum_fused, donchian_fused, donchian_hl_fused, "
                  "keltner_fused, stochastic_fused, vwap_fused, rsi_fused, "
                  "macd_fused, trix_fused, obv_fused, pairs, e2e, e2e_topk, "
-                 "e2e_local, direct_dispatch, queue_machine, walkforward, "
-                 "long_context, roofline_stages")
+                 "e2e_local, e2e_local_tenants, scenario_sweep, "
+                 "direct_dispatch, queue_machine, streaming_append, "
+                 "walkforward, long_context, roofline_stages")
         sys.exit(f"bench: no configs ran — DBX_BENCH_CONFIGS={only} matched "
                  f"nothing (known: {known})")
     # The headline is the north-star config when it ran; otherwise label the

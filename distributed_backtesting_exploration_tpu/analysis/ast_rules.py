@@ -567,11 +567,14 @@ class ObsCardinalityRule:
     Detection is lexical + one assignment hop: a label value that is (or
     is built from — f-strings, concatenation, ``str(...)``/``format``
     wrappers) an identifier matching the unbounded-data vocabulary
-    (``*_id``, ``jid``, ``path``, ``addr``, ``peer``, ``trace`` ...), or
-    a local name assigned from one (``wid = self.worker_id``). Bounded
-    exceptions that are real design decisions (e.g. per-worker gauges
-    whose children are removed on worker exit) carry an inline
-    suppression with the justification.
+    (``*_id``, ``jid``, ``path``, ``addr``, ``peer``, ``trace``,
+    ``tenant`` ...), or a local name assigned from one
+    (``wid = self.worker_id``). Values routed through a SANCTIONED
+    bounded-map constructor (``tenant_bucket(...)`` — sched.tenancy's
+    first-N-then-"other" label map) are bounded by construction and not
+    flagged. Bounded exceptions that are real design decisions (e.g.
+    per-worker gauges whose children are removed on worker exit) carry
+    an inline suppression with the justification.
     """
 
     name = "obs-cardinality"
@@ -580,10 +583,16 @@ class ObsCardinalityRule:
     _METRIC_CALLS = {"counter", "gauge", "histogram", "gauge_fn"}
     # Non-label kwargs of the registry constructors.
     _SKIP_KWARGS = {"help", "buckets", "fn"}
+    # Calls whose RESULT is a bounded label by construction: the tenant
+    # bucket map caps distinct values at DBX_TENANT_LABEL_MAX + "other",
+    # so feeding it unbounded tenant ids is the sanctioned pattern (the
+    # reason per-tenant obs can exist under this rule at all).
+    _SANCTIONED_CALLS = {"tenant_bucket"}
     _UNBOUNDED = re.compile(
         r"(?:^|_)(?:id|ids|jid|uid|uuid|guid|key|token|path|paths|file|"
         r"filename|dir|addr|address|peer|host|hostname|port|url|uri|"
-        r"target|trace|span|digest|digests|blake2b|checksum|hash)(?:$|_)")
+        r"target|trace|span|digest|digests|blake2b|checksum|hash|"
+        r"tenant|tenants)(?:$|_)")
 
     def check(self, ctx: LintContext) -> list[Finding]:
         out: list[Finding] = []
@@ -663,6 +672,10 @@ class ObsCardinalityRule:
             return (cls._suspicious(expr.left, assigns, depth)
                     or cls._suspicious(expr.right, assigns, depth))
         if isinstance(expr, ast.Call):
+            # A sanctioned bounded-map constructor launders unbounded
+            # input into a bounded label set — clean regardless of args.
+            if _terminal_name(expr.func) in cls._SANCTIONED_CALLS:
+                return None
             # str(x), "{}".format(x), "|".join(xs): judge the arguments.
             for a in list(expr.args) + [k.value for k in expr.keywords]:
                 hit = cls._suspicious(a, assigns, depth)

@@ -47,6 +47,7 @@ from . import service, wire
 from .journal import Journal
 from .. import obs
 from ..runtime import _core as native_core
+from ..sched import DEFAULT_TENANT, WfqScheduler, tenant_bucket
 from ..utils import data as data_mod
 
 log = logging.getLogger("dbx.dispatcher")
@@ -114,6 +115,17 @@ class JobRecord:
     # deferred this job hoping the base-holding worker polls next. One
     # deferral max — then any worker serves it (full reprice fallback).
     affinity_skips: int = 0
+    # Multi-tenant serving (proto JobSpec.tenant_id): the weighted-fair-
+    # queueing identity. proto3's default empty string — and a journal
+    # record without the key — map to the `default` tenant, so legacy
+    # clients and pre-tenancy journals keep exactly their old (FIFO)
+    # behavior. Journaled so replay rebuilds per-tenant backlogs.
+    tenant: str = DEFAULT_TENANT
+    # Digest-seeded scenario synthesis (proto ScenarioSpec): when set,
+    # this job's panel is a pure function of (scenario["base"] digest,
+    # generator params) and materializes through the panel store like a
+    # file-backed payload — the record itself stays payload-free.
+    scenario: dict | None = None
 
     @property
     def combos(self) -> int:
@@ -154,6 +166,13 @@ class JobRecord:
             # only the O(1) linkage.
             rec["apdig"] = self.append_parent
             rec["abase"] = self.append_base_len
+        if self.tenant != DEFAULT_TENANT:
+            # Default-tenant records stay slim (and byte-identical to
+            # pre-tenancy journals); compaction drops only payload keys,
+            # so the tenant survives onto slim terminal records too.
+            rec["tenant"] = self.tenant
+        if self.scenario is not None:
+            rec["scn"] = self.scenario
         return rec
 
     @staticmethod
@@ -178,7 +197,9 @@ class JobRecord:
             panel_digest=str(rec.get("pdig", "")),
             panel_digest2=str(rec.get("pdig2", "")),
             append_parent=str(rec.get("apdig", "")),
-            append_base_len=int(rec.get("abase", 0)))
+            append_base_len=int(rec.get("abase", 0)),
+            tenant=str(rec.get("tenant", "")) or DEFAULT_TENANT,
+            scenario=rec.get("scn"))
 
 
 @dataclasses.dataclass
@@ -423,10 +444,42 @@ class JobQueue:
         # backlog). Journaled-pending either way, so a crash loses
         # nothing.
         self._affinity_held: list[str] = []
+        # Weighted-fair-queueing index (sched.wfq): EVERY pending job is
+        # parked in a per-tenant lane, held OUT of the state machine's
+        # FIFO under the same discipline as _affinity_held — enqueue
+        # pushes through the state machine (register + FIFO) and
+        # immediately drains the FIFO into the lanes under the same
+        # lock, so the FIFO is empty between public calls and the WFQ
+        # pick alone decides dispatch order. `drained`/stats fold the
+        # parked count back in, so the accounting stays exact. Weights/
+        # quotas read from DBX_TENANT_WEIGHTS / DBX_TENANT_QUOTA here
+        # (one scheduler per queue, lazily — never at import).
+        self._sched = WfqScheduler()
+        # Scenario memo: (base digest, canonical params) -> generated
+        # panel digest, so N jobs sharing one scenario spec regenerate
+        # once, and re-materialization after eviction skips straight to
+        # a store probe. Bounded LRU — specs are wire-controlled input,
+        # and nothing may grow per spec ever seen; an evicted memo entry
+        # merely costs one regeneration (same digest by construction).
+        self._scenario_digests: collections.OrderedDict = \
+            collections.OrderedDict()
+        # Per-spec in-flight generation guard: concurrent takes of
+        # scenario jobs sharing one spec must not each run the
+        # generator (the gRPC pool could burn 16x duplicate work per
+        # spec); losers wait for the winner's event and re-probe.
+        self._scn_inflight: dict[tuple[str, str], threading.Event] = {}
+        # Per-thread scenario resolution chain (scenario-of-scenario
+        # bases are legal; a corrupted spec graph must degrade loudly,
+        # not recurse forever).
+        self._scn_tl = threading.local()
 
     # Native substrate cap (cpp/dbx_core.h DBX_JOBQ_MAX_ID); enforced at
     # intake on BOTH substrates so behavior cannot diverge at the edge.
     MAX_ID_BYTES = 511
+
+    # Scenario spec -> digest memo bound (entries are ~150 B; eviction
+    # costs one deterministic regeneration, never a different digest).
+    MAX_SCENARIO_MEMO = 4096
 
     # -- intake ------------------------------------------------------------
 
@@ -456,6 +509,10 @@ class JobQueue:
         # enqueue_ts is re-stamped per process (see JobRecord).
         now = time.time()
         for rec in recs:
+            if not rec.tenant:
+                # Legacy intake (empty tenant anywhere) normalizes HERE,
+                # before the journal append — records and lanes agree.
+                rec.tenant = DEFAULT_TENANT
             if not rec.trace_id:
                 rec.trace_id = obs.new_trace_id()
             if not rec.enqueue_ts:
@@ -491,6 +548,13 @@ class JobQueue:
                     self._digest_jobs[rec.panel_digest2] = rec.id
             self._state.enqueue_n([rec.id for rec in recs],
                                   [float(rec.combos) for rec in recs])
+            # Drain the batch straight out of the state FIFO into the
+            # per-tenant WFQ lanes (same lock, so the FIFO is never
+            # observably non-empty): the state machine keeps owning
+            # register/lease/completion, the lanes own dispatch ORDER.
+            for jid in self._state.take_begin_n(len(recs)):
+                r = self._records[jid]
+                self._sched.push(jid, r.tenant, float(r.combos))
 
     def restore(self, journal_path: str) -> int:
         """Replay a journal; re-enqueue pending jobs. Returns count restored.
@@ -607,7 +671,10 @@ class JobQueue:
                         # per-iteration accounting below re-counts every
                         # id in `jids`, so release the held count here.
                         self._in_take -= k
-                jids += self._state.take_begin_n(n - len(out) - len(jids))
+                # The WFQ pick replaces the FIFO pop: lowest virtual
+                # start tag across tenant lanes, quota-demoted tenants
+                # behind everyone else (sched.wfq).
+                jids += self._sched.pick(n - len(out) - len(jids))
                 if not jids:
                     break
                 # A popped id with no record is a state/record desync
@@ -617,6 +684,7 @@ class JobQueue:
                 desynced = [j for j in jids if j not in self._records]
                 for j in desynced:
                     self._state.fail(j)
+                    self._sched.release(j)
                 jids = [j for j in jids if j not in desynced]
                 recs = [self._records[j] for j in jids]
                 n_deferred0 = len(deferred)
@@ -660,7 +728,8 @@ class JobQueue:
                             # and is journaled below, so restarts keep the
                             # address stable.
                             payload, d = self._materialize(
-                                stored.panel_digest, stored.path)
+                                stored.panel_digest, stored.path,
+                                scenario=stored.scenario)
                             if d != stored.panel_digest:
                                 stored.panel_digest = d
                                 stamped.append((jid, stored))
@@ -694,6 +763,17 @@ class JobQueue:
                     committed = self._state.take_commit_n(
                         [jid for jid, _, _ in good], worker_id,
                         self.lease_s)
+                    # The quota charge landed at PICK (so concurrent
+                    # takes can't both read a stale zero); here it is
+                    # confirmed for leased ids and released for ids
+                    # that fell out (completed mid-take — complete()
+                    # already released, release is idempotent).
+                    for ok, (jid, r, _) in zip(committed, good):
+                        if ok:
+                            self._sched.on_lease(jid, r.tenant,
+                                                 float(r.combos))
+                        else:
+                            self._sched.release(jid)
                     # Every triaged id is resolved — including a failed-
                     # triage id whose fail() returns False below because
                     # a completion landed mid-take: that job is DONE, and
@@ -701,7 +781,10 @@ class JobQueue:
                     resolved = {jid for jid, _, _ in good}
                     resolved.update(jid for jid, _, _ in failed)
                     # Unreadable payloads fail under the same lock (the
-                    # per-id re-check drops jobs completed mid-take).
+                    # per-id re-check drops jobs completed mid-take);
+                    # either way the pick-time quota charge releases.
+                    for jid, _, _ in failed:
+                        self._sched.release(jid)
                     failed = [(jid, path, e) for jid, path, e in failed
                               if self._state.fail(jid)]
                 for jid, path, e in failed:
@@ -727,22 +810,27 @@ class JobQueue:
                 # lease expiry — while drained() flips True. Push the
                 # unresolved ids back to pending before propagating.
                 with self._lock:
-                    for jid in jids:
-                        if jid not in resolved:
-                            self._state.push_pending(jid)
+                    unresolved = [j for j in jids if j not in resolved]
+                    for jid in unresolved:
+                        self._sched.release(jid)
+                    self._sched.requeue_front([
+                        (jid, self._records[jid].tenant,
+                         float(self._records[jid].combos))
+                        for jid in unresolved])
                 raise
             finally:
                 with self._lock:
                     self._in_take -= len(jids)
         return out
 
-    def _materialize(self, digest: str, path: str | None) -> tuple[bytes,
-                                                                   str]:
+    def _materialize(self, digest: str, path: str | None,
+                     scenario: dict | None = None) -> tuple[bytes, str]:
         """One leg's payload bytes + content digest, blob store first.
 
-        Only reads (and CSV/Parquet-transcodes) ``path`` when the store
-        cannot serve ``digest`` — the second and every later take of a hot
-        panel, and every requeue/retry, never touch disk again. The
+        Only reads (and CSV/Parquet-transcodes) ``path`` — or regenerates
+        a ``scenario`` panel — when the store cannot serve ``digest``:
+        the second and every later take of a hot panel, and every
+        requeue/retry, never touch disk (or the generator) again. The
         returned digest is always the digest OF THE RETURNED BYTES (a file
         whose content changed between materializations re-addresses; the
         caller re-stamps and journals)."""
@@ -751,6 +839,11 @@ class JobQueue:
             if blob is not None:
                 return blob, digest
         if path is None:
+            if scenario is not None:
+                # Digest-seeded synthesis: the panel is a pure function
+                # of (base digest, params) — regeneration under the same
+                # spec re-derives the same bytes, hence the same address.
+                return self._scenario_payload(scenario, digest)
             if digest:
                 # Streaming append jobs carry no payload source of their
                 # own: the extended panel rebuilds from the delta chain.
@@ -760,6 +853,83 @@ class JobQueue:
             raise ValueError("job has neither payload nor path")
         blob = _read_payload(path)
         return blob, self.panel_store.put(blob)
+
+    def _scenario_payload(self, scn: dict,
+                          digest_hint: str = "") -> tuple[bytes, str]:
+        """Materialize a scenario job's panel: memo/store first, else
+        resolve the base panel (any payload source, incl. the append
+        chain and nested scenario specs) and run the generator. Raises
+        ``ValueError`` when the base is unservable or the spec invalid —
+        the take() triage then fails the ONE job loudly, exactly like an
+        unreadable file."""
+        from .. import scenarios as scenarios_mod
+
+        params = scenarios_mod.ScenarioParams.from_dict(scn)
+        base_digest = str(scn.get("base", ""))
+        key = (base_digest, params.canonical())
+        # Cycle check BEFORE the single-flight gate: a corrupt
+        # self-referential spec chain re-enters this method on the same
+        # thread — it must raise loudly here, not wait on its own event.
+        if base_digest in getattr(self._scn_tl, "seen", ()):
+            raise ValueError(
+                f"scenario base chain cycles at {base_digest[:16]}")
+        while True:
+            with self._lock:
+                digest = self._scenario_digests.get(key, "") or digest_hint
+                if key in self._scenario_digests:
+                    self._scenario_digests.move_to_end(key)
+            if digest:
+                blob = self.panel_store.get(digest)
+                if blob is not None:
+                    return blob, digest
+            # Single-flight per spec: the first thread generates, racers
+            # wait on its event and re-probe (a failed/evicted result
+            # makes the waiter take over — never a hang; spec references
+            # form a DAG, so cross-thread waits cannot cycle).
+            with self._lock:
+                ev = self._scn_inflight.get(key)
+                if ev is None:
+                    ev = self._scn_inflight[key] = threading.Event()
+                    break
+            ev.wait(timeout=120.0)
+        try:
+            return self._scenario_generate(scn, key, params, base_digest)
+        finally:
+            with self._lock:
+                self._scn_inflight.pop(key, None)
+            ev.set()
+
+    def _scenario_generate(self, scn: dict, key, params,
+                           base_digest: str) -> tuple[bytes, str]:
+        """The generation half of :meth:`_scenario_payload` (runs as the
+        per-spec single-flight winner)."""
+        from .. import scenarios as scenarios_mod
+
+        seen = getattr(self._scn_tl, "seen", None)
+        if seen is None:
+            seen = self._scn_tl.seen = set()
+        if base_digest in seen:
+            raise ValueError(
+                f"scenario base chain cycles at {base_digest[:16]}")
+        seen.add(base_digest)
+        try:
+            base = self._payload_from_source(base_digest)
+            if base is None:
+                base = self._splice_from_chain(base_digest)
+            if base is None:
+                raise ValueError(
+                    f"scenario base {base_digest[:16]} not servable "
+                    "(store evicted and no job carries its source)")
+        finally:
+            seen.discard(base_digest)
+        blob = scenarios_mod.scenario_panel_bytes(base, params)
+        d = self.panel_store.put(blob)
+        with self._lock:
+            self._scenario_digests[key] = d
+            self._scenario_digests.move_to_end(key)
+            while len(self._scenario_digests) > self.MAX_SCENARIO_MEMO:
+                self._scenario_digests.popitem(last=False)
+        return blob, d
 
     def _splice_from_chain(self, digest: str) -> bytes | None:
         """Rebuild an extended panel from its journaled append chain:
@@ -846,11 +1016,21 @@ class JobQueue:
                     return None   # source changed under the address
                 self.panel_store.put(blob, digest)
                 return blob
+        if rec.scenario is not None and rec.panel_digest == digest:
+            # Evicted scenario panel: re-derive it from the spec (pure
+            # function of base digest + params — the regenerated bytes
+            # carry the SAME address, verified before serving).
+            try:
+                blob, d = self._scenario_payload(rec.scenario, digest)
+            except ValueError:
+                return None
+            return blob if d == digest else None
         return None
 
     def append_bars(self, parent_digest: str, base_len: int, delta: bytes,
                     *, strategy: str, grid, cost: float = 0.0,
-                    periods_per_year: int = 252
+                    periods_per_year: int = 252,
+                    tenant: str = DEFAULT_TENANT
                     ) -> tuple[JobRecord | None, str, str, int]:
         """Streaming live-bar ingest (the AppendBars RPC's queue half):
         splice ``delta`` onto the stored base panel, journal the chain
@@ -906,7 +1086,8 @@ class JobQueue:
             id=str(uuid.uuid4()), strategy=strategy, grid=grid,
             cost=float(cost), periods_per_year=int(periods_per_year),
             panel_digest=ndig, append_parent=parent_digest,
-            append_base_len=base_series.n_bars, delta=delta)
+            append_base_len=base_series.n_bars, delta=delta,
+            tenant=tenant or DEFAULT_TENANT)
         self.enqueue(rec)
         return rec, "extended", ndig, new_len
 
@@ -929,8 +1110,25 @@ class JobQueue:
             if outcome != "new":
                 return outcome
             self._completed_ids.add(jid)
+            self._finish_complete(jid)
         self._journal.append("complete", id=jid, worker=worker_id)
         return "new"
+
+    def _finish_complete(self, jid: str) -> None:
+        """Scheduler bookkeeping for a first ("new") completion; caller
+        holds ``self._lock``. A completion for a job still PARKED in a
+        WFQ lane (a late completion that straddled a requeue or restart)
+        leaves the state machine with an orphan tombstone — its FIFO is
+        empty under the lane discipline. Discard the lane entry and
+        drive the state's documented completed-in-the-take-window path
+        (``take_commit`` on a completed id returns False and clears the
+        tombstone) so pending counts and ``drained`` stay exact instead
+        of waiting for the next worker poll to sweep it. The quota
+        charge releases either way (idempotent)."""
+        # dbxlint: disable=lock-discipline -- every caller holds self._lock
+        if self._sched.discard(jid):
+            self._state.take_commit(jid, "wfq", self.lease_s)
+        self._sched.release(jid)
 
     def complete_batch(self, jids: list[str], worker_id: str, *,
                        journal: bool = True) -> list[str]:
@@ -955,6 +1153,7 @@ class JobQueue:
             for jid, outcome in zip(jids, outcomes):
                 if outcome == "new":
                     self._completed_ids.add(jid)
+                    self._finish_complete(jid)
         if journal:
             for jid, outcome in zip(jids, outcomes):
                 if outcome == "new":
@@ -989,6 +1188,7 @@ class JobQueue:
         """Re-queue jobs whose lease deadline passed (front of the queue)."""
         with self._lock:
             jids = self._state.requeue_expired()
+            self._repark_requeued(jids)
             self._restart_queue_wait(jids)
             return jids
 
@@ -996,8 +1196,27 @@ class JobQueue:
         """Re-queue every job leased to a (pruned) worker."""
         with self._lock:
             jids = self._state.requeue_worker(worker_id)
+            self._repark_requeued(jids)
             self._restart_queue_wait(jids)
             return jids
+
+    def _repark_requeued(self, jids: list[str]) -> None:
+        """Move just-requeued ids from the state FIFO (where requeue_*
+        push-fronts them) into their tenants' WFQ lanes, preserving the
+        FIFO's service order at the lane FRONTS — a retried job keeps
+        its requeue-at-front latency class instead of re-waiting behind
+        the tenant's whole backlog. Also releases the quota charge
+        (the lease is gone). Caller holds ``self._lock``; the FIFO is
+        empty outside this window, so the drain pops exactly ``jids``."""
+        if not jids:
+            return
+        for jid in jids:
+            self._sched.release(jid)
+        self._sched.requeue_front([
+            (jid, rec.tenant if rec else DEFAULT_TENANT,
+             float(rec.combos) if rec else 1.0)
+            for jid in self._state.take_begin_n(len(jids))
+            for rec in (self._records.get(jid),)])
 
     def _restart_queue_wait(self, jids: list[str]) -> None:
         # A requeued job re-enters the pending pool NOW: restart its
@@ -1018,7 +1237,10 @@ class JobQueue:
             s = self._state.stats()
             elapsed = max(time.monotonic() - self._t0, 1e-9)
             return {
-                "jobs_pending": s["pending"],
+                # Pending = WFQ-parked jobs (the state FIFO is empty
+                # between calls under the lane discipline; the sum keeps
+                # the count exact through transient windows).
+                "jobs_pending": s["pending"] + self._sched.pending(),
                 "jobs_leased": s["leased"],
                 "jobs_completed": s["completed"],
                 "jobs_requeued": s["requeued"],
@@ -1026,12 +1248,22 @@ class JobQueue:
                 "backtests_per_sec": s["combos_done"] / elapsed,
             }
 
+    def tenant_stats(self) -> dict[str, dict]:
+        """Per-tenant scheduling snapshot (parked backlog, in-flight
+        combo charge, virtual finish, demotions) — the source behind the
+        ``dbx_tenant_queue_jobs{tenant=...}`` gauge family."""
+        with self._lock:
+            return self._sched.stats()
+
     @property
     def drained(self) -> bool:
         with self._lock:
             # _in_take covers jobs popped but not yet leased/failed (payload
-            # read in flight): drained must not flicker True in that window.
-            return self._in_take == 0 and self._state.drained()
+            # read in flight); WFQ-parked jobs are live pending work held
+            # out of the state FIFO: drained must not flicker True while
+            # either is non-zero.
+            return (self._in_take == 0 and self._sched.pending() == 0
+                    and self._state.drained())
 
 
 def _read_payload(path: str) -> bytes:
@@ -1252,6 +1484,19 @@ class Dispatcher(service.DispatcherServicer):
             "dbx_dispatch_payloads_total",
             help="payload legs dispatched, by transport mode",
             mode="delta")
+        # Multi-tenant serving obs (DESIGN.md "Multi-tenant serving"):
+        # per-tenant queue-wait distribution + SLO burn counters, labeled
+        # through the BOUNDED tenant-bucket map (sched.tenancy — the
+        # dbxlint obs-cardinality sanctioned source), riding the existing
+        # /metrics + /stats.json + GetStats obs_json surfaces. The SLO
+        # threshold is read lazily per Dispatcher, never at import.
+        self.tenant_slo_s = float(os.environ.get("DBX_TENANT_SLO_S", 60.0))
+        # Buckets whose per-tenant gauges this dispatcher has emitted: a
+        # fully idle tenant's scheduler state is pruned, so its bucket
+        # vanishes from tenant_stats() — the NEXT scrape must zero the
+        # gauges instead of freezing them at the last live value.
+        # Bounded by the tenant-bucket cap.
+        self._tenant_buckets_emitted: set[str] = set()
         # Thread-local: concurrent GetStats calls on the gRPC pool must
         # each lend their OWN snapshot to the collector, not race on one
         # shared slot.
@@ -1293,6 +1538,38 @@ class Dispatcher(service.DispatcherServicer):
             s["backtests_per_sec"])
         reg.gauge("dbx_workers_alive").set(self.peers.alive())
         reg.gauge("dbx_results_evicted").set(self.results_evicted)
+        # Per-tenant queue depth + quota charge, SUMMED per bucket (the
+        # overflow bucket aggregates every tenant past the label cap —
+        # a set per tenant would leave last-writer-wins garbage there).
+        pend: collections.Counter = collections.Counter()
+        infl: collections.Counter = collections.Counter()
+        demoted: collections.Counter = collections.Counter()
+        for t, ts in self.queue.tenant_stats().items():
+            b = tenant_bucket(t)
+            pend[b] += ts["pending"]
+            infl[b] += ts["inflight_combos"]
+            demoted[b] += ts["demoted"]
+        for b in self._tenant_buckets_emitted - set(pend):
+            # Pruned (fully idle) bucket: zero its gauges rather than
+            # freezing them at the last live reading.
+            pend[b] = 0
+        self._tenant_buckets_emitted |= set(pend)
+        for b in pend:
+            # Own family, NOT extra labels on dbx_queue_jobs: a
+            # PromQL sum over dbx_queue_jobs{pool="pending"} also
+            # matches children with extra labels, so per-tenant series
+            # under the same family would double-count the backlog.
+            reg.gauge("dbx_tenant_queue_jobs",
+                      help="pending jobs by tenant bucket",
+                      tenant=b).set(pend[b])
+            reg.gauge("dbx_tenant_inflight_combos",
+                      help="leased combo charge by tenant bucket "
+                           "(DBX_TENANT_QUOTA's unit)",
+                      tenant=b).set(infl[b])
+            reg.gauge("dbx_tenant_demotions",
+                      help="WFQ pops that pushed this tenant bucket's "
+                           "over-quota head behind other tenants",
+                      tenant=b).set(demoted[b])
         ps = self.queue.panel_store.stats()
         reg.gauge("dbx_panel_store_bytes",
                   help="bytes resident in the content-addressed panel "
@@ -1451,6 +1728,24 @@ class Dispatcher(service.DispatcherServicer):
                     "job.dispatch", t_disp0, now - t_disp0,
                     trace_id=rec.trace_id, job=rec.id,
                     worker=request.worker_id)
+            if rec.enqueue_ts:
+                # Per-tenant fairness instrumentation: queue wait under
+                # the bounded tenant-bucket label + the SLO burn pair
+                # (ok/breach vs DBX_TENANT_SLO_S) — burn rate is
+                # breach/(ok+breach) over any scrape window.
+                tb = tenant_bucket(rec.tenant)
+                wait_s = max(t_disp0 - rec.enqueue_ts, 0.0)
+                self.obs.histogram(
+                    "dbx_tenant_queue_wait_seconds",
+                    help="queue wait (enqueue -> take) by tenant bucket",
+                    tenant=tb).observe(wait_s)
+                self.obs.counter(
+                    "dbx_tenant_slo_queue_wait_total",
+                    help="queue-wait SLO burn by tenant bucket "
+                         "(threshold DBX_TENANT_SLO_S)",
+                    tenant=tb,
+                    outcome=("breach" if wait_s > self.tenant_slo_s
+                             else "ok")).inc()
             payload2 = rec.ohlcv2 or b""
             leg1 = (self._append_leg(delivered, rec, payload)
                     if rec.append_parent else
@@ -1474,7 +1769,17 @@ class Dispatcher(service.DispatcherServicer):
                 panel_bytes_len2=len(payload2),
                 append_parent_digest=rec.append_parent,
                 append_base_len=rec.append_base_len,
-                append_delta=rec.delta or b""))
+                append_delta=rec.delta or b"",
+                tenant_id=rec.tenant,
+                scenario=(pb.ScenarioSpec(
+                    base_digest=str(rec.scenario.get("base", "")),
+                    n_bars=int(rec.scenario.get("n_bars", 0)),
+                    block=int(rec.scenario.get("block", 0)),
+                    regimes=int(rec.scenario.get("regimes", 0)),
+                    vol_scale=float(rec.scenario.get("vol_scale", 0.0)),
+                    shock=float(rec.scenario.get("shock", 0.0)),
+                    seed=int(rec.scenario.get("seed", 0)))
+                    if rec.scenario else None)))
         if taken:
             log.info("dispatched %d jobs to %s", len(taken), request.worker_id)
         return reply
@@ -1664,7 +1969,8 @@ class Dispatcher(service.DispatcherServicer):
             request.panel_digest, int(request.base_len), request.delta,
             strategy=request.job.strategy, grid=grid,
             cost=request.job.cost,
-            periods_per_year=request.job.periods_per_year or 252)
+            periods_per_year=request.job.periods_per_year or 252,
+            tenant=request.job.tenant_id or DEFAULT_TENANT)
         self._c_appends[outcome].inc()
         if rec is None:
             log.warning("AppendBars %s from %s rejected: %s",
@@ -1780,7 +2086,8 @@ def jobs_from_paths(paths, strategy: str, grid, *, cost: float = 0.0,
                     periods_per_year: int = 252, wf_train: int = 0,
                     wf_test: int = 0, wf_metric: str = "", top_k: int = 0,
                     rank_metric: str = "", best_returns: bool = False,
-                    paths2=None) -> list[JobRecord]:
+                    paths2=None,
+                    tenant: str = DEFAULT_TENANT) -> list[JobRecord]:
     """File-backed jobs; two-legged strategies pass ``paths2`` (leg x
     files, positionally matched with ``paths``). Payloads are read at
     dispatch time, so enqueue stays cheap and restarts re-read nothing."""
@@ -1793,15 +2100,69 @@ def jobs_from_paths(paths, strategy: str, grid, *, cost: float = 0.0,
                       path2=p2,
                       wf_train=wf_train, wf_test=wf_test, wf_metric=wf_metric,
                       top_k=top_k, rank_metric=rank_metric,
-                      best_returns=best_returns)
+                      best_returns=best_returns, tenant=tenant)
             for p, p2 in zip(paths, paths2)]
+
+
+def scenario_jobs(base_digest: str, n: int, strategy: str, grid, *,
+                  params: dict | None = None, cost: float = 0.0,
+                  periods_per_year: int = 252,
+                  tenant: str = DEFAULT_TENANT) -> list[JobRecord]:
+    """``n`` digest-seeded scenario-sweep jobs over one real base panel.
+
+    Each job's spec is ``(base_digest, params, seed=i)`` — scenario ``i``
+    of the diversity sweep — and carries NO payload: the panel
+    materializes dispatcher-side through the panel store at first take
+    (``JobQueue._materialize``'s scenario leg) and dispatches like any
+    other content-addressed panel. ``base_digest`` must be servable on
+    this dispatcher (some enqueued job carries the base panel, or the
+    store holds it); an unservable base fails the scenario job loudly at
+    take, never silently.
+
+    ``params`` are :class:`~..scenarios.ScenarioParams` fields
+    (``n_bars``/``block``/``regimes``/``vol_scale``/``shock``; ``seed``
+    is the sweep offset added per job)."""
+    if strategy == "pairs":
+        # Same up-front rejection as every other intake path (--data2,
+        # STREAMABLE_STRATEGIES): a scenario spec generates ONE panel,
+        # so a two-legged job would dispatch with no leg 2 and the
+        # whole sweep would complete loudly empty worker-side.
+        raise ValueError("scenario_jobs supports single-asset "
+                         "strategies only (a spec generates one panel; "
+                         "pairs needs a second leg)")
+    # Normalize to the FULL effective parameter set (generator defaults
+    # applied) before anything is journaled or dispatched: the record,
+    # the journal and the wire ScenarioSpec echo must all describe the
+    # panel that actually generates — a sparse dict echoed with proto
+    # zero-defaults would not re-derive the same digest. Imported
+    # lazily: only processes that create scenario jobs pay the
+    # generator (jax) import.
+    from .. import scenarios as scenarios_mod
+
+    p = dict(params or {})
+    seed0 = int(p.pop("seed", 0))
+    known = {f.name for f in dataclasses.fields(
+        scenarios_mod.ScenarioParams)}
+    unknown = set(p) - known
+    if unknown:
+        raise ValueError(f"unknown scenario params: {sorted(unknown)} "
+                         f"(known: {sorted(known)})")
+    full = scenarios_mod.ScenarioParams.from_dict(p).to_dict()
+    out = []
+    for i in range(n):
+        scn = {"base": base_digest, **full, "seed": seed0 + i}
+        out.append(JobRecord(
+            id=str(uuid.uuid4()), strategy=strategy, grid=grid, cost=cost,
+            periods_per_year=periods_per_year, scenario=scn,
+            tenant=tenant))
+    return out
 
 
 def synthetic_jobs(n: int, n_bars: int, strategy: str, grid, *,
                    cost: float = 0.0, seed: int = 0, wf_train: int = 0,
                    wf_test: int = 0, wf_metric: str = "", top_k: int = 0,
-                   rank_metric: str = "",
-                   best_returns: bool = False) -> list[JobRecord]:
+                   rank_metric: str = "", best_returns: bool = False,
+                   tenant: str = DEFAULT_TENANT) -> list[JobRecord]:
     """Inline synthetic-OHLCV jobs (benchmarks / demos without data files).
 
     ``strategy="pairs"`` jobs carry two legs (``ohlcv`` = y, ``ohlcv2`` = x).
@@ -1821,7 +2182,7 @@ def synthetic_jobs(n: int, n_bars: int, strategy: str, grid, *,
             ohlcv=data_mod.to_wire_bytes(series), ohlcv2=ohlcv2,
             wf_train=wf_train, wf_test=wf_test, wf_metric=wf_metric,
             top_k=top_k, rank_metric=rank_metric,
-            best_returns=best_returns))
+            best_returns=best_returns, tenant=tenant))
     return out
 
 
@@ -1866,6 +2227,10 @@ def make_parser() -> argparse.ArgumentParser:
                          "param rows (0 = ship the full per-combo matrix)")
     ap.add_argument("--rank-metric", default="sharpe",
                     help="ranking metric for --top-k / --best-returns")
+    ap.add_argument("--tenant", default=DEFAULT_TENANT,
+                    help="tenant identity stamped on every enqueued job "
+                         "(weighted fair queueing; weights/quotas via "
+                         "DBX_TENANT_WEIGHTS / DBX_TENANT_QUOTA)")
     ap.add_argument("--best-returns", action="store_true",
                     help="fleet-portfolio mode: workers ship each job's "
                          "best combo (by --rank-metric) plus its net-return "
@@ -1913,6 +2278,7 @@ def build_dispatcher(args) -> Dispatcher:
             log.warning("--wf-test %d ignored: walk-forward mode needs "
                         "--wf-train > 0", args.wf_test)
         wf_kw = dict(wf_train=0, wf_test=0, wf_metric="")
+    wf_kw["tenant"] = args.tenant or DEFAULT_TENANT
     if args.top_k or args.best_returns:
         from ..ops.metrics import Metrics
 

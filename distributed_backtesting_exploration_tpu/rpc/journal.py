@@ -146,10 +146,41 @@ class Journal:
             # slimming a root would orphan the whole chain after restart.
             chain_roots = ({r.get("pdig") for r in state.deltas.values()}
                            - set(state.deltas))
+            # Scenario BASES the same way: a pending scenario job
+            # re-materializes after restart by regenerating from its
+            # base digest (the blob store starts empty), walking
+            # scenario-of-scenario specs down to a payload-carrying
+            # record — slimming that record's inline payload would fail
+            # every pending scenario job at first take.
+            by_digest: dict = {}
+            for r in state.jobs.values():
+                for dkey in ("pdig", "pdig2"):
+                    if r.get(dkey):
+                        by_digest.setdefault(r[dkey], r)
+            scn_roots: set = set()
+            stack = [state.jobs[j].get("scn", {}).get("base")
+                     for j in state.pending if state.jobs[j].get("scn")]
+            seen: set = set()
+            while stack:
+                d = stack.pop()
+                if not d or d in seen:
+                    continue
+                seen.add(d)
+                r = by_digest.get(d)
+                if r is None:
+                    continue
+                if r.get("scn") and r.get("pdig") == d:
+                    stack.append(r["scn"].get("base"))
+                else:
+                    scn_roots.add(d)
+            protected = chain_roots | scn_roots
             for jid, rec in state.jobs.items():
                 if jid in done:
-                    keep = ({"ohlcv_b64"}
-                            if rec.get("pdig") in chain_roots else set())
+                    keep = set()
+                    if rec.get("pdig") in protected:
+                        keep.add("ohlcv_b64")
+                    if rec.get("pdig2") in protected:
+                        keep.add("ohlcv2_b64")
                     rec = {k: v for k, v in rec.items()
                            if k not in Journal._PAYLOAD_KEYS or k in keep}
                 fh.write(json.dumps(rec, separators=(",", ":")) + "\n")

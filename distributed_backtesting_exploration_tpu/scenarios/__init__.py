@@ -1,0 +1,13 @@
+"""Digest-seeded scenario synthesis: on-device synthetic OHLCV panels.
+
+Adversarial load tests and scenario-diversity sweeps (stress regimes, gap
+opens, vol shocks) do not need terabytes of files: a synthetic panel is a
+pure function of ``(base panel_digest, generator params)``, so a scenario
+job ships as a spec and materializes dispatcher-side through the
+content-addressed :class:`~..rpc.panel_store.PanelStore` — the PR-5
+digest-only dispatch then moves it like any other panel, and the worker
+needs zero changes beyond the cache it already has.
+"""
+
+from .synth import (  # noqa: F401
+    ScenarioParams, generate, max_bars, scenario_panel_bytes, scenario_seed)

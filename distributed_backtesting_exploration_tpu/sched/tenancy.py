@@ -1,0 +1,73 @@
+"""Tenant identity + the bounded tenant-bucket metric label map.
+
+Tenant ids are operator-chosen strings and therefore UNBOUNDED runtime
+data from the metric registry's point of view: one gauge child per
+distinct tenant, forever, in every ``/metrics`` scrape and every
+``obs_json`` payload — exactly what dbxlint's obs-cardinality rule
+exists to reject. Per-tenant observability still matters (a starved
+tenant must be visible), so the label value goes through ONE process-
+wide bounded map: the first ``DBX_TENANT_LABEL_MAX`` distinct tenants
+keep their own name as the label, every later tenant shares the
+``other`` bucket. The mapping is sticky for the process lifetime (a
+tenant never changes buckets mid-run — its time series stays one
+series) and the rule recognizes ``tenant_bucket(...)`` as a sanctioned
+label source.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+#: The tenant every legacy client lands in: proto3's default empty
+#: ``JobSpec.tenant_id``, journal records without a ``tenant`` key, and
+#: CLI runs without ``--tenant`` all map here — single-tenant dispatch
+#: order through the WFQ lane is bit-identical to the pre-tenancy FIFO.
+DEFAULT_TENANT = "default"
+
+#: Shared label for every tenant past the bucket cap.
+OVERFLOW_BUCKET = "other"
+
+_DEFAULT_LABEL_MAX = 16
+
+_BUCKET_LOCK = threading.Lock()
+_BUCKETS: dict[str, str] = {}
+
+
+def _label_max() -> int:
+    """Bucket cap, read lazily (import-time capture would pin the knob
+    before tests/operators can set it)."""
+    return int(os.environ.get("DBX_TENANT_LABEL_MAX", _DEFAULT_LABEL_MAX))
+
+
+def tenant_bucket(tenant: str) -> str:
+    """The bounded metric label for ``tenant``.
+
+    First ``DBX_TENANT_LABEL_MAX`` distinct tenants map to themselves,
+    later ones to :data:`OVERFLOW_BUCKET`; assignment is first-contact
+    sticky so a tenant's series never splits. This is THE sanctioned
+    way to put tenant identity on a metric label (dbxlint
+    obs-cardinality treats ``tenant_bucket(...)`` as bounded by
+    construction).
+    """
+    t = tenant or DEFAULT_TENANT
+    with _BUCKET_LOCK:
+        hit = _BUCKETS.get(t)
+        if hit is not None:
+            return hit
+        if len(_BUCKETS) < _label_max():
+            _BUCKETS[t] = t
+            return t
+    # Past the cap nothing is stored: tenant ids are wire-controlled
+    # strings, and one dict entry per distinct id ever seen would be an
+    # unbounded leak in exactly the component built to bound tenant
+    # cardinality. Overflow tenants recompute to the same answer every
+    # call (only a mid-run DBX_TENANT_LABEL_MAX raise could re-home one
+    # — an explicit operator action).
+    return OVERFLOW_BUCKET
+
+
+def reset_tenant_buckets() -> None:
+    """Drop all sticky assignments (tests; a fresh process equivalent)."""
+    with _BUCKET_LOCK:
+        _BUCKETS.clear()

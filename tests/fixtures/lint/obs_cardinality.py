@@ -41,6 +41,21 @@ def record_panel(reg, panel_digest):
     reg.counter("fx_by_kernel_total", kernel=strategy).inc()
 
 
+def record_tenant(reg, tenant_id):
+    from distributed_backtesting_exploration_tpu.sched import tenant_bucket
+
+    # raw tenant identity: unbounded operator-chosen strings (one time
+    # series per tenant, forever) — flagged
+    reg.gauge("fx_tenant_depth", tenant=tenant_id).set(1)
+    # routed through the bounded tenant-bucket map (first N tenants keep
+    # their name, the rest share "other"): sanctioned — NOT flagged
+    reg.gauge("fx_tenant_depth_ok",
+              tenant=tenant_bucket(tenant_id)).set(1)
+    # one-hop alias of a sanctioned call: still bounded — NOT flagged
+    bucket = tenant_bucket(tenant_id)
+    reg.counter("fx_tenant_served_total", tenant=bucket).inc()
+
+
 def suppressed(reg, job_id):
     # dbxlint: disable=obs-cardinality -- demo: suppression carries a why
     reg.counter("fx_sup_total", job=job_id).inc()

@@ -101,6 +101,8 @@ def test_obs_cardinality_flags_unbounded_label_values():
          _fixture_line("obs_cardinality.py", 'site=f"{path}')),
         ("obs-cardinality", "obs_cardinality.py",
          _fixture_line("obs_cardinality.py", 'panel=panel_digest')),
+        ("obs-cardinality", "obs_cardinality.py",
+         _fixture_line("obs_cardinality.py", 'tenant=tenant_id')),
     ]
     alias = findings[0]
     assert "wid = self.worker_id" in alias.message
@@ -114,6 +116,13 @@ def test_obs_cardinality_flags_unbounded_label_values():
     # Digest vocabulary (dispatch-by-digest round): content digests are
     # unbounded; the bounded cache-level label is not.
     assert not any("fx_cache_hits_total" in f.message for f in findings)
+    # Tenant vocabulary (multi-tenant round): a RAW tenant id is
+    # unbounded, but the bounded tenant-bucket map is a sanctioned
+    # label source — both the direct call and its one-hop alias.
+    tb_ok = _fixture_line("obs_cardinality.py", "tenant=tenant_bucket")
+    tb_alias = _fixture_line("obs_cardinality.py", "tenant=bucket")
+    assert tb_ok not in [f.line for f in findings]
+    assert tb_alias not in [f.line for f in findings]
 
 
 def test_obs_cardinality_ignores_splats_and_bounded_loops(tmp_path):

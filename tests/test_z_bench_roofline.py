@@ -179,6 +179,73 @@ def stream_bench():
     return json.loads(buf.getvalue().strip().splitlines()[-1])
 
 
+_TENANT_ENV = {
+    "DBX_BENCH_CPU": "1", "DBX_BENCH_CACHE": "",
+    "DBX_BENCH_CONFIGS": "e2e_local_tenants,scenario_sweep",
+    # Tiny-but-real loopback drains + generator runs — structure smoke,
+    # not performance; the 2x fairness bar is asserted on the real-size
+    # run, not here (tiny samples make p95 noisy).
+    "DBX_BENCH_TENANT_SMALL_JOBS": "6", "DBX_BENCH_TENANT_WHALE_JOBS": "18",
+    "DBX_BENCH_TENANT_WHALE_COMBOS": "16",
+    "DBX_BENCH_SCENARIO_BARS": "192", "DBX_BENCH_SCENARIO_N": "4",
+}
+
+
+@pytest.fixture(scope="module")
+def tenant_bench():
+    """One tiny in-process e2e_local_tenants + scenario_sweep run (loopback
+    gRPC, instant backend, tiny generator shapes), shared by the module."""
+    prior = {k: os.environ.get(k) for k in _TENANT_ENV}
+    os.environ.update(_TENANT_ENV)
+    bench.ROOFLINE.clear()
+    buf = io.StringIO()
+    try:
+        with contextlib.redirect_stdout(buf):
+            bench.main()
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return json.loads(buf.getvalue().strip().splitlines()[-1])
+
+
+def test_tenant_fairness_keys_present(tenant_bench):
+    """The 3-tenant adversarial A/B's acceptance numbers ride these BENCH
+    JSON keys (tenant_p95_queue_wait_{solo,contended} + the ratio) — a
+    renamed key would silently invalidate the next round's measurement."""
+    tb = tenant_bench["roofline"]["e2e_local_tenants"]
+    for key in ("small_jobs", "whale_jobs", "small_combos_per_job",
+                "whale_combos_per_job", "tenant_p95_queue_wait_solo",
+                "tenant_p95_queue_wait_contended", "fairness_ratio",
+                "fairness_ok", "per_tenant_p95_contended",
+                "jobs_per_s_solo", "jobs_per_s_contended"):
+        assert key in tb, key
+    assert tb["tenant_p95_queue_wait_solo"] > 0.0
+    assert tb["tenant_p95_queue_wait_contended"] > 0.0
+    assert tb["jobs_per_s_contended"] > 0.0
+    for t in ("whale", "small_a", "small_b"):
+        assert t in tb["per_tenant_p95_contended"], t
+    assert tenant_bench["configs"]["e2e_local_tenants"] > 0.0
+
+
+def test_scenario_sweep_keys_present(tenant_bench):
+    """Scenario synthesis facts: generator rate, the (digest, params)
+    spec-vs-panel wire columns, e2e dispatcher-materialized drain, and
+    — structurally true at ANY scale — bit-reproducible digests."""
+    sc = tenant_bench["roofline"]["scenario_sweep"]
+    for key in ("panels", "bars", "gen_s_per_panel", "panels_per_s",
+                "bar_rate", "digest_deterministic", "panel_bytes",
+                "spec_bytes", "spec_wire_reduction", "jobs_per_s_e2e"):
+        assert key in sc, key
+    assert sc["digest_deterministic"] is True
+    assert sc["panels_per_s"] > 0.0
+    assert sc["jobs_per_s_e2e"] > 0.0
+    assert sc["spec_bytes"] < sc["panel_bytes"]
+    assert tenant_bench["configs"]["scenario_sweep"] > 0.0
+
+
 def test_streaming_append_keys_present(stream_bench):
     """The streaming A/B's acceptance numbers (append_speedup at the
     headline T=8192/ΔT=16, and the delta-vs-full wire columns) ride
