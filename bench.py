@@ -1693,6 +1693,110 @@ def main():
               f"{rates['long_context'] * lc_bars / 1e6:.1f}M bar-backtests/s",
               file=sys.stderr)
 
+    # --- ragged_paged: mixed-length fleet through the device page pool ----
+    # ROADMAP item 2's acceptance instrument: a log-spaced mixed-length
+    # universe swept through the page tables (fused_paged_sweep — one
+    # launch per page-count class, pad bounded by one page per ticker)
+    # vs the SAME total bar count as one uniform-length dense sweep.
+    # `paged_vs_uniform_ratio` is the <=1.3x acceptance number;
+    # `launches_*`/`pad_bars_*` record what the paged schedule saves over
+    # the dense power-of-two length bucketing (the pre-round-10 grouping
+    # rule, reproduced here from the wire byte-length formula), and
+    # `pool_bytes_per_ticker` the device-residency cost.
+    if enabled("ragged_paged"):
+        from distributed_backtesting_exploration_tpu.rpc.page_pool import (
+            PagePool)
+
+        rp_n = int(os.environ.get("DBX_BENCH_RAGGED_TICKERS", 1024))
+        rp_spread = float(os.environ.get("DBX_BENCH_RAGGED_SPREAD", 8))
+        rp_tmax = n_bars
+        rp_B = fused.resolve_page_bars()
+        rp_lens = np.unique(np.round(np.geomspace(
+            max(rp_tmax / rp_spread, 64), rp_tmax, rp_n)).astype(np.int64),
+            return_inverse=False)
+        # geomspace collapses duplicates at tiny scales; tile back to rp_n.
+        rp_lens = np.sort(np.resize(rp_lens, rp_n))
+        rp_total = int(rp_lens.sum())
+        rp_Tu = max(int(rp_total // rp_n), 64)
+        rgrid = {k: np.asarray(v) for k, v in sweep.product_grid(
+            fast=np.arange(5.0, 13.0, dtype=np.float32),
+            slow=np.arange(30.0, 46.0, 4.0, dtype=np.float32)).items()}
+        rp_P = int(rgrid["fast"].size)
+
+        rp_panel = data.synthetic_ohlcv(rp_n, rp_tmax, seed=11)
+        rp_close = np.asarray(rp_panel.close, np.float32)
+        rp_series = [data.OHLCV(*(np.asarray(f, np.float32)[i, :t]
+                                  for f in rp_panel))
+                     for i, t in enumerate(rp_lens)]
+        rp_pool = PagePool(
+            max_bytes=2 * rp_n * (-(-rp_tmax // rp_B)) * rp_B * 4)
+        prep = rp_pool.prepare([f"rp{i}" for i in range(rp_n)], rp_series,
+                               ("close",))
+        if prep is None:
+            sys.exit("bench[ragged_paged]: page pool rejected the fleet")
+        rp_pool_arr, rp_tables, _ = prep
+        rp_treal = np.asarray(rp_lens, np.int32)
+
+        from types import SimpleNamespace
+
+        def run_paged():
+            m = fused.fused_paged_sweep(
+                "sma_crossover", rp_pool_arr, rp_tables, rp_treal, rgrid,
+                cost=1e-3)
+            return SimpleNamespace(sharpe=m.sharpe)
+
+        def run_uniform():
+            m = fused.fused_sma_sweep(rp_close[:, :rp_Tu], rgrid["fast"],
+                                      rgrid["slow"], cost=1e-3)
+            return SimpleNamespace(sharpe=m.sharpe)
+
+        rp_iters = max(min(iters, 5), 2)
+        rp_warm = max(min(warmup, 2), 1)
+        rate_paged = _measure(run_paged, rp_n * rp_P, iters=rp_iters,
+                              warmup=rp_warm, name="ragged_paged")
+        rate_uni = _measure(run_uniform, rp_n * rp_P, iters=rp_iters,
+                            warmup=rp_warm, name="ragged_paged_uniform")
+        t_paged = rp_n * rp_P / rate_paged
+        t_uni = rp_n * rp_P / rate_uni
+
+        # Dense-bucketing counterfactual (the pre-round-10 grouping rule:
+        # power-of-two buckets on the DBX1 wire byte length, then each
+        # bucket repeat-last padded to its own max).
+        wire_len = 8 + 4 * 5 * rp_lens          # DBX1: magic+T+5 f32[T]
+        buckets: dict = {}
+        for t, wl in zip(rp_lens, wire_len):
+            buckets.setdefault(int(wl).bit_length(), []).append(int(t))
+        pad_dense = sum(max(ts) * len(ts) - sum(ts)
+                        for ts in buckets.values())
+        pages_per = -(-rp_lens // rp_B)
+        pad_paged = int((pages_per * rp_B - rp_lens).sum())
+        launches_paged = int(np.unique(pages_per).size)
+        pool_stats = rp_pool.stats()
+
+        ratio = t_paged / max(t_uni, 1e-9)
+        ROOFLINE["ragged_paged"] = {
+            "tickers": rp_n, "t_max": int(rp_lens.max()),
+            "t_min": int(rp_lens.min()), "total_bars": rp_total,
+            "uniform_bars": rp_Tu, "combos": rp_P,
+            "page_bars": rp_B,
+            "paged_s_per_sweep": round(t_paged, 6),
+            "uniform_s_per_sweep": round(t_uni, 6),
+            "paged_vs_uniform_ratio": round(ratio, 3),
+            "ratio_ok": bool(ratio <= 1.3),
+            "launches_dense": len(buckets),
+            "launches_paged": launches_paged,
+            "pad_bars_dense": int(pad_dense),
+            "pad_bars_paged": pad_paged,
+            "pool_bytes": pool_stats["bytes"],
+            "pool_bytes_per_ticker": round(pool_stats["bytes"] / rp_n, 1),
+        }
+        rates["ragged_paged"] = rate_paged
+        print(f"bench[ragged_paged]: {rp_n} tickers x {rp_P} combos, "
+              f"lengths {int(rp_lens.min())}..{int(rp_lens.max())} "
+              f"(B={rp_B}): paged/uniform {ratio:.2f}x, launches "
+              f"{len(buckets)} dense -> {launches_paged} paged, pad bars "
+              f"{pad_dense} -> {pad_paged}", file=sys.stderr)
+
     if not rates:
         known = ("sma_fused, bollinger_fused, bollinger_touch_fused, "
                  "momentum_fused, donchian_fused, donchian_hl_fused, "
@@ -1700,7 +1804,7 @@ def main():
                  "macd_fused, trix_fused, obv_fused, pairs, e2e, e2e_topk, "
                  "e2e_local, e2e_local_tenants, scenario_sweep, "
                  "direct_dispatch, queue_machine, streaming_append, "
-                 "walkforward, long_context, roofline_stages")
+                 "ragged_paged, walkforward, long_context, roofline_stages")
         sys.exit(f"bench: no configs ran — DBX_BENCH_CONFIGS={only} matched "
                  f"nothing (known: {known})")
     # The headline is the north-star config when it ran; otherwise label the

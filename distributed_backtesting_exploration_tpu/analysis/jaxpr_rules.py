@@ -219,7 +219,48 @@ class KernelHygieneRule:
                 lambda *arrs, _run=run, _g=grid: _run(*arrs, _g, 0.0, 252,
                                                       None),
                 arrays, path=rel, line=line))
+        findings.extend(self._check_paged(ctx, suffix))
         findings.extend(self._check_append_steps(ctx, suffix))
+        return findings
+
+    def _check_paged(self, ctx: LintContext, suffix: str) -> list[Finding]:
+        """The paged execution variants (round 10) are registered kernels
+        too: every ``_FUSED_STRATEGIES`` entry traces its page-table path
+        (gather + repeat-last fix + the family kernel on the assembled
+        block) under the active epilogue substrate, via
+        ``ops.fused.paged_hygiene_probe`` — a tiny pool + ragged
+        two-ticker page table. A registry entry with no paged row or
+        probe template surfaces as a loud finding, so a newly added
+        family can't silently serve dense-only."""
+        from ..ops import fused
+        from ..rpc.compute import JaxSweepBackend
+
+        findings: list[Finding] = []
+        try:
+            src, line = (inspect.getsourcefile(fused.fused_paged_sweep),
+                         inspect.getsourcelines(fused.fused_paged_sweep)[1])
+            rel = os.path.relpath(src, ctx.root)
+        except (OSError, TypeError):
+            rel, line = "ops/fused.py", 0
+        for strategy in sorted(JaxSweepBackend._FUSED_STRATEGIES):
+            label = f"{strategy}.paged{suffix}"
+            try:
+                fn, args = fused.paged_hygiene_probe(strategy)
+            except Exception as e:   # a probe that cannot build is a
+                # finding, never a crashed run. Probe-template gaps are
+                # substrate-independent — report once, on the scan pass
+                # (the _check_registry template-gap discipline).
+                if not suffix:
+                    findings.append(Finding(
+                        self.name, rel, line,
+                        f"kernel `{label}`: paged hygiene probe failed "
+                        f"to build tiny pool/page-table inputs: {e!r} — "
+                        f"extend ops/fused.py _PAGED_FAMILIES/"
+                        f"_PAGED_PROBE_AXES so this kernel's paged path "
+                        f"stays under coverage"))
+                continue
+            findings.extend(check_traced(label, fn, args, path=rel,
+                                         line=line))
         return findings
 
     def _check_append_steps(self, ctx: LintContext,

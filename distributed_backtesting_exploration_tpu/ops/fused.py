@@ -3124,3 +3124,288 @@ def _vwap_grid_setup(window_bytes: bytes, k_bytes: bytes):
     warm = np.ones((1, warm.shape[1]), np.float32)
     warm[0, :P] = 2.0 * window - 1.0
     return windows, oh, k_lanes, _const(warm)
+
+
+# ---------------------------------------------------------------------------
+# Ragged paged panel batching (round 10)
+#
+# A realistic multi-ticker universe holds thousands of symbols with wildly
+# different history lengths; dense batching either splits them into
+# per-length launch groups or pads every panel to the group max. The paged
+# mode stores field data as fixed-size T-pages in a device pool
+# (rpc.page_pool.PagePool) and drives the EXISTING fused kernels through a
+# per-job page table — the paged-KV discipline of PAPERS.md "Ragged Paged
+# Attention" applied to OHLCV:
+#
+# - `_paged_gather` assembles a group's (n, T_run) field block from the
+#   pool with ONE device gather per field (no host restack, no per-panel
+#   h2d), then re-imposes the repeat-last padding discipline beyond each
+#   ticker's real length — so the assembled block is BIT-IDENTICAL to the
+#   dense `_stack_field_ragged` stack and every kernel numerics contract
+#   carries over unchanged (including the carry-scan epilogue threading
+#   across what are now page boundaries: pad bars earn exactly zero, so
+#   the carries freeze at the last real bar regardless of how many pages
+#   ride behind it).
+# - `fused_paged_sweep` bins the group by PAGE COUNT, so each ticker pads
+#   only to its own page boundary — pad work bounded by one page per
+#   ticker instead of (t_max - t_i), and a mixed-length group costs one
+#   launch per page-count class instead of one per power-of-two length
+#   bucket with up-to-2x padding.
+#
+# The pool side (keying, eviction, upload batching) lives in
+# rpc.page_pool; this section owns the kernel-facing schedule and the
+# env knobs (`DBX_PAGE_BARS`, `DBX_PAGED`).
+# ---------------------------------------------------------------------------
+
+_PAGE_BARS_DEFAULT = 512
+
+
+def paged_enabled() -> bool:
+    """Kill switch for the paged execution path (``DBX_PAGED=0`` routes
+    every group through the dense stacks; default on). Read lazily per
+    backend construction — never at import time."""
+    return os.environ.get("DBX_PAGED", "1") != "0"
+
+
+def resolve_page_bars() -> int:
+    """Validated ``DBX_PAGE_BARS`` page size (default 512 bars).
+
+    Must be a positive multiple of 8 — pages land on the kernels' f32
+    sublane tiles, so an off-tile page width would misalign every gather.
+    512 balances sharing granularity (an append chain re-uploads at most
+    one boundary page) against per-ticker pad waste (< 1 page) and pool
+    index overhead; see DESIGN.md "Ragged paged panels".
+    """
+    raw = os.environ.get("DBX_PAGE_BARS")
+    if not raw:
+        return _PAGE_BARS_DEFAULT
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"DBX_PAGE_BARS={raw!r} is not an integer (expected a "
+            "positive multiple of 8)") from None
+    if v < 8 or v % 8:
+        raise ValueError(
+            f"DBX_PAGE_BARS={v} is unusable: pages must be a positive "
+            "multiple of 8 bars (the f32 sublane tile)")
+    return v
+
+
+@functools.partial(jax.jit, static_argnames=("T_run",))
+def _paged_gather(pool, table, t_real, *, T_run: int):
+    """Assemble an ``(n, T_run)`` field block from the page pool.
+
+    ``pool`` is the ``(slots, page_bars)`` f32 device pool, ``table`` the
+    ``(n, max_pages)`` int32 slot table, ``t_real`` the per-ticker real
+    bar counts. One gather concatenates each row's pages; the trailing
+    select re-imposes the repeat-last padding discipline (bars at
+    ``t >= t_real`` replay bar ``t_real - 1``) so the result is
+    bit-identical to the dense repeat-last stack no matter what the
+    padded table entries point at — table values beyond a ticker's last
+    page only need to be in-bounds.
+    """
+    n = table.shape[0]
+    rows = jnp.take(pool, table.reshape(-1), axis=0, mode="clip")
+    rows = rows.reshape(n, -1)[:, :T_run]
+    t_idx = jax.lax.broadcasted_iota(jnp.int32, (n, T_run), 1)
+    tr = t_real.astype(jnp.int32)[:, None]
+    last = jnp.take_along_axis(rows, jnp.maximum(tr - 1, 0), axis=1)
+    return jnp.where(t_idx < tr, rows, last)
+
+
+# Paged twin of the worker's fused registry: strategy -> (OHLCV fields the
+# kernel consumes, grid axes, wrapper adapter). Every
+# rpc.compute._FUSED_STRATEGIES entry MUST have a row here — dbxlint's
+# kernel-hygiene rule probes the paged path per registry entry
+# (`paged_hygiene_probe`), so a missing row surfaces as a loud finding,
+# never as a silently dense-only family.
+_PAGED_FAMILIES = {
+    "sma_crossover": (
+        ("close",), ("fast", "slow"),
+        lambda a, g, **kw: fused_sma_sweep(a[0], g["fast"], g["slow"],
+                                           **kw)),
+    "bollinger": (
+        ("close",), ("window", "k"),
+        lambda a, g, **kw: fused_bollinger_sweep(a[0], g["window"],
+                                                 g["k"], **kw)),
+    "bollinger_touch": (
+        ("close",), ("window", "k"),
+        lambda a, g, **kw: fused_bollinger_touch_sweep(
+            a[0], g["window"], g["k"], **kw)),
+    "momentum": (
+        ("close",), ("lookback",),
+        lambda a, g, **kw: fused_momentum_sweep(a[0], g["lookback"], **kw)),
+    "donchian": (
+        ("close",), ("window",),
+        lambda a, g, **kw: fused_donchian_sweep(a[0], g["window"], **kw)),
+    "donchian_hl": (
+        ("close", "high", "low"), ("window",),
+        lambda a, g, **kw: fused_donchian_hl_sweep(
+            a[0], a[1], a[2], g["window"], **kw)),
+    "rsi": (
+        ("close",), ("period", "band"),
+        lambda a, g, **kw: fused_rsi_sweep(a[0], g["period"], g["band"],
+                                           **kw)),
+    "stochastic": (
+        ("close", "high", "low"), ("window", "band"),
+        lambda a, g, **kw: fused_stochastic_sweep(
+            a[0], a[1], a[2], g["window"], g["band"], **kw)),
+    "keltner": (
+        ("close", "high", "low"), ("window", "k"),
+        lambda a, g, **kw: fused_keltner_sweep(
+            a[0], a[1], a[2], g["window"], g["k"], **kw)),
+    "macd": (
+        ("close",), ("fast", "slow", "signal"),
+        lambda a, g, **kw: fused_macd_sweep(
+            a[0], g["fast"], g["slow"], g["signal"], **kw)),
+    "trix": (
+        ("close",), ("span", "signal"),
+        lambda a, g, **kw: fused_trix_sweep(a[0], g["span"], g["signal"],
+                                            **kw)),
+    "vwap_reversion": (
+        ("close", "volume"), ("window", "k"),
+        lambda a, g, **kw: fused_vwap_sweep(
+            a[0], a[1], g["window"], g["k"], **kw)),
+    "obv_trend": (
+        ("close", "volume"), ("window",),
+        lambda a, g, **kw: fused_obv_sweep(a[0], a[1], g["window"], **kw)),
+}
+
+
+def paged_supported(strategy: str) -> bool:
+    """True when ``strategy`` has a paged execution row."""
+    return strategy in _PAGED_FAMILIES
+
+
+def paged_fields(strategy: str) -> tuple:
+    """The OHLCV columns the strategy's paged path gathers."""
+    return _PAGED_FAMILIES[strategy][0]
+
+
+def fused_paged_sweep(strategy: str, pool, tables, t_real, grid, *,
+                      cost: float = 0.0, periods_per_year: int = 252,
+                      interpret: bool | None = None,
+                      epilogue: str | None = None) -> Metrics:
+    """Run a (possibly mixed-length) group through the fused kernels from
+    the device page pool.
+
+    ``pool`` is the ``(slots, page_bars)`` f32 pool array; ``tables`` maps
+    each consumed field to a HOST-side ``(n, max_pages)`` int32 slot
+    table (short rows padded with any in-bounds slot — dead under the
+    assembly's repeat-last fix); ``t_real`` the per-ticker real lengths;
+    ``grid`` the flat per-combo axis arrays (:func:`product_grid` order).
+
+    Schedule: the group is binned by page count, each bin assembled by
+    :func:`_paged_gather` at its own max length and swept by the family's
+    fused kernel — so a ticker's pad work is bounded by ONE page and a
+    heterogeneous fleet costs one launch per page-count class. A bin
+    whose lengths are uniform takes the kernels' static-length fast path
+    and is bit-identical to the dense fused sweep; ragged bins follow the
+    documented repeat-last-pad contract (same bits as the dense ragged
+    stack). ``epilogue`` routes the metrics-tail substrate exactly as in
+    the dense wrappers — the carry scan threads across page boundaries
+    like any other T-block boundary.
+    """
+    fam = _PAGED_FAMILIES.get(strategy)
+    if fam is None:
+        raise ValueError(
+            f"strategy {strategy!r} has no paged execution row "
+            f"(known: {sorted(_PAGED_FAMILIES)})")
+    fields, _, call = fam
+    missing = [f for f in fields if f not in tables]
+    if missing:
+        raise ValueError(
+            f"paged sweep for {strategy!r} needs page tables for fields "
+            f"{list(fields)}; missing {missing}")
+    t_real = np.asarray(t_real, np.int32).reshape(-1)
+    n = t_real.shape[0]
+    if n == 0:
+        raise ValueError("paged sweep over an empty group")
+    B = int(pool.shape[1])
+    pages_of = -(-t_real // B)
+    bins: dict = {}
+    for i, p in enumerate(pages_of):
+        bins.setdefault(int(p), []).append(i)
+
+    kw = dict(cost=float(cost), periods_per_year=int(periods_per_year),
+              interpret=interpret, epilogue=epilogue)
+    parts = []
+    order: list = []
+    for p, idx in sorted(bins.items()):
+        t_bin = t_real[idx]
+        T_bin = int(t_bin.max())
+        tr_dev = jnp.asarray(t_bin, jnp.int32)
+        arrays = [
+            _paged_gather(pool,
+                          jnp.asarray(np.asarray(tables[f],
+                                                 np.int32)[idx][:, :p]),
+                          tr_dev, T_run=T_bin)
+            for f in fields]
+        uniform = bool((t_bin == T_bin).all())
+        parts.append(call(arrays, grid,
+                          t_real=None if uniform else t_bin, **kw))
+        order.extend(idx)
+    if len(parts) == 1:
+        return parts[0]
+    inv = np.empty(n, np.int64)
+    inv[np.asarray(order)] = np.arange(n)
+    inv = jnp.asarray(inv)
+    return Metrics(*(jnp.concatenate(cols, axis=0)[inv]
+                     for cols in zip(*parts)))
+
+
+# One representative value per grid axis for the tiny hygiene probe —
+# the paged twin of analysis.jaxpr_rules._AXIS_VALUES (windows small and
+# integral, MACD/TRIX fast < slow, 18 real bars clear every warmup).
+_PAGED_PROBE_AXES = {
+    "fast": [2.0], "slow": [5.0], "window": [3.0], "k": [1.0],
+    "lookback": [2.0], "period": [3.0], "band": [20.0], "signal": [2.0],
+    "span": [2.0],
+}
+_PAGED_PROBE_BARS = (20, 18)    # ragged pair, both 3 pages of 8 bars
+
+
+def paged_hygiene_probe(strategy: str):
+    """``(fn, args)`` tracing the paged path of ``strategy`` over a tiny
+    pool + page table — dbxlint's kernel-hygiene rule feeds this to
+    ``jax.make_jaxpr`` under both epilogue substrates so the paged
+    variants can never silently fall out of lint coverage. Raises for a
+    registry entry with no paged row or probe template (the rule reports
+    that as a loud finding)."""
+    fields, axes, _ = _PAGED_FAMILIES[strategy]
+    B = 8
+    T = max(_PAGED_PROBE_BARS)
+    t_real = np.asarray(_PAGED_PROBE_BARS, np.int32)
+    t = np.arange(1, T + 1, dtype=np.float32)
+    close = 100.0 + np.sin(t) + 0.01 * t
+    by_name = {
+        "close": close, "high": close * 1.01, "low": close * 0.99,
+        "open": close, "volume": np.full(T, 1e4, np.float32),
+    }
+    pool_rows: list[np.ndarray] = []
+    tables: dict = {}
+    n_pages = -(-T // B)
+    for f in fields:
+        tbl = np.zeros((len(t_real), n_pages), np.int32)
+        for i, tr in enumerate(t_real):
+            series = (by_name[f][:tr] * (1.0 + 0.001 * i)).astype(
+                np.float32)
+            pages = [series[s:s + B] for s in range(0, tr, B)]
+            pages = [np.concatenate(
+                [pg, np.full(B - pg.shape[0], pg[-1], np.float32)])
+                if pg.shape[0] < B else pg for pg in pages]
+            slots = list(range(len(pool_rows),
+                               len(pool_rows) + len(pages)))
+            pool_rows.extend(pages)
+            tbl[i, :len(slots)] = slots
+            tbl[i, len(slots):] = slots[-1]
+        tables[f] = tbl
+    pool = np.stack(pool_rows)
+    grid = {a: np.asarray(_PAGED_PROBE_AXES[a], np.float32) for a in axes}
+
+    def fn(pool_arg):
+        return fused_paged_sweep(strategy, pool_arg, tables, t_real, grid,
+                                 interpret=True)
+
+    return fn, (pool,)

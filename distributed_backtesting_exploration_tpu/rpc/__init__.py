@@ -6,7 +6,9 @@ messages live in ``backtesting_pb2``, the hand-written stubs in
 :mod:`.service`. :mod:`.dispatcher` is the server (leased durable queue,
 peer liveness, stats); :mod:`.worker` the polling client; :mod:`.compute`
 the backend seam where the JAX engine plugs in; :mod:`.journal` the
-crash-recovery log; :mod:`.wire` the binary result codec.
+crash-recovery log; :mod:`.wire` the binary result codec;
+:mod:`.page_pool` the device page pool behind ragged paged batching
+(the worker panel cache's third level).
 
 Run them:
 

@@ -47,13 +47,20 @@ class PanelCache:
       skips the wire decode entirely;
     - **device level**: the panel's stacked ``(5, T)`` field block already
       resident on the accelerator — a hit additionally skips the
-      host->device transfer (group stacking then runs device-side).
+      host->device transfer (group stacking then runs device-side);
+    - **page level** (:attr:`pages`, ragged paged batching): field data as
+      fixed-size T-pages in one device pool keyed by page CONTENT — an
+      append-extended panel reuses all of its base's full pages and
+      overlapping histories share pages across digests, where the block
+      level would duplicate the whole ``(5, T)`` history per digest.
 
-    Each level is LRU-bounded by approximate bytes (``DBX_PANEL_CACHE_MB``,
-    default 256 per level). Eviction is not an error: the worker recovers
-    a digest-only miss through the dispatcher's ``FetchPayload`` RPC.
+    The first two levels are LRU-bounded by approximate bytes
+    (``DBX_PANEL_CACHE_MB``, default 256 per level); the page pool by
+    ``DBX_PAGE_POOL_MB``. Eviction is not an error: the worker recovers
+    a digest-only miss through the dispatcher's ``FetchPayload`` RPC, and
+    a pool-rejected group falls back to the dense stack path.
     Thread-safe — the worker's control thread probes/fills the host level
-    while the compute thread serves from both.
+    while the compute thread serves from all levels.
     """
 
     def __init__(self, max_bytes: int | None = None,
@@ -63,6 +70,7 @@ class PanelCache:
         self.max_bytes = (cache_max_bytes() if max_bytes is None
                           else int(max_bytes))
         self._lock = threading.Lock()
+        self._pages = None
         # Both levels ride the ONE eviction/accounting implementation the
         # dispatcher's blob store uses (panel_store.ByteLRU); only the
         # pricing differs (decoded array nbytes vs caller-supplied device
@@ -70,6 +78,7 @@ class PanelCache:
         self._series = ByteLRU(self.max_bytes, self._nbytes)
         self._device = ByteLRU(self.max_bytes)   # put() passes nbytes
         reg = registry or obs.get_registry()
+        self._reg = reg
         self._c_hits = {
             lvl: reg.counter("dbx_panel_cache_hits_total",
                              help="panel-cache hits by level "
@@ -130,13 +139,30 @@ class PanelCache:
             self._device.put(digest, block, nbytes)
             self._publish_bytes()
 
+    @property
+    def pages(self):
+        """Third cache level: the device page pool (ragged paged
+        batching), created lazily so workers that never take the paged
+        route (mesh workers, pre-digest dispatchers, DBX_PAGED=0) do not
+        allocate it."""
+        with self._lock:
+            if self._pages is None:
+                from .page_pool import PagePool
+
+                self._pages = PagePool(registry=self._reg)
+            return self._pages
+
     def stats(self) -> dict:
         with self._lock:
-            return {"host_panels": len(self._series),
-                    "host_bytes": self._series.bytes,
-                    "device_panels": len(self._device),
-                    "device_bytes": self._device.bytes,
-                    "max_bytes": self.max_bytes}
+            out = {"host_panels": len(self._series),
+                   "host_bytes": self._series.bytes,
+                   "device_panels": len(self._device),
+                   "device_bytes": self._device.bytes,
+                   "max_bytes": self.max_bytes}
+            pages = self._pages
+        if pages is not None:
+            out["page_pool"] = pages.stats()
+        return out
 
 
 class Completion:
@@ -370,6 +396,26 @@ class JaxSweepBackend:
         from ..ops import fused as fused_ops
 
         self._fused_ops = fused_ops
+        # Ragged paged panel batching (round 10): fused groups assemble
+        # from the device page pool (PanelCache.pages) through per-job
+        # page tables instead of dense per-length stacks. Meshless fused
+        # workers only — the mesh path needs explicit shardings on its
+        # device_put (same boundary as the device block cache).
+        # DBX_PAGED=0 is the kill switch.
+        self.use_paged = (self.use_fused and self._mesh is None
+                          and fused_ops.paged_enabled())
+        # Padding-waste observability: bars materialized ONLY to batch
+        # (dense = repeat-last stacks padded to the group/bucket max;
+        # paged = in-page pad of newly uploaded partial tail pages —
+        # bounded by one page per ticker).
+        _pad_help = ("panel pad bars materialized for batching, by "
+                     "execution path (dense = stacks padded to the group "
+                     "max; paged = in-page pad of uploaded tail pages)")
+        self._c_pad_bars = {
+            "dense": reg.counter("dbx_pad_bars_total", help=_pad_help,
+                                 path="dense"),
+            "paged": reg.counter("dbx_pad_bars_total", help=_pad_help,
+                                 path="paged")}
         reg.gauge("dbx_fused_substrate_info",
                   help="constant 1; labels carry the live fused-kernel "
                        "substrate defaults (epilogue/table/lanes)",
@@ -905,6 +951,30 @@ class JaxSweepBackend:
                              for k, v in axes.items())),
                 float(job.cost), int(job.periods_per_year or 252))
 
+    def _length_bucket(self, job, grid) -> int:
+        """Power-of-two length bucket for the submit grouping key — or 0
+        (no bucketing) when the paged path will serve the job: the page
+        tables make mixed-length groups first-class (one launch per
+        page-count class, pad bounded by one page per ticker), so
+        splitting them by length would only multiply launches.
+
+        The collapse is gated on actually being paged-SERVABLE, not just
+        paged-capable: the job must carry a digest (page keys memoize per
+        digest; a digestless job would drag its whole merged group onto
+        the dense fallback) and its GRID must pass the length-independent
+        fused gates (axes/integrality/table caps — checked with a 1-bar
+        length so only the VMEM bar cap, which the submit-time cap split
+        handles, is deferred). Jobs that fail any of this keep the
+        power-of-two bucket, so a merged group can only miss the paged
+        route through a pool rejection — and that path re-splits by this
+        same bucket before stacking densely."""
+        if (self.use_paged and job.wf_train == 0 and not job.best_returns
+                and job.strategy != "pairs" and job.panel_digest
+                and job.strategy in self._FUSED_STRATEGIES
+                and self._fused_demotion_reason(job, grid, (1,)) is None):
+            return 0
+        return (len(job.ohlcv) or job.panel_bytes_len).bit_length()
+
     @staticmethod
     def _topk_request_ok(group) -> bool:
         """Validate a group's ``top_k``/``rank_metric`` request up front.
@@ -1176,8 +1246,9 @@ class JaxSweepBackend:
                    tuple(sorted((k, v.tobytes()) for k, v in grid.items())),
                    # Digest-only dispatches ship no bytes; the stamped
                    # panel_bytes_len keeps them in the same length bucket
-                   # as their full-payload twins.
-                   (len(job.ohlcv) or job.panel_bytes_len).bit_length(),
+                   # as their full-payload twins. With the paged path
+                   # live the bucket collapses to 0 — mixed lengths fuse.
+                   self._length_bucket(job, grid),
                    (len(job.ohlcv2)
                     or job.panel_bytes_len2).bit_length(),   # 0 single-asset
                    job.cost, job.periods_per_year,
@@ -1316,84 +1387,94 @@ class JaxSweepBackend:
                         "time-shardable (%s); falling through to the "
                         "generic path", [j.id for j in group],
                         group[0].strategy, t_max_g, ts_reason)
-            h2d_hit = False
             if fused_ok:
-                # Repeat-last padding + per-ticker lengths: the kernels'
-                # padding discipline makes pad bars earn zero return and
-                # hold the final position, and all metric reductions use
-                # each ticker's real length. Only the columns the kernel
-                # consumes (spec.fields — close for most; +high/low or
-                # +volume for the channel/VWAP families) reach the device.
-                spec = self._FUSED_STRATEGIES[group[0].strategy]
-                if len(set(int(x) for x in lengths)) == 1:
-                    arrays, h2d_hit = self._uniform_field_arrays(
-                        group, series, spec.fields)
-                    t_real = None
-                else:
-                    # Column-wise stack (pad_and_stack would also pad the
-                    # unused fields — wasted memcpy on the hot path).
-                    t_max = int(max(lengths))
-                    arrays = [_stack_field_ragged(series, t_max, f)
-                              for f in spec.fields]
-                    t_real = np.asarray(lengths, np.int32)
-                cost = group[0].cost
-                self._observe_substrates(group[0].strategy)
-                if self._mesh is not None:
-                    run = spec.run
+                pending.extend(self._submit_fused_group(
+                    group, series, lengths, axes, grid, t0))
+                continue
+            if (self.use_paged and demotion is not None
+                    and group[0].strategy in self._FUSED_STRATEGIES
+                    and t_max_g > self._FUSED_MAX_BARS):
+                # Over-cap ragged groups route through paging FIRST: the
+                # paged group key no longer buckets by length, so one
+                # oversized panel would otherwise demote every under-cap
+                # member of its merged group to the generic path. Split:
+                # the under-cap subset keeps the fused (paged) route,
+                # only the genuinely-long remainder stays demoted.
+                ok_idx = [i for i, t in enumerate(lengths)
+                          if int(t) <= self._FUSED_MAX_BARS]
+                if ok_idx and len(ok_idx) < len(group) \
+                        and self._fused_demotion_reason(
+                            group[0], axes,
+                            [int(lengths[i]) for i in ok_idx]) is None:
+                    log.info(
+                        "jobs %s (%s) route paged-fused under the VMEM "
+                        "bar cap; %s stay demoted (%s)",
+                        [group[i].id for i in ok_idx], group[0].strategy,
+                        [group[i].id for i in range(len(group))
+                         if i not in set(ok_idx)], demotion)
+                    pending.extend(self._submit_fused_group(
+                        [group[i] for i in ok_idx],
+                        [series[i] for i in ok_idx],
+                        [int(lengths[i]) for i in ok_idx], axes, grid, t0))
+                    rest = [i for i in range(len(group))
+                            if i not in set(ok_idx)]
+                    # The remainder restarts the clock (the timeshard
+                    # split's discipline): its route observation must not
+                    # re-attribute the fused subset's submit wall.
+                    t0 = time.perf_counter()
+                    group = [group[i] for i in rest]
+                    series = [series[i] for i in rest]
+                    lengths = [int(lengths[i]) for i in rest]
+                    t_max_g = int(max(lengths))
+            if (demotion is not None
+                    and group[0].strategy in self._FUSED_STRATEGIES):
+                # A fleet silently dropping to the ~6x-slower generic
+                # path is a throughput bug nobody can see; name the cap.
+                log.warning(
+                    "jobs %s (%s) demoted to the generic path: %s",
+                    [j.id for j in group], group[0].strategy, demotion)
+            if len(set(int(t) for t in lengths)) > 1:
+                # The generic stack pads every series to the group max —
+                # the padding-waste counter must see this path too.
+                self._c_pad_bars["dense"].inc(
+                    int(sum(t_max_g - int(t) for t in lengths)))
+            batch, _, mask = data_mod.pad_and_stack(series)
+            # One chunk-eligibility rule for both branches: the mesh and
+            # single-device backends must agree on memory bounding.
+            P = sweep_mod.grid_size(grid) if grid else 1
+            chunk = (self.param_chunk
+                     if self.param_chunk and P % self.param_chunk == 0
+                     else None)
+            if self._mesh is not None:
+                # The generic path's multi-chip story already exists in
+                # the library: device_put_sweep + sharded_sweep (tickers
+                # over the mesh, grid replicated). The two memory valves
+                # compose: the mesh divides the ticker axis, param_chunk
+                # still bounds the param axis's live set per chip.
+                from ..parallel import sharding as sharding_mod
 
-                    def runner(*a, run=run, grid=grid, cost=cost, ppy=ppy):
-                        return run(*a[:-1], grid, cost, ppy, a[-1])
-
-                    m = self._mesh_call(
-                        ("fused",) + self._group_key(group[0], axes),
-                        runner, arrays, t_real)
-                else:
-                    m = spec.run(*arrays, grid, cost, ppy, t_real)
+                sh_panel, sh_grid, sh_mask, _ = (
+                    sharding_mod.device_put_sweep(
+                        self._mesh, batch,
+                        {k: jnp.asarray(v) for k, v in grid.items()},
+                        bar_mask=mask))
+                m = sharding_mod.sharded_sweep(
+                    self._mesh, sh_panel, strategy, sh_grid,
+                    cost=group[0].cost, bar_mask=sh_mask,
+                    periods_per_year=ppy, param_chunk=chunk)
             else:
-                if (demotion is not None
-                        and group[0].strategy in self._FUSED_STRATEGIES):
-                    # A fleet silently dropping to the ~6x-slower generic
-                    # path is a throughput bug nobody can see; name the cap.
-                    log.warning(
-                        "jobs %s (%s) demoted to the generic path: %s",
-                        [j.id for j in group], group[0].strategy, demotion)
-                batch, _, mask = data_mod.pad_and_stack(series)
-                # One chunk-eligibility rule for both branches: the mesh and
-                # single-device backends must agree on memory bounding.
-                P = sweep_mod.grid_size(grid) if grid else 1
-                chunk = (self.param_chunk
-                         if self.param_chunk and P % self.param_chunk == 0
-                         else None)
-                if self._mesh is not None:
-                    # The generic path's multi-chip story already exists in
-                    # the library: device_put_sweep + sharded_sweep (tickers
-                    # over the mesh, grid replicated). The two memory valves
-                    # compose: the mesh divides the ticker axis, param_chunk
-                    # still bounds the param axis's live set per chip.
-                    from ..parallel import sharding as sharding_mod
-
-                    sh_panel, sh_grid, sh_mask, _ = (
-                        sharding_mod.device_put_sweep(
-                            self._mesh, batch,
-                            {k: jnp.asarray(v) for k, v in grid.items()},
-                            bar_mask=mask))
-                    m = sharding_mod.sharded_sweep(
-                        self._mesh, sh_panel, strategy, sh_grid,
-                        cost=group[0].cost, bar_mask=sh_mask,
-                        periods_per_year=ppy, param_chunk=chunk)
+                panel = type(batch)(*(jnp.asarray(f) for f in batch))
+                kwargs = dict(cost=group[0].cost,
+                              bar_mask=jnp.asarray(mask),
+                              periods_per_year=ppy)
+                if chunk:
+                    m = sweep_mod.chunked_sweep(
+                        panel, strategy, grid, param_chunk=chunk,
+                        **kwargs)
                 else:
-                    panel = type(batch)(*(jnp.asarray(f) for f in batch))
-                    kwargs = dict(cost=group[0].cost,
-                                  bar_mask=jnp.asarray(mask),
-                                  periods_per_year=ppy)
-                    if chunk:
-                        m = sweep_mod.chunked_sweep(
-                            panel, strategy, grid, param_chunk=chunk,
-                            **kwargs)
-                    else:
-                        m = sweep_mod.jit_sweep(panel, strategy, grid,
-                                                **kwargs)
-            route = (("fused" if fused_ok else "generic")
+                    m = sweep_mod.jit_sweep(panel, strategy, grid,
+                                            **kwargs)
+            route = ("generic"
                      + ("_mesh" if self._mesh is not None else ""))
             # Shape in the cold key: jit compiles per (rows, bars), so a
             # new group size IS a compile, not an execute.
@@ -1402,8 +1483,134 @@ class JaxSweepBackend:
                 cold_key=(route, len(group), t_max_g)
                 + self._group_key(group[0], axes), group=group)
             pending.append(self._finish_group(group, m, t0, len(group),
-                                              group[0], h2d_hit=h2d_hit))
+                                              group[0]))
         return pending
+
+    def _try_paged_submit(self, group, series, lengths, grid):
+        """Paged fused submit: resolve the group against the device page
+        pool (uploading only pool-missing pages) and sweep it through
+        the page tables — one launch per page-count class, mixed lengths
+        welcome. Returns ``(metrics, pool_warm)`` where ``pool_warm``
+        means every page was already device-resident (no upload — the
+        paged analogue of the device-block h2d cache hit), or None when
+        the pool rejects the group (working set over the pool bound) —
+        the caller falls back to the dense stacks, degraded never
+        failed. Fields come from the paged registry itself
+        (`fused.paged_fields`) so the tables can never be prepared for a
+        different column set than `fused_paged_sweep` validates
+        against."""
+        prep = self.panel_cache.pages.prepare(
+            [j.panel_digest for j in group], series,
+            self._fused_ops.paged_fields(group[0].strategy))
+        if prep is None:
+            return None
+        pool_arr, tables, info = prep
+        if info["pad_bars_new"]:
+            self._c_pad_bars["paged"].inc(info["pad_bars_new"])
+        job0 = group[0]
+        m = self._fused_ops.fused_paged_sweep(
+            job0.strategy, pool_arr, tables,
+            np.asarray(lengths, np.int32), grid,
+            cost=float(job0.cost),
+            periods_per_year=int(job0.periods_per_year or 252))
+        return m, info["pages_new"] == 0
+
+    def _submit_fused_group(self, group, series, lengths, axes, grid, t0,
+                            *, allow_paged: bool = True):
+        """Fused submit of one (possibly mixed-length) group.
+
+        Paged route first (digest-keyed device pages + page tables —
+        round 10); dense stacks as the fallback for digestless jobs,
+        mesh workers, pool rejections and ``DBX_PAGED=0``. Repeat-last
+        padding + per-ticker lengths either way: pad bars earn zero
+        return and hold the final position, and all metric reductions
+        use each ticker's real length. Only the columns the kernel
+        consumes (spec.fields) reach the device. Returns a LIST of
+        pending entries for :meth:`collect` — one normally; several when
+        a pool-rejected merged mixed-length group re-splits by the
+        power-of-two length bucket so the dense fallback keeps the
+        pre-paging ~2x pad bound instead of padding every ticker to the
+        merged group's max.
+        """
+        job0 = group[0]
+        spec = self._FUSED_STRATEGIES[job0.strategy]
+        ppy = job0.periods_per_year or 252
+        cost = job0.cost
+        h2d_hit = False
+        m = None
+        paged = False
+        ragged = len(set(int(x) for x in lengths)) > 1
+        if (allow_paged and self.use_paged
+                and all(j.panel_digest for j in group)
+                and self._fused_ops.paged_supported(job0.strategy)):
+            paged_out = self._try_paged_submit(group, series, lengths,
+                                               grid)
+            paged = paged_out is not None
+            if paged:
+                # A fully pool-warm group skipped every upload: collect's
+                # d2h span reports it exactly like a device-block h2d hit.
+                m, h2d_hit = paged_out
+            if m is None and ragged:
+                buckets: dict[int, list[int]] = {}
+                for i, j in enumerate(group):
+                    b = (len(j.ohlcv) or j.panel_bytes_len).bit_length()
+                    buckets.setdefault(b, []).append(i)
+                if len(buckets) > 1:
+                    log.warning(
+                        "jobs %s (%s): page pool rejected the merged "
+                        "group; re-splitting into %d dense length "
+                        "buckets", [j.id for j in group], job0.strategy,
+                        len(buckets))
+                    out = []
+                    sub_t0 = t0
+                    for _, idx in sorted(buckets.items()):
+                        out.extend(self._submit_fused_group(
+                            [group[i] for i in idx],
+                            [series[i] for i in idx],
+                            [lengths[i] for i in idx], axes, grid,
+                            sub_t0, allow_paged=False))
+                        # Later buckets restart the clock (the split
+                        # disciplines' rule: one subset's submit wall
+                        # must not re-attribute to the next).
+                        sub_t0 = time.perf_counter()
+                    return out
+        self._observe_substrates(job0.strategy)
+        if m is None:
+            if not ragged:
+                arrays, h2d_hit = self._uniform_field_arrays(
+                    group, series, spec.fields)
+                t_real = None
+            else:
+                # Column-wise stack (pad_and_stack would also pad the
+                # unused fields — wasted memcpy on the hot path).
+                t_max = int(max(lengths))
+                arrays = [_stack_field_ragged(series, t_max, f)
+                          for f in spec.fields]
+                t_real = np.asarray(lengths, np.int32)
+                self._c_pad_bars["dense"].inc(
+                    int(sum(t_max - int(t) for t in lengths)))
+            if self._mesh is not None:
+                run = spec.run
+
+                def runner(*a, run=run, grid=grid, cost=cost, ppy=ppy):
+                    return run(*a[:-1], grid, cost, ppy, a[-1])
+
+                m = self._mesh_call(
+                    ("fused",) + self._group_key(job0, axes),
+                    runner, arrays, t_real)
+            else:
+                m = spec.run(*arrays, grid, cost, ppy, t_real)
+        # paged implies mesh is None, so the suffix is vacuous there.
+        route = (("paged" if paged else "fused")
+                 + ("_mesh" if self._mesh is not None else ""))
+        # Shape in the cold key: jit compiles per (rows, bars), so a new
+        # group size IS a compile, not an execute.
+        self._observe_submit(
+            job0.strategy, route, t0,
+            cold_key=(route, len(group), int(max(lengths)))
+            + self._group_key(job0, axes), group=group)
+        return [self._finish_group(group, m, t0, len(group), job0,
+                                   h2d_hit=h2d_hit)]
 
     def _submit_best_returns_group(self, group, series, lengths, t0):
         """Fleet-portfolio jobs (proto ``JobSpec.best_returns``): sweep the

@@ -1204,18 +1204,26 @@ def _shared_panel_jobs(n, n_bars=96, seed=11, grid=None):
                  for i in range(n)]
 
 
-def test_dispatch_by_digest_cache_hits_and_matching_results(tmp_path):
+def test_dispatch_by_digest_cache_hits_and_matching_results(tmp_path,
+                                                            monkeypatch):
     """The dispatch-by-digest tentpole end to end: jobs sharing ONE panel
     ship the bytes once (every later delivery is digest-only), the
     worker's two-level cache serves the repeats — decode AND h2d skipped,
     asserted via the spans' cache_hit attrs — and the stored results
-    still match the direct sweep."""
+    still match the direct sweep.
+
+    Pinned to the DENSE path (DBX_PAGED=0): with round-10 paging live,
+    fused groups serve from the page pool and never touch the device
+    block level this test asserts — the paged twin of this flow (pool
+    hits, no re-upload on warm re-submit) lives in tests/test_paged.py,
+    and the kill switch gets its integration coverage here."""
     import jax.numpy as jnp
 
     from distributed_backtesting_exploration_tpu import obs
     from distributed_backtesting_exploration_tpu.models import base
     from distributed_backtesting_exploration_tpu.parallel import sweep
 
+    monkeypatch.setenv("DBX_PAGED", "0")
     one, recs = _shared_panel_jobs(4)
     queue = JobQueue()
     for rec in recs:

@@ -246,6 +246,61 @@ def test_scenario_sweep_keys_present(tenant_bench):
     assert tenant_bench["configs"]["scenario_sweep"] > 0.0
 
 
+_RAGGED_ENV = {
+    "DBX_BENCH_CPU": "1", "DBX_BENCH_CACHE": "",
+    "DBX_BENCH_CONFIGS": "ragged_paged",
+    # Tiny-but-real mixed-length fleet through the page pool (CPU
+    # interpret mode) — structure smoke; the 1.3x ratio bar is asserted
+    # on the real-size run, not here.
+    "DBX_BENCH_RAGGED_TICKERS": "6", "DBX_BENCH_RAGGED_SPREAD": "3",
+    "DBX_BENCH_BARS": "96", "DBX_BENCH_ITERS": "1",
+    "DBX_BENCH_WARMUP": "0", "DBX_PAGE_BARS": "16",
+}
+
+
+@pytest.fixture(scope="module")
+def ragged_bench():
+    """One tiny in-process ragged_paged run, shared by the module."""
+    prior = {k: os.environ.get(k) for k in _RAGGED_ENV}
+    os.environ.update(_RAGGED_ENV)
+    bench.ROOFLINE.clear()
+    buf = io.StringIO()
+    try:
+        with contextlib.redirect_stdout(buf):
+            bench.main()
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return json.loads(buf.getvalue().strip().splitlines()[-1])
+
+
+def test_ragged_paged_keys_present(ragged_bench):
+    """The ragged paged A/B's acceptance numbers (paged_vs_uniform_ratio
+    <= 1.3 at real scale, the launch/pad-bar savings and the pool
+    residency cost) ride these BENCH JSON keys — a renamed key would
+    silently invalidate the next round's measurement."""
+    rp = ragged_bench["roofline"]["ragged_paged"]
+    for key in ("tickers", "t_max", "t_min", "total_bars", "uniform_bars",
+                "combos", "page_bars", "paged_s_per_sweep",
+                "uniform_s_per_sweep", "paged_vs_uniform_ratio",
+                "ratio_ok", "launches_dense", "launches_paged",
+                "pad_bars_dense", "pad_bars_paged", "pool_bytes",
+                "pool_bytes_per_ticker"):
+        assert key in rp, key
+    assert rp["paged_s_per_sweep"] > 0.0
+    assert rp["uniform_s_per_sweep"] > 0.0
+    assert rp["paged_vs_uniform_ratio"] > 0.0
+    assert rp["launches_paged"] >= 1
+    # The pad saving is structural (one page per ticker vs up-to-2x
+    # bucket padding), true at any scale with a mixed-length fleet.
+    assert rp["pad_bars_paged"] <= rp["tickers"] * rp["page_bars"]
+    assert rp["pool_bytes"] > 0
+    assert ragged_bench["configs"]["ragged_paged"] > 0.0
+
+
 def test_streaming_append_keys_present(stream_bench):
     """The streaming A/B's acceptance numbers (append_speedup at the
     headline T=8192/ΔT=16, and the delta-vs-full wire columns) ride
