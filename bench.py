@@ -1797,6 +1797,227 @@ def main():
               f"{len(buckets)} dense -> {launches_paged} paged, pad bars "
               f"{pad_dense} -> {pad_paged}", file=sys.stderr)
 
+    # --- autotune: substrate autotuner + fleet-shared compile cache -------
+    # ROADMAP item 4's acceptance instrument, two halves:
+    # (a) per-family A/B of the hardcoded substrate defaults vs the
+    #     autotuner's measured winner for this (shape-bucket, platform) —
+    #     `autotuned_vs_default_speedup{family}` records the MEASURED
+    #     ratio on this box plus the deterministic MODELED twin from the
+    #     op-model prior (the on-chip expectation, recorded like PR 3's
+    #     modeled acceptance when no chip is in the round's loop);
+    # (b) the fleet compile-cache cold-start A/B: worker A pays a cold
+    #     compile into a fresh persistent-cache dir, offers the entries
+    #     over the REAL OfferCompiled RPC, worker B fetches + installs
+    #     into its own fresh dir and re-compiles the same program —
+    #     `second_worker_compile_wall_{cold,warm}_s` and
+    #     `compile_wall_reduction` are the >=5x acceptance numbers.
+    if enabled("autotune"):
+        import contextlib
+        import tempfile
+        import threading
+
+        from distributed_backtesting_exploration_tpu import (
+            tune as tune_mod)
+        from distributed_backtesting_exploration_tpu.rpc import (
+            backtesting_pb2 as at_pb, service as at_service)
+        from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+            Dispatcher, DispatcherServer, JobQueue, PeerRegistry)
+
+        at_bars = int(os.environ.get("DBX_BENCH_AUTOTUNE_BARS", 512))
+        at_tickers = int(os.environ.get("DBX_BENCH_AUTOTUNE_TICKERS", 4))
+        at_reps = max(min(iters, 5), 2)
+        at_panel = data.synthetic_ohlcv(at_tickers, at_bars, seed=21)
+        at_close = np.asarray(at_panel.close, np.float32)
+        at_hi = np.asarray(at_panel.high, np.float32)
+        at_lo = np.asarray(at_panel.low, np.float32)
+
+        fa16 = np.tile(np.arange(3.0, 7.0, dtype=np.float32), 4)
+        sl16 = np.repeat(np.arange(10.0, 18.0, 2.0,
+                                   dtype=np.float32), 4)
+        w16 = np.tile(np.arange(4.0, 8.0, dtype=np.float32), 4)
+        k16 = np.repeat(np.linspace(0.5, 2.0, 4,
+                                    dtype=np.float32), 4)
+        lb16 = np.arange(2.0, 18.0, dtype=np.float32)
+        at_cases = {
+            "sma_crossover": lambda **kw: fused.fused_sma_sweep(
+                at_close, fa16, sl16, cost=1e-3, **kw),
+            "bollinger": lambda **kw: fused.fused_bollinger_sweep(
+                at_close, w16, k16, cost=1e-3, **kw),
+            "momentum": lambda **kw: fused.fused_momentum_sweep(
+                at_close, lb16, cost=1e-3, **kw),
+            "stochastic": lambda **kw: fused.fused_stochastic_sweep(
+                at_close, at_hi, at_lo, w16, k16 * 20 + 40, cost=1e-3,
+                **kw),
+            "obv_trend": lambda **kw: fused.fused_obv_sweep(
+                at_close,
+                np.asarray(at_panel.volume, np.float32), w16 + 2,
+                cost=1e-3, **kw),
+        }
+
+        def at_wall(run, substrates=None):
+            ctx = (fused.tuned_schedule(substrates) if substrates
+                   else contextlib.nullcontext())
+            with ctx:
+                jax.block_until_ready(run().sharpe)   # compile + warm
+                t0 = time.perf_counter()
+                for _ in range(at_reps):
+                    jax.block_until_ready(run().sharpe)
+                return (time.perf_counter() - t0) / at_reps
+
+        prior_mode = os.environ.get("DBX_AUTOTUNE")
+        os.environ["DBX_AUTOTUNE"] = prior_mode or "1"
+        at_sched = tune_mod.ScheduleRegistry()
+        tuner = tune_mod.Autotuner(at_sched)
+        fam_rows = {}
+        speedups, speedups_modeled = {}, {}
+        try:
+            platform = jax.default_backend()
+            for fam, run in at_cases.items():
+                n_combos = 16
+                bucket = tune_mod.shape_bucket(at_bars, n_combos)
+                winner = tuner.tune(
+                    fam, bucket, platform, n_bars=at_bars,
+                    n_combos=n_combos,
+                    measure=lambda subs, run=run: at_wall(run, subs))
+                t_default = at_wall(run)
+                t_tuned = at_wall(run, winner)
+                defaults = fused.substrate_defaults()
+                d_subs = {"epilogue": defaults["epilogue"],
+                          "lanes_cap": defaults["lanes_cap"]}
+                tf = fused._STRATEGY_TABLE_FAMILY.get(fam)
+                if tf:
+                    d_subs[f"table_{tf}"] = defaults[f"table_{tf}"]
+                m_default = tune_mod.modeled_cost(
+                    fam, d_subs, n_bars=at_bars, n_combos=n_combos)
+                m_tuned = tune_mod.modeled_cost(
+                    fam, winner or d_subs, n_bars=at_bars,
+                    n_combos=n_combos)
+                speedups[fam] = round(t_default / max(t_tuned, 1e-9), 3)
+                speedups_modeled[fam] = round(
+                    m_default / max(m_tuned, 1e-9), 3)
+                fam_rows[fam] = {
+                    "bucket": bucket,
+                    "default_s_per_sweep": round(t_default, 6),
+                    "tuned_s_per_sweep": round(t_tuned, 6),
+                    "substrates": winner,
+                }
+                print(f"bench[autotune:{fam}]: default "
+                      f"{t_default * 1e3:.2f} ms -> tuned "
+                      f"{t_tuned * 1e3:.2f} ms ({speedups[fam]:.2f}x, "
+                      f"modeled {speedups_modeled[fam]:.2f}x) "
+                      f"{winner}", file=sys.stderr)
+        finally:
+            if prior_mode is None:
+                os.environ.pop("DBX_AUTOTUNE", None)
+            else:
+                os.environ["DBX_AUTOTUNE"] = prior_mode
+
+        # (b) fleet compile-cache cold-start A/B over real RPCs.
+        depth = int(os.environ.get("DBX_BENCH_AUTOTUNE_COMPILE_DEPTH",
+                                   48))
+
+        def compile_probe():
+            w = jnp.eye(64, dtype=jnp.float32) * 1.001
+
+            @jax.jit
+            def prog(x):
+                acc = x
+                for i in range(depth):
+                    acc = jnp.tanh(acc @ w + np.float32(i) * 1e-3)
+                return acc.sum()
+            t0 = time.perf_counter()
+            jax.block_until_ready(
+                prog(jnp.ones((64, 64), jnp.float32)))
+            return time.perf_counter() - t0
+
+        queue = JobQueue()
+        disp = Dispatcher(queue, PeerRegistry(prune_window_s=30.0))
+        srv = DispatcherServer(disp, bind="localhost:0",
+                               prune_interval_s=5.0).start()
+        import grpc as at_grpc
+
+        prior_cache_dir = getattr(jax.config,
+                                  "jax_compilation_cache_dir", None)
+        tmp_root = tempfile.mkdtemp(prefix="dbx-autotune-cache-")
+        try:
+            channel = at_grpc.insecure_channel(
+                f"localhost:{srv.port}",
+                options=at_service.default_channel_options())
+            stub = at_service.DispatcherStub(channel)
+            # Both "workers" use the SAME canonical cache path — the
+            # runtime default_cache_dir() is the same path on every host,
+            # and jax's persistent-cache key folds the configured dir
+            # path (measured on this jax generation: identical program,
+            # different dir -> different key), so fleet sharing is
+            # defined over the canonical path. Worker B is modeled as a
+            # different host: the dir is WIPED (its own disk is cold)
+            # and repopulated only by the fleet fetch.
+            cache_path = os.path.join(tmp_root, "cache")
+            sync_a = tune_mod.CacheSync(cache_path)
+            tune_mod.configure(cache_path, min_compile_time_s=0.0)
+            jax.clear_caches()
+            wall_cold = compile_probe()
+            offers = sync_a.poll_new()
+            if offers:
+                stub.OfferCompiled(at_pb.CompiledOffer(
+                    worker_id="bench-a",
+                    entries=[at_pb.CompiledEntry(key=k, name=n,
+                                                 payload=p)
+                             for k, n, p in offers]))
+            # Worker B: cold disk, fleet-warmed cache.
+            import shutil
+
+            shutil.rmtree(cache_path, ignore_errors=True)
+            sync_b = tune_mod.CacheSync(cache_path)
+            listing = stub.FetchCompiled(at_pb.CompiledRequest(
+                worker_id="bench-b"))
+            miss = sync_b.missing(listing.known_keys)
+            installed = 0
+            if miss:
+                got = stub.FetchCompiled(at_pb.CompiledRequest(
+                    worker_id="bench-b", keys=miss))
+                installed = sync_b.install(
+                    (e.key, e.name, e.payload) for e in got.entries)
+            tune_mod.configure(cache_path, min_compile_time_s=0.0)
+            jax.clear_caches()
+            wall_warm = compile_probe()
+            channel.close()
+        finally:
+            srv.stop()
+            # Restore the prior cache config (or the canonical default —
+            # leaving jax pointed at the deleted tmp dir would break
+            # persistent-cache writes for the rest of the run).
+            tune_mod.configure(prior_cache_dir
+                               or tune_mod.default_cache_dir())
+            import shutil
+
+            shutil.rmtree(tmp_root, ignore_errors=True)
+
+        reduction = wall_cold / max(wall_warm, 1e-9)
+        store_stats = disp.compile_store.stats()
+        ROOFLINE["autotune"] = {
+            "bars": at_bars, "tickers": at_tickers, "combos": 16,
+            "platform": platform,
+            "autotuned_vs_default_speedup": speedups,
+            "autotuned_vs_default_speedup_modeled": speedups_modeled,
+            "families": fam_rows,
+            "speedup_families_ok": sum(
+                1 for v in speedups.values() if v >= 1.2),
+            "second_worker_compile_wall_cold_s": round(wall_cold, 4),
+            "second_worker_compile_wall_warm_s": round(wall_warm, 4),
+            "compile_wall_reduction": round(reduction, 2),
+            "fleet_entries_offered": len(offers),
+            "fleet_entries_installed": installed,
+            "fleet_store_bytes": store_stats["bytes"],
+        }
+        rates["autotune"] = 1.0 / max(
+            sum(r["tuned_s_per_sweep"] for r in fam_rows.values()), 1e-9)
+        print(f"bench[autotune]: speedups {speedups} (modeled "
+              f"{speedups_modeled}); second-worker compile wall "
+              f"{wall_cold * 1e3:.0f} ms cold -> {wall_warm * 1e3:.0f} ms "
+              f"fleet-warm ({reduction:.1f}x, {installed} entries "
+              f"installed)", file=sys.stderr)
+
     if not rates:
         known = ("sma_fused, bollinger_fused, bollinger_touch_fused, "
                  "momentum_fused, donchian_fused, donchian_hl_fused, "
@@ -1804,7 +2025,8 @@ def main():
                  "macd_fused, trix_fused, obv_fused, pairs, e2e, e2e_topk, "
                  "e2e_local, e2e_local_tenants, scenario_sweep, "
                  "direct_dispatch, queue_machine, streaming_append, "
-                 "ragged_paged, walkforward, long_context, roofline_stages")
+                 "ragged_paged, autotune, walkforward, long_context, "
+                 "roofline_stages")
         sys.exit(f"bench: no configs ran — DBX_BENCH_CONFIGS={only} matched "
                  f"nothing (known: {known})")
     # The headline is the north-star config when it ran; otherwise label the

@@ -586,8 +586,11 @@ class ObsCardinalityRule:
     # Calls whose RESULT is a bounded label by construction: the tenant
     # bucket map caps distinct values at DBX_TENANT_LABEL_MAX + "other",
     # so feeding it unbounded tenant ids is the sanctioned pattern (the
-    # reason per-tenant obs can exist under this rule at all).
-    _SANCTIONED_CALLS = {"tenant_bucket"}
+    # reason per-tenant obs can exist under this rule at all); the
+    # autotuner's shape_bucket clamps (T, P) onto finite power-of-two
+    # rails, so per-shape-bucket obs is bounded the same way (raw dims
+    # would mint one series per shape).
+    _SANCTIONED_CALLS = {"tenant_bucket", "shape_bucket"}
     _UNBOUNDED = re.compile(
         r"(?:^|_)(?:id|ids|jid|uid|uuid|guid|key|token|path|paths|file|"
         r"filename|dir|addr|address|peer|host|hostname|port|url|uri|"

@@ -28,8 +28,10 @@ Numerics match :func:`~..parallel.sweep.run_sweep` +
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import os
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -55,6 +57,74 @@ def _round_up(x: int, m: int) -> int:
 _WIDE_BLOCK_BYTES = 6 * 1024 * 1024
 
 
+# ---------------------------------------------------------------------------
+# Tuned-schedule consultation (tune/ round 11)
+#
+# Every substrate knob below resolves through the SAME four-step chain:
+# explicit call arg > env knob > tuned schedule > hardcoded default. The
+# tuned schedule is the autotuner's persisted winner for the group's
+# (kernel family, shape-bucket) — activated HOST-side by the worker
+# backend around one group submit (`tuned_schedule`, thread-local so
+# concurrent submit threads cannot bleed schedules into each other), or
+# process-wide for knobs that bind at construction time
+# (`set_tuned_defaults`, e.g. the page pool's page size). Placing the
+# schedule BELOW env keeps every existing test and operator override
+# byte-identical: an env knob always beats a tuned schedule. Tuned values
+# are validated like env values but NEVER raise — an invalid entry (a
+# corrupt registry, a newer peer's schema) silently degrades to the
+# hardcoded default, because tuning must never fail a job. All reads stay
+# host-side resolve-helper territory (dbxlint trace-time-env): resolved
+# values thread into the kernels as jit statics exactly like env knobs.
+# ---------------------------------------------------------------------------
+
+_TUNED_TLS = threading.local()
+_TUNED_GLOBAL: dict = {}
+
+
+def set_tuned_defaults(schedule: dict | None) -> None:
+    """Install (or clear, with None) process-wide tuned substrate
+    defaults — the construction-time consultation used for knobs that
+    bind before any group is submitted (page pool sizing). Host-side."""
+    _TUNED_GLOBAL.clear()
+    if schedule:
+        _TUNED_GLOBAL.update({str(k): str(v)
+                              for k, v in schedule.items()})
+
+
+@contextlib.contextmanager
+def tuned_schedule(schedule: dict | None):
+    """Activate a tuned substrate schedule for the calling thread. The
+    worker backend wraps one group submit in this, so every resolver the
+    wrappers call inside sees the group's tuned values (below env)."""
+    prev = getattr(_TUNED_TLS, "schedule", None)
+    _TUNED_TLS.schedule = dict(schedule) if schedule else None
+    try:
+        yield
+    finally:
+        _TUNED_TLS.schedule = prev
+
+
+def _tuned_value(key: str):
+    """The active tuned value for ``key`` (thread-local schedule first,
+    then the process-wide defaults), or None."""
+    sched = getattr(_TUNED_TLS, "schedule", None)
+    if sched is not None and key in sched:
+        return sched[key]
+    return _TUNED_GLOBAL.get(key)
+
+
+def tuned_schedule_active() -> dict:
+    """The merged tuned schedule in effect on this thread (observability:
+    the ``dbx_tuned_substrate_info`` surface reads this, never the
+    registry directly, so it cannot report values the kernels did not
+    serve)."""
+    out = dict(_TUNED_GLOBAL)
+    sched = getattr(_TUNED_TLS, "schedule", None)
+    if sched:
+        out.update(sched)
+    return out
+
+
 # The legal param-block widths (f32 lane multiples the kernels tile by).
 # DBX_LANES_CAP must name one of these — an off-ladder value can satisfy
 # no candidate, and the old fall-through then returned the FULL un-blocked
@@ -75,7 +145,15 @@ def resolve_lanes_cap() -> int:
     """
     raw = os.environ.get("DBX_LANES_CAP")
     if not raw:
-        return 0
+        tuned = _tuned_value("lanes_cap")
+        if tuned is not None:
+            try:
+                tv = int(tuned)
+            except (TypeError, ValueError):
+                tv = -1
+            if tv == 0 or tv in _LANES_LADDER:
+                return tv
+        return 0   # invalid tuned value: degrade to unset, never raise
     try:
         v = int(raw)
     except ValueError:
@@ -238,24 +316,37 @@ _SCAN_BLOCK_DEFAULT = 8          # one f32 sublane tile per block step
 _SCAN_MAX_BLOCKS = 256           # unroll bound: B doubles past this
 
 
-def _resolve_epilogue(epilogue: str | None) -> str:
-    """Shared epilogue-substrate knob: explicit arg > ``DBX_EPILOGUE`` >
-    ``"scan"``. ``"scan"`` (default) is the single-pass blocked carry scan;
-    ``"scan:<B>"`` pins the T-block size to ``B`` sublane rows (multiple of
-    8 — the tuning surface for the on-chip A/B); ``"ladder"`` is the
-    O(T log T) full-T shift-ladder fallback kept for substrate-vs-substrate
-    verification."""
-    if epilogue is None:
-        epilogue = os.environ.get("DBX_EPILOGUE", _EPILOGUE_DEFAULT)
-    if epilogue == "ladder" or epilogue == "scan":
-        return epilogue
-    if epilogue.startswith("scan:"):
+def _epilogue_ok(epilogue: str) -> bool:
+    if epilogue in ("ladder", "scan"):
+        return True
+    if isinstance(epilogue, str) and epilogue.startswith("scan:"):
         try:
             b = int(epilogue[5:])
         except ValueError:
-            b = -1
-        if b >= 8 and b % 8 == 0:
-            return epilogue
+            return False
+        return b >= 8 and b % 8 == 0
+    return False
+
+
+def _resolve_epilogue(epilogue: str | None) -> str:
+    """Shared epilogue-substrate knob: explicit arg > ``DBX_EPILOGUE`` >
+    tuned schedule > ``"scan"``. ``"scan"`` (default) is the single-pass
+    blocked carry scan; ``"scan:<B>"`` pins the T-block size to ``B``
+    sublane rows (multiple of 8 — the tuning surface for the on-chip A/B
+    and the autotuner's epilogue axis); ``"ladder"`` is the O(T log T)
+    full-T shift-ladder fallback kept for substrate-vs-substrate
+    verification. An invalid arg/env value raises (operator error); an
+    invalid TUNED value silently degrades to the default (tuning must
+    never fail a job)."""
+    if epilogue is None:
+        epilogue = os.environ.get("DBX_EPILOGUE")
+        if epilogue is None:
+            tuned = _tuned_value("epilogue")
+            if tuned is not None and _epilogue_ok(tuned):
+                return tuned
+            epilogue = _EPILOGUE_DEFAULT
+    if _epilogue_ok(epilogue):
+        return epilogue
     raise ValueError(
         f"epilogue must be 'scan', 'scan:<B>' (B a positive multiple of 8) "
         f"or 'ladder', got {epilogue!r}")
@@ -2020,13 +2111,19 @@ def _fused_don_call(close, hi_src, lo_src, onehot_w, warm, t_real, *,
         interpret=interpret, lanes_cap=256)
 
 
-def _resolve_table(table: str | None, env_var: str, default: str) -> str:
-    """Shared table-substrate knob: explicit arg > per-family env > default.
+def _resolve_table(table: str | None, env_var: str, default: str,
+                   tuned_key: str | None = None) -> str:
+    """Shared table-substrate knob: explicit arg > per-family env > tuned
+    schedule > default.
 
     ``"inline"`` builds the window table in VMEM scratch inside the kernel;
-    ``"hbm"`` streams the XLA-built table (kept as the A/B twin)."""
+    ``"hbm"`` streams the XLA-built table (kept as the A/B twin). An
+    invalid tuned value degrades to the default instead of raising."""
     if table is None:
-        table = os.environ.get(env_var, default)
+        table = os.environ.get(env_var)
+        if table is None:
+            tuned = _tuned_value(tuned_key) if tuned_key else None
+            table = tuned if tuned in ("inline", "hbm") else default
     if table not in ("inline", "hbm"):
         raise ValueError(f"table must be 'inline' or 'hbm', got {table!r}")
     return table
@@ -2051,7 +2148,8 @@ def _family_table(family: str, table: str | None) -> str:
     literal (env, default) pair) so ``substrate_defaults()`` /
     ``route_substrates()`` — and the observability surfaces built on them —
     can never report a different substrate than the kernel serves."""
-    return _resolve_table(table, *_TABLE_FAMILIES[family])
+    return _resolve_table(table, *_TABLE_FAMILIES[family],
+                          tuned_key=f"table_{family}")
 
 # Strategy name (rpc.compute registry key) -> table family, for the route
 # substrate counters. Strategies without an in-kernel table substrate
@@ -2080,7 +2178,8 @@ def substrate_defaults() -> dict:
     out = {"epilogue": _resolve_epilogue(None),
            "lanes_cap": str(resolve_lanes_cap())}
     for fam, (env_var, default) in _TABLE_FAMILIES.items():
-        out[f"table_{fam}"] = _resolve_table(None, env_var, default)
+        out[f"table_{fam}"] = _resolve_table(None, env_var, default,
+                                             tuned_key=f"table_{fam}")
     return out
 
 
@@ -2089,8 +2188,7 @@ def route_substrates(strategy: str) -> dict:
     run under right now (env-resolved defaults) — the label set for the
     per-group ``dbx_fused_substrate_total`` route counter."""
     fam = _STRATEGY_TABLE_FAMILY.get(strategy)
-    table = ("hbm" if fam is None
-             else _resolve_table(None, *_TABLE_FAMILIES[fam]))
+    table = ("hbm" if fam is None else _family_table(fam, None))
     return {"epilogue": _resolve_epilogue(None), "table": table}
 
 
@@ -3178,6 +3276,14 @@ def resolve_page_bars() -> int:
     """
     raw = os.environ.get("DBX_PAGE_BARS")
     if not raw:
+        tuned = _tuned_value("page_bars")
+        if tuned is not None:
+            try:
+                tv = int(tuned)
+            except (TypeError, ValueError):
+                tv = -1
+            if tv >= 8 and tv % 8 == 0:
+                return tv
         return _PAGE_BARS_DEFAULT
     try:
         v = int(raw)

@@ -3,12 +3,13 @@
 # layer is hand-written in service.py). Regenerated WITHOUT protoc: the
 # environment lacks grpc_tools, so the serialized FileDescriptorProto below
 # was produced by loading the previous descriptor, appending the new fields
-# (JobSpec.tenant_id = 23 — the multi-tenant fair-queueing identity — and
-# JobSpec.scenario = 24 with the new ScenarioSpec message, the
-# digest-seeded scenario-synthesis carrier; previous rounds added the
-# streaming append-bar fields + AppendBars, the content-addressed panel
-# fields + FetchPayload, and the tracing fields the same way) via
-# google.protobuf.descriptor_pb2, and re-serializing.
+# (JobsRequest.schedule_json = 5 / StatsReply.schedule_json = 10 — the
+# substrate-schedule gossip legs — plus the fleet compile-cache messages
+# CompiledRequest/CompiledEntry/CompiledReply/CompiledOffer and the
+# FetchCompiled/OfferCompiled RPCs; previous rounds added the tenant +
+# scenario fields, the streaming append-bar fields + AppendBars, the
+# content-addressed panel fields + FetchPayload, and the tracing fields
+# the same way) via google.protobuf.descriptor_pb2, and re-serializing.
 # backtesting.proto remains the source of truth; keep the two in sync
 # (dbxlint proto-drift checks structurally).
 # source: backtesting.proto
@@ -24,7 +25,7 @@ _sym_db = _symbol_database.Default()
 
 
 
-DESCRIPTOR = _descriptor_pool.Default().AddSerializedFile(b'\n\x11backtesting.proto\x12\x07dbx.rpc"c\n\x0bJobsRequest\x12\x11\n\tworker_id\x18\x01 \x01(\t\x12\r\n\x05chips\x18\x02 \x01(\x05\x12\x15\n\rjobs_per_chip\x18\x03 \x01(\x05\x12\x1b\n\x13accepts_digest_only\x18\x04 \x01(\x08"\x1a\n\x08GridAxis\x12\x0e\n\x06values\x18\x01 \x03(\x02"\xdb\x04\n\x07JobSpec\x12\n\n\x02id\x18\x01 \x01(\t\x12\x10\n\x08strategy\x18\x02 \x01(\t\x12\r\n\x05ohlcv\x18\x03 \x01(\x0c\x12(\n\x04grid\x18\x04 \x03(\x0b2\x1a.dbx.rpc.JobSpec.GridEntry\x12\x0c\n\x04cost\x18\x05 \x01(\x02\x12\x18\n\x10periods_per_year\x18\x06 \x01(\x05\x12\x0e\n\x06ohlcv2\x18\x07 \x01(\x0c\x12\x10\n\x08wf_train\x18\x08 \x01(\x05\x12\x0f\n\x07wf_test\x18\t \x01(\x05\x12\x11\n\twf_metric\x18\n \x01(\t\x12\r\n\x05top_k\x18\x0b \x01(\x05\x12\x13\n\x0brank_metric\x18\x0c \x01(\t\x12\x14\n\x0cbest_returns\x18\r \x01(\x08\x12\x10\n\x08trace_id\x18\x0e \x01(\t\x12\x16\n\x0eparent_span_id\x18\x0f \x01(\t\x12\x14\n\x0cpanel_digest\x18\x10 \x01(\t\x12\x17\n\x0fpanel_bytes_len\x18\x11 \x01(\x03\x12\x15\n\rpanel_digest2\x18\x12 \x01(\t\x12\x18\n\x10panel_bytes_len2\x18\x13 \x01(\x03\x12\x1c\n\x14append_parent_digest\x18\x14 \x01(\t\x12\x17\n\x0fappend_base_len\x18\x15 \x01(\x03\x12\x14\n\x0cappend_delta\x18\x16 \x01(\x0c\x12\x11\n\ttenant_id\x18\x17 \x01(\t\x12\'\n\x08scenario\x18\x18 \x01(\x0b2\x15.dbx.rpc.ScenarioSpec\x1a>\n\tGridEntry\x12\x0b\n\x03key\x18\x01 \x01(\t\x12 \n\x05value\x18\x02 \x01(\x0b2\x11.dbx.rpc.GridAxis:\x028\x01"+\n\tJobsReply\x12\x1e\n\x04jobs\x18\x01 \x03(\x0b2\x10.dbx.rpc.JobSpec"I\n\rStatusRequest\x12\x11\n\tworker_id\x18\x01 \x01(\t\x12%\n\x06status\x18\x02 \x01(\x0e2\x15.dbx.rpc.WorkerStatus"!\n\x03Ack\x12\n\n\x02ok\x18\x01 \x01(\x08\x12\x0e\n\x06detail\x18\x02 \x01(\t"f\n\x0fCompleteRequest\x12\n\n\x02id\x18\x01 \x01(\t\x12\x11\n\tworker_id\x18\x02 \x01(\t\x12\x0f\n\x07metrics\x18\x03 \x01(\x0c\x12\x11\n\telapsed_s\x18\x04 \x01(\x02\x12\x10\n\x08trace_id\x18\x05 \x01(\t"P\n\x0cCompleteItem\x12\n\n\x02id\x18\x01 \x01(\t\x12\x0f\n\x07metrics\x18\x02 \x01(\x0c\x12\x11\n\telapsed_s\x18\x03 \x01(\x02\x12\x10\n\x08trace_id\x18\x04 \x01(\t"H\n\rCompleteBatch\x12\x11\n\tworker_id\x18\x01 \x01(\t\x12$\n\x05items\x18\x02 \x03(\x0b2\x15.dbx.rpc.CompleteItem";\n\x12CompleteBatchReply\x12\x10\n\x08accepted\x18\x01 \x01(\x05\x12\x13\n\x0bunknown_ids\x18\x02 \x03(\t"\x0e\n\x0cStatsRequest"\xdb\x01\n\nStatsReply\x12\x14\n\x0cjobs_pending\x18\x01 \x01(\x03\x12\x13\n\x0bjobs_leased\x18\x02 \x01(\x03\x12\x16\n\x0ejobs_completed\x18\x03 \x01(\x03\x12\x15\n\rjobs_requeued\x18\x04 \x01(\x03\x12\x13\n\x0bjobs_failed\x18\x05 \x01(\x03\x12\x15\n\rworkers_alive\x18\x06 \x01(\x05\x12\x19\n\x11backtests_per_sec\x18\x07 \x01(\x01\x12\x11\n\tsubstrate\x18\x08 \x01(\t\x12\x19\n\x08obs_json\x18\t \x01(\tR\x07obsJson"3\n\x0ePayloadRequest\x12\x11\n\tworker_id\x18\x01 \x01(\t\x12\x0e\n\x06digest\x18\x02 \x01(\t"/\n\x0cPayloadReply\x12\x0e\n\x06digest\x18\x01 \x01(\t\x12\x0f\n\x07payload\x18\x02 \x01(\x0c"x\n\rAppendRequest\x12\x11\n\tworker_id\x18\x01 \x01(\t\x12\x14\n\x0cpanel_digest\x18\x02 \x01(\t\x12\x10\n\x08base_len\x18\x03 \x01(\x03\x12\r\n\x05delta\x18\x04 \x01(\x0c\x12\x1d\n\x03job\x18\x05 \x01(\x0b2\x10.dbx.rpc.JobSpec"`\n\x0bAppendReply\x12\n\n\x02ok\x18\x01 \x01(\x08\x12\x0e\n\x06detail\x18\x02 \x01(\t\x12\x0e\n\x06job_id\x18\x03 \x01(\t\x12\x14\n\x0cpanel_digest\x18\x04 \x01(\t\x12\x0f\n\x07new_len\x18\x05 \x01(\x03"\x83\x01\n\x0cScenarioSpec\x12\x13\n\x0bbase_digest\x18\x01 \x01(\t\x12\x0e\n\x06n_bars\x18\x02 \x01(\x05\x12\r\n\x05block\x18\x03 \x01(\x05\x12\x0f\n\x07regimes\x18\x04 \x01(\x05\x12\x11\n\tvol_scale\x18\x05 \x01(\x02\x12\r\n\x05shock\x18\x06 \x01(\x02\x12\x0c\n\x04seed\x18\x07 \x01(\x03*A\n\x0cWorkerStatus\x12\x16\n\x12WORKER_STATUS_IDLE\x10\x00\x12\x19\n\x15WORKER_STATUS_RUNNING\x10\x012\xa9\x03\n\nDispatcher\x127\n\x0bRequestJobs\x12\x14.dbx.rpc.JobsRequest\x1a\x12.dbx.rpc.JobsReply\x122\n\nSendStatus\x12\x16.dbx.rpc.StatusRequest\x1a\x0c.dbx.rpc.Ack\x125\n\x0bCompleteJob\x12\x18.dbx.rpc.CompleteRequest\x1a\x0c.dbx.rpc.Ack\x12C\n\x0cCompleteJobs\x12\x16.dbx.rpc.CompleteBatch\x1a\x1b.dbx.rpc.CompleteBatchReply\x126\n\x08GetStats\x12\x15.dbx.rpc.StatsRequest\x1a\x13.dbx.rpc.StatsReply\x12>\n\x0cFetchPayload\x12\x17.dbx.rpc.PayloadRequest\x1a\x15.dbx.rpc.PayloadReply\x12:\n\nAppendBars\x12\x16.dbx.rpc.AppendRequest\x1a\x14.dbx.rpc.AppendReplyb\x06proto3')
+DESCRIPTOR = _descriptor_pool.Default().AddSerializedFile(b'\n\x11backtesting.proto\x12\x07dbx.rpc"\x88\x01\n\x0bJobsRequest\x12\x11\n\tworker_id\x18\x01 \x01(\t\x12\r\n\x05chips\x18\x02 \x01(\x05\x12\x15\n\rjobs_per_chip\x18\x03 \x01(\x05\x12\x1b\n\x13accepts_digest_only\x18\x04 \x01(\x08\x12#\n\rschedule_json\x18\x05 \x01(\tR\x0cscheduleJson"\x1a\n\x08GridAxis\x12\x0e\n\x06values\x18\x01 \x03(\x02"\xdb\x04\n\x07JobSpec\x12\n\n\x02id\x18\x01 \x01(\t\x12\x10\n\x08strategy\x18\x02 \x01(\t\x12\r\n\x05ohlcv\x18\x03 \x01(\x0c\x12(\n\x04grid\x18\x04 \x03(\x0b2\x1a.dbx.rpc.JobSpec.GridEntry\x12\x0c\n\x04cost\x18\x05 \x01(\x02\x12\x18\n\x10periods_per_year\x18\x06 \x01(\x05\x12\x0e\n\x06ohlcv2\x18\x07 \x01(\x0c\x12\x10\n\x08wf_train\x18\x08 \x01(\x05\x12\x0f\n\x07wf_test\x18\t \x01(\x05\x12\x11\n\twf_metric\x18\n \x01(\t\x12\r\n\x05top_k\x18\x0b \x01(\x05\x12\x13\n\x0brank_metric\x18\x0c \x01(\t\x12\x14\n\x0cbest_returns\x18\r \x01(\x08\x12\x10\n\x08trace_id\x18\x0e \x01(\t\x12\x16\n\x0eparent_span_id\x18\x0f \x01(\t\x12\x14\n\x0cpanel_digest\x18\x10 \x01(\t\x12\x17\n\x0fpanel_bytes_len\x18\x11 \x01(\x03\x12\x15\n\rpanel_digest2\x18\x12 \x01(\t\x12\x18\n\x10panel_bytes_len2\x18\x13 \x01(\x03\x12\x1c\n\x14append_parent_digest\x18\x14 \x01(\t\x12\x17\n\x0fappend_base_len\x18\x15 \x01(\x03\x12\x14\n\x0cappend_delta\x18\x16 \x01(\x0c\x12\x11\n\ttenant_id\x18\x17 \x01(\t\x12\'\n\x08scenario\x18\x18 \x01(\x0b2\x15.dbx.rpc.ScenarioSpec\x1a>\n\tGridEntry\x12\x0b\n\x03key\x18\x01 \x01(\t\x12 \n\x05value\x18\x02 \x01(\x0b2\x11.dbx.rpc.GridAxis:\x028\x01"+\n\tJobsReply\x12\x1e\n\x04jobs\x18\x01 \x03(\x0b2\x10.dbx.rpc.JobSpec"I\n\rStatusRequest\x12\x11\n\tworker_id\x18\x01 \x01(\t\x12%\n\x06status\x18\x02 \x01(\x0e2\x15.dbx.rpc.WorkerStatus"!\n\x03Ack\x12\n\n\x02ok\x18\x01 \x01(\x08\x12\x0e\n\x06detail\x18\x02 \x01(\t"f\n\x0fCompleteRequest\x12\n\n\x02id\x18\x01 \x01(\t\x12\x11\n\tworker_id\x18\x02 \x01(\t\x12\x0f\n\x07metrics\x18\x03 \x01(\x0c\x12\x11\n\telapsed_s\x18\x04 \x01(\x02\x12\x10\n\x08trace_id\x18\x05 \x01(\t"P\n\x0cCompleteItem\x12\n\n\x02id\x18\x01 \x01(\t\x12\x0f\n\x07metrics\x18\x02 \x01(\x0c\x12\x11\n\telapsed_s\x18\x03 \x01(\x02\x12\x10\n\x08trace_id\x18\x04 \x01(\t"H\n\rCompleteBatch\x12\x11\n\tworker_id\x18\x01 \x01(\t\x12$\n\x05items\x18\x02 \x03(\x0b2\x15.dbx.rpc.CompleteItem";\n\x12CompleteBatchReply\x12\x10\n\x08accepted\x18\x01 \x01(\x05\x12\x13\n\x0bunknown_ids\x18\x02 \x03(\t"\x0e\n\x0cStatsRequest"\x80\x02\n\nStatsReply\x12\x14\n\x0cjobs_pending\x18\x01 \x01(\x03\x12\x13\n\x0bjobs_leased\x18\x02 \x01(\x03\x12\x16\n\x0ejobs_completed\x18\x03 \x01(\x03\x12\x15\n\rjobs_requeued\x18\x04 \x01(\x03\x12\x13\n\x0bjobs_failed\x18\x05 \x01(\x03\x12\x15\n\rworkers_alive\x18\x06 \x01(\x05\x12\x19\n\x11backtests_per_sec\x18\x07 \x01(\x01\x12\x11\n\tsubstrate\x18\x08 \x01(\t\x12\x19\n\x08obs_json\x18\t \x01(\tR\x07obsJson\x12#\n\rschedule_json\x18\n \x01(\tR\x0cscheduleJson"3\n\x0ePayloadRequest\x12\x11\n\tworker_id\x18\x01 \x01(\t\x12\x0e\n\x06digest\x18\x02 \x01(\t"/\n\x0cPayloadReply\x12\x0e\n\x06digest\x18\x01 \x01(\t\x12\x0f\n\x07payload\x18\x02 \x01(\x0c"x\n\rAppendRequest\x12\x11\n\tworker_id\x18\x01 \x01(\t\x12\x14\n\x0cpanel_digest\x18\x02 \x01(\t\x12\x10\n\x08base_len\x18\x03 \x01(\x03\x12\r\n\x05delta\x18\x04 \x01(\x0c\x12\x1d\n\x03job\x18\x05 \x01(\x0b2\x10.dbx.rpc.JobSpec"`\n\x0bAppendReply\x12\n\n\x02ok\x18\x01 \x01(\x08\x12\x0e\n\x06detail\x18\x02 \x01(\t\x12\x0e\n\x06job_id\x18\x03 \x01(\t\x12\x14\n\x0cpanel_digest\x18\x04 \x01(\t\x12\x0f\n\x07new_len\x18\x05 \x01(\x03"\x83\x01\n\x0cScenarioSpec\x12\x13\n\x0bbase_digest\x18\x01 \x01(\t\x12\x0e\n\x06n_bars\x18\x02 \x01(\x05\x12\r\n\x05block\x18\x03 \x01(\x05\x12\x0f\n\x07regimes\x18\x04 \x01(\x05\x12\x11\n\tvol_scale\x18\x05 \x01(\x02\x12\r\n\x05shock\x18\x06 \x01(\x02\x12\x0c\n\x04seed\x18\x07 \x01(\x03"B\n\x0fCompiledRequest\x12\x1b\n\tworker_id\x18\x01 \x01(\tR\x08workerId\x12\x12\n\x04keys\x18\x02 \x03(\tR\x04keys"O\n\rCompiledEntry\x12\x10\n\x03key\x18\x01 \x01(\tR\x03key\x12\x12\n\x04name\x18\x02 \x01(\tR\x04name\x12\x18\n\x07payload\x18\x03 \x01(\x0cR\x07payload"`\n\rCompiledReply\x120\n\x07entries\x18\x01 \x03(\x0b2\x16.dbx.rpc.CompiledEntryR\x07entries\x12\x1d\n\nknown_keys\x18\x02 \x03(\tR\tknownKeys"^\n\rCompiledOffer\x12\x1b\n\tworker_id\x18\x01 \x01(\tR\x08workerId\x120\n\x07entries\x18\x02 \x03(\x0b2\x16.dbx.rpc.CompiledEntryR\x07entries*A\n\x0cWorkerStatus\x12\x16\n\x12WORKER_STATUS_IDLE\x10\x00\x12\x19\n\x15WORKER_STATUS_RUNNING\x10\x012\xa3\x04\n\nDispatcher\x127\n\x0bRequestJobs\x12\x14.dbx.rpc.JobsRequest\x1a\x12.dbx.rpc.JobsReply\x122\n\nSendStatus\x12\x16.dbx.rpc.StatusRequest\x1a\x0c.dbx.rpc.Ack\x125\n\x0bCompleteJob\x12\x18.dbx.rpc.CompleteRequest\x1a\x0c.dbx.rpc.Ack\x12C\n\x0cCompleteJobs\x12\x16.dbx.rpc.CompleteBatch\x1a\x1b.dbx.rpc.CompleteBatchReply\x126\n\x08GetStats\x12\x15.dbx.rpc.StatsRequest\x1a\x13.dbx.rpc.StatsReply\x12>\n\x0cFetchPayload\x12\x17.dbx.rpc.PayloadRequest\x1a\x15.dbx.rpc.PayloadReply\x12:\n\nAppendBars\x12\x16.dbx.rpc.AppendRequest\x1a\x14.dbx.rpc.AppendReply\x12A\n\rFetchCompiled\x12\x18.dbx.rpc.CompiledRequest\x1a\x16.dbx.rpc.CompiledReply\x125\n\rOfferCompiled\x12\x16.dbx.rpc.CompiledOffer\x1a\x0c.dbx.rpc.Ackb\x06proto3')
 
 _builder.BuildMessageAndEnumDescriptors(DESCRIPTOR, globals())
 _builder.BuildTopDescriptorsAndMessages(DESCRIPTOR, 'backtesting_pb2', globals())

@@ -446,6 +446,43 @@ class JaxSweepBackend:
                      "(carry_hit=O(ΔT) advance, full_reprice=checkpoint "
                      "miss fallback)", outcome=outcome)
             for outcome in ("carry_hit", "full_reprice")}
+        # Substrate autotuner (tune/, round 11): the schedule registry is
+        # consulted per fused group submit — explicit arg > env > tuned
+        # schedule > hardcoded default, so every existing override keeps
+        # its exact semantics — and, under DBX_AUTOTUNE, first contact
+        # with a (family, shape-bucket) measures the substrate
+        # cross-product and persists the winner. The worker control loop
+        # gossips new entries up (JobsRequest.schedule_json) and adopts
+        # the merged fleet registry from GetStats, so the Nth worker
+        # inherits the first worker's tuning without re-measuring.
+        from .. import tune as tune_mod
+
+        self._tune = tune_mod
+        self.schedule_registry = tune_mod.ScheduleRegistry.open_default(
+            registry=reg)
+        self._autotuner = tune_mod.Autotuner(self.schedule_registry,
+                                             registry=reg)
+        self._platform = jax.default_backend()
+        # First-contact memo: a (family, bucket) whose tune attempt found
+        # no winner must not re-pay the measurement on every group.
+        self._tuned_attempted: set = set()
+        self._tuned_info_seen: set = set()
+        # Construction-time tuned defaults: knobs that bind before any
+        # group submit (the page pool's page size) apply through the
+        # process-wide tuned default layer when the restored registry
+        # holds a page_bars winner for this platform (deterministic pick:
+        # most common value, ties to the smallest).
+        pb_counts: dict = {}
+        for e in self.schedule_registry.entries():
+            if e["platform"] != self._platform:
+                continue
+            v = e["substrates"].get("page_bars")
+            if v:
+                pb_counts[v] = pb_counts.get(v, 0) + 1
+        if pb_counts:
+            pb_pick = sorted(pb_counts.items(),
+                             key=lambda kv: (-kv[1], kv[0]))[0][0]
+            fused_ops.set_tuned_defaults({"page_bars": pb_pick})
 
     def _evict_mesh_fn(self) -> None:
         """FIFO-evict the oldest compiled mesh fn AND its shape-signature
@@ -1515,8 +1552,110 @@ class JaxSweepBackend:
             periods_per_year=int(job0.periods_per_year or 252))
         return m, info["pages_new"] == 0
 
+    def _tuned_schedule_for(self, job0, lengths, grid) -> dict | None:
+        """Registry consultation at group-submit time (tune/ round 11):
+        the tuned substrate schedule for this group's (family,
+        shape-bucket, platform) — running a first-contact autotune under
+        ``DBX_AUTOTUNE`` — or None (hardcoded defaults). NEVER raises:
+        a broken registry or failed tune degrades to today's routing."""
+        try:
+            n_bars = int(max(lengths))
+            n_combos = max((int(np.asarray(v).shape[0])
+                            for v in grid.values()), default=1)
+            bucket = self._tune.shape_bucket(n_bars, n_combos)
+            family = job0.strategy
+            sched = self.schedule_registry.lookup(family, bucket,
+                                                  self._platform)
+            mode = self._tune.autotune_mode()
+            if (sched is None and mode != "off"
+                    and (family, bucket) not in self._tuned_attempted):
+                self._tuned_attempted.add((family, bucket))
+                # page_bars joins the search space only under the model
+                # prior: it binds at pool construction, so a live
+                # measurement through the dense wrapper could not tell
+                # the candidates apart anyway.
+                sched = self._autotuner.tune(
+                    family, bucket, self._platform, n_bars=n_bars,
+                    n_combos=n_combos,
+                    measure=(None if mode == "model"
+                             else self._autotune_measure(job0, grid)),
+                    paged=(mode == "model" and self.use_paged
+                           and self._fused_ops.paged_supported(family)))
+            if sched:
+                self._publish_tuned_info(family, bucket, sched)
+            return sched
+        except Exception:
+            log.exception("tuned-schedule consultation failed; serving "
+                          "hardcoded substrate defaults")
+            return None
+
+    def _autotune_measure(self, job0, grid):
+        """The live measurement harness handed to the autotuner: one
+        representative single-ticker sweep of this group's family/grid
+        under the candidate substrate tuple (warm run timed — compile
+        excluded, it is what the fleet compile cache amortizes)."""
+        spec = self._FUSED_STRATEGIES[job0.strategy]
+        series, _hit = self._resolve_series(job0)
+        arrays = [np.asarray(getattr(series, f), np.float32)[None, :]
+                  for f in spec.fields]
+        cost, ppy = job0.cost, job0.periods_per_year or 252
+        jax = self._jax
+
+        def measure(substrates: dict) -> float:
+            with self._fused_ops.tuned_schedule(substrates):
+                jax.block_until_ready(
+                    spec.run(*arrays, grid, cost, ppy, None))
+                t0 = time.perf_counter()
+                jax.block_until_ready(
+                    spec.run(*arrays, grid, cost, ppy, None))
+                return time.perf_counter() - t0
+        return measure
+
+    def _publish_tuned_info(self, family: str, bucket: str,
+                            sched: dict) -> None:
+        """``dbx_tuned_substrate_info`` — the tuned twin of
+        ``dbx_fused_substrate_info``: constant 1, labels carry which
+        tuned substrates route this (family, shape-bucket). Fixed label
+        keys ("default" = knob left on hardcoded routing); family and
+        bucket are bounded (strategy registry x clamped pow2 rails)."""
+        key = (family, bucket, tuple(sorted(sched.items())))
+        if key in self._tuned_info_seen:
+            return
+        self._tuned_info_seen.add(key)
+        table = next((v for k, v in sorted(sched.items())
+                      if k.startswith("table_")), "default")
+        self._obs.gauge(
+            "dbx_tuned_substrate_info",
+            help="constant 1; labels carry the tuned substrate schedule "
+                 "serving this (kernel family, shape-bucket) — the "
+                 "tuned-vs-default twin of dbx_fused_substrate_info",
+            kernel=family, bucket=bucket,
+            epilogue=sched.get("epilogue", "default"),
+            table=table,
+            lanes_cap=sched.get("lanes_cap", "default"),
+            page_bars=sched.get("page_bars", "default")).set(1)
+
     def _submit_fused_group(self, group, series, lengths, axes, grid, t0,
                             *, allow_paged: bool = True):
+        """Tuned-schedule activation around one fused group submit: the
+        registry's winner for this (family, shape-bucket) routes every
+        substrate resolver the wrappers call inside — below explicit
+        args and env knobs, above hardcoded defaults — and folds into
+        the jit cache keys exactly like an env knob flip (the wrappers'
+        static args and the mesh path's substrate_defaults() key both
+        resolve through the same chain)."""
+        sched = self._tuned_schedule_for(group[0], lengths, grid)
+        if not sched:
+            return self._submit_fused_group_routed(
+                group, series, lengths, axes, grid, t0,
+                allow_paged=allow_paged)
+        with self._fused_ops.tuned_schedule(sched):
+            return self._submit_fused_group_routed(
+                group, series, lengths, axes, grid, t0,
+                allow_paged=allow_paged)
+
+    def _submit_fused_group_routed(self, group, series, lengths, axes,
+                                   grid, t0, *, allow_paged: bool = True):
         """Fused submit of one (possibly mixed-length) group.
 
         Paged route first (digest-keyed device pages + page tables —

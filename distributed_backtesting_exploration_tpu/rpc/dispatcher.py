@@ -1394,6 +1394,11 @@ class Dispatcher(service.DispatcherServicer):
     # panel) instead of growing one entry per panel forever.
     MAX_DELIVERED_DIGESTS = 1 << 16
 
+    # FetchCompiled payload bytes per reply: keeps one bulk fetch safely
+    # under the channel's 256 MB message cap even when the fleet compile
+    # store is full.
+    COMPILED_REPLY_BUDGET = 64 * 1024 * 1024
+
     def __init__(self, queue: JobQueue, peers: PeerRegistry | None = None, *,
                  default_jobs_per_chip: int = 1,
                  results_dir: str | None = None,
@@ -1435,7 +1440,7 @@ class Dispatcher(service.DispatcherServicer):
                                   method=m)
             for m in ("RequestJobs", "SendStatus", "CompleteJob",
                       "CompleteJobs", "GetStats", "FetchPayload",
-                      "AppendBars")}
+                      "AppendBars", "FetchCompiled", "OfferCompiled")}
         self._c_dispatched = self.obs.counter(
             "dbx_jobs_dispatched_total", help="jobs handed to workers")
         self._c_completions = {
@@ -1497,6 +1502,21 @@ class Dispatcher(service.DispatcherServicer):
         # gauges instead of freezing them at the last live value.
         # Bounded by the tenant-bucket cap.
         self._tenant_buckets_emitted: set[str] = set()
+        # Substrate autotuner fleet registry (tune/, round 11): workers
+        # push newly tuned entries on JobsRequest.schedule_json; the
+        # deterministic merge keeps the union, and GetStats ships it back
+        # so the Nth worker inherits the first worker's tuning. Persists
+        # through DBX_SCHEDULE_DIR when set (restarts keep the fleet's
+        # schedules without re-gossip).
+        from .. import tune as tune_mod
+
+        self.fleet_schedule = tune_mod.ScheduleRegistry.open_default(
+            registry=self.obs, scope="fleet")
+        # Fleet-shared compile cache: byte-bounded store of workers'
+        # persistent-compile-cache entries (DBX_COMPILE_CACHE_MB), served
+        # by FetchCompiled / fed by OfferCompiled. Entries are opaque —
+        # the dispatcher never needs jax.
+        self.compile_store = tune_mod.CompileStore(registry=self.obs)
         # Thread-local: concurrent GetStats calls on the gRPC pool must
         # each lend their OWN snapshot to the collector, not race on one
         # shared slot.
@@ -1580,6 +1600,13 @@ class Dispatcher(service.DispatcherServicer):
         reg.gauge("dbx_panel_store_evictions",
                   help="LRU evictions from the panel store").set(
             ps["evictions"])
+        cs = self.compile_store.stats()
+        reg.gauge("dbx_compile_store_bytes",
+                  help="bytes resident in the fleet compile-cache "
+                       "store").set(cs["bytes"])
+        reg.gauge("dbx_compile_store_entries",
+                  help="compile-cache entries resident in the fleet "
+                       "store").set(cs["entries"])
 
     def obs_summary(self) -> dict:
         """The extended-stats payload: registry summaries (histogram
@@ -1684,6 +1711,11 @@ class Dispatcher(service.DispatcherServicer):
     @_timed_rpc("RequestJobs")
     def RequestJobs(self, request: pb.JobsRequest, context) -> pb.JobsReply:
         is_new = self.peers.touch(request.worker_id, chips=request.chips)
+        if request.schedule_json:
+            # Tuned-schedule gossip (up leg): merge this worker's new
+            # entries into the fleet registry. Malformed payloads teach
+            # nothing (skip-and-count inside) — never an RPC error.
+            self.fleet_schedule.merge_json(request.schedule_json)
         if is_new:
             log.info("new worker %s with %d chips",
                      request.worker_id, request.chips)
@@ -1929,7 +1961,9 @@ class Dispatcher(service.DispatcherServicer):
             self._pending_stats.s = None
         return pb.StatsReply(workers_alive=self.peers.alive(),
                              substrate=self.queue.substrate,
-                             obs_json=obs_json, **{
+                             obs_json=obs_json,
+                             schedule_json=self.fleet_schedule.to_json(),
+                             **{
             k: (int(v) if k != "backtests_per_sec" else v)
             for k, v in s.items()})
 
@@ -1982,6 +2016,49 @@ class Dispatcher(service.DispatcherServicer):
                  request.panel_digest[:16], ndig[:16], new_len, rec.id)
         return pb.AppendReply(ok=True, job_id=rec.id, panel_digest=ndig,
                               new_len=new_len)
+
+    @_timed_rpc("FetchCompiled")
+    def FetchCompiled(self, request: pb.CompiledRequest,
+                      context) -> pb.CompiledReply:
+        """Fleet compile-cache fetch: empty ``keys`` = the listing only
+        (the cheap poll — known_keys, no payloads); otherwise the
+        requested entries still resident. A missing key is simply absent
+        from the reply — the worker compiles locally and offers the
+        result, never a failed job."""
+        self.peers.touch(request.worker_id)
+        reply = pb.CompiledReply()
+        if not request.keys:
+            reply.known_keys.extend(self.compile_store.keys())
+            return reply
+        budget = self.COMPILED_REPLY_BUDGET
+        for key in request.keys:
+            if budget <= 0:
+                # Reply size guard (the worker also chunks its key
+                # lists): entries past the budget simply stay missing
+                # and ride the worker's next sync tick.
+                break
+            v = self.compile_store.get(key)
+            if v is not None:
+                reply.entries.append(pb.CompiledEntry(
+                    key=key, name=v[0], payload=v[1]))
+                budget -= len(v[1])
+        return reply
+
+    @_timed_rpc("OfferCompiled")
+    def OfferCompiled(self, request: pb.CompiledOffer,
+                      context) -> pb.Ack:
+        """Fleet compile-cache offer: adopt a worker's freshly compiled
+        cache entries (byte-bounded LRU; oversized/duplicate entries are
+        silently ignored)."""
+        self.peers.touch(request.worker_id)
+        n = 0
+        for e in request.entries:
+            if self.compile_store.offer(e.key, e.name, e.payload):
+                n += 1
+        if n:
+            log.info("adopted %d compile-cache entries from %s",
+                     n, request.worker_id)
+        return pb.Ack(ok=True, detail=str(n))
 
 
 class DispatcherServer:
@@ -2409,6 +2486,14 @@ def main(argv=None) -> None:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    if os.environ.get("DBX_COMPILE_CACHE_DIR"):
+        # Operator opted the dispatcher host into the persistent compile
+        # cache (a dispatcher that also runs local jax work — bench, a
+        # colocated worker). Best-effort; gated on the env knob because
+        # importing jax is heavyweight for a pure control-plane process.
+        from .. import tune as tune_mod
+
+        tune_mod.configure()
     dispatcher = build_dispatcher(args)
     queue = dispatcher.queue
     server = DispatcherServer(dispatcher, bind=args.bind,

@@ -39,6 +39,8 @@ _METHODS = (
     ("GetStats", pb.StatsRequest, pb.StatsReply),
     ("FetchPayload", pb.PayloadRequest, pb.PayloadReply),
     ("AppendBars", pb.AppendRequest, pb.AppendReply),
+    ("FetchCompiled", pb.CompiledRequest, pb.CompiledReply),
+    ("OfferCompiled", pb.CompiledOffer, pb.Ack),
 )
 
 
@@ -67,6 +69,14 @@ class DispatcherServicer:
 
     def AppendBars(self, request: pb.AppendRequest,
                    context) -> pb.AppendReply:
+        raise NotImplementedError
+
+    def FetchCompiled(self, request: pb.CompiledRequest,
+                      context) -> pb.CompiledReply:
+        raise NotImplementedError
+
+    def OfferCompiled(self, request: pb.CompiledOffer,
+                      context) -> pb.Ack:
         raise NotImplementedError
 
 

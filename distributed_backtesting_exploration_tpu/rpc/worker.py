@@ -112,6 +112,11 @@ def _pb_size(msg) -> int:
     return size() if callable(size) else 0
 
 
+class _SyncLegFailed(Exception):
+    """One tune-sync RPC leg failed (already counted under its own
+    method label); the tick aborts and retries on the next interval."""
+
+
 _BATCH_SENTINEL = b"S"
 
 
@@ -187,12 +192,14 @@ class Worker:
                                   help="worker-side RPC wall (incl. wire)",
                                   method=m)
             for m in ("RequestJobs", "SendStatus", "CompleteJobs",
-                      "FetchPayload")}
+                      "FetchPayload", "FetchCompiled", "OfferCompiled",
+                      "GetStats")}
         self._c_rpc_errors = {
             m: self.obs.counter("dbx_worker_rpc_errors_total",
                                 help="failed worker RPC attempts", method=m)
             for m in ("RequestJobs", "SendStatus", "CompleteJobs",
-                      "FetchPayload")}
+                      "FetchPayload", "FetchCompiled", "OfferCompiled",
+                      "GetStats")}
         # Wire accounting (serialized proto bytes, pre-compression): the
         # bench's `wire_bytes_per_job` column and the dispatch-by-digest
         # A/B read these deltas.
@@ -222,6 +229,18 @@ class Worker:
         # uuid-labeled gauge child.
         self._jobs_rate = obs.StepTimer()
         self._gauges: dict | None = None
+        # Substrate-autotuner + fleet-compile-cache sync (tune/, round
+        # 11): attached in run() only for backends that expose a schedule
+        # registry (the jax backend) — the instant/sleep fakes neither
+        # tune nor compile. New local schedule entries piggyback on
+        # JobsRequest.schedule_json (zero-cost when clean); the pull leg
+        # (fleet registry via GetStats + compile-cache exchange) runs on
+        # its own tick — 10s default: schedules and compiles change on
+        # first-contact timescales, and each GetStats makes the
+        # dispatcher build its full obs summary.
+        self.tune_sync_interval_s = 10.0
+        self._compile_sync = None
+        self._next_tune_sync = 0.0
 
     def _collect_gauges(self, reg: "obs.Registry") -> None:
         # Sets the children PRE-CREATED in run() (held on self._gauges)
@@ -355,6 +374,13 @@ class Worker:
             # resolution happens in _poll_jobs on this thread.
             self.backend.payload_fetcher = (
                 lambda digest: self._fetch_payload(stub, digest))
+        if getattr(self.backend, "schedule_registry", None) is not None:
+            # Fleet compile-cache exchange rides the jax persistent cache
+            # dir this process already configured (a harness's choice is
+            # respected); best-effort — None degrades to uncached.
+            from .. import tune as tune_mod
+
+            self._compile_sync = tune_mod.attach(registry=self.obs)
         # Fresh timer epoch: the rate is "since the worker STARTED", not
         # since it was constructed (a harness may build workers long
         # before running them).
@@ -395,6 +421,11 @@ class Worker:
                 if now >= self._next_status:
                     self._next_status = now + self.status_interval_s
                     self._send_status(stub)
+                if (now >= self._next_tune_sync
+                        and getattr(self.backend, "schedule_registry",
+                                    None) is not None):
+                    self._next_tune_sync = now + self.tune_sync_interval_s
+                    self._sync_tune(stub)
                 if now >= next_poll:
                     next_poll = now + self.poll_interval_s
                     got = self._poll_jobs(stub)
@@ -472,18 +503,93 @@ class Worker:
             self._c_rpc_errors["SendStatus"].inc()
             self._log_disconnected(e)
 
+    def _sync_tune(self, stub) -> None:
+        """One tuned-schedule / compile-cache sync tick (control thread,
+        never sleeps, every leg best-effort — a flaky dispatcher costs a
+        tick, never a job):
+
+        - offer cache entries this worker's own compiles just wrote;
+        - poll the fleet listing, fetch + install entries we lack (the
+          cold-start compile skip);
+        - adopt the merged fleet schedule registry from GetStats (the
+          push leg rides JobsRequest.schedule_json in `_poll_jobs`).
+        """
+        sync = self._compile_sync
+        try:
+            if sync is not None:
+                fresh = sync.poll_new()
+                if fresh:
+                    req = pb.CompiledOffer(
+                        worker_id=self.worker_id,
+                        entries=[pb.CompiledEntry(key=k, name=n,
+                                                  payload=p)
+                                 for k, n, p in fresh])
+                    try:
+                        with obs.timer(self._h_rpc["OfferCompiled"]):
+                            stub.OfferCompiled(req, timeout=30.0)
+                    except grpc.RpcError as e:
+                        # A lost offer must not drop a paid compile wall
+                        # from fleet sharing: un-mark so the next poll
+                        # re-offers (the remark_dirty twin).
+                        self._c_rpc_errors["OfferCompiled"].inc()
+                        sync.unmark(fresh)
+                        raise _SyncLegFailed from e
+                try:
+                    with obs.timer(self._h_rpc["FetchCompiled"]):
+                        listing = stub.FetchCompiled(pb.CompiledRequest(
+                            worker_id=self.worker_id), timeout=10.0)
+                    miss = sync.missing(listing.known_keys)
+                    # Chunked fetches: one bulk reply for a full store
+                    # could exceed the channel's message cap; remaining
+                    # keys stay missing and ride the next tick.
+                    for i in range(0, len(miss),
+                                   self._COMPILE_FETCH_BATCH):
+                        chunk = miss[i:i + self._COMPILE_FETCH_BATCH]
+                        with obs.timer(self._h_rpc["FetchCompiled"]):
+                            got = stub.FetchCompiled(pb.CompiledRequest(
+                                worker_id=self.worker_id, keys=chunk),
+                                timeout=60.0)
+                        installed = sync.install(
+                            (e.key, e.name, e.payload)
+                            for e in got.entries)
+                        sync.count_fleet_misses(len(chunk) - installed)
+                except grpc.RpcError as e:
+                    self._c_rpc_errors["FetchCompiled"].inc()
+                    raise _SyncLegFailed from e
+            try:
+                with obs.timer(self._h_rpc["GetStats"]):
+                    stats = stub.GetStats(pb.StatsRequest(), timeout=10.0)
+            except grpc.RpcError as e:
+                self._c_rpc_errors["GetStats"].inc()
+                raise _SyncLegFailed from e
+            if stats.schedule_json:
+                self.backend.schedule_registry.merge_json(
+                    stats.schedule_json)
+            self._log_reconnected()
+        except _SyncLegFailed as e:
+            self._log_disconnected(e.__cause__)
+        except Exception:
+            log.exception("tune sync tick failed; will retry next tick")
+
     def _poll_jobs(self, stub):
         """Request a batch if the compute queue has room; None on RPC error."""
         if self._in.full():
             return None
         self._c_polls.inc()
+        schedule_json = ""
+        reg = getattr(self.backend, "schedule_registry", None)
+        if reg is not None:
+            # Gossip-up leg: entries tuned since the last poll (usually
+            # empty — zero wire cost on a clean poll).
+            schedule_json = reg.take_dirty_json()
         req = pb.JobsRequest(
             worker_id=self.worker_id, chips=self.backend.chips,
             jobs_per_chip=self.jobs_per_chip,
             # Digest-only dispatch is safe for ANY backend this worker
             # hosts: backends with a panel cache resolve digests, and
             # payload-less fakes (instant/sleep) never read ohlcv at all.
-            accepts_digest_only=True)
+            accepts_digest_only=True,
+            schedule_json=schedule_json)
         try:
             with obs.timer(self._h_rpc["RequestJobs"]):
                 reply = stub.RequestJobs(req, timeout=30.0)
@@ -491,6 +597,10 @@ class Worker:
         except grpc.RpcError as e:
             self._c_rpc_errors["RequestJobs"].inc()
             self._log_disconnected(e)
+            if schedule_json and reg is not None:
+                # The drained dirty entries never reached the dispatcher:
+                # re-mark them so the next successful poll pushes them.
+                reg.remark_dirty(schedule_json)
             return None
         self._c_wire[("RequestJobs", "request")].inc(_pb_size(req))
         self._c_wire[("RequestJobs", "reply")].inc(_pb_size(reply))
@@ -577,6 +687,12 @@ class Worker:
             return b""
         self._c_fetches.inc()
         return reply.payload
+
+    # Compile-cache entries fetched per FetchCompiled RPC: bounds the
+    # reply under the channel message cap even when the fleet store is
+    # full (single entries are capped at 64 MB by the store; typical
+    # XLA-CPU/TPU entries are KBs).
+    _COMPILE_FETCH_BATCH = 32
 
     # Retry due-times for failed completion RPCs. Attempts are spread over
     # due windows with heartbeats flowing in between — nothing here ever

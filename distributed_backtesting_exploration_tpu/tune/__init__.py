@@ -1,0 +1,32 @@
+"""tune/: substrate autotuner + schedule registry + fleet compile cache.
+
+The two halves of ROADMAP item 4 (DESIGN.md "Substrate autotuner & shared
+compile cache"):
+
+- :mod:`.registry` / :mod:`.autotune` — measure the substrate schedule
+  cross-product once per (kernel family, shape-bucket, platform), persist
+  winners in a journal-style registry (``DBX_SCHEDULE_DIR``), gossip them
+  through the dispatcher so the Nth worker inherits the first worker's
+  tuning (``JobsRequest.schedule_json`` up, ``StatsReply.schedule_json``
+  down). Consumption is ops/fused.py's resolution chain: explicit arg >
+  env > tuned schedule > hardcoded default.
+- :mod:`.compile_cache` — JAX's persistent compilation cache as a
+  first-class runtime module (one home for the version-drift best-effort
+  conftest used to carry), plus the dispatcher-served entry exchange
+  (``FetchCompiled``/``OfferCompiled``) that lets a cold worker skip a
+  compile any peer already paid for.
+"""
+
+from .autotune import (Autotuner, autotune_mode, autotune_trials,
+                       candidate_space, modeled_cost)
+from .compile_cache import (CacheSync, CompileStore, attach, configure,
+                            default_cache_dir, entry_key)
+from .registry import (ScheduleRegistry, entry_line, schedule_dir,
+                       shape_bucket)
+
+__all__ = [
+    "Autotuner", "CacheSync", "CompileStore", "ScheduleRegistry",
+    "attach", "autotune_mode", "autotune_trials", "candidate_space",
+    "configure", "default_cache_dir", "entry_key", "entry_line",
+    "modeled_cost", "schedule_dir", "shape_bucket",
+]

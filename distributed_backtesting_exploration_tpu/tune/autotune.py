@@ -1,0 +1,308 @@
+"""Substrate autotuner: measure the live schedule cross-product per
+(kernel family, shape-bucket), prune it with the bench op model as a
+prior, persist the winner (DESIGN.md "Substrate autotuner & shared
+compile cache"; the TVM discipline from PAPERS.md applied to this repo's
+substrate knobs).
+
+Search space (the same knobs an operator could hand-set):
+
+- ``epilogue``: blocked carry-scan block sizes (``scan:8..scan:128``) vs
+  the ``ladder`` verification substrate (ops/fused.py round 6);
+- ``table_<fam>``: in-VMEM ``inline`` rebuild vs the XLA-built ``hbm``
+  stream, for the five table families in ``fused._TABLE_FAMILIES``;
+- ``lanes_cap``: the validated ``DBX_LANES_CAP`` ladder (0 = kernel
+  default pick);
+- ``page_bars``: page-count binning granularity for paged groups
+  (model-scored only — re-paging a live pool per trial would cost more
+  than it could ever win; the tuned value applies at the next pool
+  construction).
+
+The PRIOR is the per-cell-bar op model bench.py's roofline uses (VPU
+ladder rounds + carry fixes, MXU selection matmuls, HBM table streams):
+candidates are scored by the model first and only the top
+``DBX_AUTOTUNE_TRIALS`` are measured live. ``DBX_AUTOTUNE`` picks the
+mode: ``0``/unset = off (hardcoded defaults, zero new work — the shipped
+default), ``model`` = pick the model's argmin with no measurement (free,
+deterministic — what CPU-only rounds record), ``1``/``measure`` = measure
+the pruned candidates on the caller-supplied harness. Every failure path
+degrades to the defaults: tuning must never fail a job.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+
+from .. import obs
+from .registry import ScheduleRegistry, entry_line
+
+_TRIALS_DEFAULT = 4
+_REPS_DEFAULT = 1
+
+# Families whose position path runs the 3-state compose machine (the
+# band/latch kernels — PR 3's second ladder). Everything else pays only
+# the shared metrics tail.
+_COMPOSE_FAMILIES = frozenset({
+    "bollinger", "bollinger_touch", "rsi", "vwap_reversion", "keltner",
+    "stochastic", "donchian", "donchian_hl", "pairs"})
+
+_EPILOGUE_CANDIDATES = ("scan:8", "scan:32", "scan:128", "ladder")
+_LANES_CANDIDATES = ("0", "256", "512")
+_PAGE_BARS_CANDIDATES = ("256", "512", "1024")
+
+
+def autotune_mode() -> str:
+    """``DBX_AUTOTUNE`` resolution (lazy, host-side): ``"off"`` (default),
+    ``"model"`` (cost-model argmin, no measurement) or ``"measure"``."""
+    raw = os.environ.get("DBX_AUTOTUNE", "").strip().lower()
+    if raw in ("", "0", "off"):
+        return "off"
+    if raw == "model":
+        return "model"
+    return "measure"
+
+
+def autotune_trials() -> int:
+    """Measured candidates per (family, bucket) — the prune width."""
+    try:
+        return max(int(os.environ.get("DBX_AUTOTUNE_TRIALS",
+                                      _TRIALS_DEFAULT)), 1)
+    except ValueError:
+        return _TRIALS_DEFAULT
+
+
+def _table_family(family: str) -> str | None:
+    from ..ops import fused
+    return fused._STRATEGY_TABLE_FAMILY.get(family)
+
+
+def env_pinned_keys(family: str) -> frozenset:
+    """Substrate keys the operator pinned by env for ``family`` — those
+    axes are excluded from the search space (env beats tuned, so their
+    candidates could only measure noise)."""
+    from ..ops import fused
+    pinned = set()
+    if os.environ.get("DBX_EPILOGUE"):
+        pinned.add("epilogue")
+    if os.environ.get("DBX_LANES_CAP"):
+        pinned.add("lanes_cap")
+    if os.environ.get("DBX_PAGE_BARS"):
+        pinned.add("page_bars")
+    tf = _table_family(family)
+    if tf is not None and os.environ.get(fused._TABLE_FAMILIES[tf][0]):
+        pinned.add(f"table_{tf}")
+    return frozenset(pinned)
+
+
+def default_substrates(family: str) -> dict:
+    """Today's hardcoded substrate defaults as a candidate tuple — the
+    INCUMBENT. Always measured alongside the pruned candidates, so a
+    measured winner can never be slower than the defaults it replaces
+    (the prior prunes toward the model's optimum, which is chip-shaped;
+    on a platform where the model is wrong — CPU interpret mode — the
+    incumbent guard keeps the tune a no-op instead of a regression)."""
+    from ..ops import fused
+    out = {"epilogue": "scan", "lanes_cap": "0"}
+    tf = _table_family(family)
+    if tf is not None:
+        out[f"table_{tf}"] = fused._TABLE_FAMILIES[tf][1]
+    return out
+
+
+def candidate_space(family: str, *, paged: bool = False) -> list[dict]:
+    """The live substrate cross-product for ``family`` (epilogue x table
+    x lanes [x page_bars]), in deterministic order."""
+    tf = _table_family(family)
+    tables = (None,) if tf is None else ("inline", "hbm")
+    pages = _PAGE_BARS_CANDIDATES if paged else (None,)
+    out = []
+    for epi in _EPILOGUE_CANDIDATES:
+        for tab in tables:
+            for lanes in _LANES_CANDIDATES:
+                for pb in pages:
+                    c = {"epilogue": epi, "lanes_cap": lanes}
+                    if tab is not None:
+                        c[f"table_{tf}"] = tab
+                    if pb is not None:
+                        c["page_bars"] = pb
+                    out.append(c)
+    return out
+
+
+def modeled_cost(family: str, substrates: dict, *, n_bars: int,
+                 n_combos: int) -> float:
+    """Relative modeled cost per cell-bar of one substrate tuple — the
+    SAME accounting bench.py's roofline model uses (PR 3/5 numbers:
+    metrics tail = 26 reduction/PnL ops + 2 ladders x 2 ops/round [+7
+    carry fixes under scan]; band/latch compose = 9 ops/round [+2]; hbm
+    tables stream 4*W bytes/cell-bar amortized over P lanes, inline
+    rebuilds cost ~2 VPU ops/cell-bar; wider lane blocks amortize the
+    per-cell fixed overhead). A PRIOR for pruning, not gospel — the
+    measured trials rank the survivors."""
+    from ..ops import fused
+
+    T_pad = -(-max(int(n_bars), 8) // 8) * 8
+    epi = substrates.get("epilogue", "scan")
+    if epi == "ladder":
+        rounds = max(math.ceil(math.log2(max(T_pad, 2))), 1)
+        tail = 26 + 4 * rounds
+        compose = 9 * rounds
+    else:
+        try:
+            blk = fused._scan_block(T_pad, epi)
+        except (ValueError, AttributeError):
+            blk = 8
+        rounds = max(math.ceil(math.log2(max(min(blk, T_pad), 2))), 1)
+        tail = 26 + 4 * rounds + 7
+        compose = 9 * rounds + 2
+    vpu = 24.0 + tail   # ~24 signal/PnL ops per cell-bar outside the tail
+    if family in _COMPOSE_FAMILIES:
+        vpu += compose
+    tf = _table_family(family)
+    if tf is not None:
+        w_pad = 8.0                     # representative distinct-window pad
+        p_pad = -(-max(int(n_combos), 1) // 128) * 128
+        if substrates.get(f"table_{tf}") == "hbm":
+            # HBM stream (bytes -> VPU-op equivalents at the v5e byte/op
+            # ratio the bench model uses) amortized over the param lanes.
+            vpu += 4.0 * w_pad * 4 / p_pad
+        else:
+            vpu += 2.0                  # in-kernel scratch rebuild
+    try:
+        lanes = int(substrates.get("lanes_cap", "0") or 0)
+    except ValueError:
+        lanes = 0
+    eff_lanes = lanes if lanes else 256
+    vpu *= 1.0 + 16.0 / eff_lanes       # per-cell fixed overhead share
+    pb = substrates.get("page_bars")
+    if pb:
+        try:
+            vpu *= 1.0 + float(pb) / (2.0 * max(int(n_bars), 1))
+        except ValueError:
+            pass
+    return vpu
+
+
+class Autotuner:
+    """First-contact tuner: consult the prior, measure the survivors,
+    persist the winner in the schedule registry."""
+
+    def __init__(self, schedule: ScheduleRegistry,
+                 registry: "obs.Registry | None" = None):
+        self.schedule = schedule
+        self._obs = registry or obs.get_registry()
+        self._c_trials: dict[str, obs.registry.Counter] = {}
+
+    def _count_trials(self, family: str, n: int) -> None:
+        c = self._c_trials.get(family)
+        if c is None:
+            # family is bounded: the fused strategy registry's key set.
+            c = self._c_trials[family] = self._obs.counter(
+                "dbx_autotune_trials_total",
+                help="live autotune measurements run, by kernel family",
+                family=family)
+        c.inc(n)
+
+    def tune(self, family: str, bucket: str, platform: str, *,
+             n_bars: int, n_combos: int, measure=None,
+             paged: bool = False) -> dict | None:
+        """Tune one (family, bucket, platform) and persist the winner.
+
+        ``measure(substrates) -> seconds`` runs the family's sweep under
+        the candidate substrate tuple (the caller owns shapes and data);
+        None or mode="model" picks the cost model's argmin without
+        measuring. Returns the winning substrate dict, or None when the
+        mode is off / everything failed — the caller then serves today's
+        defaults (degradation ladder: tuning never fails a job)."""
+        mode = autotune_mode()
+        if mode == "off":
+            return None
+        cands = candidate_space(family, paged=paged)
+        pinned = env_pinned_keys(family)
+        if pinned:
+            # Env knobs beat tuned schedules in every resolver, so a
+            # pinned axis would make its candidates measure the SAME
+            # substrate — the "winner" value would be timing noise, then
+            # gossip fleet-wide as a measured entry. Drop pinned axes
+            # from the search (and from the recorded schedule).
+            cands = [{k: v for k, v in c.items() if k not in pinned}
+                     for c in cands]
+            seen: set = set()
+            cands = [c for c in cands
+                     if c and entry_line(c) not in seen
+                     and not seen.add(entry_line(c))]
+            if not cands:
+                return None      # everything pinned: nothing to tune
+        scored = sorted(
+            cands,
+            key=lambda c: (modeled_cost(family, c, n_bars=n_bars,
+                                        n_combos=n_combos),
+                           entry_line(c)))
+        if mode == "model" or measure is None:
+            winner, best_us, trials = scored[0], None, 0
+        else:
+            winner, best_us, trials = self._measure(
+                family, self._pruned(family, scored, autotune_trials(),
+                                     pinned=pinned),
+                measure)
+            if winner is None:
+                return None
+        if pinned:
+            # The incumbent candidate carries every knob; pinned axes
+            # must not be recorded as if they had been searched.
+            winner = {k: v for k, v in winner.items() if k not in pinned}
+            if not winner:
+                return None
+        self.schedule.record(family, bucket, platform, winner,
+                             trials=trials, best_us=best_us)
+        return winner
+
+    @staticmethod
+    def _pruned(family: str, scored: list[dict], n: int,
+                pinned: frozenset = frozenset()) -> list[dict]:
+        """The measured candidate set: the incumbent defaults first (the
+        winner can never regress past them), then the model's best
+        candidate PER EPILOGUE VALUE (diversity — a prior that is wrong
+        for this platform must not prune the whole truth away), then the
+        remaining model order up to ``n`` beyond the incumbent."""
+        out: list[dict] = []
+        seen: set[str] = set()
+
+        def add(c: dict) -> None:
+            k = entry_line(c)
+            if k not in seen:
+                seen.add(k)
+                out.append(c)
+
+        add({k: v for k, v in default_substrates(family).items()
+             if k not in pinned})
+        best_per: dict[str, dict] = {}
+        for c in scored:
+            best_per.setdefault(c.get("epilogue", ""), c)
+        for c in best_per.values():
+            add(c)
+        for c in scored:
+            if len(out) > max(n, len(best_per)):
+                break
+            add(c)
+        return out[: max(n, len(best_per)) + 1]
+
+    def _measure(self, family: str, cands: list[dict], measure):
+        reps = _REPS_DEFAULT
+        try:
+            reps = max(int(os.environ.get("DBX_AUTOTUNE_REPS", reps)), 1)
+        except ValueError:
+            pass
+        best, best_s, ran = None, float("inf"), 0
+        for c in cands:
+            try:
+                s = min(float(measure(dict(c))) for _ in range(reps))
+            except Exception:
+                continue    # a failing candidate is just not the winner
+            ran += 1
+            if s < best_s:
+                best, best_s = c, s
+        self._count_trials(family, ran)
+        if best is None:
+            return None, None, 0
+        return best, round(best_s * 1e6, 3), ran

@@ -25,17 +25,15 @@ jax.config.update("jax_platforms", "cpu")
 # suite's wall on the 1-core box is dominated by CPU compiles (SPMD
 # partitioning, interpret-mode pallas), and every entry is keyed by the HLO
 # hash so re-runs of unchanged kernels skip straight to execution (measured
-# cross-process hit on this box). Threshold configs are best-effort — names
-# have drifted across jax generations.
-jax.config.update("jax_compilation_cache_dir",
-                  os.environ.get("DBX_TEST_COMPILE_CACHE",
-                                 "/tmp/dbx_test_jax_cache"))
-for _opt, _val in (("jax_persistent_cache_min_compile_time_secs", 0.5),
-                   ("jax_persistent_cache_min_entry_size_bytes", 0)):
-    try:
-        jax.config.update(_opt, _val)
-    except Exception:  # pragma: no cover - older/newer jax
-        pass
+# cross-process hit on this box). The configuration (including the
+# best-effort threshold options whose names drift across jax generations)
+# lives in ONE place — tune.compile_cache, the same module dispatcher and
+# worker runtimes use.
+from distributed_backtesting_exploration_tpu.tune import (  # noqa: E402
+    compile_cache as _compile_cache)
+
+_compile_cache.configure(os.environ.get("DBX_TEST_COMPILE_CACHE",
+                                        "/tmp/dbx_test_jax_cache"))
 
 import pytest  # noqa: E402
 

@@ -56,6 +56,17 @@ def record_tenant(reg, tenant_id):
     reg.counter("fx_tenant_served_total", tenant=bucket).inc()
 
 
+def record_shape(reg, panel_key, n_bars, n_combos):
+    from distributed_backtesting_exploration_tpu.tune import shape_bucket
+
+    # raw shape key: unbounded (one series per distinct shape) — flagged
+    reg.gauge("fx_shape_depth", shape=panel_key).set(1)
+    # routed through the clamped power-of-two shape-bucket rails (a
+    # finite label set by construction): sanctioned — NOT flagged
+    reg.gauge("fx_shape_depth_ok",
+              shape=shape_bucket(n_bars, n_combos)).set(1)
+
+
 def suppressed(reg, job_id):
     # dbxlint: disable=obs-cardinality -- demo: suppression carries a why
     reg.counter("fx_sup_total", job=job_id).inc()

@@ -27,6 +27,26 @@ def _fused_call(close, *, P_pad):
     return close * lanes
 
 
+def _tuned_schedule_lookup():
+    # VIOLATION: the round-11 bug class — consulting the schedule
+    # registry (DBX_SCHEDULE_DIR) inside a traced root. Registry
+    # consultation must stay host-side: the worker backend resolves the
+    # tuned substrates BEFORE the jit call and threads them as statics.
+    return os.environ.get("DBX_SCHEDULE_DIR", "")
+
+
+@jax.jit
+def _tuned_kernel(close):
+    sched = _tuned_schedule_lookup()
+    return close * (2.0 if sched else 1.0)
+
+
 def host_side_helper():
     # NOT a violation: host-side read, not reachable from any traced root.
     return os.environ.get("DBX_HOST_ONLY", "")
+
+
+def host_side_autotune_mode():
+    # NOT a violation: the autotuner's mode knob is resolved host-side at
+    # group-submit time (tune.autotune.autotune_mode), never in a trace.
+    return os.environ.get("DBX_AUTOTUNE", "off")

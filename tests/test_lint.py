@@ -35,16 +35,25 @@ def _lint_fixture(name: str, rule):
 # ---------------------------------------------------------------------------
 
 def test_trace_time_env_detects_pre_pr1_lanes_cap_pattern():
-    """The regression fixture reproduces the pre-PR-1 ops/fused.py shape:
-    DBX_LANES_CAP read inside a helper called from a jitted kernel
-    launcher. Exactly that read is flagged; the host-side read is not."""
+    """The regression fixture reproduces the pre-PR-1 ops/fused.py shape
+    (DBX_LANES_CAP read inside a helper called from a jitted kernel
+    launcher) and the round-11 twin (a DBX_SCHEDULE_DIR registry lookup
+    reachable from a traced root — schedule consultation must stay
+    host-side). Exactly those reads are flagged; the host-side reads
+    (DBX_HOST_ONLY, DBX_AUTOTUNE) are not."""
     findings, _ = _lint_fixture("trace_time_env.py",
                                 ast_rules.TraceTimeEnvRule())
-    assert [(f.rule, f.path, f.line) for f in findings] == [
+    assert sorted((f.rule, f.path, f.line) for f in findings) == sorted([
         ("trace-time-env", "trace_time_env.py",
          _fixture_line("trace_time_env.py",
-                       'os.environ.get("DBX_LANES_CAP")'))]
+                       'os.environ.get("DBX_LANES_CAP")')),
+        ("trace-time-env", "trace_time_env.py",
+         _fixture_line("trace_time_env.py",
+                       'os.environ.get("DBX_SCHEDULE_DIR", "")')),
+    ])
     assert "static argument" in findings[0].message
+    assert not any("DBX_AUTOTUNE" in f.message or "DBX_HOST_ONLY"
+                   in f.message for f in findings)
 
 
 def test_lock_discipline_flags_unlocked_mutation_only():
@@ -103,6 +112,8 @@ def test_obs_cardinality_flags_unbounded_label_values():
          _fixture_line("obs_cardinality.py", 'panel=panel_digest')),
         ("obs-cardinality", "obs_cardinality.py",
          _fixture_line("obs_cardinality.py", 'tenant=tenant_id')),
+        ("obs-cardinality", "obs_cardinality.py",
+         _fixture_line("obs_cardinality.py", 'shape=panel_key')),
     ]
     alias = findings[0]
     assert "wid = self.worker_id" in alias.message
@@ -123,6 +134,11 @@ def test_obs_cardinality_flags_unbounded_label_values():
     tb_alias = _fixture_line("obs_cardinality.py", "tenant=bucket")
     assert tb_ok not in [f.line for f in findings]
     assert tb_alias not in [f.line for f in findings]
+    # Shape-bucket vocabulary (autotuner round): a raw shape key is
+    # unbounded; the clamped power-of-two shape_bucket rails are a
+    # sanctioned label source.
+    sb_ok = _fixture_line("obs_cardinality.py", "shape=shape_bucket")
+    assert sb_ok not in [f.line for f in findings]
 
 
 def test_obs_cardinality_ignores_splats_and_bounded_loops(tmp_path):

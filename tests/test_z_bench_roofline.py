@@ -318,3 +318,63 @@ def test_streaming_append_keys_present(stream_bench):
     # The wire saving is structural (ΔT vs T+ΔT bars), true at any scale.
     assert sa["wire_bytes_delta"] < sa["wire_bytes_full"]
     assert stream_bench["configs"]["streaming_append"] > 0.0
+
+
+_AUTOTUNE_ENV = {
+    "DBX_BENCH_CPU": "1", "DBX_BENCH_CACHE": "",
+    "DBX_BENCH_CONFIGS": "autotune",
+    # Tiny-but-real: a handful of measured candidates per family on tiny
+    # shapes, and a small compile probe through the REAL gRPC compile
+    # exchange — structure smoke; the 1.2x / 5x acceptance bars are
+    # asserted on the real-size run (BENCH_r10.json), not here.
+    "DBX_BENCH_AUTOTUNE_BARS": "64", "DBX_BENCH_AUTOTUNE_TICKERS": "2",
+    "DBX_BENCH_AUTOTUNE_COMPILE_DEPTH": "4",
+    "DBX_AUTOTUNE_TRIALS": "2", "DBX_BENCH_ITERS": "1",
+}
+
+
+@pytest.fixture(scope="module")
+def autotune_bench():
+    """One tiny in-process autotune run, shared by the module."""
+    prior = {k: os.environ.get(k) for k in _AUTOTUNE_ENV}
+    prior["DBX_AUTOTUNE"] = os.environ.pop("DBX_AUTOTUNE", None)
+    os.environ.update(_AUTOTUNE_ENV)
+    bench.ROOFLINE.clear()
+    buf = io.StringIO()
+    try:
+        with contextlib.redirect_stdout(buf):
+            bench.main()
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return json.loads(buf.getvalue().strip().splitlines()[-1])
+
+
+def test_autotune_keys_present(autotune_bench):
+    """The substrate-autotuner A/B's acceptance numbers
+    (autotuned_vs_default_speedup{family} with its modeled twin, and the
+    fleet compile-cache second_worker_compile_wall_{cold,warm}_s /
+    compile_wall_reduction pair) ride these BENCH JSON keys — a renamed
+    key would silently invalidate the next round's measurement."""
+    at = autotune_bench["roofline"]["autotune"]
+    for key in ("autotuned_vs_default_speedup",
+                "autotuned_vs_default_speedup_modeled", "families",
+                "speedup_families_ok", "second_worker_compile_wall_cold_s",
+                "second_worker_compile_wall_warm_s",
+                "compile_wall_reduction", "fleet_entries_offered",
+                "fleet_entries_installed", "platform"):
+        assert key in at, key
+    # >= 3 kernel families measured, each with a winner recorded.
+    assert len(at["autotuned_vs_default_speedup"]) >= 3
+    for fam, row in at["families"].items():
+        assert row["default_s_per_sweep"] > 0.0, fam
+        assert row["tuned_s_per_sweep"] > 0.0, fam
+        assert at["autotuned_vs_default_speedup"][fam] > 0.0, fam
+        assert at["autotuned_vs_default_speedup_modeled"][fam] > 0.0, fam
+    assert at["second_worker_compile_wall_cold_s"] > 0.0
+    assert at["second_worker_compile_wall_warm_s"] > 0.0
+    assert at["compile_wall_reduction"] > 0.0
+    assert autotune_bench["configs"]["autotune"] > 0.0
