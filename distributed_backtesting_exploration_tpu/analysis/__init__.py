@@ -9,10 +9,21 @@ vigilance. This package gives the repo the same treatment across two
 layers it already has IRs for:
 
 - **AST layer** (:mod:`.ast_rules`): *trace-time-env* (env reads reachable
-  from jit/pallas-traced code), *lock-discipline* (guarded-field mutations
-  outside ``with ...lock`` blocks), *import-time-config* (module-level
-  env/IO capture), *blocking-call* (sleeps/subprocesses inside gRPC
-  servicer handlers and the worker control loop).
+  from jit/pallas-traced code), *import-time-config* (module-level
+  env/IO capture), *blocking-call* (sleeps/subprocesses/device syncs
+  inside gRPC servicer handlers and the worker control loop),
+  *obs-cardinality* (metric labels fed from unbounded runtime data).
+- **concurrency layer** (:mod:`.locks`): one whole-package lock model —
+  cross-module call graph + interprocedural held-lock sets + the global
+  lock-acquisition-order graph — behind *lock-discipline* (guarded-field
+  mutations on lock-free paths, helper mutations proven clean when every
+  caller holds the lock), *lock-order* (acquisition-order cycles and
+  non-reentrant re-acquisition), *atomicity* (check-then-act on guarded
+  fields across lock release) and *lock-blocking* (blocking/device-sync
+  calls while holding a lock). Its runtime twin, :mod:`.lockdep`, is an
+  opt-in (``DBX_LOCKDEP=1``) instrumented-lock shim recording ACTUAL
+  acquisition edges, cycles and blocking-under-lock at runtime onto the
+  obs surface.
 - **jaxpr/IR layer** (:mod:`.jaxpr_rules`): *kernel-hygiene* — trace every
   registered fused kernel with ``jax.make_jaxpr`` and flag host callbacks,
   float64 leaks, and weak-type promotions escaping the kernel.

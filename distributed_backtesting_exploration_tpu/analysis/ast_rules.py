@@ -1,11 +1,14 @@
 """dbxlint AST-layer rules.
 
-Five rules over parsed source, all sharing one scope model
+Four single-module rules over parsed source, all sharing one scope model
 (:class:`_Scope`): a tree of function-like nodes (def / async def /
 lambda) with bare-name resolution walking lexically outward. Class bodies
 are transparent for scoping (names defined in a class body are NOT
 visible inside its methods, matching Python), but methods are still
-scanned as potential roots/targets.
+scanned as potential roots/targets. The concurrency rules
+(``lock-discipline``, ``lock-order``, ``atomicity``, ``lock-blocking``)
+need a whole-package view and live in :mod:`.locks`, built on the same
+scope model.
 """
 
 from __future__ import annotations
@@ -108,7 +111,7 @@ def _build_scopes(tree: ast.Module) -> tuple[_Scope, list[_Scope]]:
 
 
 # ---------------------------------------------------------------------------
-# Rule 1: trace-time-env
+# Rule: trace-time-env
 # ---------------------------------------------------------------------------
 
 # Callables whose function arguments are traced (executed at trace time,
@@ -216,21 +219,22 @@ class TraceTimeEnvRule:
 
 
 # ---------------------------------------------------------------------------
-# Rule 2: lock-discipline
+# Shared concurrency vocabulary (the lock rules in .locks build on these)
 # ---------------------------------------------------------------------------
 
-# Method names that mutate their receiver (dict/list/set/deque surface).
+# Method names that mutate their receiver (dict/list/set/deque surface,
+# plus `put` — the ByteLRU/store API every cache level here speaks).
 _MUTATORS = {
     "append", "appendleft", "extend", "extendleft", "insert", "add",
     "discard", "remove", "pop", "popleft", "popitem", "clear", "update",
-    "setdefault", "sort", "reverse", "push", "push_front",
+    "setdefault", "sort", "reverse", "push", "push_front", "put",
 }
-_LOCK_FACTORIES = {"Lock", "RLock"}
 
-
-def _lock_value(node: ast.AST) -> bool:
-    return (isinstance(node, ast.Call)
-            and _terminal_name(node.func) in _LOCK_FACTORIES)
+# Device-synchronizing calls: each blocks the calling host thread until
+# the accelerator drains — milliseconds to seconds on a loaded chip, an
+# eternity in a gRPC handler or under a lock (the PR-9 PagePool
+# scrape-stall class).
+_DEVICE_SYNC = {"block_until_ready", "device_get"}
 
 
 def _self_attr(node: ast.AST) -> str | None:
@@ -241,164 +245,8 @@ def _self_attr(node: ast.AST) -> str | None:
     return None
 
 
-def _mutations(body_nodes, field_of):
-    """Yield ``(field, line, under_lock)`` for mutations in an iterable of
-    ``(node, under_lock)`` pairs. ``field_of(expr)`` maps a target
-    expression to a tracked field name (or None)."""
-    for node, locked in body_nodes:
-        targets: list[ast.AST] = []
-        if isinstance(node, ast.Assign):
-            targets = node.targets
-        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
-            targets = [node.target]
-        elif isinstance(node, ast.Delete):
-            targets = node.targets
-        for t in targets:
-            base = t.value if isinstance(t, ast.Subscript) else t
-            f = field_of(base)
-            if f is not None:
-                yield f, node.lineno, locked
-        if isinstance(node, ast.Call):
-            fn = node.func
-            if (isinstance(fn, ast.Attribute) and fn.attr in _MUTATORS):
-                f = field_of(fn.value)
-                if f is not None:
-                    yield f, node.lineno, locked
-
-
-def _walk_with_locks(root: ast.AST, is_lock_expr):
-    """Yield ``(node, under_lock)`` over ``root``'s body, not descending
-    into nested function-like nodes (their bodies run on their own call
-    stack, possibly under the caller's lock — out of scope here)."""
-    def rec(node, locked):
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, _FUNC_NODES):
-                continue
-            child_locked = locked
-            if isinstance(child, ast.With):
-                if any(is_lock_expr(item.context_expr)
-                       for item in child.items):
-                    child_locked = True
-            yield child, child_locked
-            yield from rec(child, child_locked)
-    yield from rec(root, False)
-
-
-class LockDisciplineRule:
-    """Guarded-field mutations outside ``with <lock>`` blocks.
-
-    A field is *guarded* when the class (or module) that owns a
-    ``threading.Lock``/``RLock`` mutates it at least once inside a
-    ``with <lock>:`` block outside ``__init__``. Any other mutation of
-    the same field outside a lock block is a discipline violation — the
-    single-lock model every threaded class here documents (JobQueue,
-    PeerRegistry, the obs registry, the journal). Constructor bodies are
-    initialization and exempt. Reads are not checked.
-    """
-
-    name = "lock-discipline"
-    doc = "guarded-field mutation outside the owning lock"
-
-    def check(self, ctx: LintContext) -> list[Finding]:
-        out: list[Finding] = []
-        for pf in ctx.files:
-            for node in ast.walk(pf.tree):
-                if isinstance(node, ast.ClassDef):
-                    out.extend(self._check_class(pf, node))
-            out.extend(self._check_module(pf))
-        return out
-
-    def _check_class(self, pf: PyFile, cls: ast.ClassDef) -> list[Finding]:
-        lock_attrs = {
-            _self_attr(t)
-            for m in ast.walk(cls) if isinstance(m, ast.Assign)
-            if _lock_value(m.value)
-            for t in m.targets if _self_attr(t)
-        }
-        lock_attrs.discard(None)
-        if not lock_attrs:
-            return []
-
-        def is_lock_expr(expr):
-            return _self_attr(expr) in lock_attrs
-
-        methods = [m for m in cls.body
-                   if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
-                   and m.name != "__init__"]
-        muts: list[tuple[str, int, bool]] = []
-        for m in methods:
-            muts.extend(_mutations(_walk_with_locks(m, is_lock_expr),
-                                   _self_attr))
-        guarded = {f for f, _, locked in muts if locked}
-        return [
-            Finding(self.name, pf.rel, line,
-                    f"`self.{f}` is mutated under `{cls.name}`'s lock "
-                    f"elsewhere but mutated here without holding it")
-            for f, line, locked in muts
-            if f in guarded and not locked
-        ]
-
-    def _check_module(self, pf: PyFile) -> list[Finding]:
-        lock_names = {
-            t.id
-            for stmt in pf.tree.body if isinstance(stmt, ast.Assign)
-            if _lock_value(stmt.value)
-            for t in stmt.targets if isinstance(t, ast.Name)
-        }
-        if not lock_names:
-            return []
-        module_globals = {
-            t.id
-            for stmt in pf.tree.body
-            if isinstance(stmt, (ast.Assign, ast.AnnAssign))
-            for t in (stmt.targets if isinstance(stmt, ast.Assign)
-                      else [stmt.target])
-            if isinstance(t, ast.Name)
-        } - lock_names
-
-        def field_of(expr):
-            if isinstance(expr, ast.Name) and expr.id in module_globals:
-                return expr.id
-            return None
-
-        def is_lock_expr(expr):
-            return isinstance(expr, ast.Name) and expr.id in lock_names
-
-        funcs = [n for n in ast.walk(pf.tree)
-                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
-        muts: list[tuple[str, int, bool]] = []
-        for fn in funcs:
-            declared_global = {
-                name for node in ast.walk(fn)
-                if isinstance(node, ast.Global) for name in node.names}
-            # Python scoping: ANY plain assignment to a name (without
-            # `global`) makes it function-local for the WHOLE function —
-            # every mutation of such a name targets the local shadow, not
-            # the guarded global, and must not be reported.
-            local_shadows = {
-                t.id
-                for node in ast.walk(fn)
-                if isinstance(node, (ast.Assign, ast.AnnAssign, ast.For))
-                for t in (node.targets if isinstance(node, ast.Assign)
-                          else [node.target])
-                if isinstance(t, ast.Name)
-            } - declared_global
-            muts.extend(
-                (f, line, locked)
-                for f, line, locked in _mutations(
-                    _walk_with_locks(fn, is_lock_expr), field_of)
-                if f not in local_shadows)
-        guarded = {f for f, _, locked in muts if locked}
-        return [
-            Finding(self.name, pf.rel, line,
-                    f"module global `{f}` is mutated under the module "
-                    f"lock elsewhere but mutated here without holding it")
-            for f, line, locked in muts
-            if f in guarded and not locked
-        ]
-
 # ---------------------------------------------------------------------------
-# Rule 3: import-time-config
+# Rule: import-time-config
 # ---------------------------------------------------------------------------
 
 class ImportTimeConfigRule:
@@ -471,25 +319,32 @@ class ImportTimeConfigRule:
 
 
 # ---------------------------------------------------------------------------
-# Rule 4: blocking-call
+# Rule: blocking-call
 # ---------------------------------------------------------------------------
 
 class BlockingCallRule:
-    """Sleeps / subprocesses inside gRPC servicer handlers and the worker
-    control loop.
+    """Sleeps / subprocesses / device syncs inside gRPC servicer handlers
+    and the worker control loop.
 
     A dispatcher RPC handler runs on the shared gRPC thread pool — one
     sleeping handler steals a pool slot from every worker; the worker's
     control loop owns the liveness heartbeat — a sleep there starves
     SendStatus past the dispatcher's prune window and gets a healthy
     worker pruned mid-drain (the deferred-completion redesign exists
-    because exactly that happened). File IO is deliberately allowed
-    (journal/results persistence is the handlers' job). The poll-tick
-    and bounded-drain sleeps are allowlisted by qualname below.
+    because exactly that happened). Device syncs
+    (``jax.block_until_ready``, ``jax.device_get``) and future waits
+    (``.result()``) block the same way for as long as the accelerator
+    (or the producing thread) takes — compute belongs on the compute
+    thread, never in a handler or the heartbeat loop. File IO is
+    deliberately allowed (journal/results persistence is the handlers'
+    job). The poll-tick and bounded-drain sleeps are allowlisted by
+    qualname below. The "while holding a lock" variant of this class is
+    its own rule (``lock-blocking``, :mod:`.locks`) fed by the
+    interprocedural held-lock sets.
     """
 
     name = "blocking-call"
-    doc = "time.sleep/subprocess in a servicer handler or the worker loop"
+    doc = "sleep/subprocess/device-sync in a servicer or the worker loop"
 
     # Control-plane classes scanned in addition to *Servicer subclasses.
     _CONTROL_PLANE_CLASSES = {"Worker", "SliceWorker"}
@@ -504,7 +359,7 @@ class BlockingCallRule:
         "SliceWorker._leader_loop": "leader idle tick between empty polls",
     }
 
-    _BLOCKING_TERMINAL = {"sleep", "input"}
+    _BLOCKING_TERMINAL = {"sleep", "input", "result"} | _DEVICE_SYNC
     _BLOCKING_MODULES = {"subprocess"}
 
     def check(self, ctx: LintContext) -> list[Finding]:
@@ -549,7 +404,7 @@ class BlockingCallRule:
 
 
 # ---------------------------------------------------------------------------
-# Rule 5: obs-cardinality
+# Rule: obs-cardinality
 # ---------------------------------------------------------------------------
 
 class ObsCardinalityRule:

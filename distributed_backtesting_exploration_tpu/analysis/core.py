@@ -211,11 +211,14 @@ def apply_suppressions(findings: list[Finding], root: str,
 
 def all_rules() -> list:
     """The registered rule set, in catalogue order."""
-    from . import ast_rules, jaxpr_rules, proto_rules
+    from . import ast_rules, jaxpr_rules, locks, proto_rules
 
     return [
         ast_rules.TraceTimeEnvRule(),
-        ast_rules.LockDisciplineRule(),
+        locks.LockDisciplineRule(),
+        locks.LockOrderRule(),
+        locks.AtomicityRule(),
+        locks.LockBlockingRule(),
         ast_rules.ImportTimeConfigRule(),
         ast_rules.BlockingCallRule(),
         ast_rules.ObsCardinalityRule(),

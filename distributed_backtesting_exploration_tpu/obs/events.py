@@ -37,42 +37,57 @@ _env_checked = False
 def configure(path: str | None) -> None:
     """Open (or with ``None``, close) the process-wide event log.
 
-    Explicit configuration wins: after any call the environment variable
-    is never consulted (``configure(None)`` therefore disables logging
-    even with ``DBX_OBS_JSONL`` set)."""
+    Explicit configuration wins: after any call — even one whose open
+    RAISES — the environment variable is never consulted
+    (``configure(None)`` therefore disables logging even with
+    ``DBX_OBS_JSONL`` set, and a failed configure must not let the env
+    fallback sneak logging back on). The open happens OUTSIDE the
+    module lock (dbxlint lock-blocking: a slow open — NFS, a fifo —
+    must not stall every concurrent ``emit``); an unopenable path
+    raises without touching the current log."""
     global _fh, _path, _env_checked
     with _lock:
         _env_checked = True
+    new_fh = open(path, "a", encoding="utf-8") if path else None
+    with _lock:
         if _fh is not None:
             _fh.close()
-            _fh = None
-        _path = path
-        if path:
-            _fh = open(path, "a", encoding="utf-8")
+        _fh = new_fh
+        _path = path if new_fh is not None else None
 
 
 def _check_env() -> None:
     """First-use environment opt-in: workers/dispatchers started with
     ``DBX_OBS_JSONL`` set begin logging without any code change. A bad
     path must not kill the process — this log is diagnostic, so degrade
-    to disabled with a loud warning instead."""
+    to disabled with a loud warning instead. The open runs OUTSIDE the
+    module lock (dbxlint lock-blocking) with a re-check under the
+    second acquisition: two first-use racers may both open, the loser
+    closes and adopts the winner's state."""
     global _fh, _path, _env_checked
     with _lock:
         if _env_checked:
             return
-        _env_checked = True
-        env_path = os.environ.get("DBX_OBS_JSONL")
-        if not env_path:
-            return
+    env_path = os.environ.get("DBX_OBS_JSONL")
+    fh = None
+    if env_path:
         try:
-            _fh = open(env_path, "a", encoding="utf-8")
-            _path = env_path
+            fh = open(env_path, "a", encoding="utf-8")
         except OSError as e:
             import logging
 
             logging.getLogger("dbx.obs").warning(
                 "DBX_OBS_JSONL=%s could not be opened (%s); event logging "
                 "disabled", env_path, e)
+    with _lock:
+        if _env_checked:
+            if fh is not None:
+                fh.close()
+            return
+        _env_checked = True
+        if fh is not None:
+            _fh = fh
+            _path = env_path
 
 
 def configured_path() -> str | None:

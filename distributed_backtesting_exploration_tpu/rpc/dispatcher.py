@@ -1124,8 +1124,9 @@ class JobQueue:
         (``take_commit`` on a completed id returns False and clears the
         tombstone) so pending counts and ``drained`` stay exact instead
         of waiting for the next worker poll to sweep it. The quota
-        charge releases either way (idempotent)."""
-        # dbxlint: disable=lock-discipline -- every caller holds self._lock
+        charge releases either way (idempotent). No suppression needed:
+        interprocedural lock-discipline proves every caller holds the
+        lock."""
         if self._sched.discard(jid):
             self._state.take_commit(jid, "wfq", self.lease_s)
         self._sched.release(jid)
@@ -2486,6 +2487,11 @@ def main(argv=None) -> None:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    # Runtime lockdep (DBX_LOCKDEP=1): install BEFORE the queue/stores
+    # are built so every package lock created below is instrumented.
+    from ..analysis import lockdep
+
+    lockdep.maybe_install()
     if os.environ.get("DBX_COMPILE_CACHE_DIR"):
         # Operator opted the dispatcher host into the persistent compile
         # cache (a dispatcher that also runs local jax work — bench, a

@@ -833,6 +833,11 @@ def main(argv=None) -> None:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    # Runtime lockdep (DBX_LOCKDEP=1): install BEFORE any backend/cache
+    # construction so every package lock created below is instrumented.
+    from ..analysis import lockdep
+
+    lockdep.maybe_install()
     tristate = {"auto": None, "on": True, "off": False}
     backend = make_backend(args.backend, param_chunk=args.param_chunk,
                            use_fused=tristate[args.fused],

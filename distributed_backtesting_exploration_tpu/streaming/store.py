@@ -86,6 +86,13 @@ class CarryStore:
         self._c_hits["host"].inc()
         carry = recurrent.carry_from_bytes(blob)
         with self._lock:
+            if key in self._device:
+                # A racer re-primed (or a fresh append re-checkpointed)
+                # the key in the deserialize window: the resident carry
+                # is same-or-newer, and overwriting it with this
+                # thread's older copy would silently lose the advance
+                # (dbxlint atomicity — check-then-act across release).
+                return self._device.get(key)
             # Re-prime the device level so the next append skips the
             # deserialize too.
             self._device.put(key, carry, carry.nbytes)

@@ -43,6 +43,11 @@ from .. import obs
 SCHEMA_VERSION = 1
 _FILENAME = f"schedule.v{SCHEMA_VERSION}.jsonl"
 
+# Bound on journal entries queued while the file is unopenable (kept for
+# the next flush's retry; beyond this the oldest drop — an unwritable
+# path already degrades to memory-only, the queue must stay bounded).
+_MAX_PENDING_IO = 1024
+
 # The substrate keys a schedule entry may carry. Unknown keys are dropped
 # at record/merge time so a newer peer's extended schema cannot poison an
 # older consumer's resolution chain (it simply will not see the new knob).
@@ -116,6 +121,17 @@ class ScheduleRegistry:
                  registry: "obs.Registry | None" = None,
                  scope: str = "local"):
         self._lock = threading.Lock()
+        # Journal IO never runs under ``_lock`` (dbxlint lock-blocking:
+        # a slow append — NFS, a full disk retry — would stall every
+        # lookup() on the worker submit hot path and every gossip
+        # merge). Mutations enqueue their entry on ``_pending_io``
+        # under ``_lock``; ``_flush_io`` drains it to the file under
+        # the dedicated leaf ``_io_lock`` — which both serializes
+        # appends and preserves journal order == mutation order (the
+        # queue is filled in ``_lock`` order), so replay's later-wins
+        # semantics still reconstruct the in-memory state.
+        self._io_lock = threading.Lock()
+        self._pending_io: list[dict] = []
         self.path = path
         self._entries: dict[tuple, dict] = {}
         self._dirty: set[tuple] = set()
@@ -157,6 +173,8 @@ class ScheduleRegistry:
         except OSError:
             self.io_errors += 1
             return
+        entries: list[dict] = []
+        bad = 0
         for line in lines:
             if not line.strip():
                 continue
@@ -165,24 +183,78 @@ class ScheduleRegistry:
             except ValueError:
                 e = None
             if e is None or not _valid_entry(e):
-                self.corrupt_entries += 1
-                self._c_corrupt.inc()
+                bad += 1
                 continue
             # Journal replay: later entries win (append-only semantics).
-            # __init__-only today, but locked like every other _entries
-            # mutation so a future reload path cannot race a lookup.
-            with self._lock:
-                self._entries[self._key(e)] = self._scrub(e)
+            entries.append(self._scrub(e))
+        if bad:
+            self.corrupt_entries += bad
+            self._c_corrupt.inc(bad)
+        # ONE lock hold for the whole replay merge (__init__-only today,
+        # but a future reload path racing a gossip merge must not
+        # interleave: a per-line lock would let an older journal line
+        # land AFTER — and silently overwrite — a fresher merged entry).
+        with self._lock:
+            for e in entries:
+                self._entries[self._key(e)] = e
 
-    def _append(self, entry: dict) -> None:
-        if not self.path:
-            return
+    def _open_journal(self):
+        """Open the journal for appending, OUTSIDE every lock; None on
+        failure (memory-only degradation, never a raise)."""
         try:
             os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-            with open(self.path, "a", encoding="utf-8") as fh:
-                fh.write(entry_line(entry) + "\n")
+            return open(self.path, "a", encoding="utf-8")
         except OSError:
-            self.io_errors += 1   # degrade to memory-only, never raise
+            self.io_errors += 1
+            return None
+
+    def _flush_io(self) -> None:
+        """Drain ``_pending_io`` to the journal (constructor docstring:
+        called after ``_lock`` is RELEASED, never nested inside it). A
+        concurrent flusher holding ``_io_lock`` will drain this
+        thread's enqueued entries too — the queue swap under ``_lock``
+        is the only moment both locks are held (io -> lock order,
+        acquisition-cheap on both sides). The file handle lives for ONE
+        flush (O_APPEND, writes serialized by ``_io_lock``): no fd
+        outlives the call, matching the pre-round-12 per-append cost
+        profile without its under-lock open."""
+        if not self.path:
+            return   # memory-only registry: nothing is ever enqueued
+        with self._lock:
+            if not self._pending_io:
+                return
+        fh = self._open_journal()
+        if fh is None:
+            # Transient open failure: keep the queue for the next
+            # flush's retry — clearing here would drop entries OTHER
+            # threads just enqueued whose own flush would succeed.
+            # Bounded (oldest dropped) so a permanently unwritable
+            # path cannot grow it without limit.
+            with self._lock:
+                if len(self._pending_io) > _MAX_PENDING_IO:
+                    del self._pending_io[:-_MAX_PENDING_IO]
+            return
+        failed = 0
+        try:
+            with self._io_lock:
+                while True:
+                    with self._lock:
+                        if not self._pending_io:
+                            break
+                        batch = self._pending_io[:]
+                        self._pending_io.clear()
+                    try:
+                        for e in batch:
+                            fh.write(entry_line(e) + "\n")
+                        fh.flush()
+                    except OSError:
+                        failed += 1
+        finally:
+            fh.close()
+        if failed:
+            # Counted outside both locks (io_errors is a best-effort
+            # diagnostic, never guarded state): degrade, don't raise.
+            self.io_errors += failed
 
     # -- core map ----------------------------------------------------------
 
@@ -228,7 +300,9 @@ class ScheduleRegistry:
                 return False
             self._entries[key] = e
             self._dirty.add(key)
-            self._append(e)
+            if self.path:
+                self._pending_io.append(e)
+        self._flush_io()
         return True
 
     def entries(self) -> list[dict]:
@@ -322,5 +396,7 @@ class ScheduleRegistry:
             self._entries[key] = e
             if mark_dirty:
                 self._dirty.add(key)
-            self._append(e)
+            if self.path:
+                self._pending_io.append(e)
+        self._flush_io()
         return True

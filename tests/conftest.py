@@ -35,6 +35,17 @@ from distributed_backtesting_exploration_tpu.tune import (  # noqa: E402
 _compile_cache.configure(os.environ.get("DBX_TEST_COMPILE_CACHE",
                                         "/tmp/dbx_test_jax_cache"))
 
+# Runtime lockdep (analysis.lockdep): DBX_LOCKDEP=1 turns the WHOLE
+# tier-1 suite into a race harness — every in-process gRPC integration
+# fixture then runs with instrumented package locks recording real
+# acquisition edges and blocking-under-lock violations. Installed here,
+# before any fixture constructs a queue/worker/cache, so every package
+# lock is wrapped; a no-op (nothing patched) when the knob is unset.
+from distributed_backtesting_exploration_tpu.analysis import (  # noqa: E402
+    lockdep as _lockdep)
+
+_lockdep.maybe_install()
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,46 @@
+"""Seeded violation: check-then-act on a guarded field across a lock
+release — the PR-8 quota-charge bug class (read under lock, branch
+unlocked, re-acquire and write a value computed from the stale read).
+The double-checked and single-critical-section forms are the clean
+counterparts."""
+
+import threading
+
+
+class QuotaLedger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._spent = {}
+
+    def charge(self, tenant, cost, quota):
+        with self._lock:
+            spent = self._spent.get(tenant, 0.0)
+        if spent + cost > quota:          # decision on the stale read
+            return False
+        with self._lock:
+            # VIOLATION: another thread may have charged in the window;
+            # this write acts on the pre-window value.
+            self._spent[tenant] = spent + cost
+        return True
+
+    def charge_checked(self, tenant, cost, quota):
+        with self._lock:
+            spent = self._spent.get(tenant, 0.0)
+        if spent + cost > quota:
+            return False
+        with self._lock:
+            # CLEAN: re-validated under the second acquisition (the
+            # double-checked fix).
+            if self._spent.get(tenant, 0.0) + cost > quota:
+                return False
+            self._spent[tenant] = self._spent.get(tenant, 0.0) + cost
+        return True
+
+    def charge_atomic(self, tenant, cost, quota):
+        with self._lock:
+            # CLEAN: one critical section end to end.
+            spent = self._spent.get(tenant, 0.0)
+            if spent + cost > quota:
+                return False
+            self._spent[tenant] = spent + cost
+        return True

@@ -1,6 +1,11 @@
-"""Seeded violation: a sleep inside a gRPC servicer handler."""
+"""Seeded violations: a sleep and a device sync inside gRPC servicer
+handlers (blocking-call), and a sleep while holding a lock
+(lock-blocking — the PR-9 PagePool scrape-stall class)."""
 
+import threading
 import time
+
+import jax
 
 
 class DispatcherServicer:
@@ -14,6 +19,29 @@ class SlowDispatcher(DispatcherServicer):
         time.sleep(0.5)
         return None
 
+    def GetStats(self, request, context):
+        # VIOLATION (device-sync vocabulary): the handler blocks for as
+        # long as the accelerator takes to drain.
+        jax.block_until_ready(request)
+        return None
+
     def _helper(self):
         # NOT in the allowlist either; helpers of a servicer class count.
         return 1
+
+
+class StallingPool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pages = {}
+
+    def upload(self, key, page):
+        with self._lock:
+            self._pages[key] = page
+            # VIOLATION (lock-blocking): the device sync runs under the
+            # index lock — every concurrent stats scrape stalls for it.
+            jax.block_until_ready(page)
+
+    def scrape(self):
+        with self._lock:
+            return len(self._pages)

@@ -1,0 +1,41 @@
+"""Seeded interprocedural lock-discipline scenario: a private helper
+mutating a guarded field is CLEAN when every caller path holds the lock
+(the PagePool ``prepare()`` shape — previously only expressible as a
+suppression), and a violation when one reachable path does not."""
+
+import threading
+
+
+class PageIndex:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._slots = {}
+        self._free = []
+
+    def prepare(self, key):
+        with self._lock:
+            return self._take_slot(key)
+
+    def _take_slot(self, key):
+        # CLEAN: every caller (prepare, and _grow via prepare) holds
+        # self._lock — provable from the call graph, no suppression.
+        slot = self._free.pop() if self._free else self._grow()
+        self._slots[key] = slot
+        return slot
+
+    def _grow(self):
+        # CLEAN for the same reason (reached only via _take_slot).
+        self._free.extend(range(8))
+        return self._free.pop()
+
+    def forget(self, key):
+        # VIOLATION: public method, lock-free entry path, mutates the
+        # guarded `_slots`.
+        self._slots.pop(key, None)
+
+    def _sweep(self):
+        # VIOLATION: private, but reachable lock-free through audit().
+        self._slots.clear()
+
+    def audit(self):
+        return self._sweep()

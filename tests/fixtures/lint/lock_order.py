@@ -1,0 +1,46 @@
+"""Seeded violations: a 2-lock acquisition-order cycle (ABBA), a nested
+re-acquisition of a non-reentrant lock through a helper, and — as the
+clean counterpart — a 2-lock hierarchy acquired in ONE consistent order
+everywhere."""
+
+import threading
+
+_alpha = threading.Lock()
+_beta = threading.Lock()
+
+_outer = threading.Lock()
+_inner = threading.Lock()
+
+
+def transfer_ab():
+    with _alpha:
+        with _beta:        # VIOLATION: beta-under-alpha
+            return 1
+
+
+def transfer_ba():
+    with _beta:
+        with _alpha:       # VIOLATION: alpha-under-beta (the reverse)
+            return 2
+
+
+def hierarchy_one():
+    with _outer:
+        with _inner:       # clean: outer -> inner everywhere
+            return 3
+
+
+def hierarchy_two():
+    with _outer:
+        with _inner:       # same order: no cycle, no finding
+            return 4
+
+
+def reenter():
+    with _outer:
+        return _locked_helper()
+
+
+def _locked_helper():
+    with _outer:           # VIOLATION: self-nest via reenter()
+        return 5

@@ -24,9 +24,9 @@ def test_package_lints_clean():
     assert result["clean"]
     # The gate must actually have run every registered rule.
     assert set(result["rules"]) == {
-        "trace-time-env", "lock-discipline", "import-time-config",
-        "blocking-call", "obs-cardinality", "kernel-hygiene",
-        "proto-drift"}
+        "trace-time-env", "lock-discipline", "lock-order", "atomicity",
+        "lock-blocking", "import-time-config", "blocking-call",
+        "obs-cardinality", "kernel-hygiene", "proto-drift"}
 
 
 def test_cli_module_entrypoint_is_wired():

@@ -155,6 +155,36 @@ def test_span_nesting_and_event_log(tmp_path):
     assert s["dbx_span_seconds{span=inner}"]["count"] >= 1
 
 
+def test_event_log_open_runs_outside_the_module_lock(tmp_path,
+                                                     monkeypatch):
+    """Round-12 lock-blocking fix: configure() and the DBX_OBS_JSONL
+    first-use path used to open the file INSIDE the module lock — a
+    slow open (NFS, a fifo) stalled every concurrent emit. Both opens
+    now run with the lock free; a failed configure() leaves the
+    previous log attached instead of half-torn-down."""
+    path = str(tmp_path / "ev.jsonl")
+    lock_states = []
+    real_open = open
+
+    def spy_open(*a, **k):
+        if a and str(a[0]).endswith("ev.jsonl"):
+            lock_states.append(events._lock.locked())
+        return real_open(*a, **k)
+
+    monkeypatch.setattr("builtins.open", spy_open)
+    events.configure(path)
+    try:
+        assert lock_states == [False]
+        # An unopenable reconfigure raises WITHOUT killing the live log.
+        with pytest.raises(OSError):
+            events.configure(str(tmp_path / "no" / "dir" / "x.jsonl"))
+        assert events.enabled() and events.configured_path() == path
+        events.emit("still_alive")
+    finally:
+        events.configure(None)
+    assert "still_alive" in real_open(path).read()
+
+
 def test_span_records_on_exception(tmp_path):
     path = str(tmp_path / "ev.jsonl")
     events.configure(path)

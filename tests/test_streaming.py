@@ -197,6 +197,38 @@ def test_carry_store_levels_bounds_and_counters():
     assert tiny.get(key) is None
 
 
+def test_carry_store_reprime_does_not_overwrite_racer(monkeypatch):
+    """Round-12 atomicity fix (dbxlint check-then-act): the host-restore
+    path used to re-prime the device level blindly — a carry checkpointed
+    by a racing thread in the deserialize window (same key, MORE bars
+    advanced) was overwritten by this thread's older copy, silently
+    losing the advance. get() now re-validates under the second
+    acquisition and serves the resident carry."""
+    grid = _grid("momentum")
+    older = rc.build_carry("momentum", _fields("momentum", T_BASE), grid)
+    newer = rc.append_step(older, _fields("momentum", T_FULL, T_BASE))
+    store = CarryStore(max_bytes=1 << 22)
+    key = ("d-race", "s-race")
+    store.put(key, older)
+    store.evict_device(key)               # host blob = the OLDER state
+
+    real = rc.carry_from_bytes
+
+    def racing_deserialize(blob):
+        out = real(blob)
+        # The race, made deterministic: a racer re-checkpoints the key
+        # while this thread is between the two lock acquisitions.
+        with store._lock:
+            store._device.put(key, newer, newer.nbytes)
+        return out
+
+    monkeypatch.setattr(rc, "carry_from_bytes", racing_deserialize)
+    got = store.get(key)
+    assert got is newer                   # the resident (newer) carry wins
+    with store._lock:
+        assert store._device.get(key) is newer   # never overwritten
+
+
 def test_append_epilogue_substrates_agree():
     """The append's equity advance under scan vs ladder: selection-only
     state is identical (count metrics bit-exact); the equity path differs

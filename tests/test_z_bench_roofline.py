@@ -150,6 +150,19 @@ def test_wire_bytes_per_job_keys_present(local_bench):
     assert floor["wire_bytes_per_job"]["b128"] > 0.0
 
 
+def test_direct_dispatch_lockdep_ab_keys_present(local_bench):
+    """Round 12: the direct_dispatch floor is re-measured with the
+    runtime lockdep shim on — overhead and violation count are tracked
+    bench columns (DBX_LOCKDEP=1 must stay fleet-viable), and the
+    instrumented control-plane cycle must be violation-free."""
+    ld = local_bench["roofline"]["direct_dispatch_floor"]["lockdep"]
+    for key in ("batch32_jobs_per_s", "overhead_pct", "floor_ok",
+                "edges", "violations"):
+        assert key in ld, key
+    assert ld["batch32_jobs_per_s"] > 0.0
+    assert ld["violations"] == 0
+
+
 _STREAM_ENV = {
     "DBX_BENCH_CPU": "1", "DBX_BENCH_CACHE": "",
     "DBX_BENCH_CONFIGS": "streaming_append",
