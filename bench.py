@@ -1385,6 +1385,211 @@ def main():
               f"full reprice {t_full * 1e3:.1f} ms -> {speedup:.1f}x "
               f"(wire {wire_full}B -> {wire_delta}B)", file=sys.stderr)
 
+    # --- fanout: live signal fan-out scaling (serve/, ROADMAP item 3) -----
+    # The serving-cost contract measured end to end: N subscriptions over
+    # M symbol chains (all sharing one param block per symbol -> M unique
+    # streams), one tick-only AppendBars per symbol, an instant-backend
+    # worker draining the advance jobs over loopback gRPC, and every
+    # push delivered through real server-streaming Subscribe calls.
+    # `advances_per_tick` MUST equal unique streams per chain (1 here) —
+    # carry advances scale with streams, not subscribers — and
+    # `pushes_per_advance` is the fan-out amplification (N/M). Tick-to-
+    # push latency is client-measured (same host, same clock): recv wall
+    # minus the PushUpdate's dispatcher tick stamp; the p99 bar is
+    # bench-pinned at 2s on this box (loopback + instant compute — the
+    # number is the SERVING tier's overhead, not kernel wall).
+    if enabled("fanout"):
+        import tempfile
+        import threading
+
+        import grpc as grpc_mod
+
+        from distributed_backtesting_exploration_tpu import obs as obs_mod
+        from distributed_backtesting_exploration_tpu.rpc import (
+            backtesting_pb2 as fan_pb, service as fan_service,
+            wire as fan_wire)
+        from distributed_backtesting_exploration_tpu.rpc.compute import (
+            InstantBackend)
+        from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+            Dispatcher, DispatcherServer, JobQueue, JobRecord,
+            PeerRegistry)
+        from distributed_backtesting_exploration_tpu.rpc.worker import (
+            Worker)
+
+        sub_n = int(os.environ.get("DBX_BENCH_SUB_N", 10000))
+        n_symbols = int(os.environ.get("DBX_BENCH_SUB_SYMBOLS", 1000))
+        n_conns = min(int(os.environ.get("DBX_BENCH_SUB_CONNS", 32)),
+                      sub_n)
+        fan_bars = 64
+        fan_grid = {"fast": np.arange(5.0, 9.0, dtype=np.float32)}
+        hist = data.synthetic_ohlcv(n_symbols, fan_bars + 1, seed=700)
+
+        def sym_cut(i, lo, hi):
+            return data.to_wire_bytes(
+                type(hist)(*(np.asarray(f[i, lo:hi]) for f in hist)))
+
+        base_recs = [JobRecord(id=f"fan-{i}", strategy="sma_crossover",
+                               grid=fan_grid, ohlcv=sym_cut(i, 0, fan_bars))
+                     for i in range(n_symbols)]
+
+        class _FanCollector:
+            """Drains one Subscribe stream; samples tick->recv wall."""
+
+            def __init__(self, stub, request, expected):
+                self.lat: list[float] = []
+                self.expected = expected
+                self._call = stub.Subscribe(request)
+                self.thread = threading.Thread(target=self._drain,
+                                               daemon=True)
+                self.thread.start()
+
+            def _drain(self):
+                try:
+                    for item in self._call:
+                        if item.tick_unix:
+                            self.lat.append(time.time() - item.tick_unix)
+                        if len(self.lat) >= self.expected:
+                            break
+                except grpc_mod.RpcError:
+                    pass
+
+            def stop(self):
+                self._call.cancel()
+                self.thread.join(timeout=10)
+
+        queue = JobQueue()
+        reg = obs_mod.get_registry()
+        adv0 = reg.counter("dbx_stream_advances_total").value
+        drop0 = reg.counter("dbx_sub_pushes_total",
+                            outcome="dropped").value
+        with tempfile.TemporaryDirectory() as results_dir:
+            disp = Dispatcher(queue, PeerRegistry(prune_window_s=60.0),
+                              results_dir=results_dir)
+            srv = DispatcherServer(disp, bind="localhost:0",
+                                   prune_interval_s=0.5,
+                                   max_workers=n_conns + 16).start()
+            worker = Worker(f"localhost:{srv.port}", InstantBackend(),
+                            worker_id="fanout-worker",
+                            poll_interval_s=0.001, status_interval_s=0.5,
+                            jobs_per_chip=64)
+            wt = threading.Thread(target=worker.run, daemon=True)
+            channel = grpc_mod.insecure_channel(
+                f"localhost:{srv.port}",
+                options=fan_service.default_channel_options())
+            stub = fan_service.DispatcherStub(channel)
+            collectors = []
+            try:
+                wt.start()
+                for rec in base_recs:
+                    queue.enqueue(rec)
+                deadline = time.monotonic() + 300.0
+                while not queue.drained:
+                    if time.monotonic() > deadline:
+                        sys.exit("bench[fanout]: base drain wedged — "
+                                 f"stats={queue.stats()}")
+                    time.sleep(0.005)
+                # N subscriptions spread so each symbol's subscribers
+                # land on DISTINCT connections (a connection naming the
+                # same stream twice is deduped by design — one
+                # membership, one push — so per-stream fan-out is
+                # counted in connections). Symbol s's k-th subscriber
+                # rides connection (s + k) % n_conns: with
+                # subs-per-symbol <= n_conns they are all distinct.
+                per_sym = sub_n // n_symbols
+                if per_sym > n_conns:
+                    sys.exit("bench[fanout]: DBX_BENCH_SUB_CONNS "
+                             f"({n_conns}) < subscribers per symbol "
+                             f"({per_sym}) — a connection would hold "
+                             "duplicate interests in one stream, which "
+                             "dedupes to one push")
+                per_conn = [[] for _ in range(n_conns)]
+                for j in range(sub_n):
+                    s, k = divmod(j, per_sym) if per_sym else (j, 0)
+                    s %= n_symbols
+                    per_conn[(s + k) % n_conns].append(fan_pb.JobSpec(
+                        strategy="sma_crossover",
+                        panel_digest=base_recs[s].panel_digest,
+                        grid=fan_wire.grid_to_proto(fan_grid),
+                        periods_per_year=252))
+                for c, interests in enumerate(per_conn):
+                    collectors.append(_FanCollector(
+                        stub, fan_pb.SubscribeRequest(
+                            subscriber_id=f"fan-c{c}",
+                            interests=interests),
+                        expected=len(interests)))
+                deadline = time.monotonic() + 120.0
+                while disp.hub.stats()["interests"] < sub_n:
+                    if time.monotonic() > deadline:
+                        sys.exit("bench[fanout]: subscriptions never "
+                                 f"registered — {disp.hub.stats()}")
+                    time.sleep(0.01)
+                t0 = time.perf_counter()
+                for i, rec in enumerate(base_recs):
+                    r = stub.AppendBars(fan_pb.AppendRequest(
+                        worker_id="feed", panel_digest=rec.panel_digest,
+                        base_len=fan_bars,
+                        delta=sym_cut(i, fan_bars, fan_bars + 1),
+                        job=fan_pb.JobSpec()))
+                    if not r.ok:
+                        sys.exit(f"bench[fanout]: tick {i} rejected: "
+                                 f"{r.detail}")
+                t_ticks = time.perf_counter() - t0
+                deadline = time.monotonic() + 300.0
+                while any(len(c.lat) < c.expected for c in collectors):
+                    if time.monotonic() > deadline:
+                        got = sum(len(c.lat) for c in collectors)
+                        # Drop-and-count is legal under load; report
+                        # what arrived rather than wedging (the keys
+                        # below carry the drop counter).
+                        print(f"bench[fanout]: {got}/{sub_n} pushes "
+                              "after 300s (rest dropped or late)",
+                              file=sys.stderr)
+                        break
+                    time.sleep(0.01)
+                t_all = time.perf_counter() - t0
+            finally:
+                for c in collectors:
+                    c.stop()
+                worker.stop()
+                wt.join(timeout=30)
+                channel.close()
+                srv.stop()
+        lat = sorted(x for c in collectors for x in c.lat)
+        advances = reg.counter("dbx_stream_advances_total").value - adv0
+        dropped = reg.counter("dbx_sub_pushes_total",
+                              outcome="dropped").value - drop0
+        from distributed_backtesting_exploration_tpu.obs.timeline import (
+            _quantile)
+
+        p99 = _quantile(lat, 0.99)
+        p99_bar_s = 2.0
+        ROOFLINE["fanout"] = {
+            "subscriptions": sub_n, "symbols": n_symbols,
+            "connections": n_conns,
+            "unique_streams": n_symbols,
+            "ticks": n_symbols,
+            "advances_total": int(advances),
+            "advances_per_tick": round(advances / max(n_symbols, 1), 4),
+            "advances_eq_streams": bool(advances == n_symbols),
+            "pushes_delivered": len(lat),
+            "pushes_dropped": int(dropped),
+            "pushes_per_advance": round(len(lat) / max(advances, 1), 2),
+            "tick_to_push_p50_s": round(_quantile(lat, 0.50), 6),
+            "tick_to_push_p99_s": round(p99, 6),
+            "p99_bar_s": p99_bar_s,
+            "p99_ok": bool(p99 <= p99_bar_s),
+            "tick_wall_s": round(t_ticks, 3),
+            "drain_wall_s": round(t_all, 3)}
+        rates["fanout"] = len(lat) / max(t_all, 1e-9)
+        print(f"bench[fanout]: {sub_n} subs / {n_symbols} symbols on "
+              f"{n_conns} conns: {advances} advances "
+              f"({advances / max(n_symbols, 1):.2f}/tick, streams="
+              f"{n_symbols}), {len(lat)} pushes "
+              f"({len(lat) / max(advances, 1):.1f}/advance, "
+              f"{dropped} dropped), tick->push p50 "
+              f"{_quantile(lat, 0.5) * 1e3:.1f}ms p99 {p99 * 1e3:.1f}ms, "
+              f"drain {t_all:.1f}s", file=sys.stderr)
+
     # --- e2e_local_tenants: 3-tenant adversarial fairness A/B -------------
     # ROADMAP item 5's acceptance instrument: a whale tenant's oversized
     # grid sweep (many jobs x many combos) must not blow up a small
@@ -2070,8 +2275,8 @@ def main():
                  "macd_fused, trix_fused, obv_fused, pairs, e2e, e2e_topk, "
                  "e2e_local, e2e_local_tenants, scenario_sweep, "
                  "direct_dispatch, queue_machine, streaming_append, "
-                 "ragged_paged, autotune, walkforward, long_context, "
-                 "roofline_stages")
+                 "fanout, ragged_paged, autotune, walkforward, "
+                 "long_context, roofline_stages")
         sys.exit(f"bench: no configs ran — DBX_BENCH_CONFIGS={only} matched "
                  f"nothing (known: {known})")
     # The headline is the north-star config when it ran; otherwise label the

@@ -444,13 +444,16 @@ class ObsCardinalityRule:
     # reason per-tenant obs can exist under this rule at all); the
     # autotuner's shape_bucket clamps (T, P) onto finite power-of-two
     # rails, so per-shape-bucket obs is bounded the same way (raw dims
-    # would mint one series per shape).
-    _SANCTIONED_CALLS = {"tenant_bucket", "shape_bucket"}
+    # would mint one series per shape); stream_bucket is the tenant map's
+    # twin for the live fan-out tier's param-block digests
+    # (DBX_STREAM_LABEL_MAX sticky prefixes + "other").
+    _SANCTIONED_CALLS = {"tenant_bucket", "shape_bucket", "stream_bucket"}
     _UNBOUNDED = re.compile(
         r"(?:^|_)(?:id|ids|jid|uid|uuid|guid|key|token|path|paths|file|"
         r"filename|dir|addr|address|peer|host|hostname|port|url|uri|"
         r"target|trace|span|digest|digests|blake2b|checksum|hash|"
-        r"tenant|tenants)(?:$|_)")
+        r"tenant|tenants|stream|streams|sub|subs|subscriber|subscribers|"
+        r"subscription|subscriptions)(?:$|_)")
 
     def check(self, ctx: LintContext) -> list[Finding]:
         out: list[Finding] = []

@@ -44,9 +44,14 @@ _FIELD_RE = re.compile(
     r"(?:map\s*<[^>]+>|[A-Za-z0-9_.]+)\s+"
     r"([A-Za-z0-9_]+)\s*=\s*(\d+)\s*(?:\[[^\]]*\])?\s*;")
 _ENUM_VALUE_RE = re.compile(r"([A-Za-z0-9_]+)\s*=\s*(\d+)\s*;")
+# The optional `stream` keywords are CAPTURED, not skipped: a pb2 whose
+# method drops (or invents) server streaming is a wire-breaking drift —
+# the client would issue a unary call against a streaming handler. The
+# model encodes streaming-ness as a "stream " prefix on the type name,
+# so unary signatures stay plain (input, output) tuples.
 _RPC_RE = re.compile(
-    r"\brpc\s+([A-Za-z0-9_]+)\s*\(\s*([A-Za-z0-9_.]+)\s*\)\s*"
-    r"returns\s*\(\s*([A-Za-z0-9_.]+)\s*\)")
+    r"\brpc\s+([A-Za-z0-9_]+)\s*\(\s*(stream\s+)?([A-Za-z0-9_.]+)\s*\)\s*"
+    r"returns\s*\(\s*(stream\s+)?([A-Za-z0-9_.]+)\s*\)")
 _BLOCK_RE = re.compile(r"^\s*(message|enum|service)\s+([A-Za-z0-9_]+)\s*\{")
 
 
@@ -84,9 +89,10 @@ def parse_proto_text(text: str) -> ProtoModel:
             (None, None))
         if kind == "service":
             for m in _RPC_RE.finditer(content):
-                meth, inp, outp = m.groups()
-                model.services[name][meth] = (inp.split(".")[-1],
-                                              outp.split(".")[-1])
+                meth, in_stream, inp, out_stream, outp = m.groups()
+                model.services[name][meth] = (
+                    ("stream " if in_stream else "") + inp.split(".")[-1],
+                    ("stream " if out_stream else "") + outp.split(".")[-1])
                 model.lines[("rpc", name, meth)] = lineno
         elif kind == "enum":
             for m in _ENUM_VALUE_RE.finditer(content):
@@ -137,10 +143,26 @@ def describe_pb2(pb2_module) -> ProtoModel:
         add_message(desc)
     enums = {e.name: {v.name: v.number for v in e.values}
              for e in fd.enum_types_by_name.values()}
-    services = {
-        s.name: {m.name: (m.input_type.name, m.output_type.name)
-                 for m in s.methods}
-        for s in fd.services_by_name.values()}
+    # Streaming flags live on the serialized FileDescriptorProto, not the
+    # runtime MethodDescriptor surface (portable across protobuf
+    # generations) — re-parse it for the same "stream " prefix encoding
+    # the text side uses.
+    from google.protobuf import descriptor_pb2
+
+    fdp = descriptor_pb2.FileDescriptorProto.FromString(fd.serialized_pb)
+    streaming = {
+        (s.name, m.name): (m.client_streaming, m.server_streaming)
+        for s in fdp.service for m in s.method}
+    services = {}
+    for s in fd.services_by_name.values():
+        sigs = {}
+        for m in s.methods:
+            c_stream, s_stream = streaming.get((s.name, m.name),
+                                               (False, False))
+            sigs[m.name] = (
+                ("stream " if c_stream else "") + m.input_type.name,
+                ("stream " if s_stream else "") + m.output_type.name)
+        services[s.name] = sigs
     return ProtoModel(messages, enums, services)
 
 

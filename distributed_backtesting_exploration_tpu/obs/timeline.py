@@ -47,8 +47,15 @@ import sys
 # would read as decode work that never happened, and timelines from
 # workers that skip the span entirely would silently mis-charge the gap
 # to transport.
+# `push` is the live fan-out stage (serve/): the dispatcher-side window
+# from a completion landing to the result fanned out onto every
+# subscriber queue — emitted BEFORE the job's e2e span closes, so it
+# lands inside the attribution window (delivery to the client socket is
+# the subscriber generator's own wall, visible on the tick-to-push
+# histogram instead).
 STAGES = ("queue_wait", "dispatch", "transport", "panel_cache_hit",
-          "carry_hit", "decode", "compile", "execute", "d2h", "report")
+          "carry_hit", "decode", "compile", "execute", "d2h", "report",
+          "push")
 
 # span name -> (stage, priority). Priority 2 = stage-specific span wins
 # its interval outright; priority 1 = envelope fallback (charged only
@@ -73,6 +80,10 @@ SPAN_STAGE = {
     # execute work at full-reprice scale); a checkpoint-miss full reprice
     # stays execute.
     "worker.append": ("execute", 2),
+    # Live fan-out (serve/): the completion->fanned-out window on the
+    # dispatcher. Priority 2: it overlaps only envelope spans (the
+    # worker's report fallback), and those instants ARE push work.
+    "job.push": ("push", 2),
     "worker.submit": ("execute", 1),
     "worker.collect": ("d2h", 1),
     "worker.process": ("execute", 1),

@@ -1027,32 +1027,23 @@ class JobQueue:
             return blob if d == digest else None
         return None
 
-    def append_bars(self, parent_digest: str, base_len: int, delta: bytes,
-                    *, strategy: str, grid, cost: float = 0.0,
-                    periods_per_year: int = 252,
-                    tenant: str = DEFAULT_TENANT
-                    ) -> tuple[JobRecord | None, str, str, int]:
-        """Streaming live-bar ingest (the AppendBars RPC's queue half):
-        splice ``delta`` onto the stored base panel, journal the chain
-        link, and enqueue one repricing job for the extended panel.
+    def extend_chain(self, parent_digest: str, base_len: int,
+                     delta: bytes) -> tuple[str, str, int]:
+        """Splice ``delta`` onto the stored base panel and journal the
+        chain link — the tick half of AppendBars, shared by the job
+        template AND the subscription tier's per-stream advances (one
+        splice per tick, however many streams fan out of it).
 
-        Returns ``(record, outcome, new_digest, new_len)`` — record None
-        with a reject outcome (``unsupported_strategy`` /
-        ``base_missing`` / ``bad_delta`` / ``base_len_mismatch``) when
-        nothing was enqueued. Journal order: the ``delta`` event lands
-        BEFORE the job's enqueue record, so a restored append job always
-        finds its chain; a crash in between merely leaves a harmless
-        orphan link.
-        """
-        if strategy not in STREAMABLE_STRATEGIES:
-            # Reject synchronously — enqueueing would burn a dispatch
-            # round trip only for the worker to complete it loudly empty
-            # (pairs cannot stream over a one-panel wire; unknown
-            # families have no carry).
-            return None, "unsupported_strategy", "", 0
+        Returns ``(outcome, new_digest, new_len)``; a reject outcome
+        (``base_missing`` / ``bad_delta`` / ``base_len_mismatch``)
+        carries an empty digest (``base_len_mismatch`` reports the REAL
+        base length in ``new_len`` for caller re-sync). Journal order:
+        the ``delta`` event lands BEFORE any job's enqueue record, so a
+        restored append job always finds its chain; a crash in between
+        merely leaves a harmless orphan link."""
         base = self.payload_for_digest(parent_digest)
         if base is None:
-            return None, "base_missing", "", 0
+            return "base_missing", "", 0
         base_series = data_mod.from_wire_bytes(base)
         if base_len and base_len != base_series.n_bars:
             # Stale feed guard, checked BEFORE any splice work: the
@@ -1060,13 +1051,13 @@ class JobQueue:
             # base — appending would silently misalign every later bar.
             # Reject near-free; the caller re-syncs off the reply's
             # digest/new_len.
-            return None, "base_len_mismatch", "", base_series.n_bars
+            return "base_len_mismatch", "", base_series.n_bars
         try:
             d_series = data_mod.from_wire_bytes(delta)
             if d_series.n_bars < 1:
                 raise ValueError("empty delta slice")
         except ValueError:
-            return None, "bad_delta", "", 0
+            return "bad_delta", "", 0
         # One decode each + one encode (splice_wire_bytes would re-decode
         # both blobs — the live-serving hot path skips that).
         blob = data_mod.to_wire_bytes(data_mod.OHLCV(*(
@@ -1082,13 +1073,63 @@ class JobQueue:
         with self._lock:
             self._delta_chain[ndig] = (parent_digest, delta,
                                        base_series.n_bars)
-        rec = JobRecord(
+        return "extended", ndig, new_len
+
+    def make_append_record(self, ndig: str, *, strategy: str, grid,
+                           cost: float = 0.0, periods_per_year: int = 252,
+                           tenant: str = DEFAULT_TENANT
+                           ) -> JobRecord | None:
+        """A repricing JobRecord for the extended panel ``ndig`` (NOT
+        enqueued — the caller may need to index the id first, e.g. the
+        subscription hub's register-before-enqueue discipline). The
+        append linkage (parent, base length, delta bytes) comes from the
+        chain ``extend_chain`` just recorded; None when ``ndig`` has no
+        chain link (caller bug or a raced restart)."""
+        with self._lock:
+            link = self._delta_chain.get(ndig)
+        if link is None:
+            return None
+        parent, delta, base_n = link
+        return JobRecord(
             id=str(uuid.uuid4()), strategy=strategy, grid=grid,
             cost=float(cost), periods_per_year=int(periods_per_year),
-            panel_digest=ndig, append_parent=parent_digest,
-            append_base_len=base_series.n_bars, delta=delta,
+            panel_digest=ndig, append_parent=parent,
+            append_base_len=base_n, delta=delta,
             tenant=tenant or DEFAULT_TENANT)
-        self.enqueue(rec)
+
+    def append_bars(self, parent_digest: str, base_len: int, delta: bytes,
+                    *, strategy: str, grid, cost: float = 0.0,
+                    periods_per_year: int = 252,
+                    tenant: str = DEFAULT_TENANT
+                    ) -> tuple[JobRecord | None, str, str, int]:
+        """Streaming live-bar ingest (the AppendBars RPC's queue half):
+        :meth:`extend_chain` + one enqueued repricing job for the
+        extended panel. An EMPTY ``strategy`` is a tick-only append —
+        the chain extends (and the subscription tier's advances ride
+        it, dispatcher-side) but no template job enqueues.
+
+        Returns ``(record, outcome, new_digest, new_len)`` — record None
+        with a reject outcome (``unsupported_strategy`` /
+        ``base_missing`` / ``bad_delta`` / ``base_len_mismatch``) when
+        nothing was enqueued, and None with ``extended`` for tick-only
+        appends.
+        """
+        if strategy and strategy not in STREAMABLE_STRATEGIES:
+            # Reject synchronously — enqueueing would burn a dispatch
+            # round trip only for the worker to complete it loudly empty
+            # (pairs cannot stream over a one-panel wire; unknown
+            # families have no carry).
+            return None, "unsupported_strategy", "", 0
+        outcome, ndig, new_len = self.extend_chain(parent_digest,
+                                                   base_len, delta)
+        if outcome != "extended":
+            return None, outcome, ndig, new_len
+        rec = None
+        if strategy:
+            rec = self.make_append_record(
+                ndig, strategy=strategy, grid=grid, cost=cost,
+                periods_per_year=periods_per_year, tenant=tenant)
+            self.enqueue(rec)
         return rec, "extended", ndig, new_len
 
     def complete(self, jid: str, worker_id: str) -> str:
@@ -1518,6 +1559,18 @@ class Dispatcher(service.DispatcherServicer):
         # by FetchCompiled / fed by OfferCompiled. Entries are opaque —
         # the dispatcher never needs jax.
         self.compile_store = tune_mod.CompileStore(registry=self.obs)
+        # Live signal fan-out (serve/, round 13): the subscription
+        # registry + result cache + push fan-out behind the
+        # server-streaming Subscribe RPC. In-memory only — restart
+        # semantics are "streams terminate, clients re-subscribe against
+        # the journal-replayed chain". Imported lazily like tune above:
+        # serve sits on rpc.panel_store/rpc.wire, and a module-level
+        # import here would cycle through the rpc package __init__.
+        from .. import serve as serve_mod
+
+        self._serve = serve_mod
+        self.hub = serve_mod.SubscriptionHub(
+            registry=self.obs, streamable=STREAMABLE_STRATEGIES)
         # Thread-local: concurrent GetStats calls on the gRPC pool must
         # each lend their OWN snapshot to the collector, not race on one
         # shared slot.
@@ -1533,7 +1586,10 @@ class Dispatcher(service.DispatcherServicer):
     def close(self) -> None:
         """Unhook this dispatcher from the obs registry: one final gauge
         refresh, then remove the collector so a stopped dispatcher neither
-        publishes stale queue gauges nor pins its JobQueue alive."""
+        publishes stale queue gauges nor pins its JobQueue alive. Also
+        closes the subscription hub — every live Subscribe stream's pull
+        loop wakes, sees its subscription closed, and ends."""
+        self.hub.close()
         try:
             self._collect_gauges(self.obs)
         except Exception:
@@ -1864,6 +1920,14 @@ class Dispatcher(service.DispatcherServicer):
         if metrics:
             self._record_result(jid, metrics)
         if outcome == "new":
+            # Live fan-out BEFORE the e2e span closes (its `push` span
+            # must land inside the job's attribution window); the hub
+            # probe is lock-free for the zero-subscription fleet, and a
+            # dup can never re-push (the advance index pops on first
+            # completion).
+            if metrics and self.hub.has_advances():
+                self.hub.on_result(jid, metrics,
+                                   trace_id=self.queue.job_trace(jid)[0])
             self._close_job_trace(jid, worker_id)
         log.info("job %s completed by %s in %.3fs", jid, worker_id, elapsed_s)
         if outcome == "new" or (outcome == "dup" and metrics):
@@ -1906,6 +1970,14 @@ class Dispatcher(service.DispatcherServicer):
                 reply.unknown_ids.append(item.id)
                 continue
             if outcome == "new":
+                # Live fan-out first (see _complete_one): the pushed
+                # block is the completion payload, valid regardless of
+                # whether the persist below succeeds — a redelivered
+                # batch is "dup" and cannot double-push.
+                if item.metrics and self.hub.has_advances():
+                    self.hub.on_result(
+                        item.id, item.metrics,
+                        trace_id=self.queue.job_trace(item.id)[0])
                 # Close the e2e span NOW: the state machine just recorded
                 # the completion, which is the trace's end regardless of
                 # whether the result block persists below — a persist
@@ -1993,30 +2065,131 @@ class Dispatcher(service.DispatcherServicer):
     def AppendBars(self, request: pb.AppendRequest,
                    context) -> pb.AppendReply:
         """Streaming live-bar ingest: extend a content-addressed panel by
-        a ΔT-bar DBX1 slice and enqueue one repricing job on the extended
-        panel (see ``JobQueue.append_bars`` for the journal/chain
-        semantics). A rejected append is an explicit ok=false reply with
-        the reason — the caller re-syncs; nothing is enqueued and nothing
-        fails dispatcher-side."""
+        a ΔT-bar DBX1 slice, enqueue one repricing job on the extended
+        panel (when the request carries a job template — an EMPTY
+        strategy is a tick-only append: chain extension for the
+        subscription tier with no template job), and schedule the live
+        fan-out: exactly ONE O(ΔT) advance job per unique subscribed
+        stream of this chain, each registered with the hub BEFORE it is
+        enqueued so its completion cannot outrun the push index. A
+        rejected append is an explicit ok=false reply with the reason —
+        the caller re-syncs; nothing is enqueued and nothing fails
+        dispatcher-side."""
         self.peers.touch(request.worker_id)
+        t_tick = time.time()
+        strategy = request.job.strategy
         grid = wire.grid_from_proto(request.job.grid)
-        rec, outcome, ndig, new_len = self.queue.append_bars(
-            request.panel_digest, int(request.base_len), request.delta,
-            strategy=request.job.strategy, grid=grid,
-            cost=request.job.cost,
-            periods_per_year=request.job.periods_per_year or 252,
-            tenant=request.job.tenant_id or DEFAULT_TENANT)
+        cost = request.job.cost
+        ppy = request.job.periods_per_year or 252
+        tenant = request.job.tenant_id or DEFAULT_TENANT
+        if strategy and strategy not in STREAMABLE_STRATEGIES:
+            outcome, ndig, new_len = "unsupported_strategy", "", 0
+        else:
+            outcome, ndig, new_len = self.queue.extend_chain(
+                request.panel_digest, int(request.base_len),
+                request.delta)
         self._c_appends[outcome].inc()
-        if rec is None:
+        if outcome != "extended":
             log.warning("AppendBars %s from %s rejected: %s",
                         request.panel_digest[:16], request.worker_id,
                         outcome)
             return pb.AppendReply(ok=False, detail=outcome,
                                   panel_digest=ndig, new_len=new_len)
-        log.info("AppendBars %s -> %s (%d bars): job %s",
-                 request.panel_digest[:16], ndig[:16], new_len, rec.id)
-        return pb.AppendReply(ok=True, job_id=rec.id, panel_digest=ndig,
-                              new_len=new_len)
+        # The tick hook: one dict probe for the non-serving case; on a
+        # subscribed chain, the plan names every unique live stream
+        # whose advance the template job does not already cover.
+        tmpl_key = (self._serve.stream_key(strategy, grid, cost, ppy)
+                    if strategy else None)
+        plan = self.hub.on_tick(request.panel_digest, ndig, new_len,
+                                template_key=tmpl_key)
+        recs: list[JobRecord] = []
+        rec = None
+        if strategy:
+            rec = self.queue.make_append_record(
+                ndig, strategy=strategy, grid=grid, cost=cost,
+                periods_per_year=ppy, tenant=tenant)
+            recs.append(rec)
+            if plan is not None and plan.template_live:
+                self.hub.register_advance(rec.id, plan.chain, tmpl_key,
+                                          ndig, new_len, t_tick)
+        if plan is not None:
+            for spec in plan.advances:
+                r = self.queue.make_append_record(
+                    ndig, strategy=spec.strategy, grid=spec.grid,
+                    cost=spec.cost, periods_per_year=spec.ppy,
+                    tenant=spec.tenant)
+                self.hub.register_advance(r.id, plan.chain, spec.key,
+                                          ndig, new_len, t_tick)
+                recs.append(r)
+        if recs:
+            self.queue.enqueue_many(recs)
+        log.info("AppendBars %s -> %s (%d bars): %d job(s)%s",
+                 request.panel_digest[:16], ndig[:16], new_len,
+                 len(recs),
+                 f", {len(plan.advances)} stream advance(s)"
+                 if plan is not None else "")
+        return pb.AppendReply(ok=True,
+                              job_id=rec.id if rec is not None else "",
+                              panel_digest=ndig, new_len=new_len)
+
+    # NOT @_timed_rpc: a streaming handler's "latency" is its lifetime —
+    # timing the generator's construction would record ~0 and timing the
+    # stream would poison the RPC histogram with hours-long samples.
+    # Delivery latency has its own instrument (dbx_tick_to_push_seconds).
+    def Subscribe(self, request: pb.SubscribeRequest, context):
+        """Live signal fan-out (serve/): register this connection's
+        interests and stream result pushes until the client drops the
+        call, the dispatcher shuts down, or the handler's context dies.
+        Invalid interests (unstreamable strategy) abort the RPC with
+        INVALID_ARGUMENT — a client bug, answered loudly. The generator
+        parks on the subscription's wake-up event between pushes (its
+        own dedicated stream slot, never a shared unary one — see
+        service.py on sizing max_workers), holding no locks while it
+        waits. Deliberately NOT registered in the peer registry:
+        subscribers are readers, not workers — 10k dashboards must not
+        inflate workers_alive or churn the prune loop (their liveness
+        IS the stream; the hub's dbx_subscriptions gauge counts them)."""
+        interests = [
+            self._serve.StreamSpec(
+                strategy=js.strategy,
+                grid=wire.grid_from_proto(js.grid),
+                cost=js.cost,
+                ppy=js.periods_per_year or 252,
+                tenant=request.tenant_id or DEFAULT_TENANT,
+                digest=js.panel_digest)
+            for js in request.interests]
+        try:
+            sub = self.hub.subscribe(request.subscriber_id,
+                                     request.tenant_id or DEFAULT_TENANT,
+                                     interests)
+        except ValueError as e:
+            if context is not None:
+                import grpc
+
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            raise
+        log.info("subscriber %s: %d interest(s), tenant %s%s",
+                 request.subscriber_id, len(interests),
+                 request.tenant_id or DEFAULT_TENANT,
+                 " (demoted: over DBX_TENANT_SUB_QUOTA)"
+                 if sub.demoted else "")
+        try:
+            while not sub.closed and (context is None
+                                      or context.is_active()):
+                for item in sub.pull(timeout=0.25):
+                    self.hub.observe_delivery(item)
+                    yield pb.PushUpdate(
+                        panel_digest=item.digest,
+                        stream_key=item.key,
+                        seq=item.seq,
+                        metrics=item.metrics,
+                        new_len=item.new_len,
+                        tick_unix=item.tick_unix,
+                        changed=item.changed,
+                        dropped=item.dropped,
+                        catch_up=item.catch_up)
+        finally:
+            self.hub.unsubscribe(sub)
 
     @_timed_rpc("FetchCompiled")
     def FetchCompiled(self, request: pb.CompiledRequest,

@@ -43,6 +43,16 @@ _METHODS = (
     ("OfferCompiled", pb.CompiledOffer, pb.Ack),
 )
 
+# Server-streaming RPCs (the live signal fan-out's Subscribe): the
+# handler is a GENERATOR that yields replies for the stream's lifetime,
+# so it occupies one server thread-pool slot per live subscriber
+# connection — size DispatcherServer(max_workers=...) for the expected
+# connection count plus unary headroom (one connection can carry many
+# interests; see SubscribeRequest).
+_STREAM_METHODS = (
+    ("Subscribe", pb.SubscribeRequest, pb.PushUpdate),
+)
+
 
 class DispatcherServicer:
     """Interface for the server side; subclass and override each RPC."""
@@ -79,9 +89,13 @@ class DispatcherServicer:
                       context) -> pb.Ack:
         raise NotImplementedError
 
+    def Subscribe(self, request: pb.SubscribeRequest, context):
+        """Server-streaming: yields :class:`pb.PushUpdate` messages."""
+        raise NotImplementedError
+
 
 def add_dispatcher_to_server(servicer: DispatcherServicer, server) -> None:
-    """Register the servicer's unary handlers under the service name."""
+    """Register the servicer's unary + server-streaming handlers."""
     handlers = {
         name: grpc.unary_unary_rpc_method_handler(
             getattr(servicer, name),
@@ -90,16 +104,34 @@ def add_dispatcher_to_server(servicer: DispatcherServicer, server) -> None:
         )
         for name, req, rep in _METHODS
     }
+    handlers.update({
+        name: grpc.unary_stream_rpc_method_handler(
+            getattr(servicer, name),
+            request_deserializer=req.FromString,
+            response_serializer=rep.SerializeToString,
+        )
+        for name, req, rep in _STREAM_METHODS
+    })
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),))
 
 
 class DispatcherStub:
-    """Client stub; one callable per RPC, bound to ``channel``."""
+    """Client stub; one callable per RPC, bound to ``channel``.
+
+    Streaming stubs (``Subscribe``) return an iterator of replies; the
+    call stays open until the client drops it (``.cancel()`` / channel
+    close) or the server ends the stream."""
 
     def __init__(self, channel: grpc.Channel):
         for name, req, rep in _METHODS:
             setattr(self, name, channel.unary_unary(
+                f"/{SERVICE_NAME}/{name}",
+                request_serializer=req.SerializeToString,
+                response_deserializer=rep.FromString,
+            ))
+        for name, req, rep in _STREAM_METHODS:
+            setattr(self, name, channel.unary_stream(
                 f"/{SERVICE_NAME}/{name}",
                 request_serializer=req.SerializeToString,
                 response_deserializer=rep.FromString,

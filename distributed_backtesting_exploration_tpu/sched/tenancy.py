@@ -39,35 +39,79 @@ def _label_max() -> int:
     before tests/operators can set it)."""
     return int(os.environ.get("DBX_TENANT_LABEL_MAX", _DEFAULT_LABEL_MAX))
 
+def _sticky_bucket(store: dict, lock: threading.Lock, cap: int,
+                   key: str, label: str) -> str:
+    """The shared sticky-map core behind both bucket maps: first ``cap``
+    distinct keys keep ``label`` (first-contact sticky — a series never
+    splits), later ones share :data:`OVERFLOW_BUCKET` with NOTHING
+    stored (both maps bound wire-controlled input; one dict entry per
+    id ever seen would be an unbounded leak in exactly the components
+    built to bound label cardinality). Overflow keys recompute to the
+    same answer every call; only a mid-run cap raise could re-home one
+    — an explicit operator action."""
+    with lock:
+        hit = store.get(key)
+        if hit is not None:
+            return hit
+        if len(store) < cap:
+            store[key] = label
+            return label
+    return OVERFLOW_BUCKET
+
 
 def tenant_bucket(tenant: str) -> str:
     """The bounded metric label for ``tenant``.
 
     First ``DBX_TENANT_LABEL_MAX`` distinct tenants map to themselves,
-    later ones to :data:`OVERFLOW_BUCKET`; assignment is first-contact
-    sticky so a tenant's series never splits. This is THE sanctioned
-    way to put tenant identity on a metric label (dbxlint
-    obs-cardinality treats ``tenant_bucket(...)`` as bounded by
-    construction).
+    later ones to :data:`OVERFLOW_BUCKET`. This is THE sanctioned way
+    to put tenant identity on a metric label (dbxlint obs-cardinality
+    treats ``tenant_bucket(...)`` as bounded by construction).
     """
     t = tenant or DEFAULT_TENANT
-    with _BUCKET_LOCK:
-        hit = _BUCKETS.get(t)
-        if hit is not None:
-            return hit
-        if len(_BUCKETS) < _label_max():
-            _BUCKETS[t] = t
-            return t
-    # Past the cap nothing is stored: tenant ids are wire-controlled
-    # strings, and one dict entry per distinct id ever seen would be an
-    # unbounded leak in exactly the component built to bound tenant
-    # cardinality. Overflow tenants recompute to the same answer every
-    # call (only a mid-run DBX_TENANT_LABEL_MAX raise could re-home one
-    # — an explicit operator action).
-    return OVERFLOW_BUCKET
+    return _sticky_bucket(_BUCKETS, _BUCKET_LOCK, _label_max(), t, t)
 
 
 def reset_tenant_buckets() -> None:
     """Drop all sticky assignments (tests; a fresh process equivalent)."""
     with _BUCKET_LOCK:
         _BUCKETS.clear()
+    with _STREAM_BUCKET_LOCK:
+        _STREAM_BUCKETS.clear()
+
+
+# -- stream buckets ---------------------------------------------------------
+#
+# Stream keys (serve.stream_key — blake2b over strategy + grid + cost +
+# ppy) are exactly as unbounded as tenant ids: one live fleet serves
+# thousands of distinct param blocks, and a per-stream metric label would
+# mint a permanent time series each. Same sticky core, own namespace +
+# cap: the first DBX_STREAM_LABEL_MAX distinct keys keep a short
+# recognizable prefix (a 32-hex digest is a terrible label; its first 12
+# chars identify it in any log), later ones share ``other``.
+
+_DEFAULT_STREAM_LABEL_MAX = 16
+_STREAM_PREFIX_CHARS = 12
+
+_STREAM_BUCKET_LOCK = threading.Lock()
+_STREAM_BUCKETS: dict[str, str] = {}
+
+
+def _stream_label_max() -> int:
+    """Bucket cap, read lazily like :func:`_label_max`."""
+    return int(os.environ.get("DBX_STREAM_LABEL_MAX",
+                              _DEFAULT_STREAM_LABEL_MAX))
+
+
+def stream_bucket(key: str) -> str:
+    """The bounded metric label for a stream key.
+
+    First ``DBX_STREAM_LABEL_MAX`` distinct keys map to their first 12
+    hex chars, later ones to :data:`OVERFLOW_BUCKET`. This is THE
+    sanctioned way to put stream identity on a metric label (dbxlint
+    obs-cardinality treats ``stream_bucket(...)`` as bounded by
+    construction, beside ``tenant_bucket``/``shape_bucket``).
+    """
+    k = key or "?"
+    return _sticky_bucket(_STREAM_BUCKETS, _STREAM_BUCKET_LOCK,
+                          _stream_label_max(), k,
+                          k[:_STREAM_PREFIX_CHARS])

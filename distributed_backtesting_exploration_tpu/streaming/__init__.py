@@ -11,10 +11,42 @@ carry is digest-keyed and device-resident like a KV cache
 (:class:`~.store.CarryStore`, the streaming twin of the worker's
 PanelCache), with a host-serialized level that survives device-level
 eviction.
+
+The carry halves (``recurrent``/``store``) build the per-family carry
+machinery on import and are LAZY-loaded (PEP 562): the dispatcher's
+live fan-out tier (``serve/``) imports :mod:`.delta` — the metric-delta
+extraction over DBXM blocks that sits behind every push — and a pure
+control-plane process must not pay the carry-registry import wall for
+a byte diff.
+Attribute access (``streaming.build_carry``, ``streaming.CarryStore``)
+and direct submodule imports keep working unchanged; they simply load
+the heavy halves at first touch.
 """
 
-from .recurrent import (  # noqa: F401
-    StreamCarry, append_step, build_carry, carry_from_bytes,
-    carry_to_bytes, finalize, stream_fields, stream_key,
-    supports_strategy, tail_bars)
-from .store import CarryStore, carry_cache_max_bytes  # noqa: F401
+from .delta import metric_delta  # noqa: F401
+
+# name -> submodule holding it; resolved on first attribute access.
+_LAZY = {name: "recurrent" for name in (
+    "StreamCarry", "append_step", "build_carry", "carry_from_bytes",
+    "carry_to_bytes", "finalize", "stream_fields", "stream_key",
+    "supports_strategy", "tail_bars")}
+_LAZY.update({name: "store" for name in (
+    "CarryStore", "carry_cache_max_bytes")})
+
+__all__ = ["metric_delta", *_LAZY]
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(f".{mod}", __name__), name)
+    globals()[name] = value   # cache: later access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -67,6 +67,21 @@ def record_shape(reg, panel_key, n_bars, n_combos):
               shape=shape_bucket(n_bars, n_combos)).set(1)
 
 
+def record_stream(reg, stream_key, subscriber_id):
+    from distributed_backtesting_exploration_tpu.sched import stream_bucket
+
+    # raw stream identity: param-block digests are unbounded (one time
+    # series per distinct grid/cost/strategy tuple, forever) — flagged
+    reg.counter("fx_stream_pushes_total", stream=stream_key).inc()
+    # subscriber identity: same class — flagged
+    reg.gauge("fx_sub_depth", sub=subscriber_id).set(1)
+    # routed through the bounded stream-bucket map (first N keys keep a
+    # short sticky prefix, the rest share "other"): sanctioned — NOT
+    # flagged
+    reg.counter("fx_stream_pushes_ok_total",
+                stream=stream_bucket(stream_key)).inc()
+
+
 def suppressed(reg, job_id):
     # dbxlint: disable=obs-cardinality -- demo: suppression carries a why
     reg.counter("fx_sup_total", job=job_id).inc()

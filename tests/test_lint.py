@@ -241,6 +241,10 @@ def test_obs_cardinality_flags_unbounded_label_values():
          _fixture_line("obs_cardinality.py", 'tenant=tenant_id')),
         ("obs-cardinality", "obs_cardinality.py",
          _fixture_line("obs_cardinality.py", 'shape=panel_key')),
+        ("obs-cardinality", "obs_cardinality.py",
+         _fixture_line("obs_cardinality.py", 'stream=stream_key')),
+        ("obs-cardinality", "obs_cardinality.py",
+         _fixture_line("obs_cardinality.py", 'sub=subscriber_id')),
     ]
     alias = findings[0]
     assert "wid = self.worker_id" in alias.message
@@ -266,6 +270,11 @@ def test_obs_cardinality_flags_unbounded_label_values():
     # sanctioned label source.
     sb_ok = _fixture_line("obs_cardinality.py", "shape=shape_bucket")
     assert sb_ok not in [f.line for f in findings]
+    # Stream vocabulary (live fan-out round): raw stream keys and
+    # subscriber ids are unbounded; the bounded stream-bucket map is a
+    # sanctioned label source.
+    st_ok = _fixture_line("obs_cardinality.py", "stream=stream_bucket")
+    assert st_ok not in [f.line for f in findings]
 
 
 def test_obs_cardinality_ignores_splats_and_bounded_loops(tmp_path):

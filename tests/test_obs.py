@@ -445,6 +445,33 @@ def test_timeline_carry_hit_pseudo_stage():
     assert stages["carry_hit"] == 0.0
 
 
+def test_timeline_push_stage():
+    """Live fan-out attribution (serve/): a `job.push` span — the
+    dispatcher-side completion->fanned-out window, emitted before the
+    e2e span closes — charges its window to the `push` stage; stage
+    seconds still sum exactly to the e2e window. The span overlapping
+    the worker's report ENVELOPE wins it (priority 2 vs 1): those
+    instants are fan-out work, not report wall."""
+    tid = obs.new_trace_id()
+    spans = [
+        {"ev": "span", "name": "job", "t0": 0.0, "dur_s": 3.0,
+         "trace_id": tid, "span_id": "s0", "job": "p1", "worker": "w0"},
+        {"ev": "span", "name": "job.queue_wait", "t0": 0.0, "dur_s": 1.0,
+         "trace_id": tid, "span_id": "s1", "job": "p1"},
+        {"ev": "span", "name": "worker.report", "t0": 2.0, "dur_s": 1.0,
+         "trace_id": tid, "span_id": "s2"},
+        {"ev": "span", "name": "job.push", "t0": 2.8, "dur_s": 0.2,
+         "trace_id": tid, "span_id": "s3", "job": "p1"},
+    ]
+    tls = timeline.reconstruct(spans)
+    stages = timeline.critical_path(tls[tid])
+    assert stages["push"] == pytest.approx(0.2)
+    assert stages["report"] == pytest.approx(0.8)
+    assert sum(stages.values()) == pytest.approx(3.0)
+    summary = timeline.summarize(tls)
+    assert summary["stages"]["push"]["total_s"] == pytest.approx(0.2)
+
+
 def test_event_log_env_opt_in_is_lazy(tmp_path, monkeypatch):
     """DBX_OBS_JSONL is consulted at FIRST USE, not import (dbxlint
     import-time-config): setting it after import but before first use

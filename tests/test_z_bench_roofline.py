@@ -192,6 +192,61 @@ def stream_bench():
     return json.loads(buf.getvalue().strip().splitlines()[-1])
 
 
+_FANOUT_ENV = {
+    "DBX_BENCH_CPU": "1", "DBX_BENCH_CACHE": "",
+    "DBX_BENCH_CONFIGS": "fanout",
+    # Tiny-but-real: 12 subscriptions over 3 symbol chains on 4
+    # connections — the serving-cost invariant (advances == unique
+    # streams, pushes_per_advance == subs/streams) is exact at any
+    # size; the p99 bar gets its real numbers from the full-size run.
+    "DBX_BENCH_SUB_N": "12", "DBX_BENCH_SUB_SYMBOLS": "3",
+    "DBX_BENCH_SUB_CONNS": "4",
+}
+
+
+@pytest.fixture(scope="module")
+def fanout_bench():
+    """One tiny in-process fanout run (loopback gRPC, streaming
+    Subscribe calls, instant backend), shared by the module."""
+    prior = {k: os.environ.get(k) for k in _FANOUT_ENV}
+    os.environ.update(_FANOUT_ENV)
+    bench.ROOFLINE.clear()
+    buf = io.StringIO()
+    try:
+        with contextlib.redirect_stdout(buf):
+            bench.main()
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return json.loads(buf.getvalue().strip().splitlines()[-1])
+
+
+def test_fanout_keys_present(fanout_bench):
+    """The live fan-out acceptance numbers ride these BENCH JSON keys
+    (advances_per_tick == unique streams, pushes_per_advance, the p99
+    latency bar) — a renamed key would silently invalidate BENCH_r12's
+    successors. The tiny run's invariants are exact: 3 ticks, 3
+    advances, 12 pushes, nothing dropped."""
+    fb = fanout_bench["roofline"]["fanout"]
+    for key in ("subscriptions", "symbols", "unique_streams", "ticks",
+                "advances_total", "advances_per_tick",
+                "advances_eq_streams", "pushes_delivered",
+                "pushes_dropped", "pushes_per_advance",
+                "tick_to_push_p50_s", "tick_to_push_p99_s", "p99_bar_s",
+                "p99_ok", "tick_wall_s", "drain_wall_s"):
+        assert key in fb, key
+    assert fb["advances_total"] == 3
+    assert fb["advances_per_tick"] == 1.0
+    assert fb["advances_eq_streams"] is True
+    assert fb["pushes_delivered"] == 12
+    assert fb["pushes_dropped"] == 0
+    assert fb["pushes_per_advance"] == 4.0
+    assert fb["tick_to_push_p99_s"] > 0.0
+
+
 _TENANT_ENV = {
     "DBX_BENCH_CPU": "1", "DBX_BENCH_CACHE": "",
     "DBX_BENCH_CONFIGS": "e2e_local_tenants,scenario_sweep",
