@@ -236,6 +236,21 @@ _MUTATORS = {
 # scrape-stall class).
 _DEVICE_SYNC = {"block_until_ready", "device_get"}
 
+# Bounded queue/thread waits (round 14): `.get`/`.put`/`.join` with an
+# explicit ``timeout=`` keyword. The keyword is the detector — it is what
+# separates a queue/thread WAIT from the untimeouted `dict.get(k, d)` and
+# `str.join(xs)` vocabulary that saturates ordinary code. A bounded wait
+# is still a wait: in a servicer handler or under a lock it parks the
+# caller exactly like a sleep of the timeout's length.
+_WAIT_TERMINALS = {"get", "put", "join"}
+
+
+def _is_timeout_wait(node: ast.Call, terminal: str | None) -> bool:
+    """True for ``x.get(timeout=...)`` / ``x.put(..., timeout=...)`` /
+    ``x.join(timeout=...)`` — the pipeline-queue wait vocabulary."""
+    return (terminal in _WAIT_TERMINALS
+            and any(kw.arg == "timeout" for kw in node.keywords))
+
 
 def _self_attr(node: ast.AST) -> str | None:
     """``self.X`` -> ``X``."""
@@ -359,6 +374,21 @@ class BlockingCallRule:
         "SliceWorker._leader_loop": "leader idle tick between empty polls",
     }
 
+    # qualname -> why a bounded QUEUE/THREAD WAIT (`.get(timeout=...)`,
+    # `.put(timeout=...)`, `.join(timeout=...)`) is the design there.
+    # The round-14 pipeline threads exist to wait — their handoff gets
+    # are the mechanism, not a stall — and the shutdown path's bounded
+    # joins are the drain budget. Anywhere else in a servicer or the
+    # control loop, a timeout'd wait parks the shared thread pool or the
+    # heartbeat exactly like a sleep of the same length.
+    _ALLOW_QUEUE_WAIT = {
+        "Worker._collect_loop":
+            "the pipeline handoff wait (collector thread, not the "
+            "control loop)",
+        "Worker._shutdown":
+            "bounded joins of the prefetch + compute pipeline at exit",
+    }
+
     _BLOCKING_TERMINAL = {"sleep", "input", "result"} | _DEVICE_SYNC
     _BLOCKING_MODULES = {"subprocess"}
 
@@ -383,7 +413,9 @@ class BlockingCallRule:
 
     def _check_method(self, pf: PyFile, cls: str, m) -> list[Finding]:
         out = []
-        sleep_allowed = f"{cls}.{m.name}" in self._ALLOW_SLEEP
+        qual = f"{cls}.{m.name}"
+        sleep_allowed = qual in self._ALLOW_SLEEP
+        wait_allowed = qual in self._ALLOW_QUEUE_WAIT
         for node in ast.walk(m):
             if not isinstance(node, ast.Call):
                 continue
@@ -391,7 +423,11 @@ class BlockingCallRule:
             terminal = _terminal_name(node.func)
             if terminal == "sleep" and sleep_allowed:
                 continue
+            is_wait = _is_timeout_wait(node, terminal)
+            if is_wait and wait_allowed:
+                continue
             blocking = (terminal in self._BLOCKING_TERMINAL
+                        or is_wait
                         or dotted.split(".")[0] in self._BLOCKING_MODULES)
             if blocking:
                 out.append(Finding(

@@ -60,7 +60,8 @@ import dataclasses
 import os
 
 from .ast_rules import (_DEVICE_SYNC, _FUNC_NODES, _MUTATORS, _build_scopes,
-                        _dotted, _self_attr, _terminal_name)
+                        _dotted, _is_timeout_wait, _self_attr,
+                        _terminal_name)
 from .core import Finding, LintContext, PyFile
 
 # Calls that block (or synchronize the device) and must never run under a
@@ -69,6 +70,10 @@ from .core import Finding, LintContext, PyFile
 # the order graph alone cannot see. File OPENS are included (path
 # resolution / NFS under a hot-path lock); plain writes/fsync are not —
 # the journal's serialized durable append is that discipline's point.
+# Bounded queue/thread waits (`.get`/`.put`/`.join` with ``timeout=``,
+# the round-14 pipeline handoff vocabulary) are detected by keyword in
+# the leaf walk: a producer parking on a full handoff while holding an
+# accounting lock stalls — or deadlocks against — its consumer.
 _BLOCKING_UNDER_LOCK = ({"sleep", "input", "result", "wait", "open",
                          "makedirs"} | _DEVICE_SYNC)
 _BLOCKING_MODULES = {"subprocess"}
@@ -632,6 +637,7 @@ def _walk_func(model: LockModel, fi: _Func, entry: frozenset, seed) -> None:
                 term = _terminal_name(f)
                 dotted = _dotted(f) or ""
                 if (term in _BLOCKING_UNDER_LOCK
+                        or _is_timeout_wait(node, term)
                         or dotted.split(".")[0] in _BLOCKING_MODULES):
                     model.blocking.append((fi, node.lineno, dotted or term,
                                            frozenset(held), origin))

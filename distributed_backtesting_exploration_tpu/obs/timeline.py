@@ -84,12 +84,42 @@ SPAN_STAGE = {
     # dispatcher. Priority 2: it overlaps only envelope spans (the
     # worker's report fallback), and those instants ARE push work.
     "job.push": ("push", 2),
+    # Pipelined executor (round 14): the submit-return -> collect-start
+    # window — the batch is in flight on the device while the submit
+    # thread stages the NEXT batch. Envelope priority: any specific span
+    # inside it wins, but an otherwise-uncovered in-flight window is
+    # device execute, NOT the transport the uncovered-gap rule would
+    # charge it to.
+    "worker.inflight": ("execute", 1),
+    # Control-loop payload warm-up (DBX_PREFETCH): decode work done
+    # early, so the compute-side decode span can report a cache hit
+    # without the real decode wall vanishing from the decode stage.
+    "worker.prefetch": ("decode", 2),
     "worker.submit": ("execute", 1),
     "worker.collect": ("d2h", 1),
     "worker.process": ("execute", 1),
     "slice.run_group": ("execute", 1),
     "slice.run_ts_group": ("execute", 1),
 }
+
+# Pipeline lanes of the overlap-aware mode: the submit half (host decode
+# / page-table build / compile / launch) vs the collect half (device
+# drain + d2h). A serial worker alternates lanes, so their coverages
+# tile the busy wall (overlap factor ~1); the pipelined executor runs
+# them concurrently on two threads, so one wall second carries up to two
+# lane seconds (factor -> 2 at perfect double-buffered overlap).
+# `worker.inflight` joins neither lane: its window is queue/device wait,
+# and counting it would inflate the factor without any host work
+# actually overlapping.
+_LANE_SPANS = {
+    "worker.prefetch": "submit", "worker.decode": "submit",
+    "worker.compile": "submit", "worker.execute": "submit",
+    "worker.append": "submit", "worker.submit": "submit",
+    "worker.d2h": "collect", "worker.collect": "collect",
+}
+# worker.process (the serial loop's whole-batch envelope) joins NEITHER
+# lane: it covers both halves of its batch, so counting it as submit
+# would read every serial d2h as overlapped.
 
 E2E_SPAN = "job"
 
@@ -249,6 +279,67 @@ def critical_path(tl: JobTimeline) -> dict[str, float]:
     return out
 
 
+def _merge_ivals(ivals) -> list:
+    """Union of ``(a, b)`` intervals: sorted, coalesced, as tuples."""
+    out: list = []
+    for a, b in sorted(ivals):
+        if out and a <= out[-1][1]:
+            if b > out[-1][1]:
+                out[-1] = (out[-1][0], b)
+        else:
+            out.append((a, b))
+    return out
+
+
+def _coverage(merged, lo: float, hi: float) -> float:
+    """Seconds of a merged interval union inside ``[lo, hi]``."""
+    return sum(max(0.0, min(b, hi) - max(a, lo)) for a, b in merged)
+
+
+def overlap_lanes(timelines) -> dict:
+    """Per-worker pipeline-lane interval unions for the overlap-aware
+    mode: ``worker -> {"submit": [...], "collect": [...], "both": [...]}``
+    (merged, non-overlapping intervals each).
+
+    Spans are deduped by span id across timelines first — a multi-job
+    batch's span is fanned out to every member's timeline, and counting
+    the one decode wall once per job would read co-batching as
+    pipelining. The per-JOB wall-clock attribution (:func:`critical_path`)
+    deliberately keeps the fan-out; the lanes measure the WORKER's
+    thread-level concurrency instead."""
+    per: dict = {}
+    for tl in timelines.values():
+        lanes = per.setdefault(tl.worker or "?",
+                               {"submit": {}, "collect": {}})
+        for s in tl.spans:
+            lane = _LANE_SPANS.get(s["name"])
+            if lane is None or s["dur_s"] <= 0:
+                continue
+            key = s["span_id"] or (s["name"], s["t0"], s["dur_s"])
+            lanes[lane][key] = (s["t0"], s["t0"] + s["dur_s"])
+    out = {}
+    for w, lanes in per.items():
+        submit = _merge_ivals(list(lanes["submit"].values()))
+        collect = _merge_ivals(list(lanes["collect"].values()))
+        out[w] = {"submit": submit, "collect": collect,
+                  "both": _merge_ivals(submit + collect)}
+    return out
+
+
+def overlap_factor(lanes: dict, lo: float, hi: float) -> float:
+    """Pipelining factor of one worker's lanes inside a window: lane
+    seconds per covered wall second. 1.0 = fully serial (lanes tile the
+    busy wall); 2.0 = the submit and collect halves fully overlapped
+    (perfect double buffering). Windows with no covered wall (a worker
+    that never ran compute spans) report 1.0 — no evidence of overlap is
+    not evidence of idleness."""
+    union = _coverage(lanes["both"], lo, hi)
+    if union <= 0:
+        return 1.0
+    return (_coverage(lanes["submit"], lo, hi)
+            + _coverage(lanes["collect"], lo, hi)) / union
+
+
 def _quantile(sorted_vals: list[float], q: float) -> float:
     if not sorted_vals:
         return 0.0
@@ -266,21 +357,35 @@ MIN_STRAGGLER_JOBS = 8
 
 
 def summarize(timelines: dict[str, JobTimeline], *,
-              min_straggler_jobs: int = MIN_STRAGGLER_JOBS) -> dict:
+              min_straggler_jobs: int = MIN_STRAGGLER_JOBS,
+              overlap: bool = False) -> dict:
     """Fleet digest: per-stage totals/quantiles, per-worker attribution,
-    per-job stage seconds, and stragglers (jobs > p95 in a stage)."""
+    per-job stage seconds, and stragglers (jobs > p95 in a stage).
+
+    ``overlap=True`` adds the overlap-aware mode (round 14): a per-job
+    ``overlap_factor`` — the worker's submit+collect lane seconds per
+    covered wall second inside the job's window — and a summary
+    ``overlap`` block with per-worker and fleet factors. The per-instant
+    stage attribution is unchanged (it charges wall clock and must keep
+    summing to e2e); the factor is the separate answer to "how much
+    pipeline concurrency did this wall second carry"."""
+    lanes = overlap_lanes(timelines) if overlap else {}
     jobs = []
     per_stage: dict[str, list] = {s: [] for s in STAGES}
     per_worker: dict[str, dict] = {}
     for tid, tl in sorted(timelines.items()):
         stages = critical_path(tl)
         lo, hi = tl.window
-        jobs.append({"trace_id": tid, "job": tl.job_id,
-                     "worker": tl.worker, "t0": lo,
-                     "e2e_s": round(hi - lo, 9),
-                     "measured_e2e_s": round(tl.e2e_dur, 9),
-                     "stages": {k: round(v, 9) for k, v in stages.items()},
-                     "spans": len(tl.spans)})
+        row = {"trace_id": tid, "job": tl.job_id,
+               "worker": tl.worker, "t0": lo,
+               "e2e_s": round(hi - lo, 9),
+               "measured_e2e_s": round(tl.e2e_dur, 9),
+               "stages": {k: round(v, 9) for k, v in stages.items()},
+               "spans": len(tl.spans)}
+        if overlap:
+            row["overlap_factor"] = round(overlap_factor(
+                lanes[tl.worker or "?"], lo, hi), 4)
+        jobs.append(row)
         for k, v in stages.items():
             per_stage[k].append(v)
         w = per_worker.setdefault(tl.worker or "?",
@@ -314,14 +419,34 @@ def summarize(timelines: dict[str, JobTimeline], *,
                         "seconds": j["stages"][stage], "p95_s": p95})
     stragglers.sort(key=lambda s: -(s["seconds"] - s["p95_s"]))
 
-    return {"jobs": len(jobs),
-            "e2e_total_s": round(sum(j["e2e_s"] for j in jobs), 9),
-            "stages": stage_stats,
-            "workers": {k: {kk: (vv if kk == "jobs" else round(vv, 9))
-                            for kk, vv in v.items()}
-                        for k, v in sorted(per_worker.items())},
-            "stragglers": stragglers,
-            "per_job": jobs}
+    out = {"jobs": len(jobs),
+           "e2e_total_s": round(sum(j["e2e_s"] for j in jobs), 9),
+           "stages": stage_stats,
+           "workers": {k: {kk: (vv if kk == "jobs" else round(vv, 9))
+                           for kk, vv in v.items()}
+                       for k, v in sorted(per_worker.items())},
+           "stragglers": stragglers,
+           "per_job": jobs}
+    if overlap:
+        lane_s = {ln: 0.0 for ln in ("submit", "collect")}
+        union_s = 0.0
+        workers = {}
+        for w, wl in sorted(lanes.items()):
+            cov = {ln: _coverage(wl[ln], float("-inf"), float("inf"))
+                   for ln in ("submit", "collect")}
+            union = _coverage(wl["both"], float("-inf"), float("inf"))
+            for ln in lane_s:
+                lane_s[ln] += cov[ln]
+            union_s += union
+            workers[w] = round((cov["submit"] + cov["collect"])
+                               / union if union > 0 else 1.0, 4)
+        out["overlap"] = {
+            "overlap_factor": round((lane_s["submit"] + lane_s["collect"])
+                                    / union_s if union_s > 0 else 1.0, 4),
+            "lane_seconds": {ln: round(v, 9) for ln, v in lane_s.items()},
+            "covered_wall_s": round(union_s, 9),
+            "workers": workers}
+    return out
 
 
 def summarize_spans(spans, **kw) -> dict:
@@ -386,6 +511,13 @@ def _table(rows: list[tuple], header: tuple) -> str:
 def render_text(summary: dict) -> str:
     out = [f"{summary['jobs']} job(s), "
            f"{_fmt_s(summary['e2e_total_s'])} end-to-end wall"]
+    if "overlap" in summary:
+        ov = summary["overlap"]
+        out.append(
+            f"pipeline overlap {ov['overlap_factor']:.2f}x "
+            f"(submit {_fmt_s(ov['lane_seconds']['submit'])} + collect "
+            f"{_fmt_s(ov['lane_seconds']['collect'])} over "
+            f"{_fmt_s(ov['covered_wall_s'])} covered wall)")
     rows = []
     total = summary["e2e_total_s"] or 1.0
     for stage in STAGES:
@@ -444,6 +576,10 @@ def main(argv=None) -> int:
                     default=MIN_STRAGGLER_JOBS,
                     help="minimum fleet size before stragglers are "
                          "flagged (p95 of a tiny sample is noise)")
+    ap.add_argument("--overlap", action="store_true",
+                    help="overlap-aware mode: per-job and per-worker "
+                         "pipeline overlap factors (submit+collect lane "
+                         "seconds per covered wall second)")
     args = ap.parse_args(argv)
 
     events, malformed = parse_events(args.jsonl)
@@ -467,7 +603,8 @@ def main(argv=None) -> int:
               "(pre-tracing logs?)", file=sys.stderr)
         return 2
     summary = summarize(timelines,
-                        min_straggler_jobs=args.min_straggler_jobs)
+                        min_straggler_jobs=args.min_straggler_jobs,
+                        overlap=args.overlap)
     if args.format == "json":
         print(json.dumps(summary, indent=2))
     else:

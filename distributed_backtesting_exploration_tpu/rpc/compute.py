@@ -195,10 +195,14 @@ class ComputeBackend(Protocol):
     # Backends may additionally expose a two-phase pipeline:
     #   submit(jobs) -> opaque handle   (dispatch work, return immediately)
     #   collect(handle) -> [Completion] (block for results)
-    # The worker overlaps submit(batch N+1) with collect(batch N) when both
-    # methods exist — the decode -> H2D -> compute double-buffering SURVEY.md
-    # §2.3 (PP row) prescribes against the reference's serial loop
-    # (reference src/worker/process.rs:21-25).
+    # The worker runs submit and collect on separate threads of a bounded
+    # pipeline (DBX_PIPELINE, round 14) when both methods exist — the
+    # decode -> H2D -> compute double-buffering SURVEY.md §2.3 (PP row)
+    # prescribes against the reference's serial loop (reference
+    # src/worker/process.rs:21-25) — and calls the optional
+    #   prefetch(jobs) -> int  (stage inputs early; best-effort)
+    # hook from its CONTROL thread for batches still queued behind the
+    # pipeline (DBX_PREFETCH).
 
 
 def _stack_field_ragged(series_list, t_max: int,
@@ -245,9 +249,13 @@ class _TimeshardSpec(NamedTuple):
     halo_bound: bool = True  # window must fit one per-chip block
 
 
-def _start_result_copy(m):
-    """Stack the 9 metric fields on device and begin the async d2h copy."""
-    stacked = _stack_metrics(*m)
+def _start_result_copy(m, *, donate: bool = True):
+    """Stack the 9 metric fields on device and begin the async d2h copy.
+
+    ``donate=False`` opts a caller out of the TPU buffer donation — the
+    streaming-append path must, because ``recurrent.finalize``'s outputs
+    may alias buffers the stored carry checkpoint still owns."""
+    stacked = _stack_metrics(*m, donate=donate)
     try:
         stacked.copy_to_host_async()
     except AttributeError:
@@ -258,16 +266,28 @@ def _start_result_copy(m):
 _STACK_METRICS_CACHE: dict = {}
 
 
-def _stack_metrics(*fields):
-    """Stack 9 metric fields into one device array under jit (one transfer)."""
+def _stack_metrics(*fields, donate: bool = True):
+    """Stack 9 metric fields into one device array under jit (one transfer).
+
+    On TPU the inputs are DONATED: the per-field sweep outputs hand
+    their buffers to the stacked block, so a deep pipeline holds one
+    result block per in-flight batch instead of block + 9 donors — the
+    donated-buffer half of the round-14 async-collect contract. CPU/GPU
+    skip donation (XLA there may not consume it and jax warns per call).
+    """
     import jax
 
-    fn = _STACK_METRICS_CACHE.get("fn")
+    key = "fn"
+    donate = donate and jax.default_backend() == "tpu"
+    if donate:
+        key = "fn_donate"
+    fn = _STACK_METRICS_CACHE.get(key)
     if fn is None:
         import jax.numpy as jnp
 
-        fn = _STACK_METRICS_CACHE["fn"] = jax.jit(
-            lambda *fs: jnp.stack(fs))
+        fn = _STACK_METRICS_CACHE[key] = jax.jit(
+            lambda *fs: jnp.stack(fs),
+            donate_argnums=tuple(range(9)) if donate else ())
     return fn(*fields)
 
 
@@ -1005,12 +1025,22 @@ class JaxSweepBackend:
         power-of-two bucket, so a merged group can only miss the paged
         route through a pool rejection — and that path re-splits by this
         same bucket before stacking densely."""
-        if (self.use_paged and job.wf_train == 0 and not job.best_returns
-                and job.strategy != "pairs" and job.panel_digest
-                and job.strategy in self._FUSED_STRATEGIES
-                and self._fused_demotion_reason(job, grid, (1,)) is None):
+        if self._paged_servable(job, grid):
             return 0
         return (len(job.ohlcv) or job.panel_bytes_len).bit_length()
+
+    def _paged_servable(self, job, grid) -> bool:
+        """THE paged-eligibility predicate — grouping
+        (:meth:`_length_bucket`) and :meth:`prefetch` share it, so the
+        page warm-up can never drift from what the submit path will
+        actually serve paged. Length-independent (the VMEM bar cap is
+        the caller's concern: submit splits over-cap groups, prefetch
+        gates on ``n_bars`` directly)."""
+        return (self.use_paged and job.wf_train == 0
+                and not job.best_returns and job.strategy != "pairs"
+                and bool(job.panel_digest)
+                and job.strategy in self._FUSED_STRATEGIES
+                and self._fused_demotion_reason(job, grid, (1,)) is None)
 
     @staticmethod
     def _topk_request_ok(group) -> bool:
@@ -1036,6 +1066,80 @@ class JaxSweepBackend:
             "completing with empty metrics", [j.id for j in group], metric,
             ", ".join(Metrics._fields))
         return False
+
+    def prefetch(self, jobs) -> int:
+        """Control-thread batch warm-up (the worker's ``DBX_PREFETCH``
+        leg, round 14): decode payload bytes into the host panel cache
+        and pre-stage paged groups' device pages while the compute
+        pipeline runs earlier batches.
+
+        Strictly an overlap optimization — every warmed path re-resolves
+        through the same caches on the compute thread, so a skipped or
+        failed prefetch costs nothing but the overlap. Append jobs are
+        left alone (their delta-splice path must not materialize the
+        full panel early) and a zero-budget cache
+        (``DBX_PANEL_CACHE_MB=0``) skips the decode it could not retain.
+        Returns the number of panels decoded (the worker's prefetch span
+        is emitted only when real work happened).
+        """
+        cache = self.panel_cache
+        if cache.max_bytes <= 0:
+            return 0
+        warmed = 0
+        decoded: dict = {}
+        paged_groups: dict[str, tuple[list, list]] = {}
+        for job in jobs:
+            if job.append_parent_digest:
+                continue
+            for digest, raw in ((job.panel_digest, job.ohlcv),
+                                (job.panel_digest2, job.ohlcv2)):
+                if (not digest or not raw or digest in decoded
+                        or cache.contains_series(digest)):
+                    continue
+                try:
+                    s = data_mod.from_wire_bytes(raw)
+                except Exception:
+                    log.exception(
+                        "prefetch decode failed for digest %s; the "
+                        "compute thread will decode (and error) inline",
+                        digest[:16])
+                    continue
+                cache.put_series(digest, s)
+                decoded[digest] = s
+                warmed += 1
+            # Page-pool warm-up: upload the pool-missing pages of paged-
+            # servable jobs now, so the submit-side prepare finds them
+            # resident (pages_new == 0 -> the h2d-skip fast path). Only
+            # panels decoded in THIS call join — a digest-only job whose
+            # panel is already host-cached had its pages prepared when
+            # that panel first crossed the paged submit path. Gated on
+            # the SHARED servability predicate: warming pages the submit
+            # path will demote to dense would waste H2D and evict pages
+            # live groups are about to gather.
+            s = decoded.get(job.panel_digest)
+            if (s is not None and s.n_bars <= self._FUSED_MAX_BARS
+                    and self._fused_ops.paged_supported(job.strategy)):
+                try:
+                    grid = wire.grid_from_proto(job.grid)
+                except Exception:
+                    continue
+                if not self._paged_servable(job, grid):
+                    continue
+                digests, series = paged_groups.setdefault(job.strategy,
+                                                          ([], []))
+                if job.panel_digest not in digests:
+                    digests.append(job.panel_digest)
+                    series.append(s)
+        for strategy, (digests, series) in paged_groups.items():
+            try:
+                # A pool rejection (None) is fine — the submit path will
+                # take the dense fallback exactly as without prefetch.
+                self.panel_cache.pages.prepare(
+                    digests, series, self._fused_ops.paged_fields(strategy))
+            except Exception:
+                log.exception("page-pool prefetch failed for %s; submit "
+                              "will prepare inline", strategy)
+        return warmed
 
     def _resolve_series(self, job, *, leg2: bool = False):
         """One leg's decoded panel: host cache -> inline bytes ->
@@ -1175,7 +1279,10 @@ class JaxSweepBackend:
         # jitter — a served O(ΔT) append must never read as phantom
         # execute work.
         self._observe_submit(job.strategy, "append", t0)
-        return ([job], _start_result_copy(m), t0, 1, None)
+        # donate=False: finalize's outputs may alias buffers the stored
+        # carry still owns — donating them would invalidate the
+        # checkpoint the next append in the chain advances.
+        return ([job], _start_result_copy(m, donate=False), t0, 1, None)
 
     def _decode_group(self, group):
         """Cache-aware group decode (leg 1 — the pairs path drives

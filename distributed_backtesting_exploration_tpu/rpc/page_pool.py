@@ -110,6 +110,14 @@ class PagePool:
         page_nbytes = self.page_bars * 4
         self.capacity = max(1, self.max_bytes // page_nbytes)
         self._lock = threading.Lock()
+        # Writer serialization (round 14): `prepare` now has TWO callers —
+        # the compute thread's submit path and the worker control loop's
+        # prefetch warm-up — so whole-prepare runs (index mutation +
+        # device upload + pool swap) serialize on this outer lock. The
+        # inner `_lock` still guards only the host index, so a stats
+        # scrape never waits behind a device upload/compile. Acquisition
+        # order is always _write_lock -> _lock.
+        self._write_lock = threading.Lock()
         self._pool = None                 # (alloc, page_bars) f32 device
         self._alloc = 0                   # allocated slots (grows to cap)
         self._slots: collections.OrderedDict = collections.OrderedDict()
@@ -211,14 +219,18 @@ class PagePool:
     def _upload(self, pool, slots: list[int], pages: list[np.ndarray]):
         """Batched scatter of missing pages into ``pool``; padded to a
         power-of-two page count so the jit signature set stays bounded.
-        Donates the previous pool buffer (in-place where the backend
-        supports it). Runs OUTSIDE the index lock — see ``prepare``."""
+        NON-donating (round 14): a caller's sweep dispatches its gather
+        against the pool array its own ``prepare`` returned, OUTSIDE any
+        lock — a donating scatter from the other writer (the control
+        loop's prefetch warm-up) would delete that array between return
+        and dispatch. The old buffer lives until its in-flight readers
+        drop it; the transient double allocation is bounded by one pool.
+        Runs OUTSIDE the index lock — see ``prepare``."""
         import jax
         import jax.numpy as jnp
 
         if self._scatter is None:
-            self._scatter = jax.jit(
-                lambda pool, s, p: pool.at[s].set(p), donate_argnums=0)
+            self._scatter = jax.jit(lambda pool, s, p: pool.at[s].set(p))
         k = len(slots)
         k_pad = 1 << (k - 1).bit_length()
         slots = slots + [slots[-1]] * (k_pad - k)
@@ -238,7 +250,17 @@ class PagePool:
         repeat-last fix) and ``info`` counts newly uploaded pages and
         their in-page pad bars; or None when the group cannot fit
         (caller falls back to the dense path).
+
+        Thread-safe for its two writers (compute submit + control-loop
+        prefetch): whole runs serialize on ``_write_lock``, so a
+        returned pool array always contains every page its tables
+        reference, and concurrent warm-ups cannot interleave half an
+        index update with another group's upload.
         """
+        with self._write_lock:
+            return self._prepare_serialized(digests, series_list, fields)
+
+    def _prepare_serialized(self, digests, series_list, fields):
         with self._lock:
             per_field_keys: dict[str, list[list[str]]] = {f: []
                                                           for f in fields}
@@ -309,14 +331,14 @@ class PagePool:
         # Device upload OUTSIDE the index lock: the scatter dispatch (and
         # its first-call jit compile, seconds per pow2 shape class) must
         # not stall a concurrent /metrics or GetStats scrape blocking on
-        # stats(). Safe under the pool's single-writer contract: only the
-        # worker's compute thread calls prepare(), and stats() never
-        # reads `_pool` — only the index updated above.
+        # stats(). Safe under the pool's writer-serialization contract:
+        # every prepare() holds `_write_lock` end to end, and stats()
+        # never reads `_pool` — only the index updated above.
         if new_slots:
             pool = self._upload(pool, new_slots, new_pages)
-            # Single compute-thread writer; the index lock guards
-            # stats(), which never reads the array itself.
-            # dbxlint: disable=lock-discipline -- single-writer contract
+            # Writer-serialized (caller holds _write_lock end to end);
+            # the index lock guards stats(), which never reads the array.
+            # dbxlint: disable=lock-discipline -- writer-serialized under _write_lock
             self._pool = pool
         return pool, tables, {"pages_new": len(new_slots),
                               "pad_bars_new": int(pad_new)}

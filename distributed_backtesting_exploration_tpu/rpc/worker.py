@@ -14,12 +14,22 @@ control plane, and bounded channels between them (reference
   ``src/worker/main.rs:50-55``);
 - shutdown is graceful: in-flight work drains before exit (a reference
   Limitations item, reference ``README.md:85``).
+
+Round 14 adds the **pipelined executor**: for two-phase (submit/collect)
+backends the compute side runs as a bounded two-thread pipeline —
+this module's submit thread decodes and launches batch N+1 while a
+collector thread drains batch N's device results — with the control
+loop prefetching payloads/compile-cache entries for batches still
+queued behind the pipeline. ``DBX_PIPELINE=0`` falls back to the
+strictly serial loop (the bit-identity reference); see
+DESIGN.md "Pipelined executor (round 14)".
 """
 
 from __future__ import annotations
 
 import argparse
 import logging
+import os
 import queue as queue_mod
 import threading
 import time
@@ -117,6 +127,31 @@ class _SyncLegFailed(Exception):
     method label); the tick aborts and retries on the next interval."""
 
 
+def pipeline_enabled() -> bool:
+    """``DBX_PIPELINE`` (default on): run two-phase backends through the
+    double-buffered submit/collect pipeline. ``0`` keeps the strictly
+    serial loop — the bit-identity reference for the pipelined path.
+    Read lazily (per worker run), never at import time."""
+    return os.environ.get("DBX_PIPELINE", "1").lower() not in (
+        "0", "off", "false")
+
+
+def pipeline_depth() -> int:
+    """``DBX_PIPELINE_DEPTH`` (default 2): submitted-but-uncollected
+    batches the pipeline holds before the submit thread blocks. Depth 2
+    is classic double buffering (one batch on device, one staging);
+    deeper mostly grows queue wait, not overlap."""
+    return max(int(os.environ.get("DBX_PIPELINE_DEPTH", "2")), 1)
+
+
+def prefetch_enabled() -> bool:
+    """``DBX_PREFETCH`` (default on): the control loop stages inputs for
+    batches still queued behind the compute pipeline (payload decode,
+    device page warm-up, compile-cache pull-forward)."""
+    return os.environ.get("DBX_PREFETCH", "1").lower() not in (
+        "0", "off", "false")
+
+
 _BATCH_SENTINEL = b"S"
 
 
@@ -171,6 +206,25 @@ class Worker:
         self._out = _Channel(None, _encode_completion, _decode_completion)
         self._stop = threading.Event()
         self._busy = threading.Event()
+        # Pipelined executor state (round 14): batches taken from the
+        # channel but not yet fully collected. The counter (guarded by
+        # its own lock — it is shared by the submit and collector
+        # threads) drives the busy flag, so idle-exit and status
+        # reporting see the WHOLE pipeline, not just the submit half.
+        self._pipeline_lock = threading.Lock()
+        self._pipeline_inflight = 0
+        self._pipeline_done = threading.Event()
+        # Compile-cache prefetch memo: (strategy, payload-size-bucket)
+        # signatures whose arrival already pulled a tune-sync forward.
+        self._prefetch_seen: set = set()
+        # Backend warm-up runs on its own daemon thread (started lazily,
+        # stopped in _shutdown): the page warm-up can upload device
+        # pages — whose first-call scatter compile takes seconds per
+        # pow2 shape class — and THIS thread owns the SendStatus
+        # heartbeat; a stalled heartbeat gets a healthy worker pruned
+        # mid-drain (the deferred-completion lesson).
+        self._prefetch_q: queue_mod.Queue | None = None
+        self._prefetch_thread: threading.Thread | None = None
         self._connected = True  # edge-triggered logging, reference CONNECTED
         self.jobs_completed = 0
         self.completions_dropped = 0
@@ -258,10 +312,16 @@ class Worker:
     # -- compute side ------------------------------------------------------
 
     def _compute_loop(self) -> None:
-        if hasattr(self.backend, "submit") and hasattr(self.backend,
-                                                       "collect"):
+        if (hasattr(self.backend, "submit")
+                and hasattr(self.backend, "collect")
+                and pipeline_enabled()):
             self._compute_loop_pipelined()
         else:
+            # The strictly serial path (and every process-only backend):
+            # one batch runs decode -> compute -> d2h to completion
+            # before the next is touched. DBX_PIPELINE=0 routes two-phase
+            # backends here too — the bit-identity reference the
+            # pipelined path is verified against.
             self._compute_loop_simple()
 
     def _compute_loop_simple(self) -> None:
@@ -269,7 +329,10 @@ class Worker:
             batch = self._in.get()
             if batch is None:
                 return
-            self._busy.set()
+            # The shared pipeline accounting drives the busy flag here
+            # too (one batch in flight at a time on this loop), so every
+            # `_busy` mutation stays under the one lock.
+            self._pipeline_batch_begin()
             try:
                 # Adopt the batch's dispatcher-minted traces: the process
                 # span (and everything the backend spans beneath it) joins
@@ -283,48 +346,108 @@ class Worker:
                 log.exception("backend failed on a %d-job batch; jobs will "
                               "be re-queued by lease expiry", len(batch))
             finally:
-                self._busy.clear()
+                self._pipeline_batch_end()
 
     def _compute_loop_pipelined(self) -> None:
-        """Double-buffered compute: while batch N's results stream back from
-        the device, batch N+1 is decoded, transferred, and launched.
+        """Double-buffered compute pipeline: THIS thread decodes, builds
+        page tables, and launches batch N+1 while the collector thread
+        blocks on batch N's device drain.
 
         The reference worker's loop is fully serial — one job finishes
         before the next is touched (reference ``src/worker/process.rs:21-25``);
         SURVEY.md §2.3 (PP row) and §7 hard part (e) prescribe this
-        decode -> H2D -> compute overlap instead. Depth is bounded at two
-        in-flight batches (plus ``max_inflight_batches`` queued behind them).
+        decode -> H2D -> compute overlap instead. Submitted batches hand
+        off through a queue whose depth a slot semaphore bounds at
+        ``DBX_PIPELINE_DEPTH`` (default 2 — classic double buffering);
+        the slot acquire is the backpressure that also stops the control
+        thread's polls once the input channel fills behind it. The
+        shutdown sentinel flows
+        through both stages in order, so every batch taken before it is
+        submitted AND collected before the pipeline exits — the
+        finish-or-requeue drain contract (whatever a hard kill strands
+        is re-queued by lease expiry, never silently lost).
         """
-        pending = None            # in-flight (handle, batch) or None
-        shutdown = False
-        while not shutdown:
-            if pending is None:
+        handoff: queue_mod.Queue = queue_mod.Queue()
+        # Depth is enforced by slot reservation BEFORE the submit
+        # dispatches device work — bounding the handoff queue instead
+        # would let depth+2 submitted batches live on device (the
+        # just-submitted one blocked in put, plus the collector's).
+        # Depth counts submitted-but-uncollected batches INCLUSIVE of
+        # the one being collected: 2 really is one batch on device, one
+        # staging — the old opportunistic loop's bound.
+        slots = threading.BoundedSemaphore(pipeline_depth())
+        self._pipeline_done.clear()
+        collector = threading.Thread(target=self._collect_loop,
+                                     args=(handoff, slots),
+                                     name="dbx-collect", daemon=True)
+        collector.start()
+        try:
+            while True:
                 batch = self._in.get()
                 if batch is None:
                     return
-                self._busy.set()
+                slots.acquire()
+                self._pipeline_batch_begin()
                 pending = self._try_submit(batch)
                 if pending is None:
-                    self._busy.clear()
-                continue
-            # One batch in flight: opportunistically launch the next before
-            # blocking on the first's results.
-            nxt = None
+                    # Failed submit: the batch is already logged and left
+                    # to its lease; nothing enters the pipeline.
+                    self._pipeline_batch_end()
+                    slots.release()
+                    continue
+                handoff.put((pending, time.time()))
+        finally:
+            # Ordered drain: the sentinel lands BEHIND every submitted
+            # batch, so the collector finishes them all before exiting —
+            # run()'s completion flush then sees the full pipeline.
+            handoff.put(None)
+            self._pipeline_done.set()
+            collector.join()
+
+    def _collect_loop(self, handoff: queue_mod.Queue, slots) -> None:
+        """Collector half of the pipeline: drain submitted batches in
+        submission order and stream their completions into the out
+        channel. Runs on its own thread so the blocking device drain
+        (the d2h wait) overlaps the submit thread's host work."""
+        while True:
             try:
-                nxt = self._in.get_nowait()
-                if nxt is None:
-                    shutdown = True
+                # Bounded wait (dbxlint blocking-call: allowlisted
+                # pipeline queue wait): the sentinel is the exit
+                # protocol; the timeout only guards against a submit
+                # thread that died without posting it.
+                item = handoff.get(timeout=0.25)
             except queue_mod.Empty:
-                pass
-            nxt_pending = self._try_submit(nxt) if nxt is not None else None
+                if self._pipeline_done.is_set():
+                    return
+                continue
+            if item is None:
+                return
+            pending, submitted_wall = item
+            # The submit-return -> collect-start window: the batch is in
+            # flight on the device (jax dispatched eagerly) while the
+            # submit thread works on the NEXT batch. Without a span the
+            # timeline analyzer would charge this window to transport
+            # (uncovered-gap rule); it maps to execute at envelope
+            # priority (obs.timeline SPAN_STAGE).
+            wait_s = time.time() - submitted_wall
+            if wait_s > 0:
+                obs.emit_span("worker.inflight", submitted_wall, wait_s,
+                              pairs=obs.job_trace_pairs(pending[1]),
+                              jobs=len(pending[1]))
             self._collect_into_out(pending)
-            pending = nxt_pending
-            if pending is None:
+            self._pipeline_batch_end()
+            slots.release()
+
+    def _pipeline_batch_begin(self) -> None:
+        with self._pipeline_lock:
+            self._pipeline_inflight += 1
+            self._busy.set()
+
+    def _pipeline_batch_end(self) -> None:
+        with self._pipeline_lock:
+            self._pipeline_inflight -= 1
+            if self._pipeline_inflight == 0:
                 self._busy.clear()
-        # No in-flight batch can survive the loop: shutdown is only set in
-        # the pending-branch, whose same iteration collects `pending` and
-        # replaces it with None (the sentinel never coexists with a next
-        # batch).
 
     def _try_submit(self, batch):
         try:
@@ -473,15 +596,33 @@ class Worker:
     def _shutdown(self, stub) -> None:
         """Graceful drain: finish queued batches, flush completions.
 
-        The compute thread is joined first, so nothing produces into the
-        completion queue anymore and a non-blocking drain is exhaustive.
-        Deferred (previously failed) completions get their remaining retry
+        The shutdown sentinel traverses the WHOLE pipeline in order —
+        input channel, submit stage, handoff queue, collect stage — so
+        joining the compute thread here waits for every taken batch to
+        be submitted AND collected (``_compute_loop_pipelined``'s
+        finally joins its collector); nothing produces into the
+        completion queue afterwards and a non-blocking drain is
+        exhaustive. A pipeline that cannot finish inside the join budget
+        (wedged device) is abandoned with its batches still leased —
+        finish-or-requeue, never a silently lost completion. Deferred
+        (previously failed) completions get their remaining retry
         attempts inside a bounded exit budget; whatever still fails is
         re-queued by lease expiry dispatcher-side.
         """
+        if self._prefetch_q is not None:
+            # Best-effort thread: no drain needed, just a clean exit (a
+            # straggling warm-up is abandoned with the daemon thread).
+            self._prefetch_q.put(None)
+            self._prefetch_thread.join(timeout=5.0)
+            self._prefetch_q = None
+            self._prefetch_thread = None
         self._in.put(None)
         if self._compute_thread is not None:
             self._compute_thread.join(timeout=60.0)
+            if self._compute_thread.is_alive():
+                log.error("compute pipeline did not drain within the exit "
+                          "budget; in-flight batches stay leased and will "
+                          "be re-queued by lease expiry")
         deadline = time.monotonic() + 8.0
         self._drain_completions(stub, ignore_status_deadline=True)
         while self._deferred and time.monotonic() < deadline:
@@ -609,10 +750,84 @@ class Worker:
             log.info("received %d jobs", len(jobs))
             self._c_jobs_in.inc(len(jobs))
             self._resolve_payloads(stub, jobs)
+            if prefetch_enabled():
+                self._prefetch(jobs)
             self._in.put(jobs)
         else:
             self._c_idle_polls.inc()
         return jobs
+
+    def _prefetch(self, jobs) -> None:
+        """``DBX_PREFETCH`` (default on): stage a just-received batch's
+        inputs on THIS thread while the compute pipeline runs earlier
+        batches — the control-loop half of the round-14 stage overlap.
+
+        Two legs, both best-effort and bounded by the batch:
+
+        - **backend warm-up** (``backend.prefetch``, handed to the
+          dedicated prefetch thread — page uploads can first-call-
+          compile their scatter for seconds, and THIS thread owns the
+          SendStatus heartbeat the prune window watches): decode payload
+          bytes into the host panel cache and pre-stage device pages, so
+          the compute thread's decode becomes a cache hit (the payload
+          resolution itself already ran in ``_resolve_payloads`` — the
+          PR-5 per-batch fetch memo this leg rides);
+        - **compile-cache pull-forward**: first contact with a new
+          (strategy, payload-size-bucket) signature pulls the next
+          tune-sync tick to NOW, so the FetchCompiled legs (round 10)
+          run before the batch's first compile instead of on the 10 s
+          timer — a fleet-cached compile stops stalling the compute
+          thread for the wall the first worker already paid.
+        """
+        if getattr(self.backend, "prefetch", None) is not None:
+            # Hand the warm-up to the prefetch thread: page uploads and
+            # their first-call scatter compiles must not park the
+            # heartbeat this thread owns past the dispatcher's prune
+            # window.
+            if self._prefetch_thread is None:
+                self._prefetch_q = queue_mod.Queue()
+                self._prefetch_thread = threading.Thread(
+                    target=self._prefetch_loop, name="dbx-prefetch",
+                    daemon=True)
+                self._prefetch_thread.start()
+            self._prefetch_q.put(jobs)
+        if self._compile_sync is not None:
+            fresh = {(j.strategy,
+                      (len(j.ohlcv) or j.panel_bytes_len).bit_length())
+                     for j in jobs}
+            if not fresh <= self._prefetch_seen:
+                if len(self._prefetch_seen) > 4096:  # long-lived bound
+                    self._prefetch_seen.clear()
+                self._prefetch_seen |= fresh
+                self._next_tune_sync = 0.0
+
+    def _prefetch_loop(self) -> None:
+        """Prefetch thread: best-effort backend warm-ups off the control
+        thread. Every warmed path re-resolves through the same caches on
+        the compute thread, so racing (or trailing) the batch it staged
+        costs nothing but the overlap."""
+        while True:
+            jobs = self._prefetch_q.get()
+            if jobs is None:
+                return
+            warm = getattr(self.backend, "prefetch", None)
+            if warm is None:
+                continue
+            t0_wall, t0 = time.time(), time.perf_counter()
+            try:
+                warmed = warm(jobs)
+            except Exception:
+                log.exception("backend prefetch failed; the compute "
+                              "thread will decode inline")
+                continue
+            if warmed:
+                # Prefetched decode IS decode work, done early: the span
+                # keeps obs.timeline's decode attribution honest when
+                # the compute-side decode span reports a cache hit.
+                obs.emit_span("worker.prefetch", t0_wall,
+                              time.perf_counter() - t0,
+                              pairs=obs.job_trace_pairs(jobs),
+                              jobs=len(jobs), warmed=warmed)
 
     def _resolve_payloads(self, stub, jobs) -> None:
         """Dispatch-by-digest intake: a digest-only job whose panel is not

@@ -1,11 +1,25 @@
-"""Seeded violations: a sleep and a device sync inside gRPC servicer
-handlers (blocking-call), and a sleep while holding a lock
-(lock-blocking — the PR-9 PagePool scrape-stall class)."""
+"""Seeded violations: a sleep, a device sync and a timeout'd queue wait
+inside gRPC servicer handlers / the worker control loop (blocking-call),
+a sleep while holding a lock (lock-blocking — the PR-9 PagePool
+scrape-stall class), and a bounded handoff put under the producer's
+accounting lock (lock-blocking — the round-14 pipeline handoff class).
+The allowlisted pipeline waits (Worker._collect_loop) are the clean
+counterparts."""
 
 import threading
 import time
 
 import jax
+
+
+class _Queue:
+    """Stand-in for a bounded handoff queue."""
+
+    def get(self, timeout=None):
+        return None
+
+    def put(self, item, timeout=None):
+        return None
 
 
 class DispatcherServicer:
@@ -28,6 +42,52 @@ class SlowDispatcher(DispatcherServicer):
     def _helper(self):
         # NOT in the allowlist either; helpers of a servicer class count.
         return 1
+
+    def Subscribe(self, request, context):
+        # VIOLATION (timeout'd wait vocabulary, round 14): a bounded
+        # queue wait parks the shared gRPC thread pool exactly like a
+        # sleep of the timeout's length.
+        return self._q.get(timeout=5.0)
+
+
+class Worker:
+    """Stand-in for the worker control loop (scanned by class name)."""
+
+    def __init__(self):
+        self._q = _Queue()
+
+    def run(self):
+        # VIOLATION: a timeout'd handoff wait on the CONTROL thread
+        # starves the liveness heartbeat (qualname not allowlisted).
+        return self._q.get(timeout=1.0)
+
+    def _collect_loop(self, handoff):
+        # Clean: Worker._collect_loop is the allowlisted pipeline
+        # handoff wait — the collector thread's whole job is to wait.
+        return handoff.get(timeout=0.25)
+
+
+class PipelineHandoff:
+    """The round-14 producer/consumer handoff, lock-blocking case."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = _Queue()
+        self._inflight = 0
+
+    def submit(self, item):
+        with self._lock:
+            self._inflight += 1
+            # VIOLATION (lock-blocking): the bounded handoff put runs
+            # under the accounting lock — a full queue parks the
+            # producer while every reader of the lock stalls behind it.
+            self._q.put(item, timeout=1.0)
+
+    def collect(self):
+        item = self._q.get(timeout=1.0)   # clean: waits lock-free
+        with self._lock:
+            self._inflight -= 1
+        return item
 
 
 class StallingPool:

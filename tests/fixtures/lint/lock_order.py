@@ -36,6 +36,29 @@ def hierarchy_two():
             return 4
 
 
+class PipelinedHandoff:
+    """Producer/consumer pair (the round-14 pipelined-executor shape):
+    the submit side nests the stats lock under the pipeline lock, the
+    collect side nests them the other way — an ABBA a busy pipeline
+    WILL eventually schedule."""
+
+    def __init__(self):
+        self._pipeline = threading.Lock()
+        self._stats = threading.Lock()
+        self.inflight = 0
+        self.collected = 0
+
+    def submit_side(self):
+        with self._pipeline:
+            with self._stats:       # VIOLATION: stats-under-pipeline
+                self.inflight += 1
+
+    def collect_side(self):
+        with self._stats:
+            with self._pipeline:    # VIOLATION: pipeline-under-stats
+                self.collected += 1
+
+
 def reenter():
     with _outer:
         return _locked_helper()

@@ -137,11 +137,18 @@ def test_lock_order_detects_abba_cycle_and_self_nest():
     ab = _fixture_line(fname, "VIOLATION: beta-under-alpha")
     ba = _fixture_line(fname, "VIOLATION: alpha-under-beta")
     nest = _fixture_line(fname, "VIOLATION: self-nest")
+    sp = _fixture_line(fname, "VIOLATION: stats-under-pipeline")
+    ps = _fixture_line(fname, "VIOLATION: pipeline-under-stats")
     assert sorted((f.rule, f.path, f.line) for f in findings) == sorted([
         ("lock-order", fname, ab),
         ("lock-order", fname, ba),
         ("lock-order", fname, nest),
+        ("lock-order", fname, sp),
+        ("lock-order", fname, ps),
     ])
+    # The producer/consumer handoff ABBA (round 14) names both sides.
+    handoff = next(f for f in findings if f.line == sp)
+    assert "_pipeline" in handoff.message and "_stats" in handoff.message
     cyc = next(f for f in findings if f.line == ab)
     assert "cycle" in cyc.message and "_alpha" in cyc.message \
         and "_beta" in cyc.message
@@ -173,15 +180,26 @@ def test_atomicity_flags_check_then_act_across_release():
 def test_lock_blocking_flags_device_sync_under_lock():
     """The PR-9 PagePool scrape-stall class as a rule: a device sync
     under the index lock is flagged; the same sync on a lock-free path
-    is not (that is blocking-call's servicer variant, below)."""
+    is not (that is blocking-call's servicer variant, below). The
+    round-14 producer/consumer case: a bounded handoff `put(timeout=)`
+    under the producer's accounting lock is the same stall, while the
+    consumer's lock-free `get(timeout=)` stays clean."""
     findings, _ = _lint_fixture("blocking_call.py",
                                 locks.LockBlockingRule())
     fname = "blocking_call.py"
-    assert [(f.rule, f.path, f.line) for f in findings] == [
-        ("lock-blocking", fname,
-         _fixture_line(fname, "jax.block_until_ready(page)"))]
-    assert "_lock" in findings[0].message
-    assert "block_until_ready" in findings[0].message
+    sync = _fixture_line(fname, "jax.block_until_ready(page)")
+    put = _fixture_line(fname, "self._q.put(item, timeout=1.0)")
+    assert sorted((f.rule, f.path, f.line) for f in findings) == sorted([
+        ("lock-blocking", fname, sync),
+        ("lock-blocking", fname, put),
+    ])
+    by_line = {f.line: f for f in findings}
+    assert "_lock" in by_line[sync].message
+    assert "block_until_ready" in by_line[sync].message
+    assert "put" in by_line[put].message
+    assert "PipelineHandoff.submit" in by_line[put].message
+    # The consumer's lock-free timeout'd get is not a finding.
+    assert not any("collect" in f.message for f in findings)
 
 
 def test_import_time_config_flags_module_level_env_and_io():
@@ -199,20 +217,32 @@ def test_import_time_config_flags_module_level_env_and_io():
 def test_blocking_call_flags_sleep_and_device_sync_in_servicer():
     findings, _ = _lint_fixture("blocking_call.py",
                                 ast_rules.BlockingCallRule())
-    assert [(f.rule, f.path, f.line) for f in findings] == [
-        ("blocking-call", "blocking_call.py",
-         _fixture_line("blocking_call.py", "time.sleep(0.5)")),
-        ("blocking-call", "blocking_call.py",
-         _fixture_line("blocking_call.py",
-                       "jax.block_until_ready(request)")),
-    ]
-    assert "SlowDispatcher.RequestJobs" in findings[0].message
+    fname = "blocking_call.py"
+    sleep = _fixture_line(fname, "time.sleep(0.5)")
+    sync = _fixture_line(fname, "jax.block_until_ready(request)")
+    sub_get = _fixture_line(fname, "self._q.get(timeout=5.0)")
+    run_get = _fixture_line(fname, "self._q.get(timeout=1.0)")
+    assert sorted((f.rule, f.path, f.line) for f in findings) == sorted([
+        ("blocking-call", fname, sleep),
+        ("blocking-call", fname, sync),
+        ("blocking-call", fname, sub_get),
+        ("blocking-call", fname, run_get),
+    ])
+    by_line = {f.line: f for f in findings}
+    assert "SlowDispatcher.RequestJobs" in by_line[sleep].message
     # Device-sync vocabulary (round 12): a handler blocking on the
     # accelerator is the same thread-pool theft as a sleep.
-    assert "SlowDispatcher.GetStats" in findings[1].message
+    assert "SlowDispatcher.GetStats" in by_line[sync].message
+    # Timeout'd queue waits (round 14): flagged in a handler and on the
+    # worker control thread; the allowlisted pipeline collector wait
+    # (Worker._collect_loop) is clean.
+    assert "SlowDispatcher.Subscribe" in by_line[sub_get].message
+    assert "Worker.run" in by_line[run_get].message
+    assert not any("_collect_loop" in f.message for f in findings)
     # StallingPool's under-lock sync belongs to lock-blocking, not here
     # (StallingPool is not a servicer / control-plane class).
     assert not any("StallingPool" in f.message for f in findings)
+    assert not any("PipelineHandoff" in f.message for f in findings)
 
 
 def test_obs_cardinality_flags_unbounded_label_values():

@@ -472,6 +472,122 @@ def test_timeline_push_stage():
     assert summary["stages"]["push"]["total_s"] == pytest.approx(0.2)
 
 
+def test_timeline_inflight_span_charges_execute_not_transport():
+    """Round 14: the pipelined worker's `worker.inflight` span (the
+    submit-return -> collect-start window while the batch runs on
+    device) charges to execute at envelope priority — without it the
+    analyzer's uncovered-gap rule would mis-charge the overlap window to
+    transport. Stage seconds still sum exactly to the e2e window."""
+    tid = obs.new_trace_id()
+    spans = [
+        {"ev": "span", "name": "job", "t0": 0.0, "dur_s": 4.0,
+         "trace_id": tid, "span_id": "s0", "job": "j1", "worker": "w0"},
+        {"ev": "span", "name": "job.queue_wait", "t0": 0.0, "dur_s": 0.5,
+         "trace_id": tid, "span_id": "s1", "job": "j1"},
+        {"ev": "span", "name": "worker.submit", "t0": 1.0, "dur_s": 1.0,
+         "trace_id": tid, "span_id": "s2"},
+        {"ev": "span", "name": "worker.inflight", "t0": 2.0, "dur_s": 1.0,
+         "trace_id": tid, "span_id": "s3"},
+        {"ev": "span", "name": "worker.collect", "t0": 3.0, "dur_s": 1.0,
+         "trace_id": tid, "span_id": "s4"},
+    ]
+    stages = timeline.critical_path(timeline.reconstruct(spans)[tid])
+    assert stages["execute"] == pytest.approx(2.0)   # submit + inflight
+    assert stages["d2h"] == pytest.approx(1.0)
+    assert stages["transport"] == pytest.approx(0.5)  # only the real gap
+    assert sum(stages.values()) == pytest.approx(4.0)
+
+    # Without the inflight span the same window reads as transport —
+    # the mis-charge the overlap-aware mode exists to prevent.
+    stages = timeline.critical_path(
+        timeline.reconstruct(spans[:3] + spans[4:])[tid])
+    assert stages["transport"] == pytest.approx(1.5)
+
+
+def test_timeline_overlap_factor_pipelined_vs_serial():
+    """The overlap-aware mode's `overlap_factor` (round 14): lane
+    seconds (submit-half + collect-half) per covered wall second on the
+    job's worker — ~1.0 for a serial worker whose lanes tile the busy
+    wall, rising toward 2.0 when batch N's device drain overlaps batch
+    N+1's host submit. A multi-job batch's fanned-out span lands in the
+    lane union once, so co-batching alone never reads as pipelining."""
+    tid_a, tid_b = obs.new_trace_id(), obs.new_trace_id()
+
+    def job_spans(tid, name, t0, dur):
+        return [
+            {"ev": "span", "name": "job", "t0": t0, "dur_s": dur,
+             "trace_id": tid, "span_id": f"{name}-e2e", "job": name,
+             "worker": "w0"},
+            {"ev": "span", "name": "job.queue_wait", "t0": t0,
+             "dur_s": 0.5, "trace_id": tid, "span_id": f"{name}-q",
+             "job": name}]
+
+    def pipeline_spans():
+        return (
+            job_spans(tid_a, "A", 0.0, 4.0)
+            + job_spans(tid_b, "B", 0.5, 4.5)
+            + [
+                {"ev": "span", "name": "worker.submit", "t0": 1.0,
+                 "dur_s": 1.0, "trace_id": tid_a, "span_id": "a-sub"},
+                # Fanned-out decode (a shared-batch span) inside A's
+                # submit window: present in BOTH timelines, counted once.
+                {"ev": "span", "name": "worker.decode", "t0": 1.0,
+                 "dur_s": 0.5, "span_id": "shared-dec", "parent_id": "",
+                 "traces": [[tid_a, ""], [tid_b, ""]]},
+                {"ev": "span", "name": "worker.inflight", "t0": 2.0,
+                 "dur_s": 0.5, "trace_id": tid_a, "span_id": "a-inf"},
+                {"ev": "span", "name": "worker.collect", "t0": 2.5,
+                 "dur_s": 1.5, "trace_id": tid_a, "span_id": "a-col"},
+                # B's submit overlaps A's collect drain: the pipeline.
+                {"ev": "span", "name": "worker.submit", "t0": 2.0,
+                 "dur_s": 2.0, "trace_id": tid_b, "span_id": "b-sub"},
+                {"ev": "span", "name": "worker.collect", "t0": 4.0,
+                 "dur_s": 1.0, "trace_id": tid_b, "span_id": "b-col"},
+            ])
+
+    tls = timeline.reconstruct(pipeline_spans())
+    s = timeline.summarize(tls, overlap=True)
+    # Lanes on w0: submit [1,4] (3s), collect [2.5,5] (2.5s), covered
+    # wall [1,5] (4s) -> fleet factor 5.5/4.
+    assert s["overlap"]["overlap_factor"] == pytest.approx(1.375)
+    assert s["overlap"]["workers"]["w0"] == pytest.approx(1.375)
+    assert s["overlap"]["lane_seconds"]["submit"] == pytest.approx(3.0)
+    assert s["overlap"]["lane_seconds"]["collect"] == pytest.approx(2.5)
+    by_job = {j["job"]: j for j in s["per_job"]}
+    # A's window [0,4]: submit 3s + collect 1.5s over 3s covered wall.
+    assert by_job["A"]["overlap_factor"] == pytest.approx(1.5)
+    assert by_job["B"]["overlap_factor"] == pytest.approx(1.375)
+
+    # Serial twin: same stage walls, lanes tiling the busy wall -> 1.0
+    # everywhere (and overlap=False keeps the key out entirely).
+    serial = (
+        job_spans(tid_a, "A", 0.0, 3.5)
+        + job_spans(tid_b, "B", 2.5, 2.5)
+        + [
+            {"ev": "span", "name": "worker.submit", "t0": 1.0,
+             "dur_s": 1.0, "trace_id": tid_a, "span_id": "a-sub"},
+            {"ev": "span", "name": "worker.collect", "t0": 2.0,
+             "dur_s": 1.0, "trace_id": tid_a, "span_id": "a-col"},
+            {"ev": "span", "name": "worker.submit", "t0": 3.0,
+             "dur_s": 1.0, "trace_id": tid_b, "span_id": "b-sub"},
+            {"ev": "span", "name": "worker.collect", "t0": 4.0,
+             "dur_s": 1.0, "trace_id": tid_b, "span_id": "b-col"},
+        ])
+    s = timeline.summarize(timeline.reconstruct(serial), overlap=True)
+    assert s["overlap"]["overlap_factor"] == pytest.approx(1.0)
+    assert all(j["overlap_factor"] == pytest.approx(1.0)
+               for j in s["per_job"])
+    s_off = timeline.summarize(timeline.reconstruct(serial))
+    assert "overlap" not in s_off
+    assert all("overlap_factor" not in j for j in s_off["per_job"])
+
+    # The in-memory ring hook (bench's entry point) passes the mode
+    # through and keeps the digest-not-rows discipline.
+    ring_summary = timeline.summarize_spans(pipeline_spans(), overlap=True)
+    assert ring_summary["overlap"]["overlap_factor"] == pytest.approx(1.375)
+    assert "per_job" not in ring_summary
+
+
 def test_event_log_env_opt_in_is_lazy(tmp_path, monkeypatch):
     """DBX_OBS_JSONL is consulted at FIRST USE, not import (dbxlint
     import-time-config): setting it after import but before first use

@@ -421,6 +421,70 @@ def autotune_bench():
     return json.loads(buf.getvalue().strip().splitlines()[-1])
 
 
+_PIPELINE_ENV = {
+    "DBX_BENCH_CPU": "1", "DBX_BENCH_CACHE": "",
+    "DBX_BENCH_CONFIGS": "pipeline",
+    # Tiny-but-real: a short saturated drain through the REAL gRPC
+    # worker loop in both loop modes — structure smoke; the 1.4x / 1.6x
+    # acceptance bars are asserted on the real-size run (BENCH_r13.json),
+    # not here.
+    "DBX_BENCH_PIPELINE_JOBS": "8", "DBX_BENCH_PIPELINE_BARS": "128",
+    "DBX_BENCH_PIPELINE_FAST": "2", "DBX_BENCH_PIPELINE_SLOW": "2",
+    "DBX_BENCH_PIPELINE_BATCH": "2",
+    "DBX_BENCH_PIPELINE_DEVICE_MS": "3",
+}
+
+
+@pytest.fixture(scope="module")
+def pipeline_bench():
+    """One tiny in-process pipeline A/B run, shared by the module."""
+    prior = {k: os.environ.get(k) for k in _PIPELINE_ENV}
+    for knob in ("DBX_PIPELINE", "DBX_PREFETCH"):
+        prior[knob] = os.environ.pop(knob, None)
+    os.environ.update(_PIPELINE_ENV)
+    bench.ROOFLINE.clear()
+    buf = io.StringIO()
+    try:
+        with contextlib.redirect_stdout(buf):
+            bench.main()
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return json.loads(buf.getvalue().strip().splitlines()[-1])
+
+
+def test_pipeline_ab_keys_present(pipeline_bench):
+    """The round-14 pipelined-executor A/B's acceptance numbers
+    (pipeline_speedup, overlap_factor, the per-stage before/after
+    attribution) ride these BENCH JSON keys — a renamed key would
+    silently invalidate BENCH_r13's acceptance record."""
+    pl = pipeline_bench["roofline"]["pipeline"]
+    for key in ("jobs", "bars", "combos_per_job", "batch",
+                "host_stage_ms", "device_stage_ms",
+                "jobs_per_s_serial", "jobs_per_s_pipelined",
+                "pipeline_speedup", "overlap_factor",
+                "overlap_factor_serial", "stages_serial",
+                "stages_pipelined"):
+        assert key in pl, key
+    assert pl["jobs_per_s_serial"] > 0.0
+    assert pl["jobs_per_s_pipelined"] > 0.0
+    assert pl["pipeline_speedup"] > 0.0
+    # Overlap factors are ratios >= ~1; no performance bar here (tiny
+    # shapes on a loaded CI core), but the serial arm must never read
+    # as pipelined.
+    assert pl["overlap_factor"] >= 1.0
+    assert pl["overlap_factor_serial"] == pytest.approx(1.0, abs=0.25)
+    # The before/after stage attribution actually attributed: both arms
+    # saw decode (host staging) and d2h (device drain) walls.
+    for stages in (pl["stages_serial"], pl["stages_pipelined"]):
+        assert stages.get("decode", 0.0) > 0.0
+        assert stages.get("d2h", 0.0) > 0.0
+    assert pipeline_bench["configs"]["pipeline"] > 0.0
+
+
 def test_autotune_keys_present(autotune_bench):
     """The substrate-autotuner A/B's acceptance numbers
     (autotuned_vs_default_speedup{family} with its modeled twin, and the
