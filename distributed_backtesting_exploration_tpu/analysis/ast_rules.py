@@ -482,8 +482,12 @@ class ObsCardinalityRule:
     # rails, so per-shape-bucket obs is bounded the same way (raw dims
     # would mint one series per shape); stream_bucket is the tenant map's
     # twin for the live fan-out tier's param-block digests
-    # (DBX_STREAM_LABEL_MAX sticky prefixes + "other").
-    _SANCTIONED_CALLS = {"tenant_bucket", "shape_bucket", "stream_bucket"}
+    # (DBX_STREAM_LABEL_MAX sticky prefixes + "other"); worker_bucket is
+    # the fleet telemetry plane's twin for worker ids — worker-chosen
+    # wire strings that churn per restart (DBX_WORKER_LABEL_MAX sticky
+    # names + "other").
+    _SANCTIONED_CALLS = {"tenant_bucket", "shape_bucket", "stream_bucket",
+                         "worker_bucket"}
     _UNBOUNDED = re.compile(
         r"(?:^|_)(?:id|ids|jid|uid|uuid|guid|key|token|path|paths|file|"
         r"filename|dir|addr|address|peer|host|hostname|port|url|uri|"

@@ -22,6 +22,7 @@ from .http import MetricsServer, start_metrics_server  # noqa: F401
 from .registry import (  # noqa: F401
     LATENCY_BUCKETS_S, Counter, Gauge, Histogram, Registry, get_registry)
 from .trace import (  # noqa: F401
-    StepTimer, configure_ring, current_span, current_trace, device_profile,
-    emit_span, job_trace_pairs, new_span_id, new_trace_id, recent_spans,
-    span, timed, timer, trace_context)
+    StepTimer, add_span_listener, configure_ring, current_span,
+    current_trace, device_profile, emit_span, job_trace_pairs, new_span_id,
+    new_trace_id, recent_spans, remove_span_listener, span, timed, timer,
+    trace_context)

@@ -19,7 +19,7 @@ import json
 import sys
 import urllib.request
 
-from .timeline import _fmt_s, _table, parse_events
+from .timeline import _fmt_s, _table, parse_events, stats_url
 
 
 def _phase_rows(digests: dict) -> list[tuple]:
@@ -121,16 +121,21 @@ def main(argv=None) -> int:
                     metavar="PATH",
                     help="additional JSONL event log(s); all JSONL inputs "
                          "aggregate into one table")
+    ap.add_argument("--url", nargs="+", action="extend", default=[],
+                    metavar="URL",
+                    help="live snapshot endpoint(s) (http://host:port or "
+                         "the full .../stats.json) — same as a positional "
+                         "http target, spelled like obs.timeline's flag")
     args = ap.parse_args(argv)
     urls = [t for t in args.targets
-            if t.startswith(("http://", "https://"))]
+            if t.startswith(("http://", "https://"))] + args.url
     jsonl = [t for t in args.targets
              if not t.startswith(("http://", "https://"))] + args.jsonl
     if not urls and not jsonl:
-        ap.error("no targets: pass an endpoint URL and/or JSONL path(s)")
+        ap.error("no targets: pass an endpoint URL (--url) and/or JSONL "
+                 "path(s)")
     for target in urls:
-        url = target.rstrip("/") + "/stats.json"
-        with urllib.request.urlopen(url, timeout=10) as resp:
+        with urllib.request.urlopen(stats_url(target), timeout=10) as resp:
             snap = json.loads(resp.read())
         sys.stdout.write(render_snapshot(snap))
     if jsonl:

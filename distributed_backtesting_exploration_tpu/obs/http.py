@@ -41,16 +41,21 @@ class MetricsServer:
     """Serves a registry over HTTP; ``start()``/``stop()`` lifecycle."""
 
     def __init__(self, port: int = 0, *, registry: Registry | None = None,
-                 bind: str = "0.0.0.0"):
+                 bind: str = "0.0.0.0", routes: dict | None = None):
         self.registry = registry or get_registry()
         self._bind = bind
         self._requested_port = port
+        # Extra JSON document routes: path -> zero-arg callable returning
+        # a JSON-able dict, evaluated per request (the dispatcher mounts
+        # its FleetView snapshot as /fleet.json here).
+        self._routes = dict(routes or {})
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         self.port: int | None = None
 
     def start(self) -> "MetricsServer":
         reg = self.registry
+        routes = self._routes
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):                      # noqa: N802 (stdlib API)
@@ -63,6 +68,15 @@ class MetricsServer:
                     # span attrs, same guard as the JSONL event writer.
                     body = json.dumps(stats_payload(reg),
                                       default=str).encode()
+                    ctype = "application/json"
+                elif path in routes:
+                    try:
+                        doc = routes[path]()
+                    except Exception:
+                        log.exception("route %s provider failed", path)
+                        self.send_error(500)
+                        return
+                    body = json.dumps(doc, default=str).encode()
                     ctype = "application/json"
                 elif path == "/healthz":
                     body, ctype = b"ok\n", "text/plain"

@@ -115,6 +115,36 @@ class Gauge:
         return self._value
 
 
+def histogram_quantile(counts, bounds, q: float,
+                       upper: float | None = None) -> float:
+    """Rank-interpolated quantile over per-bucket counts — the ONE
+    scrape-side estimate, shared by :class:`Histogram` and the fleet
+    telemetry fold (obs.fleet), whose wire-form frames carry the same
+    per-bucket counts over the same bounds. ``upper`` bounds the
+    overflow (+inf) bucket: a tracked max when the caller has one,
+    ``None`` caps at the last finite bound (a merged wire histogram has
+    no max to offer)."""
+    count = sum(counts)
+    if not count:
+        return 0.0
+    rank = q * count
+    acc = 0
+    lo = 0.0
+    for i, c in enumerate(counts):
+        if acc + c >= rank:
+            if i < len(bounds):
+                hi = bounds[i]
+            else:
+                hi = upper if upper is not None else lo
+            if c == 0:
+                return hi
+            return lo + (hi - lo) * (rank - acc) / c
+        acc += c
+        if i < len(bounds):
+            lo = bounds[i]
+    return upper if upper is not None else lo
+
+
 class Histogram:
     """Fixed-bucket histogram (cumulative ``le`` buckets, Prometheus-style).
 
@@ -147,23 +177,13 @@ class Histogram:
                 self.max = v
 
     def _quantile(self, counts, q: float, count: int, mx: float) -> float:
-        # count/mx come from the SAME locked snapshot as counts — a live
-        # self.count read here could exceed the snapshot's total under
-        # concurrent observes and fall through to max for every quantile.
-        rank = q * count
-        acc = 0
-        lo = 0.0
-        for i, c in enumerate(counts):
-            if acc + c >= rank:
-                hi = (self.buckets[i] if i < len(self.buckets)
-                      else mx or lo)
-                if c == 0:
-                    return hi
-                return lo + (hi - lo) * (rank - acc) / c
-            acc += c
-            if i < len(self.buckets):
-                lo = self.buckets[i]
-        return mx
+        # counts must be a locked snapshot (count/mx ride along for the
+        # callers' convenience; the shared estimator re-derives the
+        # total from the same snapshot). `mx or None`: a zero max means
+        # nothing real landed in the overflow bucket — cap at the last
+        # finite bound like the wire-form fold does.
+        return histogram_quantile(counts, self.buckets, q,
+                                  upper=mx or None)
 
     def summary(self) -> dict:
         """JSON-able digest: count/sum/avg/max + estimated p50/p90/p99."""
@@ -258,6 +278,22 @@ class Registry:
                   buckets=LATENCY_BUCKETS_S, **labels) -> Histogram:
         return self._child("histogram", name, help, labels,
                            lambda: Histogram(buckets), buckets)
+
+    def peek(self, name: str, **labels):
+        """Read one labeled counter/gauge value WITHOUT creating it
+        (None when the family or child does not exist, AND for
+        histogram children — a histogram has no single value; use
+        its ``summary()`` via the family accessor instead) — the
+        read-only probe for consumers (the fleet telemetry frame) that
+        must not mint zero-valued series on processes that never
+        recorded them."""
+        key = _label_key(labels)
+        with self._lock:
+            fam = self._families.get(name)
+            child = fam.children.get(key) if fam is not None else None
+        if child is None:
+            return None
+        return child.value if not isinstance(child, Histogram) else None
 
     def remove_child(self, name: str, **labels) -> None:
         """Drop one labeled child (and its family once empty) — lifecycle

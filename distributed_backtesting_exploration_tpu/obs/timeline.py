@@ -174,6 +174,42 @@ def parse_events(paths) -> tuple[list[dict], int]:
     return events, malformed
 
 
+def stats_url(url: str, doc: str = "stats.json") -> str:
+    """Normalize an endpoint to its ``doc`` document URL (a full
+    ``.../<doc>`` passes through) — shared by the ``--url`` CLIs here
+    and in obs.dump plus obs.fleet's ``/fleet.json`` fetch."""
+    if url.rstrip("/").endswith("/" + doc):
+        return url
+    return url.rstrip("/") + "/" + doc
+
+
+def fetch_events(urls) -> tuple[list[dict], int]:
+    """Scrape live ``/stats.json`` snapshots and return their
+    ``dbx_spans_recent`` ring records as span events — the no-log-
+    shipping twin of :func:`parse_events`, with the same skip-and-count
+    contract for malformed entries. An unreachable URL raises (operator
+    error, like an unreadable file)."""
+    import urllib.request
+
+    events: list[dict] = []
+    malformed = 0
+    for url in urls:
+        with urllib.request.urlopen(stats_url(url), timeout=10) as resp:
+            try:
+                snap = json.loads(resp.read())
+            except json.JSONDecodeError:
+                malformed += 1
+                continue
+        fam = snap.get("dbx_spans_recent")
+        vals = fam.get("values", []) if isinstance(fam, dict) else []
+        for rec in vals:
+            if not isinstance(rec, dict) or "ev" not in rec:
+                malformed += 1
+                continue
+            events.append(rec)
+    return events, malformed
+
+
 def _span_t0(rec: dict) -> float:
     # t0 is stamped by the trace layer; older logs carry only the write
     # timestamp — the span ENDED at ts, so start = ts - dur.
@@ -566,9 +602,16 @@ def main(argv=None) -> int:
                     "into per-job lifecycle timelines with critical-path "
                     "stage attribution and straggler flags")
     ap.add_argument("--jsonl", nargs="+", action="extend", default=[],
-                    required=True, metavar="PATH",
+                    metavar="PATH",
                     help="JSONL event log(s) (DBX_OBS_JSONL output); "
                          "repeatable, merged on trace ids")
+    ap.add_argument("--url", nargs="+", action="extend", default=[],
+                    metavar="URL",
+                    help="live /stats.json endpoint(s) "
+                         "(http://host:port or the full .../stats.json): "
+                         "the snapshot's recent-span ring is merged in "
+                         "beside --jsonl, so an operator can point at a "
+                         "running fleet without shipping logs")
     ap.add_argument("--job", default=None,
                     help="restrict to one job id (or trace-id prefix)")
     ap.add_argument("--format", choices=("text", "json"), default="text")
@@ -581,14 +624,21 @@ def main(argv=None) -> int:
                          "pipeline overlap factors (submit+collect lane "
                          "seconds per covered wall second)")
     args = ap.parse_args(argv)
+    if not args.jsonl and not args.url:
+        ap.error("no inputs: pass --jsonl path(s) and/or --url "
+                 "endpoint(s)")
 
     events, malformed = parse_events(args.jsonl)
+    if args.url:
+        url_events, url_malformed = fetch_events(args.url)
+        events.extend(url_events)
+        malformed += url_malformed
     if malformed:
-        print(f"obs.timeline: skipped {malformed} malformed line(s)",
-              file=sys.stderr)
+        print(f"obs.timeline: skipped {malformed} malformed "
+              "line(s)/record(s)", file=sys.stderr)
     if not events:
         print("obs.timeline: no parseable events in "
-              + ", ".join(args.jsonl), file=sys.stderr)
+              + ", ".join(args.jsonl + args.url), file=sys.stderr)
         return 2
     timelines = reconstruct(events)
     if args.job:

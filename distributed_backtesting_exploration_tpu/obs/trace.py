@@ -37,6 +37,7 @@ import contextlib
 import contextvars
 import itertools
 import logging
+import os
 import random
 import threading
 import time
@@ -121,14 +122,42 @@ def job_trace_pairs(jobs) -> list:
 SPAN_RING_CAPACITY = 512
 
 _ring_lock = threading.Lock()
-_ring: collections.deque = collections.deque(maxlen=SPAN_RING_CAPACITY)
+# Created lazily at first use so the capacity knob (DBX_SPAN_RING) is
+# read when the ring is first needed, not at import time — the
+# DBX_OBS_JSONL discipline: tests and operators can set it after import.
+_ring: collections.deque | None = None
 
 
-def configure_ring(capacity: int) -> None:
-    """Resize (and clear) the completed-span ring. 0 disables it."""
+def _ring_capacity() -> int:
+    """``DBX_SPAN_RING`` (default 512): completed spans retained for
+    /stats.json, GetStats ``obs_json`` and bench's end-of-run timeline
+    digest. 0 disables the ring entirely."""
+    try:
+        return max(int(os.environ.get("DBX_SPAN_RING",
+                                      SPAN_RING_CAPACITY)), 0)
+    except ValueError as e:
+        raise ValueError(
+            f"DBX_SPAN_RING={os.environ['DBX_SPAN_RING']!r} is not an "
+            "integer") from e
+
+
+def _get_ring() -> collections.deque:
+    """The ring, created at first use (caller holds ``_ring_lock``)."""
+    global _ring
+    if _ring is None:
+        _ring = collections.deque(maxlen=_ring_capacity())
+    return _ring
+
+
+def configure_ring(capacity: int | None = None) -> None:
+    """Resize (and clear) the completed-span ring. 0 disables it; None
+    re-reads ``DBX_SPAN_RING`` — the reset path for tests/benches that
+    flip the env knob after the ring already materialized."""
     global _ring
     with _ring_lock:
-        _ring = collections.deque(maxlen=max(int(capacity), 0))
+        _ring = collections.deque(
+            maxlen=_ring_capacity() if capacity is None
+            else max(int(capacity), 0))
 
 
 def recent_spans(n: int | None = None) -> list[dict]:
@@ -137,14 +166,41 @@ def recent_spans(n: int | None = None) -> list[dict]:
 
     Copies only the requested tail under the ring lock: every span
     completion appends under the same lock, so a stats scrape of a large
-    ring (bench sizes it to 32k) must not stall the hot path for a
-    full-ring copy."""
+    ring (bench sizes it to 32k via DBX_SPAN_RING) must not stall the
+    hot path for a full-ring copy."""
     with _ring_lock:
+        ring = _get_ring()
         if n is None:
-            return list(_ring)
+            return list(ring)
         if n <= 0:
             return []
-        return list(itertools.islice(_ring, max(len(_ring) - n, 0), None))
+        return list(itertools.islice(ring, max(len(ring) - n, 0), None))
+
+
+# In-process completed-span taps: keyed callables invoked (outside every
+# lock) with each completed span record. The empty-tuple steady state
+# keeps the hot path at one truthiness check; the tuple is rebuilt under
+# the lock on add/remove so iteration never races a mutation.
+_listeners: tuple = ()
+_listeners_by_key: dict = {}
+_listeners_lock = threading.Lock()
+
+
+def add_span_listener(key: str, fn) -> None:
+    """Register ``fn(record)`` to observe every completed span (the
+    fleet-telemetry stage collector's feed). Keyed so a re-registered
+    component replaces its predecessor instead of stacking."""
+    global _listeners
+    with _listeners_lock:
+        _listeners_by_key[key] = fn
+        _listeners = tuple(_listeners_by_key.values())
+
+
+def remove_span_listener(key: str) -> None:
+    global _listeners
+    with _listeners_lock:
+        _listeners_by_key.pop(key, None)
+        _listeners = tuple(_listeners_by_key.values())
 
 
 # Span histograms are get-or-create per distinct name; cache the children so
@@ -193,10 +249,19 @@ def _record_span(name: str, t0_wall: float, dur: float, *, span_id: str,
         rec["parent_id"] = stack_parent[1]
     rec.update(attrs)
     with _ring_lock:
-        if _ring.maxlen:
-            _ring.append(rec)
+        ring = _get_ring()
+        if ring.maxlen:
+            ring.append(rec)
     if events.enabled():
         events.emit_record(rec)
+    for fn in _listeners:
+        # In-process span taps (the fleet telemetry collector): called
+        # OUTSIDE every lock with the already-built record; a listener
+        # failure must never break the instrumented code path.
+        try:
+            fn(rec)
+        except Exception:
+            log.exception("span listener failed")
     return rec
 
 

@@ -46,6 +46,7 @@ from . import panel_store as panel_store_mod
 from . import service, wire
 from .journal import Journal
 from .. import obs
+from ..obs import fleet as obs_fleet
 from ..runtime import _core as native_core
 from ..sched import DEFAULT_TENANT, WfqScheduler, tenant_bucket
 from ..utils import data as data_mod
@@ -1571,6 +1572,12 @@ class Dispatcher(service.DispatcherServicer):
         self._serve = serve_mod
         self.hub = serve_mod.SubscriptionHub(
             registry=self.obs, streamable=STREAMABLE_STRATEGIES)
+        # Fleet telemetry plane (obs/fleet.py, round 15): worker frames
+        # gossiped on JobsRequest.telemetry_json merge here under the
+        # staleness bound; the rollup rides /fleet.json, GetStats
+        # obs_json (dbx_fleet) and the `dbxtop` CLI — and is the
+        # worker-state view ROADMAP item 3's placement scorer ranks.
+        self.fleet = obs_fleet.FleetView(registry=self.obs)
         # Thread-local: concurrent GetStats calls on the gRPC pool must
         # each lend their OWN snapshot to the collector, not race on one
         # shared slot.
@@ -1664,6 +1671,9 @@ class Dispatcher(service.DispatcherServicer):
         reg.gauge("dbx_compile_store_entries",
                   help="compile-cache entries resident in the fleet "
                        "store").set(cs["entries"])
+        # Fleet telemetry gauges + straggler/SLO-burn counters (bounded
+        # worker-bucket labels inside).
+        self.fleet.collect(reg)
 
     def obs_summary(self) -> dict:
         """The extended-stats payload: registry summaries (histogram
@@ -1673,15 +1683,24 @@ class Dispatcher(service.DispatcherServicer):
         out = self.obs.summaries(prefix="dbx_")
         out["dbx_spans_recent"] = obs.recent_spans(
             obs.http.STATS_SPAN_WINDOW)
+        # The merged fleet telemetry document (same shape as
+        # /fleet.json) — so a GetStats client needs no second endpoint.
+        # summaries() above already ran the registry collectors, whose
+        # fleet.collect built a snapshot: reuse it instead of folding
+        # the whole fleet a second time per GetStats.
+        out["dbx_fleet"] = (self.fleet.collected_snapshot()
+                            or self.fleet.snapshot())
         return out
 
     # -- dispatch-by-digest bookkeeping ------------------------------------
 
     def forget_worker(self, worker_id: str) -> None:
         """Drop a pruned worker's delivered-digest set (its next
-        registration starts cacheless anyway)."""
+        registration starts cacheless anyway) and its fleet-telemetry
+        entry (silence already proved the worker gone)."""
         with self._delivered_lock:
             self._delivered.pop(worker_id, None)
+        self.fleet.forget(worker_id)
 
     def _forget_digest(self, digest: str) -> None:
         """Erase every record of having delivered ``digest``: after an
@@ -1773,6 +1792,11 @@ class Dispatcher(service.DispatcherServicer):
             # entries into the fleet registry. Malformed payloads teach
             # nothing (skip-and-count inside) — never an RPC error.
             self.fleet_schedule.merge_json(request.schedule_json)
+        if request.telemetry_json:
+            # Fleet telemetry gossip: adopt this worker's frame into the
+            # staleness-bounded view (malformed frames counted, never an
+            # RPC error — the schedule-gossip contract).
+            self.fleet.update(request.worker_id, request.telemetry_json)
         if is_new:
             log.info("new worker %s with %d chips",
                      request.worker_id, request.chips)
@@ -1835,6 +1859,9 @@ class Dispatcher(service.DispatcherServicer):
                     tenant=tb,
                     outcome=("breach" if wait_s > self.tenant_slo_s
                              else "ok")).inc()
+                # Fleet-wide multi-window burn feed (the same SLO, the
+                # dbx_fleet_slo_burn_total{window} counters).
+                self.fleet.observe_slo(wait_s > self.tenant_slo_s)
             payload2 = rec.ohlcv2 or b""
             leg1 = (self._append_leg(delivered, rec, payload)
                     if rec.append_parent else
@@ -2273,7 +2300,12 @@ class DispatcherServer:
         if self._metrics_port is not None:
             self.metrics = obs.MetricsServer(
                 self._metrics_port, registry=self.dispatcher.obs,
-                bind=self._metrics_host).start()
+                bind=self._metrics_host,
+                routes={
+                    # The merged fleet telemetry document (obs/fleet.py;
+                    # `dbxtop --url` scrapes this).
+                    "/fleet.json": self.dispatcher.fleet.snapshot,
+                }).start()
         self._maint = threading.Thread(
             target=self._maintenance_loop, name="dbx-maint", daemon=True)
         self._maint.start()
@@ -2296,6 +2328,12 @@ class DispatcherServer:
             if expired:
                 d._c_requeued_lease.inc(len(expired))
                 log.warning("requeued %d expired leases", len(expired))
+            for wid in d.fleet.prune():
+                # Telemetry-entry eviction rides the same maintenance
+                # tick as peer pruning: flagged stale first (visible
+                # decay), evicted past 3x the staleness bound.
+                log.info("evicted stale fleet-telemetry entry for %s",
+                         wid)
 
     def stop(self, grace: float = 1.0) -> None:
         self._stop.set()

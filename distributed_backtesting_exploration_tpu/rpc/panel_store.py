@@ -101,6 +101,12 @@ class ByteLRU:
         if entry is not None:
             self.bytes -= entry[1]
 
+    def sizes(self) -> list[tuple]:
+        """``[(key, nbytes), ...]`` in LRU order, values untouched — the
+        fleet telemetry sketch's feed (caller holds the owner's lock,
+        like every other method here)."""
+        return [(k, e[1]) for k, e in self._entries.items()]
+
     def __contains__(self, key) -> bool:
         return key in self._entries
 

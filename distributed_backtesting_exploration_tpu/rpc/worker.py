@@ -40,6 +40,7 @@ import grpc
 from . import backtesting_pb2 as pb
 from . import compute, service
 from .. import obs
+from ..obs import fleet as obs_fleet
 from ..runtime import _core as native_core
 
 log = logging.getLogger("dbx.worker")
@@ -295,6 +296,27 @@ class Worker:
         self.tune_sync_interval_s = 10.0
         self._compile_sync = None
         self._next_tune_sync = 0.0
+        # Fleet telemetry gossip (obs/fleet.py, round 15): one compact
+        # frame per poll on JobsRequest.telemetry_json when something
+        # changed (or the heartbeat elapsed) — built in run() so the
+        # generation id marks THIS run, DBX_FLEET_TELEMETRY=0 disables.
+        self._telemetry: "obs_fleet.WorkerTelemetry | None" = None
+
+    def _telemetry_stats(self) -> dict:
+        """Counter snapshot for the fleet telemetry frame (obs/fleet.py
+        reads through this hook instead of reaching into worker
+        internals). The inflight read takes the pipeline lock — the same
+        leaf lock the busy flag rides."""
+        with self._pipeline_lock:
+            inflight = self._pipeline_inflight
+        return {"jobs_completed": self.jobs_completed,
+                "completions_dropped": self.completions_dropped,
+                "polls": int(self._c_polls.value),
+                "busy": 1 if self._busy.is_set() else 0,
+                "inflight": inflight,
+                "pipeline_on": (hasattr(self.backend, "submit")
+                                and pipeline_enabled()),
+                "pipeline_depth": pipeline_depth()}
 
     def _collect_gauges(self, reg: "obs.Registry") -> None:
         # Sets the children PRE-CREATED in run() (held on self._gauges)
@@ -504,6 +526,14 @@ class Worker:
             from .. import tune as tune_mod
 
             self._compile_sync = tune_mod.attach(registry=self.obs)
+        if obs_fleet.telemetry_enabled():
+            # Fleet telemetry (round 15): frames ride _poll_jobs; the
+            # generation id minted here marks THIS run, so a restarted
+            # worker's frames supersede its predecessor's at the
+            # dispatcher instead of interleaving with them.
+            self._telemetry = obs_fleet.WorkerTelemetry(
+                self.worker_id, stats_fn=self._telemetry_stats,
+                backend=self.backend, registry=self.obs)
         # Fresh timer epoch: the rate is "since the worker STARTED", not
         # since it was constructed (a harness may build workers long
         # before running them).
@@ -723,6 +753,11 @@ class Worker:
             # Gossip-up leg: entries tuned since the last poll (usually
             # empty — zero wire cost on a clean poll).
             schedule_json = reg.take_dirty_json()
+        telemetry_json = ""
+        if self._telemetry is not None:
+            # Fleet telemetry leg: empty when nothing changed inside the
+            # heartbeat interval — the same dirty-bit discipline.
+            telemetry_json = self._telemetry.take_frame_json()
         req = pb.JobsRequest(
             worker_id=self.worker_id, chips=self.backend.chips,
             jobs_per_chip=self.jobs_per_chip,
@@ -730,7 +765,8 @@ class Worker:
             # hosts: backends with a panel cache resolve digests, and
             # payload-less fakes (instant/sleep) never read ohlcv at all.
             accepts_digest_only=True,
-            schedule_json=schedule_json)
+            schedule_json=schedule_json,
+            telemetry_json=telemetry_json)
         try:
             with obs.timer(self._h_rpc["RequestJobs"]):
                 reply = stub.RequestJobs(req, timeout=30.0)
@@ -742,6 +778,9 @@ class Worker:
                 # The drained dirty entries never reached the dispatcher:
                 # re-mark them so the next successful poll pushes them.
                 reg.remark_dirty(schedule_json)
+            if telemetry_json and self._telemetry is not None:
+                # The frame never arrived: resend on the next poll.
+                self._telemetry.remark_dirty()
             return None
         self._c_wire[("RequestJobs", "request")].inc(_pb_size(req))
         self._c_wire[("RequestJobs", "reply")].inc(_pb_size(reply))

@@ -17,5 +17,5 @@ behind it. This package owns the two pieces the dispatcher composes:
 
 from .tenancy import (  # noqa: F401
     DEFAULT_TENANT, OVERFLOW_BUCKET, reset_tenant_buckets,
-    stream_bucket, tenant_bucket)
+    stream_bucket, tenant_bucket, worker_bucket)
 from .wfq import WfqScheduler, parse_tenant_map  # noqa: F401

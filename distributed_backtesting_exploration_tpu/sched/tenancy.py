@@ -77,6 +77,8 @@ def reset_tenant_buckets() -> None:
         _BUCKETS.clear()
     with _STREAM_BUCKET_LOCK:
         _STREAM_BUCKETS.clear()
+    with _WORKER_BUCKET_LOCK:
+        _WORKER_BUCKETS.clear()
 
 
 # -- stream buckets ---------------------------------------------------------
@@ -115,3 +117,40 @@ def stream_bucket(key: str) -> str:
     return _sticky_bucket(_STREAM_BUCKETS, _STREAM_BUCKET_LOCK,
                           _stream_label_max(), k,
                           k[:_STREAM_PREFIX_CHARS])
+
+
+# -- worker buckets ---------------------------------------------------------
+#
+# Worker ids are worker-chosen wire strings (uuid-suffixed by default) and
+# exactly as unbounded as tenant ids: a churning fleet registers a fresh id
+# per restart, so a raw per-worker metric label would mint a permanent
+# time series per registration. The fleet telemetry plane's label
+# surfaces (obs/fleet.py FleetView.collect) route through this map; the
+# full ids stay on the per-document JSON surfaces (/fleet.json frames),
+# which are per-snapshot, not per-series.
+
+_DEFAULT_WORKER_LABEL_MAX = 16
+
+_WORKER_BUCKET_LOCK = threading.Lock()
+_WORKER_BUCKETS: dict[str, str] = {}
+
+
+def _worker_label_max() -> int:
+    """Bucket cap, read lazily like :func:`_label_max`."""
+    return int(os.environ.get("DBX_WORKER_LABEL_MAX",
+                              _DEFAULT_WORKER_LABEL_MAX))
+
+
+def worker_bucket(worker_id: str) -> str:
+    """The bounded metric label for a worker id.
+
+    First ``DBX_WORKER_LABEL_MAX`` distinct ids keep their own name
+    (sticky — a worker's series never splits mid-run), later ones share
+    :data:`OVERFLOW_BUCKET`. This is THE sanctioned way to put worker
+    identity on a metric label (dbxlint obs-cardinality treats
+    ``worker_bucket(...)`` as bounded by construction, beside
+    ``tenant_bucket``/``shape_bucket``/``stream_bucket``).
+    """
+    w = worker_id or "?"
+    return _sticky_bucket(_WORKER_BUCKETS, _WORKER_BUCKET_LOCK,
+                          _worker_label_max(), w, w)

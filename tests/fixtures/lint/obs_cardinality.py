@@ -82,6 +82,18 @@ def record_stream(reg, stream_key, subscriber_id):
                 stream=stream_bucket(stream_key)).inc()
 
 
+def record_worker(reg, worker_id):
+    from distributed_backtesting_exploration_tpu.sched import worker_bucket
+
+    # raw worker identity: worker-chosen wire strings that churn per
+    # restart (one permanent time series per registration) — flagged
+    reg.gauge("fx_worker_rate", worker=worker_id).set(1)
+    # routed through the bounded worker-bucket map (first N workers keep
+    # their name, the rest share "other"): sanctioned — NOT flagged
+    reg.gauge("fx_worker_rate_ok",
+              worker=worker_bucket(worker_id)).set(1)
+
+
 def suppressed(reg, job_id):
     # dbxlint: disable=obs-cardinality -- demo: suppression carries a why
     reg.counter("fx_sup_total", job=job_id).inc()

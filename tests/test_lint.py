@@ -275,6 +275,8 @@ def test_obs_cardinality_flags_unbounded_label_values():
          _fixture_line("obs_cardinality.py", 'stream=stream_key')),
         ("obs-cardinality", "obs_cardinality.py",
          _fixture_line("obs_cardinality.py", 'sub=subscriber_id')),
+        ("obs-cardinality", "obs_cardinality.py",
+         _fixture_line("obs_cardinality.py", 'worker=worker_id')),
     ]
     alias = findings[0]
     assert "wid = self.worker_id" in alias.message
@@ -305,6 +307,11 @@ def test_obs_cardinality_flags_unbounded_label_values():
     # sanctioned label source.
     st_ok = _fixture_line("obs_cardinality.py", "stream=stream_bucket")
     assert st_ok not in [f.line for f in findings]
+    # Worker vocabulary (fleet telemetry round): a raw worker id is
+    # unbounded (one series per registration, forever); the bounded
+    # worker-bucket map is a sanctioned label source.
+    wb_ok = _fixture_line("obs_cardinality.py", "worker=worker_bucket")
+    assert wb_ok not in [f.line for f in findings]
 
 
 def test_obs_cardinality_ignores_splats_and_bounded_loops(tmp_path):

@@ -485,6 +485,72 @@ def test_pipeline_ab_keys_present(pipeline_bench):
     assert pipeline_bench["configs"]["pipeline"] > 0.0
 
 
+_FLEET_ENV = {
+    "DBX_BENCH_CPU": "1", "DBX_BENCH_CACHE": "",
+    "DBX_BENCH_CONFIGS": "fleet_telemetry",
+    # Tiny-but-real: a short direct-dispatch A/B plus a 2-worker
+    # loopback drain with real telemetry frames — structure smoke; the
+    # <=5% overhead and staleness bars are asserted on the real-size
+    # run (BENCH_r14.json), not here (tiny samples are noise).
+    "DBX_BENCH_LOCAL_JOBS": "96", "DBX_BENCH_FLEET_JOBS": "48",
+    "DBX_BENCH_FLEET_WORKERS": "2", "DBX_BENCH_FLEET_POLL_S": "0.1",
+}
+
+
+@pytest.fixture(scope="module")
+def fleet_bench():
+    """One tiny in-process fleet_telemetry run (loopback gRPC, instant
+    backend, real telemetry frames + FleetView), shared by the module."""
+    prior = {k: os.environ.get(k) for k in _FLEET_ENV}
+    os.environ.update(_FLEET_ENV)
+    bench.ROOFLINE.clear()
+    buf = io.StringIO()
+    try:
+        with contextlib.redirect_stdout(buf):
+            bench.main()
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return json.loads(buf.getvalue().strip().splitlines()[-1])
+
+
+def test_fleet_telemetry_keys_present(fleet_bench):
+    """The fleet telemetry plane's acceptance numbers
+    (telemetry_overhead_pct <= 5 with the 2k floor holding,
+    fleet_staleness_p95_s <= 2 poll periods, frame_bytes_p50) ride
+    these BENCH JSON keys — a renamed key would silently invalidate
+    BENCH_r14's successors. Structurally true at any scale: both A/B
+    arms drain, frames flow, and every live worker is visible in the
+    merged view."""
+    ft = fleet_bench["roofline"]["fleet_telemetry"]
+    for key in ("jobs", "batch", "jobs_per_s_off", "jobs_per_s_on",
+                "telemetry_overhead_pct", "overhead_ok", "floor_ok",
+                "frame_bytes_p50", "frames_sampled", "e2e_jobs",
+                "e2e_workers", "e2e_poll_s", "workers_seen",
+                "all_workers_visible", "fleet_staleness_p95_s",
+                "staleness_bar_s", "staleness_ok", "straggler_flagged",
+                "histogram_merge_exact"):
+        assert key in ft, key
+    assert ft["jobs_per_s_off"] > 0.0
+    assert ft["jobs_per_s_on"] > 0.0
+    # Frames really flowed, and the merged /fleet.json saw every worker
+    # (the 2 instant workers + the fast/slow straggler probes).
+    assert ft["frame_bytes_p50"] > 0
+    assert ft["frames_sampled"] >= 1
+    assert ft["workers_seen"] == ft["e2e_workers"] + 2
+    assert ft["all_workers_visible"] is True
+    assert ft["fleet_staleness_p95_s"] >= 0.0
+    # Structurally true at any scale: the slowed probe's execute EWMA
+    # sits far above the healthy bulk's p95, and the fleet histogram is
+    # the exact fold of the per-worker rows.
+    assert ft["straggler_flagged"] is True
+    assert ft["histogram_merge_exact"] is True
+    assert fleet_bench["configs"]["fleet_telemetry"] > 0.0
+
+
 def test_autotune_keys_present(autotune_bench):
     """The substrate-autotuner A/B's acceptance numbers
     (autotuned_vs_default_speedup{family} with its modeled twin, and the
