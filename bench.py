@@ -1686,6 +1686,36 @@ def main():
               f"full reprice {t_full * 1e3:.1f} ms -> {speedup:.1f}x "
               f"(wire {wire_full}B -> {wire_delta}B)", file=sys.stderr)
 
+    # --- certify: dbxcert numerics-certifier analysis cost ----------------
+    # The certifier (analysis.certify) is a CI-gate stage like lint and
+    # proto-drift: its wall is tracked per family exactly like every
+    # compute stage, so a registry/analysis growth that would blow the
+    # tier-1 budget shows up in BENCH JSON first. certify_wall_s maps
+    # family -> seconds to certify its 4 rows (2 epilogue substrates x
+    # {build_carry, append_step}); "digest" covers the scenario-synth +
+    # wire-splice digest cones. DBX_BENCH_CERTIFY_FAMILIES subsets the
+    # registry for tiny runs.
+    if enabled("certify"):
+        from distributed_backtesting_exploration_tpu.analysis import (
+            certify as dbxcert)
+
+        fams_env = os.environ.get("DBX_BENCH_CERTIFY_FAMILIES")
+        fams = ([f.strip() for f in fams_env.split(",") if f.strip()]
+                if fams_env else None)
+        t0 = time.perf_counter()
+        certify_rows, certify_walls = dbxcert.timed_rows(families=fams)
+        certify_total = time.perf_counter() - t0
+        ROOFLINE["certify"] = {
+            "certify_wall_s": {k: round(v, 4)
+                               for k, v in certify_walls.items()},
+            "rows": len(certify_rows),
+            "wall_s_total": round(certify_total, 4)}
+        rates["certify"] = len(certify_rows) / max(certify_total, 1e-9)
+        print(f"bench[certify]: {len(certify_rows)} rows in "
+              f"{certify_total:.2f}s "
+              f"({len(certify_walls) - 1} families + digest cones)",
+              file=sys.stderr)
+
     # --- fanout: live signal fan-out scaling (serve/, ROADMAP item 3) -----
     # The serving-cost contract measured end to end: N subscriptions over
     # M symbol chains (all sharing one param block per symbol -> M unique
@@ -2803,7 +2833,7 @@ def main():
                  "direct_dispatch, queue_machine, streaming_append, "
                  "fanout, ragged_paged, autotune, walkforward, "
                  "long_context, roofline_stages, pipeline, "
-                 "fleet_telemetry")
+                 "fleet_telemetry, certify")
         sys.exit(f"bench: no configs ran — DBX_BENCH_CONFIGS={only} matched "
                  f"nothing (known: {known})")
     # The headline is the north-star config when it ran; otherwise label the

@@ -24,9 +24,18 @@ layers it already has IRs for:
   opt-in (``DBX_LOCKDEP=1``) instrumented-lock shim recording ACTUAL
   acquisition edges, cycles and blocking-under-lock at runtime onto the
   obs surface.
-- **jaxpr/IR layer** (:mod:`.jaxpr_rules`): *kernel-hygiene* — trace every
-  registered fused kernel with ``jax.make_jaxpr`` and flag host callbacks,
-  float64 leaks, and weak-type promotions escaping the kernel.
+- **jaxpr/IR layer** (:mod:`.dataflow` + :mod:`.jaxpr_rules` +
+  :mod:`.certify`): one abstract-interpretation traversal over traced
+  programs backs *kernel-hygiene* (host callbacks, float64 leaks,
+  weak-type promotions — now with introducing equation chains) and
+  **dbxcert**, the numerics certifier: per-output provenance classes
+  (exact / selection / int-exact / float-accum / nondet) and an
+  association-boundary census for every streaming family x epilogue
+  substrate x scan/recurrent form plus the digest cones, pinned as the
+  committed ``numerics.contract.json`` and enforced by
+  *substrate-contract*, *weak-type-provenance* and *digest-determinism*
+  (``dbxcert`` CLI / ``dbxlint --certify``: exit 0/1/2 =
+  clean/findings/drift).
 - **wire layer** (:mod:`.proto_rules`): *proto-drift* — structural
   comparison of ``.proto`` source against the generated ``_pb2``
   serialized descriptor (this repo regenerates pb2 without protoc, so

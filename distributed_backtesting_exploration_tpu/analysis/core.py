@@ -211,7 +211,7 @@ def apply_suppressions(findings: list[Finding], root: str,
 
 def all_rules() -> list:
     """The registered rule set, in catalogue order."""
-    from . import ast_rules, jaxpr_rules, locks, proto_rules
+    from . import ast_rules, certify, jaxpr_rules, locks, proto_rules
 
     return [
         ast_rules.TraceTimeEnvRule(),
@@ -223,6 +223,9 @@ def all_rules() -> list:
         ast_rules.BlockingCallRule(),
         ast_rules.ObsCardinalityRule(),
         jaxpr_rules.KernelHygieneRule(),
+        certify.SubstrateContractRule(),
+        certify.WeakTypeProvenanceRule(),
+        certify.DigestDeterminismRule(),
         proto_rules.ProtoDriftRule(),
     ]
 

@@ -1,0 +1,696 @@
+"""Abstract interpretation over jaxprs: the shared IR traversal + the
+numerics-provenance lattice behind dbxcert (:mod:`.certify`) and the
+kernel-hygiene rule (:mod:`.jaxpr_rules`).
+
+Every distributed guarantee in this repo — content-addressed dispatch,
+journal replay reproducing digests, carry-append parity,
+substrate-vs-substrate equivalence — reduces to a numerics contract that
+used to live as prose ("selection-only => bit-identical", "one
+association boundary", "f32 sums of exact small ints merge bit-exactly").
+This module makes those contracts *computable*: one walk over a traced
+``ClosedJaxpr`` assigns every variable an :class:`AbsVal` and propagates
+it through all primitives, including ``scan``/``while``/``cond``/``pjit``
+sub-jaxprs (loop carries to a fixpoint).
+
+Provenance classes, ordered by :data:`CLASS_NAMES` (join = max):
+
+- **exact** — no float accumulation on the value path: data movement,
+  elementwise float arithmetic in a fixed op order, integer/bool work.
+  Bit-identical given bit-identical inputs, on any substrate.
+- **selection** — float data reaches the value only through comparison
+  operands, select/where predicates, gather/scatter indices, or
+  ``sign``-style discretizers: the magnitude is drawn from a discrete
+  set, so reassociating substrates cannot move it (the compose/latch
+  position machines). The boundary census below still records the
+  knife-edge exposure of its *predicates*.
+- **int-exact** — f32 accumulation of provably integer-valued summands
+  (bool casts, positions in {-1,0,1}, their abs/diffs): f32 integer
+  sums associate exactly (within the documented |sum| < 2^24 head-room),
+  so splits/merges are bit-exact in any order.
+- **float-accum** — real f32 accumulation; every accumulation *site* on
+  the dependency cone is counted into the boundary census (below).
+- **nondet** — order-nondeterministic even for a fixed program and
+  inputs: scatter-add with possibly-duplicate indices, unordered
+  cross-replica psums. Never admissible on a digest path.
+
+Association-boundary census: the ``sites`` set names every
+accumulation site on a value's dependency cone —
+
+- reassociating reduction primitives (``reduce_sum``/``cumsum``/
+  ``dot_general``/``reduce_window_sum``/...),
+- ``add`` equations whose two operands share float lineage (the
+  Hillis–Steele shift-doubling ladders ``ops.fused._cumsum_last`` /
+  ``_cumsum0`` and the blocked equity carries are *structural*
+  reassociations with no reduce primitive — an add of two partial
+  results of the same stream is a summation-tree merge),
+- loop carries updated arithmetically from themselves (scan/while
+  equations whose carry-out depends on carry-in through float
+  arithmetic — the "scan-carry site" of the certified contract).
+
+``len(sites)`` is the *boundary count* pinned per output in
+``numerics.contract.json``; a kernel edit that silently adds (or drops)
+an association boundary changes the count and fails the drift gate with
+the introducing equation chain (:attr:`AbsVal.chain`, built from jaxpr
+``source_info``).
+
+Weak-type provenance: ``weak`` mirrors the aval's ``weak_type`` and
+:attr:`AbsVal.weak_chain` records the introducing equation chain — the
+same chain discipline as class escalations, replacing a bare "output is
+weakly typed" flag with the path that produced it.
+
+The traversal is also the single walker for kernel hygiene: host
+callbacks, f64/c128 avals and nondet primitives anywhere in the nested
+program are collected on the :class:`Analysis` result (one walk, N
+rules).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+# Provenance classes, join = max over this order.
+EXACT, SELECTION, INT_EXACT, FLOAT_ACCUM, NONDET = range(5)
+CLASS_NAMES = ("exact", "selection", "int-exact", "float-accum", "nondet")
+
+_MAX_CHAIN = 6          # provenance frames kept per value (first + recent)
+_MAX_CONST_CHECK = 4096  # integrality check cap for baked const arrays
+_LOOP_FIXPOINT_CAP = 8   # lattice height is small; this is a safety net
+
+# Host round-trips inside traced programs (kernel-hygiene vocabulary).
+CALLBACK_PRIMS = {
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "outside_call",
+}
+
+# Reassociating accumulation primitives: one census site each. reduce_max
+# and friends are deliberately absent — min/max/and/or return one of
+# their operands bitwise, so evaluation order cannot move the result.
+_REDUCE_SITE_PRIMS = {
+    "reduce_sum", "reduce_prod", "cumsum", "cumprod", "cumlogsumexp",
+    "dot_general", "conv_general_dilated", "reduce_window_sum",
+}
+# Sum-shaped reductions stay exact when every summand is integer-valued.
+_INT_EXACT_REDUCES = {"reduce_sum", "cumsum", "dot_general", "add_any"}
+
+# Order-nondeterministic primitives (fixed program + inputs can still
+# produce different bits run to run).
+_NONDET_PRIMS = {
+    "scatter-add", "scatter_add", "scatter-mul", "scatter_mul",
+    "psum", "psum2", "all_reduce", "reduce_scatter",
+}
+
+_CMP_PRIMS = {"lt", "le", "gt", "ge", "eq", "ne", "is_finite"}
+# Discretizers: float in, discrete value out — selection edges like
+# comparisons (the magnitude left standing is a member of a fixed set).
+_SIGN_PRIMS = {"sign"}
+_ARG_REDUCES = {"argmax", "argmin"}
+
+# Pure data movement / value selection: integral-preserving and no
+# arithmetic applied to lineage.
+_MOVE_PRIMS = {
+    "reshape", "broadcast_in_dim", "transpose", "concatenate", "squeeze",
+    "expand_dims", "rev", "slice", "dynamic_slice", "dynamic_update_slice",
+    "pad", "copy", "copy_p", "stop_gradient", "reduce_precision", "gather",
+    "select_n", "max", "min", "reduce_max", "reduce_min", "reduce_and",
+    "reduce_or", "cummax", "cummin", "clamp", "device_put", "iota",
+    "split", "real", "imag",
+}
+# Arithmetic that maps integer-valued operands to integer values
+# (nextafter is deliberately absent: nextafter(2.0, 3.0) is 2.0000002).
+_INT_PRESERVING_ARITH = {
+    "add", "sub", "mul", "neg", "abs", "rem", "add_any",
+    "floor", "ceil", "round", "sort",
+}
+# Index-like operand positions (selection edges) per primitive: data
+# operands are listed; everything else is an index/predicate.
+_VALUE_OPERANDS = {
+    "select_n": None,           # special-cased (pred + cases)
+    "gather": (0,),
+    "dynamic_slice": (0,),
+    "dynamic_update_slice": (0, 1),
+    "scatter": (0, 2),
+    "scatter-add": (0, 2),
+    "scatter_add": (0, 2),
+    "scatter-mul": (0, 2),
+    "scatter_mul": (0, 2),
+    "take": (0,),
+    "take_along_axis": (0,),
+}
+
+
+# Primitives with dedicated first-order transfer rules: a helper jaxpr
+# in their params (scatter's update_jaxpr, sort comparators) must not
+# divert them onto the generic operand-join fallback.
+_CLASSIFIED_PRIMS = (_CMP_PRIMS | _SIGN_PRIMS | _ARG_REDUCES
+                     | _NONDET_PRIMS | _REDUCE_SITE_PRIMS
+                     | set(_VALUE_OPERANDS))
+
+
+@dataclasses.dataclass(frozen=True)
+class AbsVal:
+    """Lattice value of one jaxpr variable.
+
+    ``lineage`` holds float-source tokens reachable on the *value* path
+    (cut at comparisons/discretizers and index/predicate edges);
+    ``alineage`` is the subset that crossed at least one float arithmetic
+    op — the self-overlap test for structural reassociation and for
+    arithmetic loop carries. ``sites`` is the full-cone association
+    census (flows through every edge, including predicates: a selection
+    output's census is its knife-edge exposure)."""
+
+    dtype: str = ""
+    weak: bool = False
+    cls: int = EXACT
+    integral: bool = False
+    lineage: frozenset = frozenset()
+    alineage: frozenset = frozenset()
+    sites: frozenset = frozenset()
+    chain: tuple = ()
+    weak_chain: tuple = ()
+
+    @property
+    def class_name(self) -> str:
+        return CLASS_NAMES[self.cls]
+
+    @property
+    def boundaries(self) -> int:
+        return len(self.sites)
+
+
+@dataclasses.dataclass
+class Analysis:
+    """One-walk result over a ClosedJaxpr: per-output lattice values plus
+    the kernel-hygiene collections (callbacks, f64 leaks, nondet sites)
+    gathered on the same traversal."""
+
+    out_vals: list
+    callbacks: list          # [(prim, frame)] — deduped by prim name
+    f64: list                # [(dtype, prim, frame)] — first site only
+    nondet_sites: list       # [(prim, frame)] — deduped by equation site
+    n_eqns: int = 0
+
+    _callback_names: set = dataclasses.field(default_factory=set)
+    _nondet_seen: set = dataclasses.field(default_factory=set)
+
+
+def _dtype_integral(dtype: str) -> bool:
+    return dtype.startswith(("int", "uint", "bool"))
+
+
+def _is_float(dtype: str) -> bool:
+    return dtype.startswith(("float", "bfloat", "complex"))
+
+
+def _value_integral(value) -> bool:
+    """True when a baked value is provably integer-valued (small arrays
+    only — a huge table is conservatively non-integral)."""
+    try:
+        a = np.asarray(value)
+    except Exception:
+        return False
+    if a.size == 0 or a.size > _MAX_CONST_CHECK:
+        return False
+    if a.dtype.kind in "biu":
+        return True
+    if a.dtype.kind != "f":
+        return False
+    finite = np.isfinite(a)
+    return bool(np.all(finite) and np.all(a == np.round(a)))
+
+
+def _frame(eqn) -> str:
+    """``file:line (fn)`` of the equation's user source, best-effort."""
+    try:
+        from jax._src import source_info_util
+
+        fr = source_info_util.user_frame(eqn.source_info)
+        if fr is not None:
+            return (f"{fr.file_name}:{fr.start_line} "
+                    f"({fr.function_name})")
+    except Exception:
+        pass
+    return "?"
+
+
+def _cap_chain(chain: tuple) -> tuple:
+    if len(chain) <= _MAX_CHAIN:
+        return chain
+    return chain[:1] + chain[-(_MAX_CHAIN - 1):]
+
+
+def _join(vals, *, dtype: str, weak: bool) -> AbsVal:
+    """Plain value-edge join: class max, integral and, set unions."""
+    cls = EXACT
+    integral = True
+    lineage = frozenset()
+    alineage = frozenset()
+    sites = frozenset()
+    chain: tuple = ()
+    weak_chain: tuple = ()
+    for v in vals:
+        if v.cls > cls:
+            cls, chain = v.cls, v.chain
+        integral = integral and v.integral
+        lineage |= v.lineage
+        alineage |= v.alineage
+        sites |= v.sites
+        if v.weak and not weak_chain:
+            weak_chain = v.weak_chain
+    return AbsVal(dtype=dtype, weak=weak, cls=cls, integral=integral,
+                  lineage=lineage, alineage=alineage, sites=sites,
+                  chain=chain, weak_chain=weak_chain)
+
+
+def _aval_info(aval) -> tuple:
+    return (str(getattr(aval, "dtype", "")),
+            bool(getattr(aval, "weak_type", False)))
+
+
+def _atom_val(atom, env):
+    if hasattr(atom, "val"):        # Literal
+        dtype, weak = _aval_info(atom.aval)
+        return AbsVal(dtype=dtype, weak=weak,
+                      integral=_dtype_integral(dtype)
+                      or _value_integral(atom.val))
+    return env[atom]
+
+
+def _const_val(var, value) -> AbsVal:
+    """Baked consts are bit-fixed — exact, no lineage token."""
+    dtype, weak = _aval_info(var.aval)
+    return AbsVal(dtype=dtype, weak=weak,
+                  integral=_dtype_integral(dtype) or _value_integral(value))
+
+
+def _input_val(aval, token, *, integral: bool | None = None) -> AbsVal:
+    dtype, weak = _aval_info(aval)
+    if integral is None:
+        integral = _dtype_integral(dtype)
+    lineage = frozenset({token}) if _is_float(dtype) else frozenset()
+    return AbsVal(dtype=dtype, weak=weak, integral=bool(integral),
+                  lineage=lineage)
+
+
+def _selection_contrib(v: AbsVal) -> int:
+    """Class a predicate/index operand contributes through a selection
+    edge: nondet taints across (a nondet selector makes the selected
+    value nondet across runs), everything else launders to selection
+    when float data is actually involved."""
+    if v.cls == NONDET:
+        return NONDET
+    if v.lineage or v.cls > EXACT:
+        return SELECTION
+    return EXACT
+
+
+def _weak_of(out_aval, invals, fr) -> tuple:
+    """(weak, weak_chain) for one produced value: an outvar weak with no
+    weak operand is an introduction site; otherwise the chain is
+    inherited from the first weak operand. ``fr`` is the lazy frame
+    thunk — source_info resolution only happens on the weak path."""
+    dtype, weak = _aval_info(out_aval)
+    del dtype
+    if not weak:
+        return False, ()
+    for v in invals:
+        if v.weak:
+            return True, _cap_chain(v.weak_chain + (fr(),))
+    return True, (fr(),)
+
+
+# ---------------------------------------------------------------------------
+# The shared traversal (also the kernel-hygiene walker)
+# ---------------------------------------------------------------------------
+
+def as_jaxprs(v) -> list:
+    """Jaxprs nested in an arbitrary eqn param value (ClosedJaxpr,
+    Jaxpr, or containers thereof) — the generic-discovery half of the
+    old kernel-hygiene walker, now the single shared implementation."""
+    out = []
+    if hasattr(v, "jaxpr"):            # ClosedJaxpr
+        out.append(v.jaxpr)
+    elif hasattr(v, "eqns"):           # Jaxpr
+        out.append(v)
+    elif isinstance(v, (tuple, list)):
+        for item in v:
+            out.extend(as_jaxprs(item))
+    return out
+
+
+def analyze(closed, *, integral_inputs=None) -> Analysis:
+    """Analyze a ``ClosedJaxpr``: returns per-output :class:`AbsVal`s
+    plus the hygiene collections. ``integral_inputs`` optionally marks
+    flattened inputs (by position) as provably integer-valued — the
+    carry contract's hints (e.g. ``pos_last`` in {-1,0,1})."""
+    jaxpr = closed.jaxpr
+    an = Analysis(out_vals=[], callbacks=[], f64=[], nondet_sites=[])
+    const_vals = [_const_val(v, c)
+                  for v, c in zip(jaxpr.constvars, closed.consts)]
+    in_vals = []
+    for i, v in enumerate(jaxpr.invars):
+        hint = None
+        if integral_inputs is not None and i < len(integral_inputs) \
+                and integral_inputs[i]:
+            hint = True
+        in_vals.append(_input_val(v.aval, ("in", i), integral=hint))
+    an.out_vals = _eval_jaxpr(jaxpr, const_vals, in_vals, "", an)
+    return an
+
+
+def _eval_jaxpr(jaxpr, const_vals, in_vals, path: str, an: Analysis):
+    env: dict = {}
+    for v, val in zip(jaxpr.constvars, const_vals):
+        env[v] = val
+    for v, val in zip(jaxpr.invars, in_vals):
+        env[v] = val
+    for i, eqn in enumerate(jaxpr.eqns):
+        an.n_eqns += 1
+        site = f"{path}{i}"
+        invals = [_atom_val(a, env) for a in eqn.invars]
+        outs = _transfer(eqn, invals, site, an)
+        for v, val in zip(eqn.outvars, outs):
+            env[v] = val
+    return [_atom_val(a, env) for a in jaxpr.outvars]
+
+
+def _sub_const_vals(sub) -> list:
+    """Const seeds for a nested jaxpr: ClosedJaxpr consts carry values
+    (integrality checkable); bare Jaxpr constvars seed exact."""
+    if hasattr(sub, "consts"):
+        return [_const_val(v, c)
+                for v, c in zip(sub.jaxpr.constvars, sub.consts)]
+    return [AbsVal(dtype=_aval_info(v.aval)[0],
+                   integral=_dtype_integral(_aval_info(v.aval)[0]))
+            for v in sub.constvars]
+
+
+def _inner(sub):
+    return sub.jaxpr if hasattr(sub, "jaxpr") else sub
+
+
+def _transfer(eqn, invals, site: str, an: Analysis) -> list:
+    prim = eqn.primitive.name
+    frame = None
+
+    def fr():
+        nonlocal frame
+        if frame is None:
+            frame = f"{prim} @ {_frame(eqn)}"
+        return frame
+
+    # Hygiene collections ride the same walk regardless of class logic.
+    if prim in CALLBACK_PRIMS and prim not in an._callback_names:
+        an._callback_names.add(prim)
+        an.callbacks.append((prim, fr()))
+    if not an.f64:
+        for v in eqn.outvars:
+            dt = str(getattr(getattr(v, "aval", None), "dtype", ""))
+            if dt in ("float64", "complex128"):
+                an.f64.append((dt, prim, fr()))
+                break
+
+    # Higher-order primitives with precise sub-jaxpr semantics.
+    if prim == "scan":
+        return _transfer_scan(eqn, invals, site, an, fr)
+    if prim == "while":
+        return _transfer_while(eqn, invals, site, an, fr)
+    if prim == "cond":
+        return _transfer_cond(eqn, invals, site, an)
+    sub = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr") \
+        or eqn.params.get("fun_jaxpr")
+    if sub is not None and hasattr(_inner(sub), "eqns") \
+            and len(_inner(sub).invars) == len(invals) \
+            and len(_inner(sub).outvars) == len(eqn.outvars):
+        outs = _eval_jaxpr(_inner(sub), _sub_const_vals(sub), invals,
+                           site + ".", an)
+        # Re-stamp dtype/weak from the call's own outvars (pjit can
+        # weaken/strengthen at the boundary).
+        return [dataclasses.replace(
+                    o, dtype=_aval_info(v.aval)[0],
+                    weak=_aval_info(v.aval)[1],
+                    weak_chain=(o.weak_chain or ((fr(),)
+                                if _aval_info(v.aval)[1] else ())))
+                for o, v in zip(outs, eqn.outvars)]
+
+    # Generic sub-jaxpr discovery (pallas kernels, custom calls with
+    # mismatched arity, helper jaxprs like scatter's update_jaxpr): walk
+    # them for hygiene findings always; classified first-order prims then
+    # proceed to their own transfer, everything else falls back to an
+    # operand join — imprecise but safe (certified cones never hit it).
+    nested = as_jaxprs(list(eqn.params.values()))
+    if nested:
+        for k, sj in enumerate(nested):
+            seeds = [AbsVal(dtype=_aval_info(v.aval)[0],
+                            integral=_dtype_integral(
+                                _aval_info(v.aval)[0]))
+                     for v in sj.invars]
+            consts = [AbsVal(dtype=_aval_info(v.aval)[0])
+                      for v in sj.constvars]
+            _eval_jaxpr(sj, consts, seeds, f"{site}.g{k}.", an)
+        if prim not in _CLASSIFIED_PRIMS:
+            return [_join(invals, dtype=_aval_info(v.aval)[0],
+                          weak=_aval_info(v.aval)[1])
+                    for v in eqn.outvars]
+
+    return _transfer_first_order(eqn, prim, invals, site, an, fr)
+
+
+def _transfer_first_order(eqn, prim, invals, site, an, fr) -> list:
+    outs = []
+    for v in eqn.outvars:
+        dtype, weak_aval = _aval_info(v.aval)
+        weak, weak_chain = _weak_of(v.aval, invals, fr)
+        del weak_aval
+        all_sites = frozenset().union(*(x.sites for x in invals)) \
+            if invals else frozenset()
+
+        if prim in _CMP_PRIMS or prim in _SIGN_PRIMS \
+                or prim in _ARG_REDUCES:
+            cls = max([_selection_contrib(x) for x in invals],
+                      default=EXACT)
+            chain = ()
+            for x in invals:
+                if x.cls == NONDET:
+                    chain = x.chain
+                    break
+            outs.append(AbsVal(dtype=dtype, weak=weak, cls=cls,
+                               integral=True, sites=all_sites,
+                               chain=chain, weak_chain=weak_chain))
+            continue
+
+        if prim in _NONDET_PRIMS:
+            value_ix = _VALUE_OPERANDS.get(prim)
+            data = ([invals[i] for i in value_ix if i < len(invals)]
+                    if value_ix else list(invals))
+            base = _join(data, dtype=dtype, weak=weak)
+            if _is_float(dtype) and not base.integral:
+                # Dedup by equation site: loop bodies re-evaluate under
+                # the fixpoint iteration (same site string every pass).
+                if site not in an._nondet_seen:
+                    an._nondet_seen.add(site)
+                    an.nondet_sites.append((prim, fr()))
+                outs.append(dataclasses.replace(
+                    base, cls=NONDET, sites=all_sites,
+                    chain=_cap_chain(base.chain + (fr(),)),
+                    weak_chain=weak_chain))
+            else:
+                cls = max(base.cls,
+                          INT_EXACT if _is_float(dtype) else EXACT)
+                outs.append(dataclasses.replace(
+                    base, cls=cls, sites=all_sites,
+                    weak_chain=weak_chain))
+            continue
+
+        if prim in _REDUCE_SITE_PRIMS:
+            base = _join(invals, dtype=dtype, weak=weak)
+            if not _is_float(dtype):
+                outs.append(dataclasses.replace(base, sites=all_sites,
+                                                weak_chain=weak_chain))
+            elif base.integral and prim in _INT_EXACT_REDUCES:
+                outs.append(dataclasses.replace(
+                    base, cls=max(base.cls, INT_EXACT), sites=all_sites,
+                    alineage=base.alineage | base.lineage,
+                    weak_chain=weak_chain))
+            else:
+                outs.append(dataclasses.replace(
+                    base, cls=max(base.cls, FLOAT_ACCUM),
+                    integral=False,
+                    sites=all_sites | {f"{site}:{prim}"},
+                    alineage=base.alineage | base.lineage,
+                    chain=_cap_chain(base.chain + (fr(),)),
+                    weak_chain=weak_chain))
+            continue
+
+        if prim == "select_n":
+            pred, cases = invals[0], invals[1:]
+            base = _join(cases, dtype=dtype, weak=weak)
+            cls = max(base.cls, _selection_contrib(pred))
+            outs.append(dataclasses.replace(
+                base, cls=cls, sites=all_sites, weak_chain=weak_chain))
+            continue
+
+        value_ix = _VALUE_OPERANDS.get(prim)
+        if value_ix is not None:
+            data = [invals[i] for i in value_ix if i < len(invals)]
+            idx = [x for i, x in enumerate(invals) if i not in value_ix]
+            base = _join(data, dtype=dtype, weak=weak)
+            cls = max([base.cls] + [_selection_contrib(x) for x in idx])
+            outs.append(dataclasses.replace(
+                base, cls=cls, sites=all_sites, weak_chain=weak_chain))
+            continue
+
+        # Default: value join. Moves preserve integrality and apply no
+        # arithmetic; arithmetic marks every lineage token arith-crossed
+        # and an `add` of overlapping float lineages is a structural
+        # reassociation site (summation-tree merge).
+        base = _join(invals, dtype=dtype, weak=weak)
+        if _dtype_integral(dtype):
+            integral = True
+        elif prim in _MOVE_PRIMS or prim == "convert_element_type":
+            integral = base.integral
+        elif prim in _INT_PRESERVING_ARITH:
+            integral = base.integral
+        else:
+            integral = False
+        alineage = base.alineage
+        sites = all_sites
+        cls = base.cls
+        chain = base.chain
+        if prim not in _MOVE_PRIMS and prim != "convert_element_type" \
+                and _is_float(dtype):
+            alineage = alineage | base.lineage
+            if prim in ("add", "add_any") and len(invals) == 2 \
+                    and not integral \
+                    and (invals[0].lineage & invals[1].lineage):
+                sites = sites | {f"{site}:{prim}"}
+                cls = max(cls, FLOAT_ACCUM)
+                # Every counted site joins the chain: a census change's
+                # introducing equation must be reportable even when the
+                # class was already float-accum.
+                chain = _cap_chain(chain + (fr(),))
+        outs.append(AbsVal(dtype=dtype, weak=weak, cls=cls,
+                           integral=integral, lineage=base.lineage,
+                           alineage=alineage, sites=sites, chain=chain,
+                           weak_chain=weak_chain))
+    return outs
+
+
+def _strip_tokens(v: AbsVal, tokens: frozenset) -> AbsVal:
+    if not (v.lineage & tokens or v.alineage & tokens):
+        return v
+    return dataclasses.replace(v, lineage=v.lineage - tokens,
+                               alineage=v.alineage - tokens)
+
+
+def _loop_carry(body, body_const_vals, const_invals, init_vals, xs_vals,
+                site: str, an: Analysis, fr, *, n_carry: int):
+    """Shared scan/while carry analysis: taint each carry slot, iterate
+    the body to a fixpoint, then classify arithmetic self-dependence
+    (carry-out depending on carry-in through float arithmetic) as one
+    association site per slot — the scan-carry census entry."""
+    taints = [frozenset({("carry", site, j)}) for j in range(n_carry)]
+    all_taints = frozenset().union(*taints) if taints else frozenset()
+    carry = list(init_vals)
+    raw = carry
+    for _ in range(_LOOP_FIXPOINT_CAP):
+        seeded = [dataclasses.replace(c, lineage=c.lineage | taints[j])
+                  for j, c in enumerate(carry)]
+        out = _eval_jaxpr(body, body_const_vals,
+                          const_invals + seeded + xs_vals,
+                          site + ".", an)
+        raw = out[:n_carry]
+        merged = [_join([carry[j], _strip_tokens(raw[j], all_taints)],
+                        dtype=carry[j].dtype, weak=carry[j].weak
+                        or raw[j].weak)
+                  for j in range(n_carry)]
+        if merged == carry:
+            break
+        carry = merged
+    # Arithmetic self-dependence => accumulation across iterations.
+    final = []
+    for j in range(n_carry):
+        c = carry[j]
+        if taints[j] & raw[j].alineage and _is_float(c.dtype):
+            if c.integral:
+                c = dataclasses.replace(c, cls=max(c.cls, INT_EXACT))
+            else:
+                c = dataclasses.replace(
+                    c, cls=max(c.cls, FLOAT_ACCUM),
+                    sites=c.sites | {f"{site}#carry{j}"},
+                    chain=_cap_chain(c.chain + (fr(),)))
+        final.append(c)
+    return final, all_taints
+
+
+def _transfer_scan(eqn, invals, site, an, fr) -> list:
+    p = eqn.params
+    body = p["jaxpr"]
+    n_c, n_carry = p["num_consts"], p["num_carry"]
+    const_invals = invals[:n_c]
+    init_vals = invals[n_c:n_c + n_carry]
+    xs_vals = invals[n_c + n_carry:]
+    body_consts = _sub_const_vals(body)
+    carry, all_taints = _loop_carry(
+        _inner(body), body_consts, const_invals, init_vals, xs_vals,
+        site, an, fr, n_carry=n_carry)
+    # Final pass with the settled carries to produce the ys.
+    out = _eval_jaxpr(_inner(body), body_consts,
+                      const_invals + carry + xs_vals, site + ".", an)
+    result = []
+    for j, v in enumerate(eqn.outvars):
+        dtype, weak = _aval_info(v.aval)
+        if j < n_carry:
+            val = _join([carry[j], _strip_tokens(out[j], all_taints)],
+                        dtype=dtype, weak=weak)
+        else:
+            val = dataclasses.replace(_strip_tokens(out[j], all_taints),
+                                      dtype=dtype, weak=weak)
+        result.append(val)
+    return result
+
+
+def _transfer_while(eqn, invals, site, an, fr) -> list:
+    p = eqn.params
+    cond, body = p["cond_jaxpr"], p["body_jaxpr"]
+    n_cc, n_bc = p["cond_nconsts"], p["body_nconsts"]
+    cond_consts = invals[:n_cc]
+    body_consts_in = invals[n_cc:n_cc + n_bc]
+    init_vals = invals[n_cc + n_bc:]
+    carry, all_taints = _loop_carry(
+        _inner(body), _sub_const_vals(body), body_consts_in, init_vals,
+        [], site, an, fr, n_carry=len(init_vals))
+    # The trip count itself is data-dependent through the cond: every
+    # carry output takes the cond predicate's selection contribution.
+    cond_out = _eval_jaxpr(_inner(cond), _sub_const_vals(cond),
+                           cond_consts + carry, site + ".c", an)
+    pred = _selection_contrib(cond_out[0]) if cond_out else EXACT
+    result = []
+    for j, v in enumerate(eqn.outvars):
+        dtype, weak = _aval_info(v.aval)
+        c = _strip_tokens(carry[j], all_taints)
+        result.append(dataclasses.replace(
+            c, dtype=dtype, weak=weak, cls=max(c.cls, pred),
+            sites=c.sites | (cond_out[0].sites if cond_out
+                             else frozenset())))
+    return result
+
+
+def _transfer_cond(eqn, invals, site, an) -> list:
+    branches = eqn.params["branches"]
+    index, operands = invals[0], invals[1:]
+    per_branch = []
+    for k, br in enumerate(branches):
+        per_branch.append(_eval_jaxpr(
+            _inner(br), _sub_const_vals(br), operands,
+            f"{site}.b{k}.", an))
+    idx_contrib = _selection_contrib(index)
+    result = []
+    for j, v in enumerate(eqn.outvars):
+        dtype, weak = _aval_info(v.aval)
+        val = _join([bo[j] for bo in per_branch], dtype=dtype, weak=weak)
+        result.append(dataclasses.replace(
+            val, cls=max(val.cls, idx_contrib),
+            sites=val.sites | index.sites))
+    return result

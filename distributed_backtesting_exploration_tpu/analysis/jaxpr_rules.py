@@ -17,6 +17,12 @@ is traced with ``jax.make_jaxpr`` over tiny synthetic inputs and the full
 
 Tracing is shape-polymorphic work only (no compile, no device); the whole
 registry traces in a few seconds on CPU.
+
+The jaxpr walk itself lives in :mod:`.dataflow` (one traversal, N rules):
+``check_traced`` consumes the :class:`.dataflow.Analysis` the abstract
+interpreter produces — callbacks/f64 ride the same walk dbxcert uses for
+provenance classes, and weak-type findings now carry the introducing
+equation chain instead of a bare flag.
 """
 
 from __future__ import annotations
@@ -26,12 +32,8 @@ import os
 
 import numpy as np
 
+from . import dataflow
 from .core import Finding, LintContext
-
-_CALLBACK_PRIMS = {
-    "pure_callback", "io_callback", "debug_callback", "callback",
-    "outside_call",
-}
 
 # One representative value per grid-axis name used across the fused
 # registry (windows/periods must be small integral bar counts; MACD/TRIX
@@ -58,38 +60,13 @@ def _tiny_inputs(fields: tuple) -> list[np.ndarray]:
     return [by_name[f][None, :].astype(np.float32) for f in fields]
 
 
-def _iter_jaxprs(jaxpr):
-    """Yield ``jaxpr`` and every jaxpr nested in its equations' params
-    (pjit bodies, pallas kernels, scan/cond branches, custom calls)."""
-    seen: set[int] = set()
-    stack = [jaxpr]
-    while stack:
-        j = stack.pop()
-        if id(j) in seen:
-            continue
-        seen.add(id(j))
-        yield j
-        for eqn in j.eqns:
-            for v in eqn.params.values():
-                stack.extend(_as_jaxprs(v))
-
-
-def _as_jaxprs(v) -> list:
-    out = []
-    if hasattr(v, "jaxpr"):            # ClosedJaxpr
-        out.append(v.jaxpr)
-    elif hasattr(v, "eqns"):           # Jaxpr
-        out.append(v)
-    elif isinstance(v, (tuple, list)):
-        for item in v:
-            out.extend(_as_jaxprs(item))
-    return out
-
-
 def check_traced(name: str, fn, args, *, path: str = "?",
                  line: int = 0) -> list[Finding]:
     """Trace ``fn(*args)`` and lint the jaxpr. ``name`` labels findings;
-    ``path``/``line`` anchor them (the kernel's def site)."""
+    ``path``/``line`` anchor them (the kernel's def site). The walk is
+    :func:`dataflow.analyze` — the same single traversal dbxcert rides —
+    so callbacks, f64 leaks and weak-type provenance all come from one
+    pass over the nested program."""
     import jax
 
     rule = KernelHygieneRule.name
@@ -98,32 +75,21 @@ def check_traced(name: str, fn, args, *, path: str = "?",
     except Exception as e:  # a kernel that fails to even trace is finding #0
         return [Finding(rule, path, line,
                         f"kernel `{name}` failed to trace: {e!r}")]
+    an = dataflow.analyze(closed)
     findings: list[Finding] = []
-    callbacks_seen: set[str] = set()
-    f64_seen = False
-    for jaxpr in _iter_jaxprs(closed.jaxpr):
-        for eqn in jaxpr.eqns:
-            prim = eqn.primitive.name
-            if prim in _CALLBACK_PRIMS and prim not in callbacks_seen:
-                callbacks_seen.add(prim)
-                findings.append(Finding(
-                    rule, path, line,
-                    f"kernel `{name}`: host callback `{prim}` in the "
-                    "traced program — a host round-trip inside a fused "
-                    "kernel defeats the VMEM-resident design"))
-            if not f64_seen:
-                for var in eqn.outvars:
-                    dt = getattr(getattr(var, "aval", None), "dtype", None)
-                    if dt is not None and str(dt) in ("float64",
-                                                      "complex128"):
-                        f64_seen = True
-                        findings.append(Finding(
-                            rule, path, line,
-                            f"kernel `{name}`: {dt} value produced by "
-                            f"`{prim}` — the fused kernels are float32 "
-                            "by contract (f64 blows VMEM budgets and "
-                            "Mosaic lowering)"))
-                        break
+    for prim, _frame in an.callbacks:
+        findings.append(Finding(
+            rule, path, line,
+            f"kernel `{name}`: host callback `{prim}` in the "
+            "traced program — a host round-trip inside a fused "
+            "kernel defeats the VMEM-resident design"))
+    for dt, prim, _frame in an.f64[:1]:
+        findings.append(Finding(
+            rule, path, line,
+            f"kernel `{name}`: {dt} value produced by "
+            f"`{prim}` — the fused kernels are float32 "
+            "by contract (f64 blows VMEM budgets and "
+            "Mosaic lowering)"))
     for i, aval in enumerate(closed.out_avals):
         dt = str(getattr(aval, "dtype", ""))
         if dt and dt != "float32":
@@ -132,11 +98,15 @@ def check_traced(name: str, fn, args, *, path: str = "?",
                 f"kernel `{name}`: output {i} is {dt}, not float32 — "
                 "the Metrics wire contract is float32"))
         elif getattr(aval, "weak_type", False):
+            chain = an.out_vals[i].weak_chain if i < len(an.out_vals) \
+                else ()
+            via = (f" (provenance: {' -> '.join(chain)})" if chain
+                   else "")
             findings.append(Finding(
                 rule, path, line,
                 f"kernel `{name}`: output {i} is weakly typed — a "
                 "Python-scalar promotion escaped the kernel; anchor the "
-                "dtype with an explicit jnp.float32 cast"))
+                f"dtype with an explicit jnp.float32 cast{via}"))
     return findings
 
 

@@ -34,6 +34,14 @@ def make_parser() -> argparse.ArgumentParser:
                          "(default: all; see --list-rules)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalogue and exit")
+    ap.add_argument("--certify", action="store_true",
+                    help="run the numerics certifier only (analysis."
+                         "certify): exit 0 clean / 1 findings / 2 "
+                         "contract drift — the `dbxcert` console script "
+                         "is this mode")
+    ap.add_argument("--update-contract", action="store_true",
+                    help="with --certify: regenerate and write "
+                         "numerics.contract.json from the live trace")
     return ap
 
 
@@ -82,6 +90,24 @@ def run(paths, rules) -> dict:
 
 def main(argv=None) -> int:
     args = make_parser().parse_args(argv)
+    if args.certify:
+        from . import certify
+
+        # The certifier traces the installed package's registries; path
+        # and rule selectors don't apply — reject them loudly rather
+        # than silently running the full certifier anyway.
+        if args.paths or args.rules or args.list_rules:
+            raise SystemExit(
+                "dbxlint --certify runs the whole certified registry of "
+                "the installed package: positional paths, --rules and "
+                "--list-rules do not apply (use plain dbxlint for "
+                "scoped lint runs)")
+        result = certify.run_certify(update=args.update_contract)
+        if args.format == "json":
+            print(json.dumps(result, indent=2))
+        else:
+            certify.render_text(result, prog="dbxlint --certify")
+        return certify.exit_code(result)
     rules = _select_rules(args.rules)
     if args.list_rules:
         for r in rules:

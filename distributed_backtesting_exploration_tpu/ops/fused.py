@@ -401,7 +401,12 @@ def _equity_scan(net, block: int):
     single block this is bit-identical to the ladder substrate
     (``x + 0.0 == x``); across blocks the summation tree differs by the
     usual f32 association rounding (~1 ULP class — positions, and hence
-    every flip-sensitive comparison, are untouched)."""
+    every flip-sensitive comparison, are untouched). Since round 16
+    this is no longer prose-only: dbxcert's association-boundary census
+    counts every block-merge add and ladder step on the equity cone and
+    pins the counts per substrate in ``numerics.contract.json`` — a
+    re-blocking or reassociating edit here fails the drift gate with
+    the introducing equation chain."""
     T_pad, lanes = net.shape
     carry = jnp.zeros((1, lanes), jnp.float32)
     peak_c = jnp.full((1, lanes), -jnp.inf, jnp.float32)
@@ -458,7 +463,11 @@ def _equity_advance(net, block: int, cum, peak, mdd):
     difference vs a cold full-length scan (the PR-3 f32 budget);
     ``cum``/``peak``/``mdd`` initialize to ``0 / -inf / 0`` exactly as
     `_equity_scan` seeds them, so the scan form is literally one call
-    covering the whole panel."""
+    covering the whole panel. This is a certified cone: every streaming
+    family's build/append row in ``numerics.contract.json`` pins the
+    census of the shift-ladder and block-merge adds emitted here (the
+    structural reassociations dbxcert counts without any reduce
+    primitive present)."""
     D = net.shape[-1]
     for s, e in _spans(D, block):
         cs = _cumsum_last(net[..., s:e])

@@ -95,12 +95,13 @@ def scenario_seed(base_digest: str, params: ScenarioParams) -> int:
     return int.from_bytes(h.digest(), "little")
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("n_bars", "block", "regimes"))
-def _gen_core(open_, high, low, close, volume, vol_scale, shock, key, *,
+def _gen_impl(open_, high, low, close, volume, vol_scale, shock, key, *,
               n_bars: int, block: int, regimes: int):
     """The traced generator (fixed shapes; one compile per
-    (base_T, n_bars, block, regimes) bucket)."""
+    (base_T, n_bars, block, regimes) bucket). The un-jitted body is the
+    dbxcert digest-cone trace target (``certify_probe``) — the output
+    digest's determinism contract is certified over exactly this
+    program."""
     f32 = jnp.float32
     c_prev = close[:-1]
     ret = jnp.log(close[1:] / c_prev)              # (Tb,)
@@ -159,6 +160,10 @@ def _gen_core(open_, high, low, close, volume, vol_scale, shock, key, *,
                  (open_new, high_new, low_new, close_new, vol_new))
 
 
+_gen_core = functools.partial(
+    jax.jit, static_argnames=("n_bars", "block", "regimes"))(_gen_impl)
+
+
 def generate(base: data_mod.OHLCV, params: ScenarioParams,
              seed: int) -> data_mod.OHLCV:
     """One synthetic single-ticker panel from ``base`` (fields shaped
@@ -184,6 +189,28 @@ def generate(base: data_mod.OHLCV, params: ScenarioParams,
         jnp.float32(params.vol_scale), jnp.float32(params.shock), key,
         n_bars=n_bars, block=block, regimes=regimes)
     return data_mod.OHLCV(*(np.asarray(f) for f in fields))
+
+
+def certify_probe():
+    """``(fn, args, integral_keys)`` for dbxcert: the generation digest
+    cone on tiny pinned shapes. The scenario digest scheme is sound only
+    if this program is run-to-run deterministic for a fixed (seed,
+    params) — the certifier asserts no *nondet*-class primitive ever
+    reaches these outputs (float association is fine: the program always
+    evaluates in its own fixed order)."""
+    base = data_mod.synthetic_ohlcv(1, 48, seed=3)
+    key = jax.random.fold_in(jax.random.PRNGKey(7), 11)
+
+    def fn(open_, high, low, close, volume, key):
+        o, h, l, c, v = _gen_impl(
+            open_, high, low, close, volume, jnp.float32(2.0),
+            jnp.float32(0.1), key, n_bars=16, block=4, regimes=2)
+        return {"open": o, "high": h, "low": l, "close": c, "volume": v}
+
+    args = [np.asarray(getattr(base, f)[0], np.float32)
+            for f in ("open", "high", "low", "close", "volume")]
+    args.append(np.asarray(key))
+    return fn, args, frozenset()
 
 
 def scenario_panel_bytes(base_bytes: bytes,

@@ -192,10 +192,13 @@ def _advance_metrics(metric: dict, pos, ret, *, cost: float,
     }
 
 
-@functools.partial(jax.jit, static_argnames=("ppy",))
-def _finalize_jit(metric: dict, n, *, ppy: int) -> Metrics:
+def _finalize_impl(metric: dict, n, *, ppy: int) -> Metrics:
     """Accumulators -> the 9 metrics, replicating
-    ``ops.fused._metrics_pack``'s final op order."""
+    ``ops.fused._metrics_pack``'s final op order. Kept un-jitted beside
+    its jitted wrapper so dbxcert (analysis.certify) re-traces the LIVE
+    module code — tracing through the jit wrapper would serve a stale
+    cached jaxpr and hide the very edits the contract gate exists to
+    catch."""
     n = jnp.float32(n)
     mean = metric["s1"] / n
     var = jnp.maximum(metric["s2"] / n - mean * mean, 0.0)
@@ -217,6 +220,10 @@ def _finalize_jit(metric: dict, n, *, ppy: int) -> Metrics:
         n_trades=0.5 * metric["turnover"],
         turnover=metric["turnover"],
     )
+
+
+_finalize_jit = functools.partial(jax.jit, static_argnames=("ppy",))(
+    _finalize_impl)
 
 
 def finalize(carry: StreamCarry) -> Metrics:
@@ -590,8 +597,7 @@ def _single_asset_ret(close):
     return pnl_mod.simple_returns(close)[:, None, :]
 
 
-@functools.partial(jax.jit, static_argnames=("strategy", "cost", "block"))
-def _build_jit(fields, grid, *, strategy: str, cost: float, block: int):
+def _build_impl(fields, grid, *, strategy: str, cost: float, block: int):
     out = _positions_full(strategy, fields, grid)
     if strategy == "pairs":
         pos, beta = out
@@ -604,11 +610,15 @@ def _build_jit(fields, grid, *, strategy: str, cost: float, block: int):
     return metric, _extract_state(strategy, fields, grid)
 
 
-@functools.partial(jax.jit, static_argnames=(
-    "strategy", "cost", "block", "D", "full_cover", "K_new"))
-def _append_jit(tail, delta, grid, state, metric, *, strategy: str,
-                cost: float, block: int, D: int, full_cover: bool,
-                K_new: int):
+# Scan form, jitted for serving (the un-jitted body is the certify trace
+# target — see _finalize_impl's rationale).
+_build_jit = functools.partial(
+    jax.jit, static_argnames=("strategy", "cost", "block"))(_build_impl)
+
+
+def _append_impl(tail, delta, grid, state, metric, *, strategy: str,
+                 cost: float, block: int, D: int, full_cover: bool,
+                 K_new: int):
     win = {f: jnp.concatenate([tail[f], delta[f]], axis=-1) for f in tail}
     K = win["close"].shape[-1] - D
     spec = _STREAM_FAMILIES[strategy]
@@ -630,6 +640,12 @@ def _append_jit(tail, delta, grid, state, metric, *, strategy: str,
     metric = _advance_metrics(metric, pos_d, ret_d, cost=cost, block=block)
     new_tail = {f: win[f][..., -K_new:] for f in win}
     return new_tail, state, metric
+
+
+# Recurrent form, jitted for serving.
+_append_jit = functools.partial(
+    jax.jit, static_argnames=("strategy", "cost", "block", "D",
+                              "full_cover", "K_new"))(_append_impl)
 
 
 # Host-side unroll bound for the blocked equity advance: each block
@@ -759,10 +775,10 @@ def _probe_inputs(strategy: str):
                      "low": close * 0.99,
                      "volume": np.full_like(close, 1e4),
                      "close2": series() * 0.9}[f]
-    carry = build_carry(strategy, {f: v[..., :T] for f, v in
-                                   fields.items()}, grid)
+    base = {f: v[..., :T] for f, v in fields.items()}
+    carry = build_carry(strategy, base, grid)
     delta = {f: np.asarray(v[..., T:]) for f, v in fields.items()}
-    return carry, delta, grid
+    return carry, delta, grid, base
 
 
 def hygiene_probe(strategy: str):
@@ -771,18 +787,18 @@ def hygiene_probe(strategy: str):
     finalize) over tiny concrete inputs. The block schedule resolves the
     active ``DBX_EPILOGUE`` at call time, so the rule's substrate sweep
     traces both epilogues like the fused kernels'."""
-    carry, delta, grid = _probe_inputs(strategy)
+    carry, delta, grid, _ = _probe_inputs(strategy)
     D = _PROBE_DELTA_BARS
     epi_block = _block(D, None)
     K_new = int(carry.tail["close"].shape[-1])
 
     def fn(tail, delta_a, state, metric):
-        new_tail, new_state, new_metric = _append_jit(
+        new_tail, new_state, new_metric = _append_impl(
             tail, delta_a, _grid_jnp(grid), state, metric,
             strategy=strategy, cost=0.0, block=epi_block, D=D,
             full_cover=False, K_new=K_new)
-        m = _finalize_jit(new_metric, jnp.float32(carry.n_bars + D),
-                          ppy=252)
+        m = _finalize_impl(new_metric, jnp.float32(carry.n_bars + D),
+                           ppy=252)
         return tuple(m) + tuple(
             new_tail[k] for k in sorted(new_tail)) + tuple(
             new_state[k] for k in sorted(new_state)) + tuple(
@@ -792,3 +808,75 @@ def hygiene_probe(strategy: str):
             {k: np.asarray(v) for k, v in carry.state.items()},
             {k: np.asarray(v) for k, v in carry.metric.items()}]
     return fn, args
+
+
+# ---------------------------------------------------------------------------
+# dbxcert probes: the certified streaming cones with LABELED outputs
+# ---------------------------------------------------------------------------
+
+# Carry accumulators that are f32 sums/holds of exact small integers by
+# the documented carry contract (positions in {-1,0,1}, bool-cast win/
+# active counts, |Δpos| turnover increments): dbxcert seeds the append
+# form's inputs with this integrality hint so the analyzer can prove the
+# int-exact merge guarantee the parity tests pin empirically.
+_INTEGRAL_CARRY_KEYS = frozenset(
+    {"wins", "active", "turnover", "pos_last"})
+
+
+def certify_probe(strategy: str, *, form: str, epilogue: str | None = None):
+    """``(fn, args, integral_keys)`` for dbxcert (analysis.certify).
+
+    ``fn(*args)`` traces one certified cone of ``strategy`` — ``form``
+    is ``"build_carry"`` (scan form over the full tiny panel from the
+    zero state) or ``"append_step"`` (recurrent form over a ΔT slice
+    from the stored carry) — returning a DICT so every output is
+    addressable by a stable label in ``numerics.contract.json``
+    (``metrics/<name>`` the 9 public metrics, ``metric/<k>`` the
+    accumulators, ``state/<k>`` family signal state, ``tail/<k>`` the
+    raw-input tail). The epilogue substrate is passed explicitly (no env
+    mutation); the un-jitted impl bodies are traced so a live edit is
+    always seen. ``integral_keys`` names input dict keys the analyzer
+    may assume integer-valued (the carry contract's hints)."""
+    if form not in ("build_carry", "append_step"):
+        raise ValueError(f"unknown certify form {form!r}")
+    carry, delta, grid, base_fields = _probe_inputs(strategy)
+    gj = _grid_jnp(grid)
+
+    def _label(m: Metrics, metric: dict, state: dict, extra: dict) -> dict:
+        out = {f"metrics/{k}": getattr(m, k) for k in Metrics._fields}
+        out.update({f"metric/{k}": v for k, v in metric.items()})
+        out.update({f"state/{k}": v for k, v in state.items()})
+        out.update(extra)
+        return out
+
+    if form == "append_step":
+        D = _PROBE_DELTA_BARS
+        block = _block(D, epilogue)
+        K_new = int(carry.tail["close"].shape[-1])
+
+        def fn(tail, delta_a, state, metric):
+            new_tail, new_state, new_metric = _append_impl(
+                tail, delta_a, gj, state, metric, strategy=strategy,
+                cost=0.001, block=block, D=D, full_cover=False,
+                K_new=K_new)
+            m = _finalize_impl(new_metric, jnp.float32(carry.n_bars + D),
+                               ppy=252)
+            return _label(m, new_metric, new_state,
+                          {f"tail/{k}": v for k, v in new_tail.items()})
+
+        args = [{k: np.asarray(v) for k, v in carry.tail.items()},
+                dict(delta),
+                {k: np.asarray(v) for k, v in carry.state.items()},
+                {k: np.asarray(v) for k, v in carry.metric.items()}]
+        return fn, args, _INTEGRAL_CARRY_KEYS
+
+    T = int(base_fields["close"].shape[-1])
+    block = _block(T, epilogue)
+
+    def fn(fields):
+        metric, state = _build_impl(fields, gj, strategy=strategy,
+                                    cost=0.001, block=block)
+        m = _finalize_impl(metric, jnp.float32(T), ppy=252)
+        return _label(m, metric, state, {})
+
+    return fn, [dict(base_fields)], frozenset()

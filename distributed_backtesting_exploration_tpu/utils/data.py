@@ -225,6 +225,33 @@ def splice_wire_bytes(base: bytes, delta: bytes) -> bytes:
         for bf, df in zip(b, d))))
 
 
+def splice_cone_probe():
+    """``(fn, args, integral_keys)`` for dbxcert: the array-dataflow cone
+    of :func:`splice_wire_bytes` — per-field concatenation, nothing else.
+
+    The splice's digest guarantee (replayed chains reproduce the SAME
+    extended-panel digests) holds because the operation is pure data
+    movement: the certifier pins every output of this cone to the
+    *exact* class with a zero boundary census, so an edit that slips any
+    arithmetic (rescaling, re-encoding, accumulation) into the splice
+    path fails the digest-determinism gate. The byte-level codec framing
+    around it is covered by the wire round-trip tests."""
+    import jax.numpy as jnp  # lazy: utils.data stays numpy-only otherwise
+
+    base = synthetic_ohlcv(1, 12, seed=5)
+    delta = synthetic_ohlcv(1, 4, seed=6)
+
+    def fn(b, d):
+        return {f: jnp.concatenate([b[f], d[f]], axis=-1)
+                for f in _FIELDS}
+
+    args = [{f: np.asarray(getattr(base, f)[0], np.float32)
+             for f in _FIELDS},
+            {f: np.asarray(getattr(delta, f)[0], np.float32)
+             for f in _FIELDS}]
+    return fn, args, frozenset()
+
+
 def pad_and_stack(
     series: Sequence[OHLCV], *, lane_multiple: int = 128
 ) -> tuple[OHLCV, np.ndarray, np.ndarray]:

@@ -1,0 +1,438 @@
+"""dbxcert tests: the dataflow lattice on seeded mini-jaxprs (one per
+provenance class), contract-table canonical-bytes determinism, committed
+coverage, the empirical substrate cross-check (a `selection`-certified
+family really is bit-identical across scan:8 vs ladder), the deliberate
+reassociated-kernel-edit drift fixture, and the CLI exit-code contract.
+The package-wide certify-clean gate lives in test_lint_clean.py."""
+
+import copy
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_backtesting_exploration_tpu.analysis import (
+    certify, core, dataflow, jaxpr_rules)
+from distributed_backtesting_exploration_tpu.streaming import recurrent
+
+# Non-integral float values so integrality must be *proven*, never
+# accidental.
+_X = (np.linspace(0.1, 2.3, 8) + 0.017).astype(np.float32)
+
+
+def _analyze(fn, *args, integral_inputs=None):
+    return dataflow.analyze(jax.make_jaxpr(fn)(*args),
+                            integral_inputs=integral_inputs)
+
+
+# ---------------------------------------------------------------------------
+# Lattice: one seeded mini-jaxpr per provenance class
+# ---------------------------------------------------------------------------
+
+def test_selection_machine_classifies_selection_with_zero_census():
+    """The band/latch shape: float data reaches the output only through
+    comparisons and select branches over literals — selection class, no
+    association boundary, provably integer-valued."""
+    def machine(z):
+        def step(pos, z_t):
+            ent = jnp.where(z_t < -1.0, jnp.float32(1.0),
+                            jnp.where(z_t > 1.0, jnp.float32(-1.0),
+                                      jnp.float32(0.0)))
+            nxt = jnp.where(pos == 0, ent,
+                            jnp.where((pos > 0) & (z_t >= 0.0),
+                                      jnp.float32(0.0), pos))
+            return nxt, nxt
+
+        _, path = jax.lax.scan(step, jnp.zeros((), jnp.float32), z)
+        return path
+
+    (v,) = _analyze(machine, _X).out_vals
+    assert v.class_name == "selection"
+    assert v.boundaries == 0
+    assert v.integral
+
+
+def test_int_exact_sum_of_bool_casts():
+    """f32 sums of exact small ints (the win/active/turnover shape):
+    int-exact, zero boundary census — associativity holds exactly."""
+    (v,) = _analyze(lambda x: jnp.sum((x > 0.5).astype(jnp.float32)),
+                    _X).out_vals
+    assert v.class_name == "int-exact"
+    assert v.boundaries == 0
+
+
+def test_float_accum_census_counts_known_boundaries():
+    """One reduce_sum = one site; one cumsum = one site; a deliberately
+    split summation tree (two half-sums + a merge add of overlapping
+    lineage) = three sites."""
+    (s,) = _analyze(lambda x: jnp.sum(x * x), _X).out_vals
+    assert (s.class_name, s.boundaries) == ("float-accum", 1)
+    (c,) = _analyze(lambda x: jnp.cumsum(x)[-1], _X).out_vals
+    assert (c.class_name, c.boundaries) == ("float-accum", 1)
+    (sp,) = _analyze(lambda x: jnp.sum(x[::2]) + jnp.sum(x[1::2]),
+                     _X).out_vals
+    assert (sp.class_name, sp.boundaries) == ("float-accum", 3)
+
+
+def test_structural_reassociation_ladder_is_counted():
+    """The Hillis–Steele shift-doubling ladder (ops.fused._cumsum_last's
+    shape) has NO reduce primitive — every `x + shift(x)` step is an add
+    of overlapping lineage and must be counted as a site (log2(8) = 3)."""
+    def ladder(x):
+        s = 1
+        while s < x.shape[-1]:
+            x = x + jnp.concatenate([jnp.zeros((s,), x.dtype), x[:-s]])
+            s *= 2
+        return x
+
+    (v,) = _analyze(ladder, _X).out_vals
+    assert (v.class_name, v.boundaries) == ("float-accum", 3)
+
+
+def test_scan_carry_accumulation_is_a_boundary_site():
+    """A float carry updated arithmetically from itself is a scan-carry
+    site; a carry moved only through selects (the position machine,
+    above) is not."""
+    def accum(x):
+        c, _ = jax.lax.scan(lambda c, t: (c + t, c),
+                            jnp.zeros((), jnp.float32), x)
+        return c
+
+    (v,) = _analyze(accum, _X).out_vals
+    assert v.class_name == "float-accum"
+    assert v.boundaries == 2   # the in-body merge add + the carry site
+    assert any("carry" in s for s in v.sites)
+
+
+def test_scatter_add_is_nondet_with_site_recorded():
+    def scatter(x):
+        idx = jnp.array([0, 1, 1, 2, 3, 3, 0, 2])
+        return jnp.zeros((4,), jnp.float32).at[idx].add(x)
+
+    an = _analyze(scatter, _X)
+    (v,) = an.out_vals
+    assert v.class_name == "nondet"
+    assert an.nondet_sites and an.nondet_sites[0][0] == "scatter-add"
+    assert v.chain   # the introducing equation chain rides the value
+
+    # Integer-valued updates order-independently sum exactly: int-exact.
+    def scatter_int(x):
+        idx = jnp.array([0, 1, 1, 2])
+        ones = (x[:4] > 0).astype(jnp.float32)
+        return jnp.zeros((4,), jnp.float32).at[idx].add(ones)
+
+    (vi,) = _analyze(scatter_int, _X).out_vals
+    assert vi.class_name == "int-exact"
+
+
+def test_nextafter_breaks_integrality():
+    """nextafter(2.0, 3.0) = 2.0000002 — it must NOT be treated as
+    integer-preserving, or a sum over it would be unsoundly certified
+    int-exact."""
+    (v,) = _analyze(lambda x: jnp.sum(jnp.nextafter(x, x + 1.0)), _X,
+                    integral_inputs=[True]).out_vals
+    assert v.class_name == "float-accum"
+    assert not v.integral
+
+
+def test_nondet_site_in_scan_body_deduped_across_fixpoint():
+    """A single scatter-add inside a scan body is ONE nondet site, not
+    one per fixpoint re-evaluation of the body."""
+    def step(c, t):
+        idx = jnp.array([0, 1, 1, 2])
+        return c + jnp.zeros((4,), jnp.float32).at[idx].add(t), None
+
+    an = _analyze(
+        lambda x: jax.lax.scan(step, jnp.zeros((4,), jnp.float32),
+                               x)[0], _X)
+    assert len(an.nondet_sites) == 1
+
+
+def test_weak_type_provenance_chain_recorded():
+    (v,) = _analyze(lambda x: jnp.where(x > 0, 1.0, 0.0), _X).out_vals
+    assert v.weak
+    assert v.weak_chain and any("@" in f for f in v.weak_chain)
+
+
+def test_integral_input_hint_proves_int_exact_merge():
+    """The carry contract's hint: turnover-shaped |Δpos| sums over a
+    pos_last input asserted integer-valued classify int-exact; without
+    the hint the same program is float-accum."""
+    def turnover(p):
+        prev = jnp.concatenate([jnp.zeros((1,), jnp.float32), p[:-1]])
+        return jnp.sum(jnp.abs(p - prev))
+
+    (hinted,) = _analyze(turnover, _X, integral_inputs=[True]).out_vals
+    assert hinted.class_name == "int-exact"
+    (plain,) = _analyze(turnover, _X).out_vals
+    assert plain.class_name == "float-accum"
+
+
+def test_comparison_launders_accumulation_but_census_keeps_exposure():
+    """Per the contract semantics, a comparison's discrete result is
+    selection-class even over a reassociated operand — but the census
+    still records the knife-edge exposure on the cone."""
+    (v,) = _analyze(
+        lambda x: jnp.where(jnp.sum(x) > 1.0, jnp.float32(1.0),
+                            jnp.float32(0.0)), _X).out_vals
+    assert v.class_name == "selection"
+    assert v.boundaries == 1
+
+
+def test_elementwise_float_arithmetic_stays_exact():
+    (v,) = _analyze(lambda x: x * jnp.float32(2.0) - jnp.exp(-x),
+                    _X).out_vals
+    assert v.class_name == "exact"
+    assert v.boundaries == 0
+
+
+def test_kernel_hygiene_weak_finding_carries_provenance_chain():
+    """kernel-hygiene's weak-type flag now rides the shared dataflow
+    walk: same file/line/label, message upgraded with the chain."""
+    weak = jaxpr_rules.check_traced(
+        "weak", lambda x: jnp.full(x.shape, 2.0),
+        [np.ones((4, 8), np.float32)])
+    assert len(weak) == 1 and "weakly typed" in weak[0].message
+    assert "provenance:" in weak[0].message
+
+
+# ---------------------------------------------------------------------------
+# Contract table: coverage, canonical bytes, drift detection
+# ---------------------------------------------------------------------------
+
+def test_committed_contract_covers_all_families_substrates_forms():
+    committed = certify.load_contract()
+    assert committed is not None, "numerics.contract.json must be committed"
+    fams = certify.stream_families()
+    assert len(fams) == 14
+    expect = {certify.row_key(f, s, fo)
+              for f in fams
+              for s in certify.SUBSTRATES
+              for fo in certify.FORMS}
+    expect |= set(certify.DIGEST_KEYS)
+    assert set(committed["rows"]) == expect
+    assert committed["schema"] == certify.SCHEMA
+    # Canonical = sorted keys, no timestamps: nothing beyond the schema.
+    assert set(committed) == {"schema", "rows"}
+
+
+def test_contract_table_canonical_bytes_deterministic():
+    """Same trace twice => identical canonical JSON bytes (fresh traces,
+    not the cache)."""
+    def one_pass():
+        rows = {}
+        for sub in certify.SUBSTRATES:
+            for form in certify.FORMS:
+                r = certify.streaming_row("momentum", sub, form)
+                rows[r.key] = r
+        return certify.canonical_bytes(certify.table_from_rows(rows))
+
+    assert one_pass() == one_pass()
+
+
+def test_selection_certified_outputs_bit_identical_across_substrates():
+    """The empirical cross-check: every output the table certifies at or
+    below int-exact really is bit-identical between the scan:8 and
+    ladder epilogue substrates on the pinned tiny shapes — and the
+    certifier's selection claim covers the position state."""
+    committed = certify.load_contract()
+    _, _, grid, fields = recurrent._probe_inputs("bollinger")
+    c_scan = recurrent.build_carry("bollinger", fields, grid,
+                                   epilogue="scan:8")
+    c_lad = recurrent.build_carry("bollinger", fields, grid,
+                                  epilogue="ladder")
+    row = committed["rows"][certify.row_key("bollinger", "scan:8",
+                                            "build_carry")]["outputs"]
+    checked = 0
+    for label, rec in row.items():
+        if not label.startswith("metric/"):
+            continue
+        if rec["class"] not in ("exact", "selection", "int-exact"):
+            continue
+        name = label.split("/", 1)[1]
+        np.testing.assert_array_equal(
+            np.asarray(c_scan.metric[name]), np.asarray(c_lad.metric[name]),
+            err_msg=f"{label} certified {rec['class']} must be "
+                    f"bit-identical across substrates")
+        checked += 1
+    assert checked >= 3          # pos_last + the count accumulators
+    assert row["metric/pos_last"]["class"] == "selection"
+
+
+def test_reassociated_kernel_edit_is_caught_as_contract_diff(monkeypatch):
+    """The acceptance fixture: a deliberate reassociation (an extra
+    summation-tree merge on s1's cone) must fail the drift gate with the
+    introducing equation chain reported."""
+    orig = recurrent._advance_metrics
+
+    def reassociated(metric, pos, ret, *, cost, block):
+        out = orig(metric, pos, ret, cost=cost, block=block)
+        # Split-and-remerge: algebraically a no-op, numerically one more
+        # association boundary on the moment-sum path.
+        out["s1"] = (out["s1"] - metric["s1"]) + metric["s1"]
+        return out
+
+    monkeypatch.setattr(recurrent, "_advance_metrics", reassociated)
+    key = certify.row_key("sma_crossover", "scan:8", "append_step")
+    live = certify.streaming_row("sma_crossover", "scan:8", "append_step")
+    committed = certify.load_contract()
+    diffs = certify.diff_rows(committed, {key: live})
+    s1 = [d for d in diffs
+          if d["output"] == "metric/s1" and d["field"] == "boundaries"]
+    assert s1, f"reassociation not caught; diffs={diffs}"
+    assert s1[0]["now"] == s1[0]["was"] + 1
+    assert s1[0]["chain"] and any("add" in f for f in s1[0]["chain"])
+    assert "introduced by" in s1[0]["message"]
+
+
+def test_unpatched_row_matches_committed_contract():
+    """The drift fixture above proves sensitivity; this proves
+    specificity — the live unpatched row diffs empty (fresh trace, cache
+    not consulted)."""
+    key = certify.row_key("sma_crossover", "scan:8", "append_step")
+    live = certify.streaming_row("sma_crossover", "scan:8", "append_step")
+    assert certify.diff_rows(certify.load_contract(), {key: live}) == []
+
+
+# ---------------------------------------------------------------------------
+# Digest cones + rules + CLI exit codes
+# ---------------------------------------------------------------------------
+
+def test_digest_cones_certified_deterministic():
+    rows = {r.key: r for r in certify.digest_rows()}
+    synth = rows["digest/scenario_synth"]
+    assert not synth.nondet
+    assert all(rec["class"] != "nondet"
+               for rec in synth.outputs.values())
+    splice = rows["digest/splice"]
+    assert all(rec["class"] == "exact" and rec["boundaries"] == 0
+               for rec in splice.outputs.values())
+
+
+def _package_ctx():
+    import distributed_backtesting_exploration_tpu as dbx
+
+    return core.load_context(os.path.dirname(os.path.abspath(
+        dbx.__file__)))
+
+
+def test_digest_determinism_rule_flags_injected_scatter_add(monkeypatch):
+    """A nondet primitive slipped into a digest cone is a finding (CLI
+    exit 1 path), reported with the introducing chain."""
+    rows = dict(certify.cached_rows())
+
+    def poisoned(o, h, l, c, v, key):
+        idx = jnp.array([0, 1, 1, 2])
+        return {"close": jnp.zeros((4,), jnp.float32).at[idx].add(c[:4])}
+
+    fn_args = [np.asarray(getattr(x, "close", x), np.float32)
+               for x in [np.ones(8)] * 5] + [np.zeros(2, np.uint32)]
+    rows["digest/scenario_synth"] = certify.certify_callable(
+        "digest/scenario_synth", poisoned, fn_args)
+    monkeypatch.setattr(certify, "cached_rows", lambda: rows)
+    findings = certify.DigestDeterminismRule().check(_package_ctx())
+    assert findings
+    assert any("scatter-add" in f.message for f in findings)
+    assert all(f.rule == "digest-determinism" for f in findings)
+
+
+def test_run_certify_exit_codes(monkeypatch, tmp_path):
+    """0 clean / 1 findings / 2 table drift — the documented contract."""
+    clean = certify.run_certify()
+    assert certify.exit_code(clean) == 0
+    assert clean["rows"] == 58
+
+    # Drift: a doctored committed table (one boundary count off).
+    doctored = copy.deepcopy(certify.load_contract())
+    key = certify.row_key("sma_crossover", "scan:8", "append_step")
+    doctored["rows"][key]["outputs"]["metric/s1"]["boundaries"] += 1
+    p = tmp_path / "numerics.contract.json"
+    p.write_bytes(certify.canonical_bytes(doctored))
+    monkeypatch.setenv("DBX_CONTRACT_PATH", str(p))
+    drifted = certify.run_certify()
+    assert certify.exit_code(drifted) == 2
+    assert any(d["rule"] == "substrate-contract" for d in drifted["drift"])
+    monkeypatch.delenv("DBX_CONTRACT_PATH")
+
+    # Findings: a poisoned digest cone (drift-free table, nondet cone).
+    rows = dict(certify.cached_rows())
+    poisoned = certify.certify_callable(
+        "digest/scenario_synth",
+        lambda c: {"close": jnp.zeros((4,), jnp.float32)
+                   .at[jnp.array([0, 1, 1, 2])].add(c[:4])},
+        [_X])
+    rows["digest/scenario_synth"] = poisoned
+    monkeypatch.setattr(certify, "cached_rows", lambda: rows)
+    monkeypatch.setenv("DBX_CONTRACT_PATH",
+                       str(tmp_path / "match.json"))
+    (tmp_path / "match.json").write_bytes(
+        certify.canonical_bytes(certify.table_from_rows(rows)))
+    poisoned_run = certify.run_certify()
+    assert certify.exit_code(poisoned_run) == 1
+    assert poisoned_run["findings"]
+
+
+def test_corrupt_contract_table_is_not_missing(monkeypatch, tmp_path):
+    """A truncated/merge-conflicted table must surface as unparseable —
+    never as 'missing, run --update' (that advice would overwrite the
+    only record of what was pinned)."""
+    p = tmp_path / "corrupt.json"
+    p.write_bytes(b'{"schema": 1, "rows": {')
+    monkeypatch.setenv("DBX_CONTRACT_PATH", str(p))
+    with pytest.raises(ValueError):
+        certify.load_contract()
+    res = certify.run_certify()
+    assert certify.exit_code(res) == 2
+    assert any("unparseable" in d["message"] for d in res["drift"])
+    assert not any("no committed" in d["message"] for d in res["drift"])
+
+
+def test_missing_contract_table_is_drift(monkeypatch, tmp_path):
+    monkeypatch.setenv("DBX_CONTRACT_PATH",
+                       str(tmp_path / "absent.json"))
+    res = certify.run_certify()
+    assert certify.exit_code(res) == 2
+    assert any("no committed numerics contract" in d["message"]
+               for d in res["drift"])
+
+
+def test_update_writes_canonical_table(monkeypatch, tmp_path):
+    p = tmp_path / "regen.json"
+    monkeypatch.setenv("DBX_CONTRACT_PATH", str(p))
+    res = certify.run_certify(update=True)
+    assert certify.exit_code(res) == 0 and res["updated"]
+    # The regenerated bytes equal the committed table's (same trace, same
+    # canonical form) — byte-reproducibility across runs.
+    committed = os.path.join(os.path.dirname(certify._PKG_DIR),
+                             certify.CONTRACT_BASENAME)
+    with open(committed, "rb") as fh:
+        assert p.read_bytes() == fh.read()
+
+
+def test_certify_rules_skipped_outside_package():
+    """Like kernel-hygiene: no registry to certify outside the package —
+    skipped, never silently clean."""
+    from distributed_backtesting_exploration_tpu.analysis import (
+        lint as lint_cli)
+
+    fixtures = os.path.join(os.path.dirname(__file__), "fixtures", "lint")
+    result = lint_cli.run([fixtures], core.all_rules())
+    for rule in ("substrate-contract", "weak-type-provenance",
+                 "digest-determinism"):
+        assert rule in result["rules_skipped"]
+        assert rule not in result["rules"]
+
+
+def test_cli_certify_json_shape(capsys, monkeypatch):
+    from distributed_backtesting_exploration_tpu.analysis import certify \
+        as c
+
+    rc = c.main(["--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["rows"] == 58
+    assert out["drift"] == [] and out["findings"] == []
+    assert out["contract"].endswith("numerics.contract.json")

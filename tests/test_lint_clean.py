@@ -11,7 +11,8 @@ hatch; see DESIGN.md "Static analysis".
 import os
 
 import distributed_backtesting_exploration_tpu as dbx
-from distributed_backtesting_exploration_tpu.analysis import core, lint
+from distributed_backtesting_exploration_tpu.analysis import (
+    certify, core, lint)
 
 
 def test_package_lints_clean():
@@ -26,7 +27,30 @@ def test_package_lints_clean():
     assert set(result["rules"]) == {
         "trace-time-env", "lock-discipline", "lock-order", "atomicity",
         "lock-blocking", "import-time-config", "blocking-call",
-        "obs-cardinality", "kernel-hygiene", "proto-drift"}
+        "obs-cardinality", "kernel-hygiene", "substrate-contract",
+        "weak-type-provenance", "digest-determinism", "proto-drift"}
+
+
+def test_certify_clean_and_contract_table_pinned():
+    """The numerics drift gate: regenerate the contract table from a live
+    trace on the tiny pinned shapes and require BYTE equality with the
+    committed numerics.contract.json (canonical form: sorted keys, no
+    timestamps), plus zero weak-type/digest findings. A kernel edit that
+    adds an association boundary, drops a selection guarantee, or leaks
+    a nondet primitive into a digest path fails here with the
+    introducing equation chain (exit-code contract: dbxcert 0 clean /
+    1 findings / 2 drift)."""
+    result = certify.run_certify()
+    assert result["findings"] == [], result["findings"]
+    assert result["drift"] == [], "\n".join(
+        d["message"] for d in result["drift"])
+    assert certify.exit_code(result) == 0
+    live = certify.canonical_bytes(
+        certify.table_from_rows(certify.cached_rows()))
+    with open(certify.contract_path(), "rb") as fh:
+        assert live == fh.read(), \
+            "numerics.contract.json is stale — regenerate with " \
+            "`dbxcert --update` and review the diff"
 
 
 def test_cli_module_entrypoint_is_wired():

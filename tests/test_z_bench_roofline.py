@@ -192,6 +192,51 @@ def stream_bench():
     return json.loads(buf.getvalue().strip().splitlines()[-1])
 
 
+_CERTIFY_ENV = {
+    "DBX_BENCH_CPU": "1", "DBX_BENCH_CACHE": "",
+    "DBX_BENCH_CONFIGS": "certify",
+    # Tiny-but-real: two families through the REAL certifier (4 rows
+    # each: 2 epilogue substrates x {build_carry, append_step}) plus the
+    # digest cones — the analysis cost instrument, not a numerics check
+    # (the contract gate itself lives in test_lint_clean.py).
+    "DBX_BENCH_CERTIFY_FAMILIES": "sma_crossover,bollinger",
+}
+
+
+@pytest.fixture(scope="module")
+def certify_bench():
+    """One tiny in-process certify run, shared by the module."""
+    prior = {k: os.environ.get(k) for k in _CERTIFY_ENV}
+    os.environ.update(_CERTIFY_ENV)
+    bench.ROOFLINE.clear()
+    buf = io.StringIO()
+    try:
+        with contextlib.redirect_stdout(buf):
+            bench.main()
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return json.loads(buf.getvalue().strip().splitlines()[-1])
+
+
+def test_certify_wall_keys_present(certify_bench):
+    """The certifier's analysis cost rides BENCH JSON like every other
+    stage: certify_wall_s per family + the digest cones, and a rows
+    count matching families x substrates x forms + 2 digest cones."""
+    cf = certify_bench["roofline"]["certify"]
+    for key in ("certify_wall_s", "rows", "wall_s_total"):
+        assert key in cf, key
+    walls = cf["certify_wall_s"]
+    assert set(walls) == {"sma_crossover", "bollinger", "digest"}
+    assert all(w > 0.0 for w in walls.values())
+    assert cf["rows"] == 2 * 4 + 2
+    assert cf["wall_s_total"] > 0.0
+    assert certify_bench["configs"]["certify"] > 0.0
+
+
 _FANOUT_ENV = {
     "DBX_BENCH_CPU": "1", "DBX_BENCH_CACHE": "",
     "DBX_BENCH_CONFIGS": "fanout",
