@@ -1550,6 +1550,105 @@ def main():
               f"merge {'exact' if merge_exact else 'MISMATCH'}",
               file=sys.stderr)
 
+    # --- flight: recorder-armed overhead + residual plane (round 17) ------
+    # Two instruments. (a) The direct_dispatch floor re-measured with the
+    # flight recorder ARMED (DBX_FLIGHT_DIR set): the hot path never
+    # builds a bundle — trigger() is a counter bump plus a dedupe-map
+    # probe, and the happy path fires no trigger at all — so the
+    # acceptance bar is <= 2% overhead with the 2k floor holding and
+    # ZERO bundles written during the run; a capture_now smoke afterwards
+    # proves the armed recorder really writes. (b) A deterministic
+    # synthetic residual stream through CostModelTracker (durations are
+    # computed FROM the op model — no wall clock), so the drift plane's
+    # math — calibration warmup, signed EWMA, exact-fold histogram,
+    # rank-interpolated quantiles — lands in BENCH JSON with a known
+    # answer (costmodel_residual_{p50,p95}).
+    if enabled("flight"):
+        import tempfile
+
+        from distributed_backtesting_exploration_tpu.obs import (
+            costmodel as cm_mod, flight as flight_mod)
+        from distributed_backtesting_exploration_tpu.obs.registry import (
+            Registry)
+
+        fl_jobs = int(os.environ.get("DBX_BENCH_LOCAL_JOBS", 1500))
+        prior_fdir = os.environ.pop("DBX_FLIGHT_DIR", None)
+        r_off = r_on = 0.0
+        bundles_during = -1
+        capture_ok = False
+        try:
+            with tempfile.TemporaryDirectory() as fdir:
+                # Interleaved best-of-3 per arm (the fleet_telemetry
+                # jitter argument: run-to-run drift on this box is the
+                # same order as the overhead bar).
+                for _ in range(3):
+                    os.environ.pop("DBX_FLIGHT_DIR", None)
+                    flight_mod.reset()
+                    r, _ = run_direct_dispatch(32, fl_jobs)
+                    r_off = max(r_off, r)
+                    os.environ["DBX_FLIGHT_DIR"] = fdir
+                    flight_mod.reset()
+                    r, _ = run_direct_dispatch(32, fl_jobs)
+                    r_on = max(r_on, r)
+                bundles_during = len(
+                    [f for f in os.listdir(fdir) if f.endswith(".json")])
+                capture_ok = flight_mod.capture_now(
+                    "admin", subject="bench-smoke") is not None
+        finally:
+            flight_mod.reset()
+            if prior_fdir is None:
+                os.environ.pop("DBX_FLIGHT_DIR", None)
+            else:
+                os.environ["DBX_FLIGHT_DIR"] = prior_fdir
+        overhead_pct = (r_off - r_on) / max(r_off, 1e-9) * 100
+
+        # (b) Synthetic residual stream: calibrate a private tracker at a
+        # constant seconds-per-unit, then feed durations the model
+        # predicts times 2**r for a fixed drift set — one guaranteed
+        # blowout (first scored obs, before the calibration can absorb
+        # anything), a +2 tail, a +0.5 body, a near-zero floor.
+        tr = cm_mod.CostModelTracker(registry=Registry())
+        spu0 = 1e-6
+        base = {"name": "worker.execute",
+                "kernel": "fused:sma_crossover",
+                "bars": 2048, "combos": 64, "jobs": 1}
+        units = cm_mod._model_units("sma_crossover", 2048, 64)
+
+        def feed(r_log2):
+            tr.observe(dict(base, dur_s=units * spu0 * (2.0 ** r_log2)))
+
+        feed(0.0)                   # seeds the calibration at spu0
+        for _ in range(cm_mod.warmup_n() - 1):
+            feed(0.0)               # finish warmup; EWMA stays at spu0
+        for r_log2 in [3.5] + [0.1] * 8 + [0.5] * 8 + [2.0] * 3:
+            feed(r_log2)
+        cm_snap = tr.snapshot()
+        res_p50 = cm_mod.residual_quantile(cm_snap["buckets"], 0.5)
+        res_p95 = cm_mod.residual_quantile(cm_snap["buckets"], 0.95)
+
+        rates["flight"] = r_on
+        ROOFLINE["flight"] = {
+            "jobs": fl_jobs, "batch": 32,
+            "jobs_per_s_off": round(r_off, 1),
+            "jobs_per_s_on": round(r_on, 1),
+            "overhead_pct": round(overhead_pct, 1),
+            "overhead_ok": bool(overhead_pct <= 2.0),
+            "floor_ok": bool(r_on >= 2000),
+            "bundles_during_run": bundles_during,
+            "quiet_ok": bool(bundles_during == 0),
+            "capture_smoke_ok": bool(capture_ok),
+            "costmodel_obs": cm_snap["n"],
+            "costmodel_blowouts": cm_snap["blowouts"],
+            "costmodel_residual_p50": round(res_p50, 4),
+            "costmodel_residual_p95": round(res_p95, 4),
+        }
+        print(f"bench[flight]: direct b32 off {r_off:.0f} -> armed "
+              f"{r_on:.0f} jobs/s ({overhead_pct:+.1f}%), "
+              f"{bundles_during} bundle(s) during run, capture smoke "
+              f"{'ok' if capture_ok else 'FAILED'}; synthetic residuals "
+              f"p50 {res_p50:+.2f} / p95 {res_p95:+.2f} log2, "
+              f"{cm_snap['blowouts']} blowout(s)", file=sys.stderr)
+
     # --- queue_machine: the state machine alone, both substrates ----------
     # (VERDICT r4 weak #5 / next #7: the native DbxJobQueue driven per job
     # over ctypes measured ~2x SLOWER than the dict fallback; the batched

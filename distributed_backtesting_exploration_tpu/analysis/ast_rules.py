@@ -485,15 +485,23 @@ class ObsCardinalityRule:
     # (DBX_STREAM_LABEL_MAX sticky prefixes + "other"); worker_bucket is
     # the fleet telemetry plane's twin for worker ids — worker-chosen
     # wire strings that churn per restart (DBX_WORKER_LABEL_MAX sticky
-    # names + "other").
+    # names + "other"); trigger_bucket folds flight-recorder trigger
+    # kinds onto the closed _KINDS vocabulary + "other" (a total map,
+    # not sticky-first-N — the catalogue is a code constant).
     _SANCTIONED_CALLS = {"tenant_bucket", "shape_bucket", "stream_bucket",
-                         "worker_bucket"}
+                         "worker_bucket", "trigger_bucket"}
     _UNBOUNDED = re.compile(
         r"(?:^|_)(?:id|ids|jid|uid|uuid|guid|key|token|path|paths|file|"
         r"filename|dir|addr|address|peer|host|hostname|port|url|uri|"
         r"target|trace|span|digest|digests|blake2b|checksum|hash|"
         r"tenant|tenants|stream|streams|sub|subs|subscriber|subscribers|"
-        r"subscription|subscriptions)(?:$|_)")
+        r"subscription|subscriptions|"
+        # Flight-recorder incident identifiers (round 17): bundle names
+        # embed content digests, triggers/incidents carry job/worker
+        # subjects — all unbounded; metric labels must go through
+        # trigger_bucket (or stay label-free).
+        r"bundle|bundles|trigger|triggers|incident|incidents|subject|"
+        r"subjects)(?:$|_)")
 
     def check(self, ctx: LintContext) -> list[Finding]:
         out: list[Finding] = []

@@ -164,6 +164,18 @@ def _record_violation(kind: str, **detail) -> None:
         obs.events.emit("lockdep_violation", kind=kind, **detail)
     except Exception:
         pass   # reporting must never take the process down
+    try:
+        from ..obs import flight
+
+        # A lock-order violation is a latent-deadlock incident: capture
+        # the black box while the offending acquire's context is still
+        # in the ring. The DEFERRED path is mandatory here — this hook
+        # runs at the acquire site with the offending locks held, so a
+        # plain trigger() would add the recorder's own lock to the
+        # order graph being reported.
+        flight.trigger_deferred("lockdep", subject=kind, **detail)
+    except Exception:
+        pass
 
 
 def _find_path(src: str, dst: str) -> list | None:

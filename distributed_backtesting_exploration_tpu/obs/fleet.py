@@ -58,6 +58,7 @@ import time
 import uuid
 
 from .registry import LATENCY_BUCKETS_S, get_registry, histogram_quantile
+from . import costmodel as costmodel_mod
 from . import trace
 
 # ---------------------------------------------------------------------------
@@ -251,7 +252,7 @@ class WorkerTelemetry:
     RATE_WINDOW_S = 10.0
 
     def __init__(self, worker_id: str, *, stats_fn=None, backend=None,
-                 registry=None, stages=None):
+                 registry=None, stages=None, costmodel=None):
         self.worker_id = worker_id
         self.gen = uuid.uuid4().hex[:16]
         self._stats_fn = stats_fn
@@ -264,6 +265,15 @@ class WorkerTelemetry:
         # whether co-hosted frames share one stream.
         self._stages_scope = "proc" if stages is None else "worker"
         self._stages = stages if stages is not None else stage_stats()
+        # The cost-model drift accumulator rides the same frames
+        # (process-scoped by default, like the stage stats; an injected
+        # tracker follows the `stages` probe discipline). A probe-scoped
+        # frame (scope="worker") bypasses the dispatcher's per-process
+        # dedupe, so it may only carry costmodel data it owns — the
+        # shared process tracker would double-count in the fleet fold.
+        self._costmodel_own = costmodel is not None
+        self._costmodel = (costmodel if costmodel is not None
+                           else costmodel_mod.tracker())
         self._lock = threading.Lock()
         self._seq = 0
         self._t0 = time.time()
@@ -345,7 +355,10 @@ class WorkerTelemetry:
         with self._lock:
             self._seq += 1
             seq = self._seq
-        return {
+        cm = (self._costmodel.frame()
+              if self._stages_scope == "proc" or self._costmodel_own
+              else {})
+        frame = {
             "v": 1,
             "gen": self.gen,
             "pid": os.getpid(),
@@ -368,15 +381,22 @@ class WorkerTelemetry:
             "proc": self._proc_counters(),
             "stages": self._stages.snapshot(),
         }
+        if cm:
+            # Only when residuals exist — a drift-silent worker's frame
+            # carries zero extra wire bytes (the dirty-bit budget).
+            frame["costmodel"] = cm
+        return frame
 
     @staticmethod
-    def _fingerprint(ws: dict, bt: dict, stage_version: int) -> tuple:
+    def _fingerprint(ws: dict, bt: dict, stage_version: int,
+                     cm_version: int = 0) -> tuple:
         """The change detector behind the dirty bit: worker counters +
-        stage-stat version + cache residency. Deliberately EXCLUDES the
-        poll count (every poll polls — counting it as change would
-        defeat the dirty bit) and wall-clock-derived fields."""
+        stage-stat version + cost-model residual version + cache
+        residency. Deliberately EXCLUDES the poll count (every poll
+        polls — counting it as change would defeat the dirty bit) and
+        wall-clock-derived fields."""
         return (ws["jobs_completed"], ws["completions_dropped"],
-                ws["busy"], ws["inflight"], stage_version,
+                ws["busy"], ws["inflight"], stage_version, cm_version,
                 json.dumps(bt["caches"], sort_keys=True, default=str))
 
     def take_frame_json(self, now: float | None = None) -> str:
@@ -397,7 +417,8 @@ class WorkerTelemetry:
                 return ""
         ws = self._worker_stats()
         bt = self._backend_telemetry()
-        fp = self._fingerprint(ws, bt, self._stages.version)
+        fp = self._fingerprint(ws, bt, self._stages.version,
+                               self._costmodel.version)
         hb = heartbeat_s()
         with self._lock:
             if (fp == self._last_fingerprint
@@ -458,6 +479,17 @@ def _finite(x) -> float:
     return v
 
 
+#: Every frame key this build knows how to read. Anything else is a
+#: FUTURE field from a newer worker (the mixed-fleet rollout case):
+#: skipped and counted, never a malformed frame — forward compat is
+#: what let this build's own ``costmodel`` key roll out.
+_KNOWN_FRAME_KEYS = frozenset({
+    "v", "gen", "pid", "proc_id", "scope", "seq", "t", "uptime_s",
+    "busy", "inflight", "pipeline", "jobs_completed",
+    "completions_dropped", "polls", "jobs_per_s", "caps", "caches",
+    "proc", "stages", "costmodel"})
+
+
 def _sanitize_frame(frame: dict) -> dict:
     """Coerce a decoded frame's typed fields AT INGEST, so one
     JSON-valid frame with an ill-typed or non-finite field (a hostile
@@ -466,8 +498,12 @@ def _sanitize_frame(frame: dict) -> dict:
     later :meth:`FleetView.snapshot` — the "malformed frames teach
     nothing, never an RPC error" contract applies to types, not just
     JSON syntax. Raises (caught by the caller) on anything
-    uncoercible."""
+    uncoercible. Keys outside ``_KNOWN_FRAME_KEYS`` (a NEWER worker's
+    fields) are skipped-and-counted, not errors."""
     out = dict(frame)
+    unknown = sorted(str(k) for k in frame if k not in _KNOWN_FRAME_KEYS)
+    if unknown:
+        out["unknown_fields"] = unknown
     out["gen"] = str(frame["gen"])
     out["pid"] = int(frame.get("pid", 0))
     out["proc_id"] = str(frame.get("proc_id", ""))
@@ -491,6 +527,17 @@ def _sanitize_frame(frame: dict) -> dict:
             "buckets": [int(c) for c in st.get("buckets", [])],
         }
     out["stages"] = stages
+    cm = frame.get("costmodel")
+    if cm:
+        cm = dict(cm)
+        out["costmodel"] = {
+            "n": int(cm.get("n", 0)),
+            "ewma": _finite(cm.get("ewma", 0.0)),
+            "buckets": [int(c) for c in cm.get("buckets", [])],
+            "blowouts": int(cm.get("blowouts", 0)),
+        }
+    else:
+        out.pop("costmodel", None)
     return out
 
 
@@ -551,6 +598,10 @@ class FleetView:
         self._c_evicted = self._reg.counter(
             "dbx_fleet_workers_evicted_total",
             help="fleet-view entries evicted for staleness")
+        self._c_unknown = self._reg.counter(
+            "dbx_fleet_frame_unknown_fields_total",
+            help="frame fields this build did not recognize (newer "
+                 "workers in a mixed fleet) — skipped, not malformed")
         self._c_straggler = {
             s: self._reg.counter("dbx_fleet_straggler_flags_total",
                                  help="workers newly flagged as stage "
@@ -613,6 +664,9 @@ class FleetView:
             else:
                 self._entries[worker_id] = _Entry(frame, now)
         self._c_frames["ok"].inc()
+        unknown = frame.get("unknown_fields")
+        if unknown:
+            self._c_unknown.inc(len(unknown))
         return True
 
     def forget(self, worker_id: str) -> None:
@@ -745,6 +799,18 @@ class FleetView:
                     for s, st in frame.get("stages", {}).items()},
                 "stragglers": [],
             }
+            cm = frame.get("costmodel")
+            if cm:
+                workers[wid]["costmodel"] = {
+                    "n": int(cm.get("n", 0)),
+                    "ewma": float(cm.get("ewma", 0.0)),
+                    "p50": round(costmodel_mod.residual_quantile(
+                        cm.get("buckets", []), 0.5), 4),
+                    "blowouts": int(cm.get("blowouts", 0)),
+                }
+            unknown = frame.get("unknown_fields")
+            if unknown:
+                workers[wid]["unknown_fields"] = len(unknown)
         # Fleet-wide merged stage histograms: process-scope stats fold
         # once per process (co-hosted workers share one span stream;
         # keyed by the host-unique proc_id token, not bare pid).
@@ -794,6 +860,28 @@ class FleetView:
                 a[1] += m
         hit_ratio = {key: round(h / (h + m), 6)
                      for key, (h, m) in sorted(agg.items()) if h + m}
+        # Cost-model residual fold: exact histogram-count sums over the
+        # same per-process dedupe (the accumulator is process-scoped,
+        # like the stage stats).
+        cm_n = cm_blow = 0
+        cm_buckets = [0] * (len(costmodel_mod.RESIDUAL_BUCKETS_LOG2) + 1)
+        for f in deduped:
+            cm = f.get("costmodel")
+            if not cm:
+                continue
+            cm_n += int(cm.get("n", 0))
+            cm_blow += int(cm.get("blowouts", 0))
+            for i, c in enumerate(cm.get("buckets", [])):
+                if i < len(cm_buckets):
+                    cm_buckets[i] += int(c)
+        fleet_costmodel = {
+            "n": cm_n,
+            "blowouts": cm_blow,
+            "residual_p50": round(costmodel_mod.residual_quantile(
+                cm_buckets, 0.5), 4),
+            "residual_p95": round(costmodel_mod.residual_quantile(
+                cm_buckets, 0.95), 4),
+        }
         return {
             "stale_s": bound,
             "workers": workers,
@@ -808,6 +896,7 @@ class FleetView:
                 "jobs_completed": sum(
                     int(f.get("jobs_completed", 0)) for _, f in live),
                 "stages": fleet_stages,
+                "costmodel": fleet_costmodel,
                 "cache_hit_ratio": hit_ratio,
                 "slo": self._slo_snapshot(now),
             },
@@ -845,7 +934,12 @@ class FleetView:
         reg.gauge("dbx_fleet_jobs_per_sec",
                   help="sum of live workers' self-reported completion "
                        "rates").set(fleet["jobs_per_s"])
+        reg.gauge("dbx_fleet_cost_drift_p95",
+                  help="fleet-merged |log2 measured/predicted| stage "
+                       "cost residual p95").set(
+            snap["fleet"]["costmodel"]["residual_p95"])
         buckets: set = set()
+        drift_buckets: set = set()
         for wid, w in snap["workers"].items():
             b = worker_bucket(wid)
             buckets.add(b)
@@ -857,8 +951,17 @@ class FleetView:
                       help="1 when the worker bucket's newest frame is "
                            "older than DBX_FLEET_STALE_S",
                       worker=b).set(1 if w["stale"] else 0)
+            cm = w.get("costmodel")
+            if cm:
+                drift_buckets.add(b)
+                reg.gauge("dbx_fleet_worker_cost_drift",
+                          help="per-worker cost-model residual EWMA "
+                               "(log2 measured/predicted; bounded "
+                               "worker-bucket labels)",
+                          worker=b).set(cm["ewma"])
         with self._lock:
             dead = self._gauge_buckets - buckets
+            dead_drift = (self._gauge_buckets | buckets) - drift_buckets
             self._gauge_buckets = buckets
             self._last_collect = (self._clock(), snap)
         for b in dead:
@@ -868,6 +971,8 @@ class FleetView:
             # NO retained worker maps to it ("other" stays while shared).
             reg.remove_child("dbx_fleet_worker_jobs_per_sec", worker=b)
             reg.remove_child("dbx_fleet_worker_stale", worker=b)
+        for b in dead_drift:
+            reg.remove_child("dbx_fleet_worker_cost_drift", worker=b)
         # Straggler TRANSITIONS (not levels): count a worker's stage
         # flag once per episode, cleared when it drops below the p95.
         with self._lock:
@@ -919,6 +1024,13 @@ def render_text(snap: dict) -> str:
         out.append("")
         out.append("== fleet stage costs (merged histograms) ==")
         out.append(_table(srows, ("stage", "n", "total", "p50", "p95")))
+    cm = fleet.get("costmodel", {})
+    if cm.get("n"):
+        out.append(
+            f"cost-model drift: {cm['n']} obs, residual p50 "
+            f"{cm.get('residual_p50', 0.0):+.2f} / p95 "
+            f"{cm.get('residual_p95', 0.0):+.2f} log2, "
+            f"{cm.get('blowouts', 0)} blowout(s)")
     ratios = fleet.get("cache_hit_ratio", {})
     if ratios:
         out.append("cache hit ratios: " + ", ".join(
@@ -944,18 +1056,24 @@ def render_text(snap: dict) -> str:
         caches = w.get("caches", {})
         cache_mb = sum(
             v for k, v in _iter_bytes(caches)) / (1024 * 1024)
+        wcm = w.get("costmodel") or {}
+        if w.get("unknown_fields"):
+            flags.append(f"+{w['unknown_fields']}fields")
         rows.append((
             wid, w.get("gen", "")[:6],
             "busy" if w.get("busy") else "idle",
             f"{w.get('jobs_per_s', 0.0):.1f}",
             w.get("jobs_completed", 0),
             ew("decode"), ew("compile"), ew("execute"), ew("d2h"),
+            f"{wcm['ewma']:+.2f}" if wcm.get("n") else "-",
+            str(wcm.get("blowouts", 0)) if wcm.get("n") else "-",
             f"{cache_mb:.1f}", f"{w.get('age_s', 0.0):.1f}s",
             " ".join(flags) or "-"))
     out.append("")
     out.append(_table(rows, ("worker", "gen", "state", "jobs/s", "done",
                              "decode", "compile", "execute", "d2h",
-                             "cacheMB", "age", "flags")))
+                             "drift", "blow", "cacheMB", "age",
+                             "flags")))
     return "\n".join(out) + "\n"
 
 

@@ -526,7 +526,8 @@ class JaxSweepBackend:
                              if sk[0] != evicted}
 
     def _observe_submit(self, strategy: str, route: str, t0: float,
-                        cold_key=None, group=None) -> None:
+                        cold_key=None, group=None, bars=None,
+                        combos=None) -> None:
         """Record a group's submit-side wall (group start -> kernels
         launched, decode included) into
         ``dbx_kernel_submit_seconds{kernel=route:strategy}``. ``cold_key``
@@ -537,7 +538,9 @@ class JaxSweepBackend:
         ``worker.compile`` / ``worker.execute`` span joined to every job's
         trace — the timeline analyzer's compile-vs-execute stage split
         (the decode span nests inside this interval and wins attribution
-        for its sub-range)."""
+        for its sub-range). ``bars``/``combos`` ride the span as shape
+        attrs so the cost-model drift plane (obs/costmodel.py) can score
+        the measured wall against the op model's prediction."""
         dt = time.perf_counter() - t0
         cold = False
         if cold_key is not None:
@@ -559,10 +562,15 @@ class JaxSweepBackend:
         if group is not None:
             pairs = obs.job_trace_pairs(group)
             if pairs:
+                shape = {}
+                if bars is not None:
+                    shape["bars"] = int(bars)
+                if combos is not None:
+                    shape["combos"] = int(combos)
                 obs.emit_span("worker.compile" if cold else "worker.execute",
                               time.time() - dt, dt, pairs=pairs,
                               kernel=f"{route}:{strategy}",
-                              jobs=len(group))
+                              jobs=len(group), **shape)
 
     def _observe_substrates(self, strategy: str) -> None:
         """Count a fused group against the substrate set that served it
@@ -1507,7 +1515,9 @@ class JaxSweepBackend:
                     self._observe_submit(
                         group[0].strategy, "timeshard", t0,
                         cold_key=("timeshard", len(group), t_max_g)
-                        + self._group_key(group[0], axes), group=group)
+                        + self._group_key(group[0], axes), group=group,
+                        bars=t_max_g, combos=sweep_mod.grid_size(grid)
+                        if grid else 1)
                     continue
                 # The group-level gate uses min(lengths) for the halo
                 # bound, so ONE short job in a ragged group would drag
@@ -1539,7 +1549,9 @@ class JaxSweepBackend:
                         cold_key=("timeshard", len(ok_idx),
                                   max(int(lengths[i]) for i in ok_idx))
                         + self._group_key(group[0], axes),
-                        group=[group[i] for i in ok_idx])
+                        group=[group[i] for i in ok_idx],
+                        bars=max(int(lengths[i]) for i in ok_idx),
+                        combos=sweep_mod.grid_size(grid) if grid else 1)
                     rest = [i for i in range(len(group))
                             if i not in set(ok_idx)]
                     if not rest:
@@ -1658,7 +1670,8 @@ class JaxSweepBackend:
             self._observe_submit(
                 group[0].strategy, route, t0,
                 cold_key=(route, len(group), t_max_g)
-                + self._group_key(group[0], axes), group=group)
+                + self._group_key(group[0], axes), group=group,
+                bars=t_max_g, combos=P)
             pending.append(self._finish_group(group, m, t0, len(group),
                                               group[0]))
         return pending
@@ -1811,6 +1824,7 @@ class JaxSweepBackend:
         pre-paging ~2x pad bound instead of padding every ticker to the
         merged group's max.
         """
+        from ..parallel import sweep as sweep_mod
         job0 = group[0]
         spec = self._FUSED_STRATEGIES[job0.strategy]
         ppy = job0.periods_per_year or 252
@@ -1887,7 +1901,9 @@ class JaxSweepBackend:
         self._observe_submit(
             job0.strategy, route, t0,
             cold_key=(route, len(group), int(max(lengths)))
-            + self._group_key(job0, axes), group=group)
+            + self._group_key(job0, axes), group=group,
+            bars=int(max(lengths)),
+            combos=sweep_mod.grid_size(grid) if grid else 1)
         return [self._finish_group(group, m, t0, len(group), job0,
                                    h2d_hit=h2d_hit)]
 

@@ -47,11 +47,21 @@ from . import service, wire
 from .journal import Journal
 from .. import obs
 from ..obs import fleet as obs_fleet
+from ..obs import flight as obs_flight
 from ..runtime import _core as native_core
 from ..sched import DEFAULT_TENANT, WfqScheduler, tenant_bucket
 from ..utils import data as data_mod
 
 log = logging.getLogger("dbx.dispatcher")
+
+
+def _lockdep_report() -> dict:
+    """Flight-bundle source: the lockdep edge table + violations (empty
+    shape when lockdep was never installed). Lazy import — analysis is
+    a tooling package the serving path must not load eagerly."""
+    from ..analysis import lockdep
+
+    return lockdep.report()
 
 
 # ---------------------------------------------------------------------------
@@ -705,7 +715,10 @@ class JobQueue:
                 # count releases when a later take() re-serves them.
                 self._in_take += len(jids) + len(deferred) - n_deferred0
             good: list[tuple[str, JobRecord, bytes]] = []
-            failed: list[tuple[str, str, Exception]] = []  # id, path, err
+            # id, path, err, stored record (the record rides along so the
+            # fail path can close the job's trace and hand the flight
+            # recorder a stitchable subject).
+            failed: list[tuple[str, str, Exception, JobRecord]] = []
             resolved: set[str] = set()   # leased, failed, or completed
             stamped: list[tuple[str, JobRecord]] = []  # first-materialized
             try:
@@ -717,6 +730,8 @@ class JobQueue:
                               "desync) -> failed", j)
                     self._journal.append("fail", id=j,
                                          reason="no job record")
+                    obs_flight.trigger("job_fail", subject=j,
+                                       reason="no job record")
                 for jid, stored in zip(jids, recs):
                     rec = stored
                     payload = stored.ohlcv
@@ -752,7 +767,7 @@ class JobQueue:
                             jid,
                             stored.path2 if payload is not None
                             else stored.path,
-                            e))
+                            e, stored))
                         continue
                     good.append((jid, rec, payload))
                 with self._lock:
@@ -780,18 +795,34 @@ class JobQueue:
                     # a completion landed mid-take: that job is DONE, and
                     # the push-back handler must not return it to pending.
                     resolved = {jid for jid, _, _ in good}
-                    resolved.update(jid for jid, _, _ in failed)
+                    resolved.update(jid for jid, _, _, _ in failed)
                     # Unreadable payloads fail under the same lock (the
                     # per-id re-check drops jobs completed mid-take);
                     # either way the pick-time quota charge releases.
-                    for jid, _, _ in failed:
+                    for jid, _, _, _ in failed:
                         self._sched.release(jid)
-                    failed = [(jid, path, e) for jid, path, e in failed
+                    failed = [(jid, path, e, r)
+                              for jid, path, e, r in failed
                               if self._state.fail(jid)]
-                for jid, path, e in failed:
+                for jid, path, e, r in failed:
                     log.error("job %s: unreadable %s (%s) -> failed",
                               jid, path, e)
                     self._journal.append("fail", id=jid, reason=str(e))
+                    # Close the job's trace before the black-box fires:
+                    # the flight bundle's stitched timeline must cover
+                    # the job end-to-end even though it never dispatched
+                    # (enqueue -> failure is its whole life).
+                    if r.trace_id and r.enqueue_ts:
+                        now_w = time.time()
+                        wait = max(now_w - r.enqueue_ts, 0.0)
+                        obs.emit_span("job.queue_wait", r.enqueue_ts,
+                                      wait, trace_id=r.trace_id, job=jid)
+                        obs.emit_span("job", r.enqueue_ts, wait,
+                                      trace_id=r.trace_id, job=jid,
+                                      ok=False)
+                    obs_flight.trigger("job_fail", subject=jid,
+                                       job=jid, path=str(path),
+                                       reason=str(e))
                 # Durable digest stamps (first materialization only — one
                 # event per job, merged into its enqueue record on replay
                 # and at compaction): a restarted dispatcher keeps
@@ -1483,7 +1514,8 @@ class Dispatcher(service.DispatcherServicer):
                                   method=m)
             for m in ("RequestJobs", "SendStatus", "CompleteJob",
                       "CompleteJobs", "GetStats", "FetchPayload",
-                      "AppendBars", "FetchCompiled", "OfferCompiled")}
+                      "AppendBars", "FetchCompiled", "OfferCompiled",
+                      "TriggerDump")}
         self._c_dispatched = self.obs.counter(
             "dbx_jobs_dispatched_total", help="jobs handed to workers")
         self._c_completions = {
@@ -1589,6 +1621,21 @@ class Dispatcher(service.DispatcherServicer):
         # call it directly when done.
         self._collector_key = f"dispatcher-{id(self)}"
         self.obs.add_collector(self._collector_key, self._collect_gauges)
+        # Flight recorder sources (obs/flight.py, round 17): everything
+        # a bundle snapshots beyond the span ring. Keyed last-wins like
+        # registry collectors — the live dispatcher owns the names, and
+        # close() releases them. Each callable runs on the capture
+        # thread and takes only its own scrape-path locks (the lockdep
+        # gate's contract).
+        self._flight_sources = (
+            ("metrics", self.obs.render_prometheus),
+            ("fleet", self.fleet.snapshot),
+            ("queue", self.queue.stats),
+            ("schedule", self.fleet_schedule.to_json),
+            ("lockdep", _lockdep_report),
+        )
+        for name, fn in self._flight_sources:
+            obs_flight.add_source(name, fn)
 
     def close(self) -> None:
         """Unhook this dispatcher from the obs registry: one final gauge
@@ -1602,6 +1649,8 @@ class Dispatcher(service.DispatcherServicer):
         except Exception:
             pass
         self.obs.remove_collector(self._collector_key)
+        for name, _ in self._flight_sources:
+            obs_flight.remove_source(name)
 
     def _collect_gauges(self, reg: "obs.Registry") -> None:
         """Scrape-time refresh of queue-depth / liveness gauges (one
@@ -1861,7 +1910,17 @@ class Dispatcher(service.DispatcherServicer):
                              else "ok")).inc()
                 # Fleet-wide multi-window burn feed (the same SLO, the
                 # dbx_fleet_slo_burn_total{window} counters).
-                self.fleet.observe_slo(wait_s > self.tenant_slo_s)
+                breach = wait_s > self.tenant_slo_s
+                self.fleet.observe_slo(breach)
+                if breach:
+                    # The breach IS the incident: black-box the queue +
+                    # fleet state while the offending job's spans are
+                    # still in the ring. Deduped by (kind, tenant
+                    # bucket) — one SLO storm, one bundle.
+                    obs_flight.trigger(
+                        "slo_breach", subject=tb, job=rec.id,
+                        wait_s=round(wait_s, 3),
+                        slo_s=self.tenant_slo_s)
             payload2 = rec.ohlcv2 or b""
             leg1 = (self._append_leg(delivered, rec, payload)
                     if rec.append_parent else
@@ -2261,6 +2320,22 @@ class Dispatcher(service.DispatcherServicer):
                      n, request.worker_id)
         return pb.Ack(ok=True, detail=str(n))
 
+    @_timed_rpc("TriggerDump")
+    def TriggerDump(self, request: pb.DumpRequest,
+                    context) -> pb.DumpReply:
+        """Admin black-box capture: synchronous, dedupe-bypassing flight
+        bundle (the operator asked; they get a bundle or the reason
+        why not)."""
+        path = obs_flight.capture_now(
+            "admin", subject=request.subject,
+            detail={"reason": request.reason} if request.reason else {})
+        if path is None:
+            return pb.DumpReply(
+                ok=False,
+                detail="no bundle (DBX_FLIGHT_DIR unset or unwritable)")
+        return pb.DumpReply(ok=True, bundle=os.path.basename(path),
+                            detail=path)
+
 
 class DispatcherServer:
     """Owns the grpc.Server plus the prune/requeue maintenance thread.
@@ -2328,12 +2403,31 @@ class DispatcherServer:
             if expired:
                 d._c_requeued_lease.inc(len(expired))
                 log.warning("requeued %d expired leases", len(expired))
+                # A lease expiring means a worker went quiet mid-batch —
+                # exactly the evidence the span ring is about to roll
+                # over. Deduped by the first expired job id.
+                obs_flight.trigger("requeue_expired",
+                                   subject=str(expired[0]),
+                                   jobs=len(expired),
+                                   job=str(expired[0]))
             for wid in d.fleet.prune():
                 # Telemetry-entry eviction rides the same maintenance
                 # tick as peer pruning: flagged stale first (visible
                 # decay), evicted past 3x the staleness bound.
                 log.info("evicted stale fleet-telemetry entry for %s",
                          wid)
+            # Straggler flags from the merged fleet view are flight
+            # triggers too: dedupe by worker id keeps a persistently
+            # slow worker at one bundle per dedupe window.
+            try:
+                snap = (d.fleet.collected_snapshot()
+                        or d.fleet.snapshot())
+                for wid, w in snap.get("workers", {}).items():
+                    for s in w.get("stragglers", ()):
+                        obs_flight.trigger("straggler", subject=wid,
+                                           stage=s)
+            except Exception:
+                log.exception("straggler flight-trigger sweep failed")
 
     def stop(self, grace: float = 1.0) -> None:
         self._stop.set()
@@ -2722,6 +2816,13 @@ def main(argv=None) -> None:
     # all; its own limitations list, reference README.md:75-88).
     stopping = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stopping.set())
+    # SIGUSR2 = operator-requested black-box capture (the signal twin of
+    # the TriggerDump RPC). The handler only enqueues — capture runs on
+    # the recorder's own thread, never in signal context.
+    if hasattr(signal, "SIGUSR2"):
+        signal.signal(signal.SIGUSR2,
+                      lambda *_: obs_flight.trigger("signal",
+                                                    subject="SIGUSR2"))
     try:
         while not stopping.wait(timeout=5):
             log.info("stats: %s", queue.stats())

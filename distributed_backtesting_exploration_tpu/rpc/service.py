@@ -41,6 +41,7 @@ _METHODS = (
     ("AppendBars", pb.AppendRequest, pb.AppendReply),
     ("FetchCompiled", pb.CompiledRequest, pb.CompiledReply),
     ("OfferCompiled", pb.CompiledOffer, pb.Ack),
+    ("TriggerDump", pb.DumpRequest, pb.DumpReply),
 )
 
 # Server-streaming RPCs (the live signal fan-out's Subscribe): the

@@ -41,6 +41,7 @@ from . import backtesting_pb2 as pb
 from . import compute, service
 from .. import obs
 from ..obs import fleet as obs_fleet
+from ..obs import flight as obs_flight
 from ..runtime import _core as native_core
 
 log = logging.getLogger("dbx.worker")
@@ -364,9 +365,11 @@ class Worker:
                                  worker=self.worker_id):
                     for completion in self.backend.process(batch):
                         self._out.put(completion)
-            except Exception:
+            except Exception as e:
                 log.exception("backend failed on a %d-job batch; jobs will "
                               "be re-queued by lease expiry", len(batch))
+                obs_flight.trigger("collect_fail", subject=self.worker_id,
+                                   jobs=len(batch), reason=repr(e))
             finally:
                 self._pipeline_batch_end()
 
@@ -484,9 +487,11 @@ class Worker:
                     obs.span("worker.submit", jobs=len(batch),
                              worker=self.worker_id):
                 return (self.backend.submit(batch), batch)
-        except Exception:
+        except Exception as e:
             log.exception("backend failed submitting a %d-job batch; jobs "
                           "will be re-queued by lease expiry", len(batch))
+            obs_flight.trigger("collect_fail", subject=self.worker_id,
+                               jobs=len(batch), reason=repr(e))
             return None
 
     def _collect_into_out(self, pending) -> None:
@@ -497,9 +502,11 @@ class Worker:
                              worker=self.worker_id):
                 for completion in self.backend.collect(handle):
                     self._out.put(completion)
-        except Exception:
+        except Exception as e:
             log.exception("backend failed on a %d-job batch; jobs will "
                           "be re-queued by lease expiry", len(batch))
+            obs_flight.trigger("collect_fail", subject=self.worker_id,
+                               jobs=len(batch), reason=repr(e))
 
     # -- control side ------------------------------------------------------
 

@@ -133,6 +133,30 @@ def test_malformed_frames_are_counted_never_raised():
     assert fv.snapshot(now=0.0)["fleet"]["workers"] == 1
 
 
+def test_unknown_frame_fields_skip_and_count():
+    """Forward compatibility (round 17): a frame from a NEWER worker
+    carrying fields this dispatcher doesn't know is adopted — the known
+    fields merge, the unknown ones are skipped and counted
+    (dbx_fleet_frame_unknown_fields_total + a per-worker flag in the
+    snapshot/dbxtop), never treated as malformed. The alternative —
+    rejecting the frame — would black out telemetry for every worker
+    one release ahead of its dispatcher."""
+    reg = Registry()
+    fv = fleet.FleetView(registry=reg, clock=lambda: 0.0)
+    doc = json.loads(_frame())
+    doc["shiny_new_field"] = {"whatever": 1}
+    doc["another_future_key"] = 2
+    assert fv.update("w-f", json.dumps(doc, sort_keys=True))
+    snap = fv.snapshot(now=0.0)
+    assert snap["workers"]["w-f"]["unknown_fields"] == 2
+    assert snap["workers"]["w-f"]["jobs_completed"] == 10
+    assert reg.peek("dbx_fleet_frame_unknown_fields_total") == 2
+    assert "+2fields" in fleet.render_text(snap)
+    # A fully-known frame carries no flag at all.
+    assert fv.update("w-g", _frame(gen="g2"))
+    assert "unknown_fields" not in fv.snapshot(now=0.0)["workers"]["w-g"]
+
+
 def test_restart_with_backstepped_clock_supersedes_once_stale():
     """A live restarted worker whose wall clock stepped BACKWARD across
     the restart must not be wedged behind its dead generation: while the

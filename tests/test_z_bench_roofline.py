@@ -596,6 +596,71 @@ def test_fleet_telemetry_keys_present(fleet_bench):
     assert fleet_bench["configs"]["fleet_telemetry"] > 0.0
 
 
+_FLIGHT_ENV = {
+    "DBX_BENCH_CPU": "1", "DBX_BENCH_CACHE": "",
+    "DBX_BENCH_CONFIGS": "flight",
+    # Tiny-but-real: a short recorder-armed direct-dispatch A/B plus the
+    # deterministic synthetic residual feed — structure smoke; the <=2%
+    # overhead bar is asserted on the real-size run (tiny samples are
+    # noise), but the residual math is exact at any scale.
+    "DBX_BENCH_LOCAL_JOBS": "96", "DBX_COSTMODEL": "1",
+}
+
+
+@pytest.fixture(scope="module")
+def flight_bench():
+    """One tiny in-process flight run (loopback gRPC, armed recorder in a
+    tempdir, synthetic residual stream), shared by the module."""
+    prior = {k: os.environ.get(k) for k in _FLIGHT_ENV}
+    for knob in ("DBX_FLIGHT_DIR", "DBX_COSTMODEL_WARMUP",
+                 "DBX_COSTMODEL_BLOWOUT"):
+        prior[knob] = os.environ.pop(knob, None)
+    os.environ.update(_FLIGHT_ENV)
+    bench.ROOFLINE.clear()
+    buf = io.StringIO()
+    try:
+        with contextlib.redirect_stdout(buf):
+            bench.main()
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return json.loads(buf.getvalue().strip().splitlines()[-1])
+
+
+def test_flight_keys_present(flight_bench):
+    """The flight recorder's acceptance numbers (recorder-armed overhead
+    <= 2% on the direct_dispatch floor, zero bundles on the happy path)
+    and the drift plane's costmodel_residual_{p50,p95} ride these BENCH
+    JSON keys — a renamed key would silently invalidate the round-17
+    acceptance record. Structurally true at any scale: the armed cycle
+    writes NO bundles (the hot path never captures), capture_now really
+    writes one, and the synthetic residual stream is exact math — 20
+    scored observations, exactly one past the blowout bar."""
+    fl = flight_bench["roofline"]["flight"]
+    for key in ("jobs", "batch", "jobs_per_s_off", "jobs_per_s_on",
+                "overhead_pct", "overhead_ok", "floor_ok",
+                "bundles_during_run", "quiet_ok", "capture_smoke_ok",
+                "costmodel_obs", "costmodel_blowouts",
+                "costmodel_residual_p50", "costmodel_residual_p95"):
+        assert key in fl, key
+    assert fl["jobs_per_s_off"] > 0.0
+    assert fl["jobs_per_s_on"] > 0.0
+    assert fl["bundles_during_run"] == 0
+    assert fl["quiet_ok"] is True
+    assert fl["capture_smoke_ok"] is True
+    # The synthetic feed is deterministic: warmup_n()-1 calibration obs
+    # after the seed, then 20 drifted durations computed FROM the op
+    # model — 20 scored residuals, the first (+3.5 log2) past the
+    # default 3.0 blowout bar, tail above body.
+    assert fl["costmodel_obs"] == 20
+    assert fl["costmodel_blowouts"] == 1
+    assert fl["costmodel_residual_p95"] >= fl["costmodel_residual_p50"]
+    assert flight_bench["configs"]["flight"] > 0.0
+
+
 def test_autotune_keys_present(autotune_bench):
     """The substrate-autotuner A/B's acceptance numbers
     (autotuned_vs_default_speedup{family} with its modeled twin, and the
