@@ -1815,6 +1815,35 @@ def main():
               f"({len(certify_walls) - 1} families + digest cones)",
               file=sys.stderr)
 
+    # --- modelcheck: dbxmc interleaving/crash-point explorer cost ---------
+    # The model checker (analysis.modelcheck) is a CI-gate stage like
+    # lint and certify: its schedule throughput rides BENCH JSON so a
+    # queue-code or invariant-table growth that would blow the tier-1
+    # budget shows up here first. schedules/crash_points are summed over
+    # every available substrate (python + native when loadable);
+    # DBX_BENCH_MC_SCHEDULES subsets the sweep for tiny runs.
+    if enabled("modelcheck"):
+        from distributed_backtesting_exploration_tpu.analysis import (
+            modelcheck as dbxmc)
+
+        mc_cfg = dbxmc.MCConfig(
+            ops=int(os.environ.get("DBX_MC_OPS", "12")),
+            seed=int(os.environ.get("DBX_MC_SEED", "0")),
+            schedules=int(os.environ.get("DBX_BENCH_MC_SCHEDULES", "120")))
+        mc_res = dbxmc.explore(mc_cfg, dbxmc.available_substrates())
+        ROOFLINE["modelcheck"] = {
+            "schedules": mc_res["schedules"],
+            "crash_points": mc_res["crash_points"],
+            "boundaries": mc_res["boundaries"],
+            "violations": len(mc_res["violations"]),
+            "wall_s": mc_res["wall_s"]}
+        rates["modelcheck"] = (mc_res["schedules"]
+                               / max(mc_res["wall_s"], 1e-9))
+        print(f"bench[modelcheck]: {mc_res['schedules']} schedules, "
+              f"{mc_res['crash_points']} crash points, "
+              f"{len(mc_res['violations'])} violations in "
+              f"{mc_res['wall_s']:.2f}s", file=sys.stderr)
+
     # --- fanout: live signal fan-out scaling (serve/, ROADMAP item 3) -----
     # The serving-cost contract measured end to end: N subscriptions over
     # M symbol chains (all sharing one param block per symbol -> M unique

@@ -592,3 +592,91 @@ class ObsCardinalityRule:
                     return hit
             return None
         return None
+
+
+class JournalDisciplineRule:
+    """Journaled-state mutation not preceded by its journal append.
+
+    The dispatcher's recoverability contract is an ORDER: the publish
+    side (enqueue records, `delta` chain links) journals FIRST, then
+    mutates live state. A crash between the two merely re-enqueues a
+    journaled-but-unpublished job; the reversed order opens a window
+    where live state holds jobs (or chain links) no restart can restore
+    — the exact loss dbxmc's `journal-append-first` invariant catches
+    dynamically (analysis/modelcheck). This rule is the static half of
+    that contract.
+
+    Detection: within one function that BOTH appends a publish-side
+    journal record (``*journal.append("enqueue" | "delta", ...)``) AND
+    mutates journal-covered dispatcher state (``self._records[...]=``,
+    ``self._delta_chain[...]=``, ``*._state.enqueue_n/register/``
+    ``push_pending(...)``, ``*._sched.push(...)``), every such mutation
+    must sit on a LATER line than the first append. Functions with no
+    publish-side append (the replay/restore path, completion paths —
+    where state legally leads the journal) are out of scope; reorderings
+    that split across functions are dbxmc's job, not a lexical rule's.
+    """
+
+    name = "journal-discipline"
+    doc = "journaled-state mutation precedes its journal append"
+
+    _PUBLISH_EVENTS = {"enqueue", "delta"}
+    _STATE_CALLS = {"enqueue_n", "register", "push_pending"}
+    _MUTATED_MAPS = ("._records", "._delta_chain")
+
+    def check(self, ctx: LintContext) -> list[Finding]:
+        out: list[Finding] = []
+        for pf in ctx.files:
+            for fn in ast.walk(pf.tree):
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                append_line = self._first_publish_append(fn)
+                if append_line is None:
+                    continue
+                for lineno, what in self._mutations(fn):
+                    if lineno < append_line:
+                        out.append(Finding(
+                            self.name, pf.rel, lineno,
+                            f"`{what}` mutates journal-covered state "
+                            "BEFORE the publish-side journal append "
+                            f"(line {append_line}): a crash in between "
+                            "holds live jobs no restart can restore — "
+                            "journal first, then publish"))
+        return out
+
+    @classmethod
+    def _first_publish_append(cls, fn: ast.AST) -> int | None:
+        first: int | None = None
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            dotted = _dotted(node.func) or ""
+            if not dotted.endswith("journal.append"):
+                continue
+            ev = node.args[0]
+            if (isinstance(ev, ast.Constant)
+                    and ev.value in cls._PUBLISH_EVENTS
+                    and (first is None or node.lineno < first)):
+                first = node.lineno
+        return first
+
+    @classmethod
+    def _mutations(cls, fn: ast.AST):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                dotted = _dotted(node.func) or ""
+                parts = dotted.split(".")
+                if (len(parts) >= 3 and parts[-2] == "_state"
+                        and parts[-1] in cls._STATE_CALLS):
+                    yield node.lineno, dotted
+                elif dotted.endswith("._sched.push"):
+                    yield node.lineno, dotted
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Subscript):
+                        base = _dotted(t.value) or ""
+                        if base.endswith(cls._MUTATED_MAPS):
+                            yield node.lineno, f"{base}[...] ="

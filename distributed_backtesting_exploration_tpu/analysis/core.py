@@ -222,6 +222,7 @@ def all_rules() -> list:
         ast_rules.ImportTimeConfigRule(),
         ast_rules.BlockingCallRule(),
         ast_rules.ObsCardinalityRule(),
+        ast_rules.JournalDisciplineRule(),
         jaxpr_rules.KernelHygieneRule(),
         certify.SubstrateContractRule(),
         certify.WeakTypeProvenanceRule(),

@@ -87,6 +87,22 @@ _active = False
 _installed = False
 _saved: dict = {}
 
+# Model-checker seam (analysis/modelcheck): when set, every instrumented
+# lock crossing reports to the controlled scheduler — ``hook("acquire",
+# key)`` BEFORE a blocking acquire (the preemption point: the scheduler
+# may park this thread and run another), ``hook("acquired", key)`` after
+# the acquire succeeds and ``hook("release", key)`` after the release
+# (ownership tracking — the scheduler must never switch to a thread that
+# would block on a parked thread's lock). None (the default) costs one
+# global read per crossing.
+_schedule_hook = None
+
+
+def set_schedule_hook(fn) -> None:
+    """Install (or with None, remove) the controlled-scheduler hook."""
+    global _schedule_hook
+    _schedule_hook = fn
+
 
 def max_edges() -> int:
     return int(os.environ.get("DBX_LOCKDEP_MAX_EDGES",
@@ -256,16 +272,22 @@ class _LockdepLock:
 
     def acquire(self, blocking: bool = True, timeout: float = -1):
         if _active and blocking:
+            if _schedule_hook is not None:
+                _schedule_hook("acquire", self.key)
             _before_blocking_acquire(self)
         ok = self._lock.acquire(blocking, timeout)
         if ok and _active:
             _push(self)
+            if blocking and _schedule_hook is not None:
+                _schedule_hook("acquired", self.key)
         return ok
 
     def release(self):
         self._lock.release()
         if _active:
             _pop(self)
+            if _schedule_hook is not None:
+                _schedule_hook("release", self.key)
 
     def locked(self):
         return self._lock.locked()

@@ -230,7 +230,13 @@ class _PyQueueState:
     side is driven).
     """
 
-    def __init__(self):
+    def __init__(self, clock=time.monotonic):
+        # Injectable lease clock (defaults to the real monotonic clock):
+        # the model checker drives lease expiry deterministically by
+        # advancing a virtual clock instead of sleeping past deadlines.
+        # The native substrate keeps its C-side clock — mc gets the same
+        # determinism there with lease_s=0 (already-expired leases).
+        self._clock = clock
         self._pending: collections.deque[str] = collections.deque()
         # Ids completed while still in the pending FIFO (late completions
         # from a previous lease): the FIFO supports no interior removal, so
@@ -271,7 +277,7 @@ class _PyQueueState:
         """False when the job completed in the take window (not leased)."""
         if self._discard_if_completed(jid):
             return False
-        self._leases[jid] = Lease(worker_id, time.monotonic() + lease_s)
+        self._leases[jid] = Lease(worker_id, self._clock() + lease_s)
         return True
 
     def fail(self, jid: str) -> bool:
@@ -333,7 +339,7 @@ class _PyQueueState:
         return [self.complete(j) for j in jids]
 
     def requeue_expired(self) -> list[str]:
-        now = time.monotonic()
+        now = self._clock()
         expired = [jid for jid, l in self._leases.items()
                    if l.deadline <= now]
         for jid in expired:
@@ -401,7 +407,8 @@ class JobQueue:
     """
 
     def __init__(self, journal: Journal | None = None, *,
-                 lease_s: float = 60.0, use_native: bool | None = None):
+                 lease_s: float = 60.0, use_native: bool | None = None,
+                 clock=None):
         self._lock = threading.Lock()
         self._records: dict[str, JobRecord] = {}
         state = None
@@ -414,7 +421,14 @@ class JobQueue:
             except RuntimeError:
                 state = None
         self.substrate = "native" if state is not None else "python"
-        self._state = state if state is not None else _PyQueueState()
+        if state is not None:
+            self._state = state
+        else:
+            # ``clock`` (model-checker seam): virtual lease clock for the
+            # python substrate; ignored on native (C-side clock — mc uses
+            # lease_s=0 there for the same determinism).
+            self._state = (_PyQueueState(clock=clock) if clock is not None
+                           else _PyQueueState())
         # Content-addressed blob store of materialized DBX1 panels: hot
         # panels and requeued jobs never touch disk (or re-transcode CSV)
         # twice, and FetchPayload serves cache-missing workers from it.

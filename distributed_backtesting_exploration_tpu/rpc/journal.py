@@ -51,10 +51,27 @@ class ReplayState:
 
 
 class Journal:
-    """Append-only JSONL journal; thread-safe; no-op when ``path`` is None."""
+    """Append-only JSONL journal; thread-safe; no-op when ``path`` is None.
 
-    def __init__(self, path: str | None):
+    ``fsync=False`` trades durability for speed — the model checker
+    (analysis/modelcheck) runs thousands of short-lived journals whose
+    crash semantics are simulated by copying the file at append
+    boundaries, so the physical fsync buys nothing there. Production
+    paths never pass it.
+
+    ``crash_hook`` is the model checker's fork point: when set, it is
+    called as ``hook("pre", event, rec)`` before the record reaches the
+    file and ``hook("post", event, rec)`` after the write lands —
+    i.e. on either side of the exact boundary a real crash would
+    partition. Called OUTSIDE ``self._lock`` (and every dispatcher
+    journal append already happens outside ``JobQueue._lock``), so the
+    hook may safely replay the file and interrogate live queue state.
+    """
+
+    def __init__(self, path: str | None, *, fsync: bool = True):
         self._path = path
+        self._fsync = fsync
+        self.crash_hook = None
         self._lock = threading.Lock()
         self._fh = open(path, "a", encoding="utf-8") if path else None
         # fsync dominates append latency and gates every durable queue
@@ -80,13 +97,19 @@ class Journal:
             return
         rec = {"ev": event, **payload}
         line = json.dumps(rec, separators=(",", ":"))
+        hook = self.crash_hook
+        if hook is not None:
+            hook("pre", event, rec)
         t0 = time.perf_counter()
         with self._lock:
             self._fh.write(line + "\n")
             self._fh.flush()
             t1 = time.perf_counter()
-            os.fsync(self._fh.fileno())
+            if self._fsync:
+                os.fsync(self._fh.fileno())
         t2 = time.perf_counter()
+        if hook is not None:
+            hook("post", event, rec)
         self._h_fsync.observe(t2 - t1)
         self._h_append.observe(t2 - t0)
         self._c_appends.inc()

@@ -354,6 +354,12 @@ class NativeJobQueue:
 
     _ID_BUF = 512   # DBX_JOBQ_MAX_ID + NUL
 
+    # Model-checker seam (analysis/modelcheck): when set, called as
+    # ``step_hook(method, n)`` before each batched boundary crossing —
+    # the native twin of the python substrate's per-op visibility, used
+    # for transition counting/parity (the C state itself stays opaque).
+    step_hook = None
+
     def __init__(self):
         lib = load()
         if lib is None:
@@ -452,6 +458,8 @@ class NativeJobQueue:
         per-id buffer arithmetic."""
         if not jids:
             return
+        if self.step_hook is not None:
+            self.step_hook("enqueue_n", len(jids))
         import array as array_mod
 
         raws = [j.encode() for j in jids]
@@ -491,6 +499,8 @@ class NativeJobQueue:
         """Pop up to ``n`` live pending ids in one crossing."""
         if n <= 0:
             return []
+        if self.step_hook is not None:
+            self.step_hook("take_begin_n", int(n))
         out = self._idx_buf(min(int(n), 1 << 20))
         got = self._lib.dbx_jobq_take_begin_idx_n(
             self._h, out, min(int(n), len(out)))
@@ -503,6 +513,8 @@ class NativeJobQueue:
         in the take window (dropped, not leased)."""
         if not jids:
             return []
+        if self.step_hook is not None:
+            self.step_hook("take_commit_n", len(jids))
         idxs = self._idx_buf(len(jids), [self._idx[j] for j in jids])
         flags = self._u8_buf(len(jids))
         self._lib.dbx_jobq_take_commit_idx_n(
@@ -516,6 +528,8 @@ class NativeJobQueue:
         core reports "unknown"."""
         if not jids:
             return []
+        if self.step_hook is not None:
+            self.step_hook("complete_n", len(jids))
         get = self._idx.get
         idxs = self._idx_buf(len(jids), [get(j, -1) for j in jids])
         outcomes = self._u8_buf(len(jids))
@@ -535,9 +549,13 @@ class NativeJobQueue:
         return hit
 
     def requeue_expired(self) -> list[str]:
+        if self.step_hook is not None:
+            self.step_hook("requeue_expired", 0)
         return self._requeue(self._lib.dbx_jobq_requeue_expired)
 
     def requeue_worker(self, worker_id: str) -> list[str]:
+        if self.step_hook is not None:
+            self.step_hook("requeue_worker", 0)
         return self._requeue(self._lib.dbx_jobq_requeue_worker,
                              worker_id.encode())
 

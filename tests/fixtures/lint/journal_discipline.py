@@ -1,0 +1,35 @@
+"""Lint fixture: journaled-state mutation BEFORE the journal append.
+
+``buggy_enqueue_many`` mirrors the dispatcher's real ``enqueue_many``
+but publishes into live state FIRST and journals AFTER — the exact
+reordering the ``journal-discipline`` rule flags statically, and the
+reordering dbxmc's ``journal-append-first`` invariant catches
+dynamically when this function is monkeypatched over the real method
+(tests/test_mc_clean.py): a crash between the state push and the
+append holds live jobs no restart can restore.
+"""
+
+DEFAULT_TENANT = "default"
+
+
+def buggy_enqueue_many(self, recs, journal=True):
+    for rec in recs:
+        if not rec.tenant:
+            rec.tenant = DEFAULT_TENANT
+        if rec.ohlcv is not None and not rec.panel_digest:
+            rec.panel_digest = self.panel_store.put(rec.ohlcv)
+    with self._lock:
+        for rec in recs:
+            self._records[rec.id] = rec  # BUG: published before journaled
+            if rec.panel_digest:
+                self._digest_jobs[rec.panel_digest] = rec.id
+        self._state.enqueue_n([rec.id for rec in recs],
+                              [float(rec.combos) for rec in recs])
+        for jid in self._state.take_begin_n(len(recs)):
+            r = self._records[jid]
+            self._sched.push(jid, r.tenant, float(r.combos))
+    if journal and self._journal.enabled:
+        for rec in recs:
+            # Too late: the batch is already takeable; a crash above
+            # this line orphans every job in it.
+            self._journal.append("enqueue", **rec.journal_form())

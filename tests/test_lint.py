@@ -607,3 +607,23 @@ def test_unparseable_file_is_loud(tmp_path):
     assert len(ctx.skipped) == 1 and ctx.skipped[0][0] == "bad.py"
     result = lint_cli.run([str(tmp_path)], core.all_rules())
     assert not result["clean"]          # a syntax error never passes silently
+
+
+def test_journal_discipline_flags_mutation_before_append():
+    """The seeded fixture publishes into live state (records map, state
+    FIFO, WFQ lane) BEFORE journaling the enqueue records — every
+    journal-covered mutation above the append is flagged; the appends
+    themselves and the payload staging above them are not."""
+    findings, suppressed = _lint_fixture("journal_discipline.py",
+                                         ast_rules.JournalDisciplineRule())
+    assert suppressed == 0
+    assert [(f.rule, f.path, f.line) for f in findings] == [
+        ("journal-discipline", "journal_discipline.py",
+         _fixture_line("journal_discipline.py",
+                       "BUG: published before journaled")),
+        ("journal-discipline", "journal_discipline.py",
+         _fixture_line("journal_discipline.py", "._state.enqueue_n(")),
+        ("journal-discipline", "journal_discipline.py",
+         _fixture_line("journal_discipline.py", "._sched.push(")),
+    ]
+    assert "journal first, then publish" in findings[0].message

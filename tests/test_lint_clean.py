@@ -27,8 +27,9 @@ def test_package_lints_clean():
     assert set(result["rules"]) == {
         "trace-time-env", "lock-discipline", "lock-order", "atomicity",
         "lock-blocking", "import-time-config", "blocking-call",
-        "obs-cardinality", "kernel-hygiene", "substrate-contract",
-        "weak-type-provenance", "digest-determinism", "proto-drift"}
+        "obs-cardinality", "journal-discipline", "kernel-hygiene",
+        "substrate-contract", "weak-type-provenance", "digest-determinism",
+        "proto-drift"}
 
 
 def test_certify_clean_and_contract_table_pinned():

@@ -21,6 +21,8 @@ import os
 import pytest
 
 import bench
+from distributed_backtesting_exploration_tpu.runtime import (
+    _core as native_core)
 
 _TINY_ENV = {
     "DBX_BENCH_CPU": "1", "DBX_BENCH_TICKERS": "2", "DBX_BENCH_BARS": "64",
@@ -235,6 +237,53 @@ def test_certify_wall_keys_present(certify_bench):
     assert cf["rows"] == 2 * 4 + 2
     assert cf["wall_s_total"] > 0.0
     assert certify_bench["configs"]["certify"] > 0.0
+
+
+_MC_ENV = {
+    "DBX_BENCH_CPU": "1", "DBX_BENCH_CACHE": "",
+    "DBX_BENCH_CONFIGS": "modelcheck",
+    # Tiny-but-real: a short dbxmc sweep through the REAL explorer on
+    # every available substrate — the analysis cost instrument, not the
+    # invariant gate (that lives in test_mc_clean.py).
+    "DBX_BENCH_MC_SCHEDULES": "30",
+}
+
+
+@pytest.fixture(scope="module")
+def modelcheck_bench():
+    """One tiny in-process dbxmc run, shared by the module."""
+    prior = {k: os.environ.get(k) for k in _MC_ENV}
+    os.environ.update(_MC_ENV)
+    bench.ROOFLINE.clear()
+    buf = io.StringIO()
+    try:
+        with contextlib.redirect_stdout(buf):
+            bench.main()
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return json.loads(buf.getvalue().strip().splitlines()[-1])
+
+
+def test_modelcheck_bench_keys(modelcheck_bench):
+    """dbxmc's exploration cost rides BENCH JSON like every other CI
+    stage: schedules/crash_points/wall_s summed over the available
+    substrates, plus a violations count that must read zero on a
+    healthy tree."""
+    mc = modelcheck_bench["roofline"]["modelcheck"]
+    for key in ("schedules", "crash_points", "boundaries", "violations",
+                "wall_s"):
+        assert key in mc, key
+    n_subs = 1 + (1 if native_core.available() else 0)
+    assert mc["schedules"] == 30 * n_subs
+    assert mc["crash_points"] >= 10 * n_subs
+    assert mc["boundaries"] > mc["crash_points"]
+    assert mc["violations"] == 0
+    assert mc["wall_s"] > 0.0
+    assert modelcheck_bench["configs"]["modelcheck"] > 0.0
 
 
 _FANOUT_ENV = {
