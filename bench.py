@@ -2299,6 +2299,140 @@ def main():
               f"panel {len(blobs[0])}B, e2e {e2e_rate:.0f} jobs/s",
               file=sys.stderr)
 
+    # --- scenario_megakernel: fused in-trace generation vs materialized ---
+    # The round-18 A/B: the SAME scenario sweep drained twice through a
+    # real dispatcher+worker loop — once on the spec-batch megakernel
+    # route (one carrier JobSpec, panels regenerated in-trace inside the
+    # sweep launch, never materialized) and once with the kill switch
+    # down (every panel generated host-side, stored, shipped). Two facts
+    # ride the JSON: the scenarios/s ratio, and the panel-store
+    # bytes-vs-K curve — flat in K for the fused route (only the base
+    # panel is content-addressed) and growing for the materialized one.
+    if enabled("scenario_megakernel"):
+        import tempfile
+        import threading
+
+        from distributed_backtesting_exploration_tpu.rpc.compute import (
+            JaxSweepBackend)
+        from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+            Dispatcher, DispatcherServer, JobQueue, PeerRegistry,
+            scenario_jobs, synthetic_jobs)
+        from distributed_backtesting_exploration_tpu.rpc.worker import (
+            Worker)
+        from distributed_backtesting_exploration_tpu.utils import (
+            data as mk_data)
+
+        mk_bars = int(os.environ.get("DBX_BENCH_MEGAKERNEL_BARS", 512))
+        mk_k = max(int(os.environ.get("DBX_BENCH_MEGAKERNEL_K", 48)), 4)
+        mk_grid = {"fast": np.arange(3.0, 7.0, dtype=np.float32),
+                   "slow": np.arange(12.0, 44.0, 8.0, dtype=np.float32)}
+        mk_combos = int(np.prod([len(v) for v in mk_grid.values()]))
+        mk_params = {"n_bars": mk_bars, "block": 16, "regimes": 3,
+                     "vol_scale": 2.0, "shock": 0.01}
+        mk_series = mk_data.synthetic_ohlcv(1, mk_bars, seed=910)
+        mk_blob = mk_data.to_wire_bytes(
+            type(mk_series)(*(np.asarray(f[0]) for f in mk_series)))
+
+        def mk_leg(k: int, fused: bool):
+            """Drain base + ``k`` scenario jobs through a fresh
+            in-process dispatcher + JAX worker on the chosen route;
+            returns ``(elapsed_s, panel-store stats at drain)``."""
+            prior = os.environ.get("DBX_SCENARIO_FUSED")
+            os.environ["DBX_SCENARIO_FUSED"] = "1" if fused else "0"
+            try:
+                queue = JobQueue()
+                base_rec = synthetic_jobs(
+                    1, 16, "sma_crossover",
+                    {"fast": np.asarray([3.0], np.float32),
+                     "slow": np.asarray([12.0], np.float32)}, seed=911)[0]
+                base_rec.ohlcv = mk_blob
+                queue.enqueue(base_rec)
+                for rec in scenario_jobs(base_rec.panel_digest, k,
+                                         "sma_crossover", mk_grid,
+                                         params=mk_params):
+                    queue.enqueue(rec)
+                with tempfile.TemporaryDirectory() as results_dir:
+                    disp = Dispatcher(queue,
+                                      PeerRegistry(prune_window_s=30.0),
+                                      results_dir=results_dir)
+                    srv = DispatcherServer(disp, bind="localhost:0",
+                                           prune_interval_s=0.5).start()
+                    # jobs_per_chip >= K+1: one poll takes the whole
+                    # sweep, so the fused route coalesces it into ONE
+                    # carrier launch (the shape the megakernel serves).
+                    worker = Worker(f"localhost:{srv.port}",
+                                    JaxSweepBackend(),
+                                    worker_id="megakernel-bench",
+                                    poll_interval_s=0.001,
+                                    status_interval_s=0.5,
+                                    jobs_per_chip=k + 1)
+                    wt = threading.Thread(target=worker.run, daemon=True)
+                    try:
+                        wt.start()
+                        t0 = time.perf_counter()
+                        deadline = time.monotonic() + 600.0
+                        while not queue.drained:
+                            if time.monotonic() > deadline:
+                                sys.exit("bench[scenario_megakernel]: "
+                                         f"drain wedged for 600s (fused="
+                                         f"{fused}, K={k}) — stats="
+                                         f"{queue.stats()}")
+                            time.sleep(0.002)
+                        elapsed = time.perf_counter() - t0
+                    finally:
+                        worker.stop()
+                        wt.join(timeout=30)
+                        srv.stop()
+                return elapsed, queue.panel_store.stats()
+            finally:
+                if prior is None:
+                    os.environ.pop("DBX_SCENARIO_FUSED", None)
+                else:
+                    os.environ["DBX_SCENARIO_FUSED"] = prior
+
+        # Warm both routes at full K first: the fused launch compiles
+        # per (K, shape) bucket, so the timed full-K legs must hit a
+        # warm cache (smaller curve points compile fresh — their elapsed
+        # only annotates the curve, never the headline rates).
+        mk_leg(mk_k, True)
+        mk_leg(mk_k, False)
+        mk_ks = sorted({max(mk_k // 4, 2), max(mk_k // 2, 2), mk_k})
+        curve_fused, curve_mat = [], []
+        for k in mk_ks:
+            el, st = mk_leg(k, True)
+            curve_fused.append({"k": k, "elapsed_s": round(el, 4),
+                                "store_panels": st["panels"],
+                                "store_bytes": st["bytes"]})
+        for k in mk_ks:
+            el, st = mk_leg(k, False)
+            curve_mat.append({"k": k, "elapsed_s": round(el, 4),
+                              "store_panels": st["panels"],
+                              "store_bytes": st["bytes"]})
+        mk_fused_rate = mk_ks[-1] / curve_fused[-1]["elapsed_s"]
+        mk_mat_rate = mk_ks[-1] / curve_mat[-1]["elapsed_s"]
+        mk_fused_bytes = [p["store_bytes"] for p in curve_fused]
+        ROOFLINE["scenario_megakernel"] = {
+            "scenarios": mk_k, "bars": mk_bars, "combos": mk_combos,
+            "fused_scn_per_s": round(mk_fused_rate, 2),
+            "materialized_scn_per_s": round(mk_mat_rate, 2),
+            "speedup": round(mk_fused_rate / max(mk_mat_rate, 1e-9), 2),
+            "store_bytes_by_k_fused": curve_fused,
+            "store_bytes_by_k_materialized": curve_mat,
+            # O(1)-in-K device/store residency: the fused curve holds
+            # exactly the base panel at every K.
+            "store_bytes_flat_in_k": bool(
+                max(mk_fused_bytes) == min(mk_fused_bytes)),
+        }
+        rates["scenario_megakernel"] = mk_fused_rate
+        print(f"bench[scenario_megakernel]: {mk_k} scenarios x "
+              f"{mk_combos} combos @ {mk_bars} bars -> fused "
+              f"{mk_fused_rate:.1f} scn/s vs materialized "
+              f"{mk_mat_rate:.1f} scn/s "
+              f"({mk_fused_rate / max(mk_mat_rate, 1e-9):.2f}x), store "
+              f"bytes flat in K: "
+              f"{max(mk_fused_bytes) == min(mk_fused_bytes)}",
+              file=sys.stderr)
+
     # --- configs[4]: walk-forward (12 refit windows x grid) ---------------
     if enabled("walkforward"):
         train = n_bars // 2 - 30
@@ -2958,6 +3092,7 @@ def main():
                  "keltner_fused, stochastic_fused, vwap_fused, rsi_fused, "
                  "macd_fused, trix_fused, obv_fused, pairs, e2e, e2e_topk, "
                  "e2e_local, e2e_local_tenants, scenario_sweep, "
+                 "scenario_megakernel, "
                  "direct_dispatch, queue_machine, streaming_append, "
                  "fanout, ragged_paged, autotune, walkforward, "
                  "long_context, roofline_stages, pipeline, "

@@ -59,7 +59,8 @@ SCHEMA = 1
 # fallback substrate — the same pair kernel-hygiene sweeps.
 SUBSTRATES = ("scan:8", "ladder")
 FORMS = ("build_carry", "append_step")
-DIGEST_KEYS = ("digest/scenario_synth", "digest/splice")
+DIGEST_KEYS = ("digest/scenario_synth", "digest/scenario_fused",
+               "digest/splice")
 
 _PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -146,11 +147,14 @@ def streaming_row(family: str, substrate: str, form: str) -> RowResult:
 
 
 def digest_rows() -> list:
+    from ..ops import fused
     from ..scenarios import synth
     from ..utils import data as data_mod
 
     rows = []
     for key, probe in (("digest/scenario_synth", synth.certify_probe),
+                       ("digest/scenario_fused",
+                        fused.scenario_certify_probe),
                        ("digest/splice", data_mod.splice_cone_probe)):
         fn, args, integral_keys = probe()
         rows.append(certify_callable(key, fn, args, integral_keys))

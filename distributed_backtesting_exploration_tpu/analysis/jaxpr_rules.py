@@ -190,6 +190,7 @@ class KernelHygieneRule:
                                                       None),
                 arrays, path=rel, line=line))
         findings.extend(self._check_paged(ctx, suffix))
+        findings.extend(self._check_scenario(ctx, suffix))
         findings.extend(self._check_append_steps(ctx, suffix))
         return findings
 
@@ -228,6 +229,51 @@ class KernelHygieneRule:
                         f"extend ops/fused.py _PAGED_FAMILIES/"
                         f"_PAGED_PROBE_AXES so this kernel's paged path "
                         f"stays under coverage"))
+                continue
+            findings.extend(check_traced(label, fn, args, path=rel,
+                                         line=line))
+        return findings
+
+    def _check_scenario(self, ctx: LintContext,
+                        suffix: str) -> list[Finding]:
+        """The fused scenario generator x sweep megakernel (round 18) is
+        a registered kernel too: every family the spec-batch route can
+        serve traces its in-trace block-regeneration path (per-spec
+        threefry keying + ``_gen_impl`` block scan + the family sweep on
+        the regenerated panel) under the active epilogue substrate, via
+        ``ops.fused.scenario_hygiene_probe`` — a tiny base panel and two
+        scenario specs. A family ``scenario_supported`` claims with no
+        probe template surfaces as a loud finding, so the megakernel
+        route can't silently serve untraced."""
+        from ..ops import fused
+        from ..rpc.compute import JaxSweepBackend
+
+        findings: list[Finding] = []
+        try:
+            src, line = (
+                inspect.getsourcefile(fused.fused_scenario_sweep),
+                inspect.getsourcelines(fused.fused_scenario_sweep)[1])
+            rel = os.path.relpath(src, ctx.root)
+        except (OSError, TypeError):
+            rel, line = "ops/fused.py", 0
+        for strategy in sorted(JaxSweepBackend._FUSED_STRATEGIES):
+            if not fused.scenario_supported(strategy):
+                continue
+            label = f"{strategy}.scenario{suffix}"
+            try:
+                fn, args = fused.scenario_hygiene_probe(strategy)
+            except Exception as e:   # a probe that cannot build is a
+                # finding, never a crashed run. Probe-template gaps are
+                # substrate-independent — report once, on the scan pass
+                # (the _check_registry template-gap discipline).
+                if not suffix:
+                    findings.append(Finding(
+                        self.name, rel, line,
+                        f"kernel `{label}`: scenario hygiene probe "
+                        f"failed to build tiny base/spec inputs: {e!r} — "
+                        f"extend ops/fused.py scenario_hygiene_probe so "
+                        f"this family's megakernel route stays under "
+                        f"kernel-hygiene coverage"))
                 continue
             findings.extend(check_traced(label, fn, args, path=rel,
                                          line=line))

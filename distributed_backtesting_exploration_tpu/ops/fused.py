@@ -3524,3 +3524,185 @@ def paged_hygiene_probe(strategy: str):
                                  interpret=True)
 
     return fn, (pool,)
+
+
+# ---------------------------------------------------------------------------
+# Scenario megakernel (round 18)
+#
+# A scenario panel is a pure function of (base_digest, params) — the
+# scenarios.synth reproducibility contract — so a K-scenario stress sweep
+# never needs K panels in HBM: one launch regenerates each panel block by
+# block in-trace (the synth generator's per-block threefry schedule,
+# fold_in(key, block_index)) and feeds it straight into the family's fused
+# sweep. The recompute-from-seed trade of PAPERS.md "Compiler-First State
+# Space Duality" layered on the paged mode's block iteration: device bytes
+# are O(1) in K (a lax.map carries one scenario's working set at a time;
+# only the (T_base,) base panel persists), and the dispatcher ships K
+# ~100-byte specs instead of K materialized panels.
+#
+# Families route through the SAME adapter registry as the paged path
+# (_PAGED_FAMILIES) — the generator emits all five OHLCV columns, so every
+# fused family is scenario-capable. DBX_SCENARIO_FUSED=0 is the kill
+# switch (read host-side, per call).
+# ---------------------------------------------------------------------------
+
+
+def scenario_fused_enabled() -> bool:
+    """Kill switch for the fused scenario-sweep path
+    (``DBX_SCENARIO_FUSED=0`` keeps every scenario job on the
+    dispatcher-materialized ladder rung; default on). Read lazily per
+    call — never at import time, and never inside a trace."""
+    return os.environ.get("DBX_SCENARIO_FUSED", "1") != "0"
+
+
+def scenario_supported(strategy: str) -> bool:
+    """True when ``strategy`` can serve a spec-batch scenario job (one
+    adapter registry with the paged path — the generator emits every
+    OHLCV column, so the two capability sets are identical by
+    construction)."""
+    return strategy in _PAGED_FAMILIES
+
+
+@functools.lru_cache(maxsize=32)
+def _scenario_sweep_fn(strategy: str, grid_items: tuple, n_bars: int,
+                       block: int, regimes: int, cost: float, ppy: int,
+                       interpret: bool, epilogue: str, _subs: tuple):
+    """Build (and cache) the jitted generator x sweep program for one
+    static configuration. ``_subs`` pins the family's live substrate
+    snapshot (``route_substrates``) into the cache key: the wrappers
+    resolve table/lanes knobs at trace time, so an in-process env flip
+    must mint a NEW program, not silently reuse a stale compile."""
+    from ..scenarios import synth
+
+    fields, _, call = _PAGED_FAMILIES[strategy]
+    grid = {k: np.frombuffer(v, np.float32) for k, v in grid_items}
+
+    def run(open_, high, low, close, volume, seed_lo, seed_hi,
+            vol_scale, shock):
+        def one(xs):
+            lo, hi, vs, sh = xs
+            key = jax.random.fold_in(jax.random.PRNGKey(lo), hi)
+            o, h, l, c, v = synth._gen_impl(
+                open_, high, low, close, volume, vs, sh, key,
+                n_bars=n_bars, block=block, regimes=regimes)
+            by = {"open": o, "high": h, "low": l, "close": c, "volume": v}
+            arrays = [by[f][None, :] for f in fields]
+            m = call(arrays, grid, t_real=None, cost=cost,
+                     periods_per_year=ppy, interpret=interpret,
+                     epilogue=epilogue)
+            return tuple(x[0] for x in m)
+
+        # lax.map (a scan) holds ONE scenario's generated panel + sweep
+        # working set live at a time — the O(1)-in-K device-byte claim.
+        ms = jax.lax.map(one, (seed_lo, seed_hi, vol_scale, shock))
+        return Metrics(*ms)
+
+    return jax.jit(run)
+
+
+def fused_scenario_sweep(strategy: str, base, seed_lo, seed_hi,
+                         vol_scale, shock, grid, *, n_bars: int,
+                         block: int, regimes: int, cost: float = 0.0,
+                         periods_per_year: int = 252,
+                         interpret: bool | None = None,
+                         epilogue: str | None = None) -> Metrics:
+    """Run K scenarios of one base panel through a family's fused sweep,
+    regenerating each scenario's OHLCV in-trace — the scenario panels
+    never exist in HBM.
+
+    ``base`` maps the five OHLCV column names to ``(T_base,)`` arrays of
+    the REAL panel; ``seed_lo``/``seed_hi`` are the per-scenario effective
+    seed words (:func:`~..scenarios.synth.seed_words` of
+    ``scenario_seed(base_digest, params)``) and ``vol_scale``/``shock``
+    the per-scenario generator modulation, all ``(K,)``. The
+    shape-static generator knobs (``n_bars``/``block``/``regimes``) are
+    uniform across the batch — the dispatcher's spec coalescer keys on
+    them. Returns :class:`Metrics` with ``(K, P)`` fields, row ``k``
+    bit-matching the dense fused sweep over the host-materialized panel
+    of spec ``k`` (one shared generator program — cross-pinned by test).
+    """
+    fam = _PAGED_FAMILIES.get(strategy)
+    if fam is None:
+        raise ValueError(
+            f"strategy {strategy!r} has no scenario execution row "
+            f"(known: {sorted(_PAGED_FAMILIES)})")
+    if n_bars < 1 or block < 1 or regimes < 1:
+        raise ValueError(
+            f"scenario sweep needs n_bars/block/regimes >= 1 "
+            f"(got {n_bars}/{block}/{regimes})")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    grid_items = tuple(sorted(
+        (k, np.asarray(v, np.float32).tobytes()) for k, v in grid.items()))
+    subs = tuple(sorted(route_substrates(strategy).items()))
+    fn = _scenario_sweep_fn(strategy, grid_items, int(n_bars), int(block),
+                            int(regimes), float(cost),
+                            int(periods_per_year), bool(interpret),
+                            _resolve_epilogue(epilogue), subs)
+    seed_lo = jnp.asarray(seed_lo, jnp.int32)
+    seed_hi = jnp.asarray(seed_hi, jnp.int32)
+    if seed_lo.ndim != 1 or seed_lo.shape != seed_hi.shape:
+        raise ValueError("seed_lo/seed_hi must be matching (K,) arrays")
+    if seed_lo.shape[0] == 0:
+        raise ValueError("scenario sweep over an empty spec batch")
+    return fn(*(jnp.asarray(np.asarray(base[f]), jnp.float32)
+                for f in ("open", "high", "low", "close", "volume")),
+              seed_lo, seed_hi,
+              jnp.asarray(vol_scale, jnp.float32),
+              jnp.asarray(shock, jnp.float32))
+
+
+# 18 real base bars + 16 generated bars clear every probe axis warmup
+# (windows <= 5, MACD/TRIX fast < slow) — the paged probe's sizing rule.
+_SCENARIO_PROBE_BARS = 18
+
+
+def scenario_hygiene_probe(strategy: str):
+    """``(fn, args)`` tracing the scenario megakernel path of
+    ``strategy`` — the in-trace seed fold, the per-block generator scan
+    and the family sweep over the regenerated panel — for dbxlint's
+    kernel-hygiene rule (both epilogue substrates, like the paged twin).
+    Raises for a family with no scenario row or probe template (the rule
+    reports that as a loud finding, never a crashed run)."""
+    fields, axes, _ = _PAGED_FAMILIES[strategy]
+    del fields
+    T = _SCENARIO_PROBE_BARS
+    t = np.arange(1, T + 1, dtype=np.float32)
+    close = 100.0 + np.sin(t) + 0.01 * t
+    base = {
+        "open": close, "high": close * 1.01, "low": close * 0.99,
+        "close": close, "volume": np.full(T, 1e4, np.float32),
+    }
+    grid = {a: np.asarray(_PAGED_PROBE_AXES[a], np.float32) for a in axes}
+    args = (np.asarray([3, 5], np.int32), np.asarray([1, 2], np.int32),
+            np.asarray([2.0, 1.5], np.float32),
+            np.asarray([0.1, 0.0], np.float32))
+
+    def fn(lo, hi, vs, sh):
+        return fused_scenario_sweep(strategy, base, lo, hi, vs, sh, grid,
+                                    n_bars=16, block=4, regimes=2,
+                                    interpret=True)
+
+    return fn, args
+
+
+def scenario_certify_probe():
+    """``(fn, args, integral_keys)`` for dbxcert: the fused generator x
+    sweep cone on tiny pinned shapes — the flagship family's scenario
+    megakernel traced end to end (seed fold -> per-block regeneration ->
+    carry-scan sweep -> metrics). The in-sweep regeneration claim is
+    sound only if this program is run-to-run deterministic for fixed
+    seed words: the certifier asserts no nondet-class primitive reaches
+    any metric output, the same machine-checked contract the
+    ``scenario_synth`` cone pins for the host/materialized path. The
+    two rows TOGETHER are the proof the fused and materialized rungs of
+    the degradation ladder cannot silently diverge in kind."""
+    from .metrics import Metrics
+
+    probe_fn, args = scenario_hygiene_probe("sma_crossover")
+
+    def fn(lo, hi, vs, sh):
+        m = probe_fn(lo, hi, vs, sh)
+        return dict(zip(Metrics._fields, m))
+
+    return fn, args, frozenset()

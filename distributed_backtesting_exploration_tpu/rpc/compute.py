@@ -21,6 +21,7 @@ import numpy as np
 from . import backtesting_pb2 as pb
 from . import wire
 from .. import obs
+from ..obs import flight as obs_flight
 from ..parallel._shardmap_compat import shard_map
 from ..utils import data as data_mod
 
@@ -192,6 +193,24 @@ class Completion:
         self.metrics = metrics
         self.elapsed_s = elapsed_s
         self.trace_id = trace_id
+
+
+class _ScenarioJob:
+    """Per-scenario identity inside a coalesced spec-batch job: collect()
+    and the obs span helpers read id/grid/trace ATTRIBUTES off whatever
+    object rides the pending entry, so a K-spec batch completes as K
+    ordinary per-scenario results without K JobSpec protos ever existing
+    worker-side. ``grid`` aliases the carrier JobSpec's shared grid map
+    (every batch member swept the same grid by construction)."""
+
+    __slots__ = ("id", "grid", "trace_id", "parent_span_id")
+
+    def __init__(self, job_id: str, grid, trace_id: str,
+                 parent_span_id: str):
+        self.id = job_id
+        self.grid = grid
+        self.trace_id = trace_id
+        self.parent_span_id = parent_span_id
 
 
 class ComputeBackend(Protocol):
@@ -478,6 +497,19 @@ class JaxSweepBackend:
                      "(carry_hit=O(ΔT) advance, full_reprice=checkpoint "
                      "miss fallback)", outcome=outcome)
             for outcome in ("carry_hit", "full_reprice")}
+        # Scenario megakernel route accounting: which path served each
+        # scenario job. `fused` = in-trace regeneration inside the sweep
+        # launch (panel never in HBM); `materialized` = a concrete panel
+        # was generated first — dispatcher-side (old capability / kill
+        # switch / digestless base) or the worker's own in-process
+        # fallback when the fused leg fails.
+        self._c_scenario_route = {
+            mode: reg.counter(
+                "dbx_scenario_route_total",
+                help="scenario jobs served, by route (fused=in-trace "
+                     "regeneration, materialized=concrete panel)",
+                mode=mode)
+            for mode in ("materialized", "fused")}
         # Substrate autotuner (tune/, round 11): the schedule registry is
         # consulted per fused group submit — explicit arg > env > tuned
         # schedule > hardcoded default, so every existing override keeps
@@ -585,6 +617,16 @@ class JaxSweepBackend:
                      "epilogue/table substrate",
                 kernel=strategy, **subs)
         c.inc()
+
+    @property
+    def accepts_scenario_batch(self) -> bool:
+        """Capability the worker advertises on JobsRequest: this backend
+        can regenerate scenario panels in-trace inside the fused sweep
+        launch. Read per poll so flipping the ``DBX_SCENARIO_FUSED`` kill
+        switch stops NEW spec batches immediately (already-leased
+        batches still drain through the in-process materialized
+        fallback)."""
+        return self._fused_ops.scenario_fused_enabled()
 
     @property
     def chips(self) -> int:
@@ -1325,6 +1367,127 @@ class JaxSweepBackend:
         # checkpoint the next append in the chain advances.
         return ([job], _start_result_copy(m, donate=False), t0, 1, None)
 
+    def _submit_scenario_group(self, job):
+        """One coalesced scenario spec-batch job (JobSpec.scenario_batch):
+        a single launch regenerates each of the K scenario panels
+        IN-TRACE inside the fused sweep — the synthetic panels never
+        exist in HBM (``lax.map`` over specs holds one scenario's working
+        set at a time, so device bytes are O(1) in K; only the BASE panel
+        rides the payload/digest legs). Every spec completes under its
+        own queued job id, so queue semantics are per-scenario exactly as
+        if the K jobs had dispatched materialized.
+
+        Degradation: any fused-leg failure (unsupported family drifting
+        past the dispatcher gate, kill switch flipped mid-lease, a trace
+        error) drops to :meth:`_submit_scenario_materialized` — the
+        in-process twin of the dispatcher's materialized path — and
+        fires a flight-recorder anomaly so the demotion is capturable.
+        An unresolvable BASE raises, leaving the lease to requeue (by
+        then the dispatcher re-ships bytes): degraded, never a failed
+        job."""
+        from ..parallel import sweep as sweep_mod
+        from ..scenarios import synth
+
+        t0 = time.perf_counter()
+        specs = list(job.scenario_batch)
+        # Base resolution rides the ordinary digest machinery: host
+        # cache -> inline bytes -> FetchPayload; raises on miss.
+        series, _ = self._resolve_series(job)
+        pseudo = [_ScenarioJob(s.id, job.grid, s.trace_id,
+                               job.parent_span_id) for s in specs]
+        try:
+            if not self._fused_ops.scenario_fused_enabled():
+                raise ValueError("DBX_SCENARIO_FUSED=0")
+            base = {f: np.asarray(getattr(series, f), np.float32)
+                    for f in ("open", "high", "low", "close", "volume")}
+            n_bars = int(specs[0].n_bars) or series.n_bars
+            block = max(int(specs[0].block), 1)
+            regimes = max(int(specs[0].regimes), 1)
+            axes = wire.grid_from_proto(job.grid)
+            grid = {k: np.asarray(v, np.float32) for k, v
+                    in sweep_mod.product_grid(**axes).items()}
+            # ScenarioSpec.seed carries the EFFECTIVE 64-bit seed for
+            # batch members (dispatcher-derived from host-precision
+            # params) — the worker only splits it into the int31 words
+            # the generator's key derivation folds.
+            words = [synth.seed_words(int(s.seed)) for s in specs]
+            m = self._fused_ops.fused_scenario_sweep(
+                job.strategy, base,
+                np.asarray([w[0] for w in words], np.int32),
+                np.asarray([w[1] for w in words], np.int32),
+                np.asarray([s.vol_scale for s in specs], np.float32),
+                np.asarray([s.shock for s in specs], np.float32),
+                grid, n_bars=n_bars, block=block, regimes=regimes,
+                cost=float(job.cost),
+                periods_per_year=int(job.periods_per_year or 252))
+        except Exception as e:     # noqa: BLE001 — demote, never fail
+            log.warning(
+                "scenario batch %s (%s, %d specs): fused leg failed "
+                "(%s); falling back to in-process materialization",
+                job.id, job.strategy, len(specs), e)
+            obs_flight.trigger(
+                "scenario_fused_fail", subject=job.id,
+                strategy=job.strategy, specs=len(specs), error=str(e))
+            return self._submit_scenario_materialized(job, specs,
+                                                      series, t0)
+        self._c_scenario_route["fused"].inc(len(specs))
+        P = sweep_mod.grid_size(grid) if grid else 1
+        self._observe_submit(
+            job.strategy, "scenario", t0,
+            cold_key=("scenario", job.strategy, n_bars, block, regimes,
+                      P, len(specs)),
+            group=pseudo, bars=n_bars, combos=len(specs) * P)
+        return [(pseudo, _start_result_copy(m), t0, len(specs), None)]
+
+    def _submit_scenario_materialized(self, job, specs, series, t0):
+        """The worker-side materialized rung of the scenario degradation
+        ladder: host-generate each spec's panel (the same
+        ``synth.generate`` program the dispatcher's materialized path
+        runs, under the same effective seed — bit-identical bytes) and
+        resubmit the batch as K ordinary inline-payload jobs through the
+        normal routing. A spec whose generation raises is validated-bad
+        and completes loudly empty (a malformed spec requeue-loops
+        forever; the dispatcher's own materialized path would have
+        failed it the same way)."""
+        from ..scenarios import synth
+
+        self._c_scenario_route["materialized"].inc(len(specs))
+        expanded, bad = [], []
+        for s in specs:
+            # generate() reads only the shape/scale fields off params —
+            # the effective seed arrives pre-derived in s.seed, so the
+            # sequence-number field is irrelevant here.
+            params = synth.ScenarioParams(
+                n_bars=int(s.n_bars), block=int(s.block),
+                regimes=int(s.regimes), vol_scale=float(s.vol_scale),
+                shock=float(s.shock))
+            try:
+                panel = synth.generate(series, params, int(s.seed))
+            except (ValueError, ZeroDivisionError) as e:
+                log.error("scenario job %s: generation failed (%s); "
+                          "completing with empty metrics", s.id, e)
+                bad.append(s)
+                continue
+            spec = pb.JobSpec()
+            spec.CopyFrom(job)
+            del spec.scenario_batch[:]
+            spec.ClearField("scenario")
+            spec.id = s.id
+            spec.trace_id = s.trace_id
+            spec.ohlcv = data_mod.to_wire_bytes(panel)
+            spec.panel_digest = ""
+            spec.panel_bytes_len = 0
+            expanded.append(spec)
+        pending = []
+        if bad:
+            pending.append(
+                ([_ScenarioJob(s.id, job.grid, s.trace_id,
+                               job.parent_span_id) for s in bad],
+                 None, t0, 0, None))
+        if expanded:
+            pending.extend(self.submit(expanded))
+        return pending
+
     def _decode_group(self, group):
         """Cache-aware group decode (leg 1 — the pairs path drives
         :meth:`_resolve_series` per leg itself) under the traced
@@ -1416,6 +1579,18 @@ class JaxSweepBackend:
         stream_pending = [self._submit_append_job(j) for j in jobs
                           if j.append_parent_digest]
         jobs = [j for j in jobs if not j.append_parent_digest]
+        # Scenario spec batches peel next: each carrier JobSpec is ONE
+        # fused generator x sweep launch that completes its K coalesced
+        # scenario records individually (megakernel route).
+        for j in [j for j in jobs if j.scenario_batch]:
+            stream_pending.extend(self._submit_scenario_group(j))
+        jobs = [j for j in jobs if not j.scenario_batch]
+        # Route accounting for the materialized rung: scenario jobs that
+        # arrive as ordinary concrete panels (old worker capability,
+        # DBX_SCENARIO_FUSED=0, digestless base, unsupported family).
+        n_mat = sum(1 for j in jobs if j.scenario.base_digest)
+        if n_mat:
+            self._c_scenario_route["materialized"].inc(n_mat)
         # Group stackable jobs: same strategy, grid, cost (and walk-forward
         # windowing). Mixed history lengths stack fine — both the fused
         # kernels (per-ticker t_real) and the generic path (pad_and_stack +

@@ -643,8 +643,9 @@ class JobQueue:
 
     # -- dispatch ----------------------------------------------------------
 
-    def take(self, n: int, worker_id: str,
-             admit=None) -> list[tuple[JobRecord, bytes]]:
+    def take(self, n: int, worker_id: str, admit=None,
+             scenario_spec: dict | None = None
+             ) -> list[tuple[JobRecord, bytes]]:
         """Pop up to ``n`` jobs, lease them to ``worker_id``, return payloads.
 
         Batched against the state machine: ONE ``take_begin_n`` crossing
@@ -665,11 +666,23 @@ class JobQueue:
         callback bounds its own deferrals (``JobRecord.affinity_skips``);
         a held job is served to ANYONE on the next attempt, so affinity
         can delay a job by at most one poll round, never starve it.
+
+        ``scenario_spec`` (a dict, or None) opts the caller into the
+        scenario-megakernel spec dispatch: an eligible scenario record
+        whose BASE panel is servable skips materialization entirely —
+        its returned payload is the BASE panel's bytes and the dict
+        gains ``record id -> base digest`` so the caller can coalesce
+        the records into spec-batch JobSpecs (the worker regenerates
+        each panel in-trace). ``None`` (every legacy caller) keeps the
+        materialized path verbatim, and so does any record that fails
+        the eligibility gate — the fallback ladder is "don't coalesce",
+        nothing else changes.
         """
         out: list[tuple[JobRecord, bytes]] = []
         deferred: list[str] = []
         try:
-            return self._take_inner(n, worker_id, admit, out, deferred)
+            return self._take_inner(n, worker_id, admit, out, deferred,
+                                    scenario_spec)
         finally:
             if deferred:
                 with self._lock:
@@ -679,7 +692,8 @@ class JobQueue:
                     # held list before popping the FIFO.
                     self._affinity_held.extend(deferred)
 
-    def _take_inner(self, n, worker_id, admit, out, deferred):
+    def _take_inner(self, n, worker_id, admit, out, deferred,
+                    scenario_spec=None):
         first = True
         while len(out) < n:
             with self._lock:
@@ -750,6 +764,23 @@ class JobQueue:
                     rec = stored
                     payload = stored.ohlcv
                     try:
+                        if (payload is None and scenario_spec is not None
+                                and self._scenario_spec_eligible(stored)):
+                            # Scenario megakernel spec dispatch: serve
+                            # the BASE panel's bytes instead of
+                            # generating the scenario panel — the worker
+                            # regenerates it in-trace inside the fused
+                            # sweep. An unservable base simply drops
+                            # through to the materialized rung below
+                            # (whose own triage decides loud-fail vs
+                            # serve), so eligibility can never turn a
+                            # dispatchable job into a failed one.
+                            base_d = str(stored.scenario.get("base", ""))
+                            blob = self.payload_for_digest(base_d)
+                            if blob is not None:
+                                scenario_spec[jid] = base_d
+                                good.append((jid, stored, blob))
+                                continue
                         if payload is None:
                             # Store-first materialization: a hot panel or
                             # a requeued/retried job never re-reads (or
@@ -868,6 +899,28 @@ class JobQueue:
                 with self._lock:
                     self._in_take -= len(jids)
         return out
+
+    def _scenario_spec_eligible(self, rec: "JobRecord") -> bool:
+        """Can this record ride the scenario-megakernel spec dispatch?
+        Plain single-asset scenario sweeps of a fused-supported family
+        only — any reduction/windowing mode, a second leg, or a
+        digestless base keeps the record on the materialized rung (the
+        degradation ladder's "don't coalesce" answer, never an error).
+        The kernel-family probe imports ops.fused lazily: the dispatcher
+        stays jax-free until a spec-capable worker actually polls with
+        scenario records queued — the same moment the alternative was a
+        full generator run."""
+        if (rec.scenario is None or rec.append_parent or rec.wf_train
+                or rec.top_k or rec.best_returns
+                or rec.ohlcv2 is not None or rec.path2 is not None):
+            return False
+        if not str(rec.scenario.get("base", "")):
+            return False
+        try:
+            from ..ops import fused
+        except Exception:          # noqa: BLE001 — kernel stack absent
+            return False
+        return bool(fused.scenario_supported(rec.strategy))
 
     def _materialize(self, digest: str, path: str | None,
                      scenario: dict | None = None) -> tuple[bytes, str]:
@@ -1452,6 +1505,14 @@ class PeerRegistry:
 # The gRPC servicer + server lifecycle
 # ---------------------------------------------------------------------------
 
+def _scenario_fused_enabled() -> bool:
+    """Twin of ``ops.fused.scenario_fused_enabled`` (the
+    ``DBX_SCENARIO_FUSED`` kill switch), inlined so the dispatcher never
+    imports the kernel (jax) module just to read an env flag. Read per
+    RPC: flipping the switch stops NEW spec batches on the next poll."""
+    return os.environ.get("DBX_SCENARIO_FUSED", "1") != "0"
+
+
 def _timed_rpc(method: str):
     """Record the handler's wall into ``dbx_rpc_seconds{method=...}``.
 
@@ -1532,6 +1593,10 @@ class Dispatcher(service.DispatcherServicer):
                       "TriggerDump")}
         self._c_dispatched = self.obs.counter(
             "dbx_jobs_dispatched_total", help="jobs handed to workers")
+        self._c_scn_coalesced = self.obs.counter(
+            "dbx_scenario_specs_coalesced_total",
+            help="scenario records dispatched as spec-batch members "
+                 "(megakernel route) instead of materialized panels")
         self._c_completions = {
             o: self.obs.counter("dbx_completions_total",
                                 help="completion outcomes recorded",
@@ -1882,13 +1947,25 @@ class Dispatcher(service.DispatcherServicer):
         per_chip = request.jobs_per_chip or self.default_jobs_per_chip
         n = max(request.chips, 1) * max(per_chip, 1)
         t_disp0 = time.time()
+        # Scenario megakernel opt-in: only a worker that declared the
+        # spec-batch capability (proto3 default false — old binaries
+        # never see a batch shape) and only while the kill switch is up.
+        spec_jids: dict[str, str] | None = (
+            {} if (request.accepts_scenario_batch
+                   and _scenario_fused_enabled()) else None)
         taken = self.queue.take(n, request.worker_id,
                                 admit=self._affinity_admit(
-                                    request.worker_id, delivered))
+                                    request.worker_id, delivered),
+                                scenario_spec=spec_jids)
         if taken:
             self._c_dispatched.inc(len(taken))
         reply = pb.JobsReply()
         now = time.time()
+        # Spec-dispatch records coalesce by everything the fused launch
+        # compiles against (base, family, grid, static generator shape,
+        # cost basis, tenant) — one carrier JobSpec per group, K specs
+        # inside. vol_scale/shock/seed ride per-spec (traced values).
+        scn_batches: dict[tuple, list] = {}
         for rec, payload in taken:
             # Per-job trace stitching: close the queue-wait span (enqueue
             # -> this take) and open/close the dispatch span (take +
@@ -1935,6 +2012,19 @@ class Dispatcher(service.DispatcherServicer):
                         "slo_breach", subject=tb, job=rec.id,
                         wait_s=round(wait_s, 3),
                         slo_s=self.tenant_slo_s)
+            if spec_jids and rec.id in spec_jids:
+                scn_batches.setdefault(
+                    (spec_jids[rec.id], rec.strategy,
+                     tuple(sorted(
+                         (k, np.asarray(v, np.float32).tobytes())
+                         for k, v in rec.grid.items())),
+                     int(rec.scenario.get("n_bars", 0)),
+                     int(rec.scenario.get("block", 0)),
+                     int(rec.scenario.get("regimes", 0)),
+                     float(rec.cost), int(rec.periods_per_year),
+                     rec.tenant),
+                    []).append((rec, payload, parent_sid))
+                continue
             payload2 = rec.ohlcv2 or b""
             leg1 = (self._append_leg(delivered, rec, payload)
                     if rec.append_parent else
@@ -1969,6 +2059,44 @@ class Dispatcher(service.DispatcherServicer):
                     shock=float(rec.scenario.get("shock", 0.0)),
                     seed=int(rec.scenario.get("seed", 0)))
                     if rec.scenario else None)))
+        if scn_batches:
+            # Lazy: only spec-capable polls with scenario records taken
+            # pay the scenarios (jax) import — the same processes that
+            # would otherwise have paid a full generator run per record.
+            from .. import scenarios as scenarios_mod
+
+            for members in scn_batches.values():
+                rec0, payload0, sid0 = members[0]
+                base_d = spec_jids[rec0.id]
+                spec = pb.JobSpec(
+                    id=rec0.id, strategy=rec0.strategy,
+                    ohlcv=self._payload_leg(delivered, base_d, payload0),
+                    grid=wire.grid_to_proto(rec0.grid), cost=rec0.cost,
+                    periods_per_year=rec0.periods_per_year,
+                    trace_id=rec0.trace_id, parent_span_id=sid0,
+                    panel_digest=base_d, panel_bytes_len=len(payload0),
+                    tenant_id=rec0.tenant)
+                for rec, _, _ in members:
+                    # The EFFECTIVE seed derives dispatcher-side from the
+                    # record's host-precision params — the float32 wire
+                    # roundtrip of vol_scale/shock can never skew the
+                    # hash the worker would otherwise recompute.
+                    eff = scenarios_mod.scenario_seed(
+                        base_d,
+                        scenarios_mod.ScenarioParams.from_dict(
+                            rec.scenario))
+                    spec.scenario_batch.append(pb.ScenarioSpec(
+                        base_digest=base_d,
+                        n_bars=int(rec.scenario.get("n_bars", 0)),
+                        block=int(rec.scenario.get("block", 0)),
+                        regimes=int(rec.scenario.get("regimes", 0)),
+                        vol_scale=float(
+                            rec.scenario.get("vol_scale", 0.0)),
+                        shock=float(rec.scenario.get("shock", 0.0)),
+                        seed=scenarios_mod.seed_to_int64(eff),
+                        id=rec.id, trace_id=rec.trace_id))
+                self._c_scn_coalesced.inc(len(members))
+                reply.jobs.append(spec)
         if taken:
             log.info("dispatched %d jobs to %s", len(taken), request.worker_id)
         return reply

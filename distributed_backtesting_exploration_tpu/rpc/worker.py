@@ -772,6 +772,11 @@ class Worker:
             # hosts: backends with a panel cache resolve digests, and
             # payload-less fakes (instant/sleep) never read ohlcv at all.
             accepts_digest_only=True,
+            # Spec-batch scenario jobs need a backend that can regenerate
+            # panels in-trace; only the JAX backend declares it (and only
+            # while the DBX_SCENARIO_FUSED kill switch is up).
+            accepts_scenario_batch=bool(
+                getattr(self.backend, "accepts_scenario_batch", False)),
             schedule_json=schedule_json,
             telemetry_json=telemetry_json)
         try:

@@ -10,4 +10,5 @@ needs zero changes beyond the cache it already has.
 """
 
 from .synth import (  # noqa: F401
-    ScenarioParams, generate, max_bars, scenario_panel_bytes, scenario_seed)
+    ScenarioParams, generate, max_bars, scenario_panel_bytes,
+    scenario_seed, seed_to_int64, seed_words)

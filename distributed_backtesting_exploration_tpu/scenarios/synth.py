@@ -95,13 +95,42 @@ def scenario_seed(base_digest: str, params: ScenarioParams) -> int:
     return int.from_bytes(h.digest(), "little")
 
 
+def seed_words(seed: int) -> tuple[int, int]:
+    """The ``(lo, hi)`` int31 words of a 64-bit effective seed — exactly
+    the pair :func:`generate` folds into the PRNG key. Shared with the
+    in-trace scenario megakernel (``ops.fused.fused_scenario_sweep``),
+    which receives the words as traced scalars, so both paths derive
+    bit-identical threefry keys from one spec."""
+    return seed & 0x7FFFFFFF, (seed >> 31) & 0x7FFFFFFF
+
+
+def seed_to_int64(seed: int) -> int:
+    """Two's-complement wrap of an unsigned 64-bit effective seed into
+    the signed int64 range ``ScenarioSpec.seed`` can carry.
+    :func:`seed_words` masks fixed bit fields, so it returns the SAME
+    words for ``seed`` and ``seed_to_int64(seed)`` — the wire roundtrip
+    cannot skew key derivation."""
+    return seed - (1 << 64) if seed >= (1 << 63) else seed
+
+
 def _gen_impl(open_, high, low, close, volume, vol_scale, shock, key, *,
               n_bars: int, block: int, regimes: int):
     """The traced generator (fixed shapes; one compile per
     (base_T, n_bars, block, regimes) bucket). The un-jitted body is the
     dbxcert digest-cone trace target (``certify_probe``) — the output
     digest's determinism contract is certified over exactly this
-    program."""
+    program.
+
+    The panel builds BLOCK BY BLOCK: bars arrive in bootstrap-block
+    chunks from a `lax.scan` over the block index, with block ``b``'s
+    randomness drawn from ``fold_in(key, b)`` and only O(block) state
+    (regime, cumulative log level) carried across. That schedule is the
+    load-bearing part of the scenario megakernel: the fused sweep path
+    replays exactly this scan in-trace, regenerating each T-block of the
+    panel on the fly inside the sweep launch, and per-block keying makes
+    block ``b`` independent of everything but ``(key, b)`` — the bytes
+    the host path emits and the blocks the fused path regenerates are
+    identical by construction, not by parallel maintenance."""
     f32 = jnp.float32
     c_prev = close[:-1]
     ret = jnp.log(close[1:] / c_prev)              # (Tb,)
@@ -109,55 +138,62 @@ def _gen_impl(open_, high, low, close, volume, vol_scale, shock, key, *,
     hi = jnp.abs(jnp.log(high[1:] / jnp.maximum(open_[1:], close[1:])))
     lo = jnp.abs(jnp.log(jnp.minimum(open_[1:], close[1:]) / low[1:]))
     t_base = ret.shape[0]
-
-    k_start, k_sw, k_pick, k_shock, k_mag = jax.random.split(key, 5)
+    # Sigma of the base return stream sizes the ~5-sigma gap shocks.
+    sigma = jnp.std(ret)
     n_blocks = -(-n_bars // block)
-    starts = jax.random.randint(k_start, (n_blocks,), 0,
-                                max(t_base - block + 1, 1))
-    idx = (starts[:, None]
-           + jnp.arange(block)[None, :]).reshape(-1)[:n_bars]
-    idx = jnp.minimum(idx, t_base - 1)
-
     if regimes > 1:
         # K log-spaced vol multipliers spanning 1/vol_scale .. vol_scale;
         # the regime path is a persistent Markov chain (scan) so vol
         # clusters instead of flickering per bar.
         mult = jnp.exp(jnp.linspace(-1.0, 1.0, regimes)
                        * jnp.log(jnp.maximum(vol_scale, 1.0 + 1e-6)))
-        u = jax.random.uniform(k_sw, (n_bars,))
-        cand = jax.random.randint(k_pick, (n_bars,), 0, regimes)
 
-        def step(state, xs):
-            u_t, cand_t = xs
-            state = jnp.where(u_t < (1.0 - _REGIME_PERSIST), cand_t, state)
-            return state, state
+    def block_step(carry, b):
+        state, log_level = carry
+        kb = jax.random.fold_in(key, b)
+        k_start, k_sw, k_pick, k_shock, k_mag = jax.random.split(kb, 5)
+        start = jax.random.randint(k_start, (), 0,
+                                   max(t_base - block + 1, 1))
+        idx = jnp.minimum(start + jnp.arange(block), t_base - 1)
+        if regimes > 1:
+            u = jax.random.uniform(k_sw, (block,))
+            cand = jax.random.randint(k_pick, (block,), 0, regimes)
 
-        _, path = jax.lax.scan(step, jnp.int32(0), (u, cand))
-        scale = mult[path].astype(f32)
-    else:
-        scale = jnp.ones((n_bars,), f32)
+            def step(s, xs):
+                u_t, cand_t = xs
+                s = jnp.where(u_t < (1.0 - _REGIME_PERSIST), cand_t, s)
+                return s, s
 
-    # Gap-open shocks: rare (p = shock) jumps of ~5 sigma of the base
-    # return stream, applied to the open gap AND the close return so the
-    # level shift persists past the bar (a gap that mean-reverted by the
-    # close would not stress latch/stop logic).
-    sigma = jnp.std(ret)
-    hit = jax.random.uniform(k_shock, (n_bars,)) < shock
-    mag = jax.random.normal(k_mag, (n_bars,)) * 5.0 * sigma
-    jump = jnp.where(hit, mag, 0.0)
+            state, path = jax.lax.scan(step, state, (u, cand))
+            scale = mult[path].astype(f32)
+        else:
+            scale = jnp.ones((block,), f32)
+        # Gap-open shocks: rare (p = shock) jumps of ~5 sigma of the
+        # base return stream, applied to the open gap AND the close
+        # return so the level shift persists past the bar (a gap that
+        # mean-reverted by the close would not stress latch/stop logic).
+        hit = jax.random.uniform(k_shock, (block,)) < shock
+        mag = jax.random.normal(k_mag, (block,)) * 5.0 * sigma
+        jump = jnp.where(hit, mag, 0.0)
 
-    b_ret = ret[idx] * scale + jump
-    b_gap = gap[idx] * scale + jump
-    close_new = close[0] * jnp.exp(jnp.cumsum(b_ret))
-    prev = jnp.concatenate([close[:1], close_new[:-1]])
-    open_new = prev * jnp.exp(b_gap)
-    body_hi = jnp.maximum(open_new, close_new)
-    body_lo = jnp.minimum(open_new, close_new)
-    high_new = body_hi * jnp.exp(hi[idx] * scale)
-    low_new = body_lo * jnp.exp(-lo[idx] * scale)
-    vol_new = volume[1:][idx]
-    return tuple(a.astype(f32) for a in
-                 (open_new, high_new, low_new, close_new, vol_new))
+        b_ret = ret[idx] * scale + jump
+        b_gap = gap[idx] * scale + jump
+        cum = log_level + jnp.cumsum(b_ret)
+        close_b = close[0] * jnp.exp(cum)
+        prev = close[0] * jnp.exp(
+            jnp.concatenate([log_level[None], cum[:-1]]))
+        open_b = prev * jnp.exp(b_gap)
+        body_hi = jnp.maximum(open_b, close_b)
+        body_lo = jnp.minimum(open_b, close_b)
+        high_b = body_hi * jnp.exp(hi[idx] * scale)
+        low_b = body_lo * jnp.exp(-lo[idx] * scale)
+        vol_b = volume[1:][idx]
+        return ((state, cum[-1]),
+                (open_b, high_b, low_b, close_b, vol_b))
+
+    _, chunks = jax.lax.scan(block_step, (jnp.int32(0), f32(0.0)),
+                             jnp.arange(n_blocks))
+    return tuple(c.reshape(-1)[:n_bars].astype(f32) for c in chunks)
 
 
 _gen_core = functools.partial(
@@ -181,9 +217,8 @@ def generate(base: data_mod.OHLCV, params: ScenarioParams,
                          "(DBX_SCENARIO_MAX_BARS)")
     block = max(int(params.block), 1)
     regimes = max(int(params.regimes), 1)
-    key = jax.random.fold_in(
-        jax.random.PRNGKey(seed & 0x7FFFFFFF),
-        (seed >> 31) & 0x7FFFFFFF)
+    lo, hi = seed_words(seed)
+    key = jax.random.fold_in(jax.random.PRNGKey(lo), hi)
     fields = _gen_core(
         *(jnp.asarray(np.asarray(f), jnp.float32) for f in base),
         jnp.float32(params.vol_scale), jnp.float32(params.shock), key,

@@ -307,6 +307,10 @@ def test_digest_cones_certified_deterministic():
     assert not synth.nondet
     assert all(rec["class"] != "nondet"
                for rec in synth.outputs.values())
+    fused_row = rows["digest/scenario_fused"]
+    assert not fused_row.nondet
+    assert all(rec["class"] != "nondet"
+               for rec in fused_row.outputs.values())
     splice = rows["digest/splice"]
     assert all(rec["class"] == "exact" and rec["boundaries"] == 0
                for rec in splice.outputs.values())
@@ -343,7 +347,7 @@ def test_run_certify_exit_codes(monkeypatch, tmp_path):
     """0 clean / 1 findings / 2 table drift — the documented contract."""
     clean = certify.run_certify()
     assert certify.exit_code(clean) == 0
-    assert clean["rows"] == 58
+    assert clean["rows"] == 59
 
     # Drift: a doctored committed table (one boundary count off).
     doctored = copy.deepcopy(certify.load_contract())
@@ -433,6 +437,6 @@ def test_cli_certify_json_shape(capsys, monkeypatch):
     rc = c.main(["--format", "json"])
     out = json.loads(capsys.readouterr().out)
     assert rc == 0
-    assert out["rows"] == 58
+    assert out["rows"] == 59
     assert out["drift"] == [] and out["findings"] == []
     assert out["contract"].endswith("numerics.contract.json")

@@ -1,0 +1,377 @@
+"""Scenario megakernel (round 18): fused in-trace generation A/B'd
+against the materialized ladder.
+
+The tentpole claims under test:
+
+- **Bit-match**: a ``fused_scenario_sweep`` row equals the dense fused
+  sweep over the host-materialized panel of the same spec — selection
+  class exact, moment sums within the committed association budget
+  (``test_paged``'s rtol=2e-5/atol=2e-6).
+- **Coalescing**: a capability-declaring poll turns K eligible scenario
+  records into ONE carrier JobSpec with a K-member ``scenario_batch``
+  carrying per-record ids and EFFECTIVE seeds; each member completes
+  individually through the existing CompleteJobs path.
+- **Degradation ladder**: an old-capability worker, the
+  ``DBX_SCENARIO_FUSED=0`` kill switch, and a worker-side fused-launch
+  failure all fall back to the materialized path — never a failed job —
+  and the materialized rungs produce bit-identical result bytes. A
+  dispatcher restart (journal replay) re-coalesces the same specs.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_backtesting_exploration_tpu import scenarios as scn
+from distributed_backtesting_exploration_tpu.models.base import (
+    get_strategy)
+from distributed_backtesting_exploration_tpu.ops import fused
+from distributed_backtesting_exploration_tpu.parallel import sweep
+from distributed_backtesting_exploration_tpu.rpc import (
+    backtesting_pb2 as pb, compute, service, wire)
+from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+    Dispatcher, DispatcherServer, JobQueue, JobRecord, PeerRegistry,
+    parse_grid, scenario_jobs)
+from distributed_backtesting_exploration_tpu.rpc.journal import Journal
+from distributed_backtesting_exploration_tpu.rpc.panel_store import (
+    panel_digest)
+from distributed_backtesting_exploration_tpu.rpc.worker import Worker
+from distributed_backtesting_exploration_tpu.utils import data as data_mod
+
+# The committed association budget (test_paged): selection-class fields
+# stay exact; accumulated moments may differ by reduction order when a
+# fallback rung routes through a different kernel association.
+RTOL, ATOL = 2e-5, 2e-6
+
+GRID = parse_grid("fast=3:5,slow=10:14:2")
+PARAMS = {"n_bars": 64, "block": 8, "regimes": 2, "vol_scale": 1.5,
+          "shock": 0.01}
+
+
+def _base_blob(bars: int = 96) -> bytes:
+    s = data_mod.synthetic_ohlcv(1, bars, seed=42)
+    return data_mod.to_wire_bytes(
+        type(s)(*(np.asarray(f[0]) for f in s)))
+
+
+def _wait(pred, timeout=120.0, msg="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# Wire seed scheme
+# ---------------------------------------------------------------------------
+
+def test_seed_to_int64_wire_roundtrip():
+    """Effective seeds are unsigned 64-bit; ScenarioSpec.seed is signed
+    int64. The two's-complement wrap must roundtrip the proto and leave
+    seed_words — the only thing the generator consumes — unchanged."""
+    for s in (0, 1, (1 << 63) - 1, 1 << 63, (1 << 64) - 1,
+              11734379837973679516):
+        w = scn.seed_to_int64(s)
+        assert -(1 << 63) <= w < (1 << 63)
+        echo = pb.ScenarioSpec.FromString(
+            pb.ScenarioSpec(seed=w).SerializeToString()).seed
+        assert echo == w
+        assert scn.seed_words(echo) == scn.seed_words(s)
+
+
+# ---------------------------------------------------------------------------
+# Numerics: fused row == dense sweep over the host-materialized panel
+# ---------------------------------------------------------------------------
+
+def test_fused_sweep_cross_pins_materialized_dense():
+    """Row k of the megakernel launch matches the dense fused sweep over
+    the panel ``scenario_panel_bytes`` materializes for spec k — the
+    in-trace generator and the host generator are ONE program
+    (synth._gen_impl), so the match is by construction, not tolerance."""
+    blob = _base_blob(160)
+    base_d = panel_digest(blob)
+    base = data_mod.from_wire_bytes(blob)
+    specs = [scn.ScenarioParams(n_bars=96, block=8, regimes=3,
+                                vol_scale=vs, shock=sh, seed=i)
+             for i, (vs, sh) in enumerate(
+                 [(1.5, 0.0), (2.0, 0.02), (1.2, 0.05), (3.0, 0.0)])]
+    effs = [scn.scenario_seed(base_d, p) for p in specs]
+    words = [scn.seed_words(e) for e in effs]
+    pgrid = {k: np.asarray(v, np.float32) for k, v in
+             sweep.product_grid(fast=GRID["fast"],
+                                slow=GRID["slow"]).items()}
+    base_cols = {f: np.asarray(getattr(base, f), np.float32)
+                 for f in ("open", "high", "low", "close", "volume")}
+    m_fused = fused.fused_scenario_sweep(
+        "sma_crossover", base_cols,
+        np.asarray([w[0] for w in words], np.int32),
+        np.asarray([w[1] for w in words], np.int32),
+        np.asarray([p.vol_scale for p in specs], np.float32),
+        np.asarray([p.shock for p in specs], np.float32),
+        pgrid, n_bars=96, block=8, regimes=3, interpret=True)
+
+    fields, _, call = fused._PAGED_FAMILIES["sma_crossover"]
+    epi = fused._resolve_epilogue(None)
+    for k, p in enumerate(specs):
+        panel = data_mod.from_wire_bytes(scn.scenario_panel_bytes(blob, p))
+        arrays = [np.asarray(getattr(panel, f), np.float32)[None, :]
+                  for f in fields]
+        m_dense = call(arrays, pgrid, t_real=None, cost=0.0,
+                       periods_per_year=252, interpret=True, epilogue=epi)
+        for name in m_fused._fields:
+            got = np.asarray(getattr(m_fused, name))[k]
+            want = np.asarray(getattr(m_dense, name))[0]
+            if name == "n_trades":   # selection class: exact, always
+                assert np.array_equal(got, want), name
+            else:
+                np.testing.assert_allclose(got, want, rtol=RTOL,
+                                           atol=ATOL, err_msg=name)
+
+
+# ---------------------------------------------------------------------------
+# Dispatch-time coalescing over the real wire
+# ---------------------------------------------------------------------------
+
+def _scn_queue(k: int = 3, journal: Journal | None = None):
+    """Queue holding one base job + ``k`` scenario records; returns
+    (queue, base blob, base digest, scenario record ids, base id)."""
+    blob = _base_blob()
+    queue = JobQueue(journal)
+    base_rec = JobRecord(id="base", strategy="sma_crossover", grid=GRID,
+                         ohlcv=blob)
+    queue.enqueue(base_rec)
+    sids = []
+    for rec in scenario_jobs(base_rec.panel_digest, k, "sma_crossover",
+                             GRID, params=PARAMS):
+        queue.enqueue(rec)
+        sids.append(rec.id)
+    return queue, blob, base_rec.panel_digest, sids, base_rec.id
+
+
+def _stub(srv):
+    import grpc
+    channel = grpc.insecure_channel(
+        f"localhost:{srv.port}", options=service.default_channel_options())
+    return service.DispatcherStub(channel), channel
+
+
+def test_dispatcher_coalesces_spec_batch():
+    """A capability-declaring poll gets ONE carrier JobSpec for the K
+    coalescable scenario records: base payload only, per-member record
+    ids, and the EFFECTIVE seed (scenario_seed of host-precision params,
+    int64-wrapped) — and completing the member ids drains the queue."""
+    queue, blob, base_d, sids, base_id = _scn_queue(3)
+    disp = Dispatcher(queue, PeerRegistry(prune_window_s=30.0))
+    srv = DispatcherServer(disp, bind="localhost:0",
+                           prune_interval_s=5.0).start()
+    try:
+        stub, channel = _stub(srv)
+        reply = stub.RequestJobs(pb.JobsRequest(
+            worker_id="w", chips=1, jobs_per_chip=8,
+            accepts_scenario_batch=True))
+        carriers = [j for j in reply.jobs if j.scenario_batch]
+        plain = [j for j in reply.jobs if not j.scenario_batch]
+        assert len(carriers) == 1 and [j.id for j in plain] == [base_id]
+        car = carriers[0]
+        assert car.panel_digest == base_d
+        assert car.panel_bytes_len == len(blob)
+        assert not car.HasField("scenario"), \
+            "carrier is a batch, not a single materialized scenario"
+        assert [m.id for m in car.scenario_batch] == sids
+        for i, m in enumerate(car.scenario_batch):
+            assert m.base_digest == base_d
+            assert m.trace_id, "per-member trace for obs stitching"
+            want = scn.scenario_seed(
+                base_d, scn.ScenarioParams(**{**PARAMS, "seed": i}))
+            assert m.seed == scn.seed_to_int64(want)
+            assert scn.seed_words(m.seed) == scn.seed_words(want)
+        crep = stub.CompleteJobs(pb.CompleteBatch(
+            worker_id="w",
+            items=[pb.CompleteItem(id=i) for i in [base_id] + sids]))
+        assert crep.accepted == 4
+        channel.close()
+    finally:
+        srv.stop()
+    assert queue.drained and queue.stats()["jobs_failed"] == 0
+
+
+@pytest.mark.parametrize("declare,killswitch", [(False, False),
+                                                (True, True)])
+def test_coalescing_falls_back_materialized(declare, killswitch,
+                                            monkeypatch):
+    """Both de-escalation knobs — an old worker that never declares the
+    capability, and DBX_SCENARIO_FUSED=0 with a new worker — keep every
+    scenario record on the materialized rung: individually dispatched
+    specs with a concrete panel digest, no scenario_batch anywhere."""
+    if killswitch:
+        monkeypatch.setenv("DBX_SCENARIO_FUSED", "0")
+    queue, blob, base_d, sids, base_id = _scn_queue(3)
+    disp = Dispatcher(queue, PeerRegistry(prune_window_s=30.0))
+    srv = DispatcherServer(disp, bind="localhost:0",
+                           prune_interval_s=5.0).start()
+    try:
+        stub, channel = _stub(srv)
+        reply = stub.RequestJobs(pb.JobsRequest(
+            worker_id="w", chips=1, jobs_per_chip=8,
+            accepts_scenario_batch=declare))
+        assert len(reply.jobs) == 4
+        assert all(not j.scenario_batch for j in reply.jobs)
+        scn_specs = {j.id: j for j in reply.jobs if j.id != base_id}
+        assert set(scn_specs) == set(sids)
+        for j in scn_specs.values():
+            assert j.HasField("scenario")
+            assert j.panel_digest and j.panel_digest != base_d, \
+                "materialized rung stamps the SCENARIO panel's digest"
+        channel.close()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# End-to-end degradation ladder with the real JAX worker
+# ---------------------------------------------------------------------------
+
+class _OldCapabilityBackend(compute.JaxSweepBackend):
+    """A pre-round-18 worker: never declares accepts_scenario_batch."""
+
+    accepts_scenario_batch = False
+
+
+def _drain_ladder_rung(monkeypatch, *, k=3, fused_env="1",
+                       backend_cls=compute.JaxSweepBackend,
+                       queue=None):
+    """Drain base + k scenario jobs through a loopback dispatcher and a
+    real JAX worker on one ladder rung; returns {record id: result
+    bytes} plus the queue stats."""
+    monkeypatch.setenv("DBX_SCENARIO_FUSED", fused_env)
+    try:
+        sids = None
+        if queue is None:
+            queue, _, _, sids, _ = _scn_queue(k)
+        disp = Dispatcher(queue, PeerRegistry(prune_window_s=30.0))
+        srv = DispatcherServer(disp, bind="localhost:0",
+                               prune_interval_s=5.0).start()
+        worker = Worker(f"localhost:{srv.port}", backend_cls(),
+                        worker_id="ladder", poll_interval_s=0.01,
+                        status_interval_s=0.5, jobs_per_chip=k + 1)
+        wt = threading.Thread(target=worker.run, daemon=True)
+        try:
+            wt.start()
+            _wait(lambda: queue.drained, msg="ladder rung drained")
+        finally:
+            worker.stop()
+            wt.join(timeout=30)
+            srv.stop()
+        stats = queue.stats()
+        # Ordered per-seed scenario results: rung-to-rung comparison must
+        # key on the SPEC (seed order), not the per-queue uuid ids.
+        ordered = ([disp.results[i] for i in sids] if sids is not None
+                   else None)
+        return dict(disp.results), stats, ordered
+    finally:
+        monkeypatch.delenv("DBX_SCENARIO_FUSED", raising=False)
+
+
+def test_degradation_ladder_never_a_failed_job(monkeypatch, tmp_path):
+    """The acceptance ladder, e2e: fused route, kill switch, and an
+    old-capability worker each drain the SAME sweep with zero failed
+    jobs; the two materialized rungs produce bit-identical result bytes
+    and the fused rung stays within the association budget; a journal
+    replay (dispatcher restart) re-coalesces and completes again."""
+    k = 3
+    _, st, by_seed_fused = _drain_ladder_rung(monkeypatch, k=k,
+                                              fused_env="1")
+    assert st["jobs_failed"] == 0 and st["jobs_completed"] == k + 1
+
+    _, st_kill, by_seed_kill = _drain_ladder_rung(monkeypatch, k=k,
+                                                  fused_env="0")
+    assert st_kill["jobs_failed"] == 0
+    _, st_old, by_seed_old = _drain_ladder_rung(
+        monkeypatch, k=k, fused_env="1",
+        backend_cls=_OldCapabilityBackend)
+    assert st_old["jobs_failed"] == 0
+
+    for i in range(k):
+        # Materialized rungs: IDENTICAL code path -> identical bytes.
+        assert by_seed_kill[i] == by_seed_old[i]
+        m_f = wire.metrics_from_bytes(by_seed_fused[i])
+        m_m = wire.metrics_from_bytes(by_seed_kill[i])
+        for name in m_f._fields:
+            np.testing.assert_allclose(
+                np.asarray(getattr(m_f, name)),
+                np.asarray(getattr(m_m, name)), rtol=RTOL, atol=ATOL,
+                err_msg=f"seed {i}:{name}")
+
+    # Dispatcher restart: journal a fresh copy of the sweep, "crash"
+    # before any take, replay it into a new queue, and drain fused —
+    # the replayed records re-coalesce to bit-identical result bytes
+    # (ids are fresh uuids, so compare the result multiset).
+    jpath = str(tmp_path / "journal.jsonl")
+    _scn_queue(k, Journal(jpath))      # journaled, never taken: "crash"
+    queue2 = JobQueue()
+    assert queue2.restore(jpath) == k + 1
+    res_replay, st_replay, _ = _drain_ladder_rung(monkeypatch, k=k,
+                                                  fused_env="1",
+                                                  queue=queue2)
+    assert st_replay["jobs_failed"] == 0
+    assert st_replay["jobs_completed"] == k + 1
+    assert sorted(v for i, v in res_replay.items() if i != "base") \
+        == sorted(by_seed_fused), \
+        "restart re-derives bit-identical fused results"
+
+
+# ---------------------------------------------------------------------------
+# Worker-side fallback when the fused launch itself fails
+# ---------------------------------------------------------------------------
+
+def test_backend_falls_back_materialized_on_fused_failure(monkeypatch):
+    """A fused-launch failure (simulated compile blowup) must complete
+    every spec through the in-process materialized fallback — never a
+    failed job — with results matching the dense twin exactly (same
+    dense kernel, host-generated panel)."""
+    blob = _base_blob()
+    base_d = panel_digest(blob)
+
+    def boom(*a, **kw):
+        raise RuntimeError("simulated fused-launch failure")
+
+    monkeypatch.setattr(fused, "fused_scenario_sweep", boom)
+    job = pb.JobSpec(id="carrier", strategy="sma_crossover", ohlcv=blob,
+                     grid=wire.grid_to_proto(GRID), cost=0.0,
+                     periods_per_year=252, panel_digest=base_d,
+                     panel_bytes_len=len(blob))
+    effs = []
+    for i in range(2):
+        p = scn.ScenarioParams(**{**PARAMS, "seed": i})
+        eff = scn.scenario_seed(base_d, p)
+        effs.append(eff)
+        job.scenario_batch.add(
+            base_digest=base_d, n_bars=p.n_bars, block=p.block,
+            regimes=p.regimes, vol_scale=p.vol_scale, shock=p.shock,
+            seed=scn.seed_to_int64(eff), id=f"s{i}", trace_id="")
+    backend = compute.JaxSweepBackend()
+    out = backend.collect(backend.submit([job]))
+    got = {c.job_id: c.metrics for c in out}
+    assert set(got) == {"s0", "s1"}
+    base = data_mod.from_wire_bytes(blob)
+    for i in range(2):
+        assert got[f"s{i}"], "fallback completes with a real result"
+        m = wire.metrics_from_bytes(got[f"s{i}"])
+        panel = scn.generate(base,
+                             scn.ScenarioParams(**{**PARAMS, "seed": i}),
+                             effs[i])
+        direct = sweep.jit_sweep(
+            type(base)(*(np.asarray(f)[None, :] for f in panel)),
+            get_strategy("sma_crossover"),
+            {kk: np.asarray(vv, np.float32) for kk, vv in
+             sweep.product_grid(fast=GRID["fast"],
+                                slow=GRID["slow"]).items()})
+        for name in m._fields:
+            np.testing.assert_allclose(
+                np.asarray(getattr(m, name)),
+                np.asarray(getattr(direct, name))[0], rtol=RTOL,
+                atol=ATOL, err_msg=name)

@@ -227,14 +227,15 @@ def certify_bench():
 def test_certify_wall_keys_present(certify_bench):
     """The certifier's analysis cost rides BENCH JSON like every other
     stage: certify_wall_s per family + the digest cones, and a rows
-    count matching families x substrates x forms + 2 digest cones."""
+    count matching families x substrates x forms + 3 digest cones
+    (scenario_synth, scenario_fused, splice)."""
     cf = certify_bench["roofline"]["certify"]
     for key in ("certify_wall_s", "rows", "wall_s_total"):
         assert key in cf, key
     walls = cf["certify_wall_s"]
     assert set(walls) == {"sma_crossover", "bollinger", "digest"}
     assert all(w > 0.0 for w in walls.values())
-    assert cf["rows"] == 2 * 4 + 2
+    assert cf["rows"] == 2 * 4 + 3
     assert cf["wall_s_total"] > 0.0
     assert certify_bench["configs"]["certify"] > 0.0
 
@@ -406,6 +407,73 @@ def test_scenario_sweep_keys_present(tenant_bench):
     assert sc["jobs_per_s_e2e"] > 0.0
     assert sc["spec_bytes"] < sc["panel_bytes"]
     assert tenant_bench["configs"]["scenario_sweep"] > 0.0
+
+
+_MEGAKERNEL_ENV = {
+    "DBX_BENCH_CPU": "1", "DBX_BENCH_CACHE": "",
+    "DBX_BENCH_CONFIGS": "scenario_megakernel",
+    # Tiny-but-real fused-vs-materialized A/B drains (loopback gRPC,
+    # real JAX worker) — structure smoke; the 10x throughput bar is
+    # asserted on the real-size run, not here. The store-bytes-flat-in-K
+    # invariant IS structural and holds at any scale.
+    "DBX_BENCH_MEGAKERNEL_BARS": "96", "DBX_BENCH_MEGAKERNEL_K": "4",
+}
+
+
+@pytest.fixture(scope="module")
+def megakernel_bench():
+    """One tiny in-process scenario_megakernel A/B run, shared by the
+    module."""
+    prior = {k: os.environ.get(k) for k in _MEGAKERNEL_ENV}
+    os.environ.update(_MEGAKERNEL_ENV)
+    bench.ROOFLINE.clear()
+    buf = io.StringIO()
+    try:
+        with contextlib.redirect_stdout(buf):
+            bench.main()
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return json.loads(buf.getvalue().strip().splitlines()[-1])
+
+
+def test_scenario_megakernel_keys_present(megakernel_bench):
+    """The round-18 acceptance numbers ride these BENCH JSON keys (the
+    fused-vs-materialized scenarios/s ratio and the store-bytes-vs-K
+    curve) — a renamed key would silently invalidate the next round's
+    measurement."""
+    mk = megakernel_bench["roofline"]["scenario_megakernel"]
+    for key in ("scenarios", "bars", "combos", "fused_scn_per_s",
+                "materialized_scn_per_s", "speedup",
+                "store_bytes_by_k_fused", "store_bytes_by_k_materialized",
+                "store_bytes_flat_in_k"):
+        assert key in mk, key
+    assert mk["fused_scn_per_s"] > 0.0
+    assert mk["materialized_scn_per_s"] > 0.0
+    assert megakernel_bench["configs"]["scenario_megakernel"] > 0.0
+
+
+def test_scenario_megakernel_store_bytes_flat_in_k(megakernel_bench):
+    """Device/store residency is O(1) in K on the fused route: every
+    curve point holds exactly the base panel (1 entry, same byte count),
+    while the materialized route's store grows with K — the structural
+    half of the megakernel claim, true at any scale."""
+    mk = megakernel_bench["roofline"]["scenario_megakernel"]
+    fused = mk["store_bytes_by_k_fused"]
+    mat = mk["store_bytes_by_k_materialized"]
+    assert len(fused) >= 2 and len(mat) >= 2
+    assert mk["store_bytes_flat_in_k"] is True
+    assert len({p["store_bytes"] for p in fused}) == 1
+    assert all(p["store_panels"] == 1 for p in fused)
+    # Materialized stores base + K scenario panels: strictly growing.
+    ks = [p["k"] for p in mat]
+    assert all(p["store_panels"] == p["k"] + 1 for p in mat)
+    bytes_by_k = [p["store_bytes"] for p in mat]
+    assert bytes_by_k == sorted(bytes_by_k) and ks == sorted(ks)
+    assert bytes_by_k[-1] > fused[-1]["store_bytes"]
 
 
 _RAGGED_ENV = {
