@@ -501,7 +501,13 @@ class ObsCardinalityRule:
         # subjects — all unbounded; metric labels must go through
         # trigger_bucket (or stay label-free).
         r"bundle|bundles|trigger|triggers|incident|incidents|subject|"
-        r"subjects)(?:$|_)")
+        r"subjects|"
+        # Decision-plane record fields (round 19): candidate/actual
+        # worker ids and per-decision regret are unbounded runtime data
+        # (worker-chosen wire strings; a float per decision) — metric
+        # labels must ride the bounded route/outcome vocabularies or
+        # worker_bucket, with the raw ids in the decision record itself.
+        r"candidate|candidates|worker|workers|regret)(?:$|_)")
 
     def check(self, ctx: LintContext) -> list[Finding]:
         out: list[Finding] = []

@@ -1,0 +1,719 @@
+"""Dispatch decision plane: per-take explainability + shadow placement.
+
+Every ``JobQueue.take()`` resolution is a layered placement decision —
+a WFQ virtual-time pick (PR 8), possibly an affinity deferral (PR 6), a
+payload route (digest-only / full / delta, PR 5/6; scenario-coalesced,
+PR 18) — and none of it was observable: "why did job J land on worker W,
+and what would it have cost elsewhere?" had no answer. This module is
+that answer, built to the flight-recorder posture (obs/flight.py):
+
+- the dispatcher hands :meth:`DecisionPlane.submit` one small tuple per
+  dispatched job (the record object plus the four values only the
+  dispatch loop knows — no dict assembly, no snapshot, no model math on
+  the take path), a single small-lock deque append per poll; the
+  scoring budget (``DBX_DECISIONS_RATE``) is spent right there, and
+  :meth:`DecisionPlane.want` lets an over-budget poll skip explain
+  assembly and the submit entirely — past the budget the hot path is
+  byte-identical to the kill-switch path;
+- a daemon thread scores each batch against ONE ``FleetView.snapshot()``:
+  for every live worker it estimates the job's stage cost from the op
+  model ``obs/costmodel.py`` and ``bench.py`` already share — execute
+  wall from model units x a per-worker seconds-per-unit EWMA (calibrated
+  by completions), **carry-hit vs reprice** (an append job on a worker
+  whose top-K digest sketch holds the base panel pays only the delta
+  fraction), **page residency vs h2d** (payload bytes over a nominal
+  link rate unless the panel digest is resident), **compile-cache hit
+  vs cold wall** (first sighting of a strategy family on a worker pays
+  the cold-compile constant);
+- ``regret = cost(actual) − cost(best_shadow)`` is recorded per decision
+  (>= 0 — the actual worker is always a candidate) WITHOUT ever
+  influencing dispatch: this is ROADMAP item 2 run in shadow mode, the
+  measure-before-commit discipline the locality scorer will be held to.
+
+Storage follows the span-ring discipline (obs/trace.py): a bounded
+in-memory ring (``DBX_DECISIONS_RING``, default 256) serves
+``/decisions.json`` and ``dbxwhy``'s live path, and each record also
+lands in the opt-in JSONL event log (``DBX_OBS_JSONL``) as an
+``ev="decision"`` line beside the spans it explains — one file,
+``dbxwhy`` stitches both. Metrics stay bounded:
+``dbx_dispatch_regret_seconds`` (no labels),
+``dbx_decisions_total{route=...}`` over the fixed route vocabulary, and
+agree/disagree shadow counters. Sustained high regret (EWMA past
+``DBX_DECISIONS_REGRET_S`` for ``DBX_DECISIONS_REGRET_N`` consecutive
+scored decisions) fires the flight recorder's ``regret`` trigger — a
+fleet that keeps paying for placement is an incident, not a number.
+
+``DBX_DECISIONS=0`` is the kill switch: the dispatcher stops building
+raw dicts entirely (checked per RequestJobs, before any work).
+Everything degrades to counting — a scoring error, an empty fleet, a
+full queue, a dispatch rate past the ``DBX_DECISIONS_RATE`` scoring
+budget — never a failed or delayed job.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import os
+import threading
+import time
+
+from . import costmodel, events
+from .registry import get_registry, histogram_quantile
+
+#: Payload-route vocabulary (bounded — metric label + record field).
+#: ``held`` marks affinity-held jobs served outside the WFQ pop;
+#: anything else folds to ``other``.
+ROUTES = ("digest_only", "full", "delta", "scenario", "held")
+
+#: Regret histogram bounds in seconds (one-sided latency-style; the
+#: last bucket is +inf overflow). Finer than LATENCY_BUCKETS_S at the
+#: low end — placement regret on a warm fleet is mostly milliseconds.
+REGRET_BUCKETS_S = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5,
+                    1.0, 2.5, 5.0, 10.0)
+
+_SPU_ALPHA = 0.2      # per-worker seconds-per-model-unit EWMA
+_REGRET_ALPHA = 0.25  # regret EWMA feeding the sustained-regret trigger
+_DEFAULT_SPU = 1e-8   # pre-calibration seconds-per-unit (relative
+#                       ranking only needs a shared starting point)
+
+
+def route_bucket(route: str) -> str:
+    """Bounded bucket for a payload route: one of ``ROUTES`` or
+    ``"other"`` (the ``trigger_bucket`` discipline)."""
+    return route if route in ROUTES else "other"
+
+
+def enabled() -> bool:
+    """``DBX_DECISIONS`` (default on): record dispatch decisions.
+    ``0`` is the kill switch — the dispatcher skips record assembly
+    entirely."""
+    return os.environ.get("DBX_DECISIONS", "1").lower() not in (
+        "0", "off", "false")
+
+
+def ring_capacity() -> int:
+    """``DBX_DECISIONS_RING`` (default 256): decision records retained
+    in memory for ``/decisions.json`` and ``dbxwhy``."""
+    try:
+        return max(int(os.environ.get("DBX_DECISIONS_RING", 256)), 1)
+    except ValueError:
+        return 256
+
+
+def h2d_rate_bps() -> float:
+    """``DBX_DECISIONS_H2D_GBPS`` (default 2.0): nominal payload
+    transfer rate used to price a non-resident panel's host-to-device
+    (and wire) leg in the shadow score."""
+    try:
+        gbps = float(os.environ.get("DBX_DECISIONS_H2D_GBPS", 2.0))
+    except ValueError:
+        gbps = 2.0
+    return max(gbps, 1e-3) * 1e9
+
+
+def compile_wall_s() -> float:
+    """``DBX_DECISIONS_COMPILE_S`` (default 0.531, the measured cold
+    fused-sweep compile from DESIGN.md): cost charged when a strategy
+    family has never been seen on a candidate worker."""
+    try:
+        return max(float(os.environ.get("DBX_DECISIONS_COMPILE_S",
+                                        0.531)), 0.0)
+    except ValueError:
+        return 0.531
+
+
+def score_rate() -> float:
+    """``DBX_DECISIONS_RATE`` (default 50): scored decision records per
+    second (token bucket, burst = one second of budget, floor 32).
+    Scoring is pure-Python work on the
+    plane's thread, and on a saturated small-core box an unbounded
+    scorer would steal GIL time from the serving loop in proportion to
+    the dispatch rate — so beyond the budget records degrade to a
+    ``throttled`` counter (the flight posture: telemetry samples, it
+    never taxes the fleet). ``0`` or negative disables the throttle
+    (score everything — fine off the hot path on a multi-core box)."""
+    try:
+        return float(os.environ.get("DBX_DECISIONS_RATE", 50.0))
+    except ValueError:
+        return 50.0
+
+
+def regret_bar_s() -> float:
+    """``DBX_DECISIONS_REGRET_S`` (default 1.0): regret EWMA (seconds)
+    past which the sustained-regret flight trigger arms."""
+    try:
+        return float(os.environ.get("DBX_DECISIONS_REGRET_S", 1.0))
+    except ValueError:
+        return 1.0
+
+
+def regret_window() -> int:
+    """``DBX_DECISIONS_REGRET_N`` (default 32): consecutive scored
+    decisions the regret EWMA must stay past the bar before the flight
+    trigger fires (one noisy decision is not an incident)."""
+    try:
+        return max(int(os.environ.get("DBX_DECISIONS_REGRET_N", 32)), 1)
+    except ValueError:
+        return 32
+
+
+class DecisionPlane:
+    """Per-dispatcher decision recorder + shadow placement scorer.
+
+    Construction wires nothing global: the owning ``Dispatcher`` passes
+    its ``FleetView`` and closes the plane in its own ``close()``. The
+    scoring thread starts lazily on the first submit (the flight
+    recorder's ``_ensure_thread`` discipline)."""
+
+    QUEUE_MAX = 64        # pending decision batches; beyond this they drop
+    _COMPLETIONS_MAX = 4096   # pending calibration obs (one per job)
+    _SPU_MAX = 256        # per-worker calibration entries (hostile ids)
+    _FAM_MAX = 64         # families remembered per worker
+    _PENDING_UNITS_MAX = 2048   # jid -> units awaiting completion
+
+    def __init__(self, *, fleet=None, registry=None,
+                 clock=time.monotonic):
+        self._fleet = fleet
+        self._reg = registry or get_registry()
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._pending: collections.deque = collections.deque()
+        # Completion side lane: appended without waking the thread (the
+        # serving loop completes one job per call; per-job wakeups are a
+        # GIL tax on a small-core box), drained whenever the score queue
+        # goes idle or on the 5s housekeeping tick.
+        self._completions: collections.deque = collections.deque()
+        self._ring: collections.deque = collections.deque(
+            maxlen=ring_capacity())
+        self._wake = threading.Event()
+        self._thread = None
+        self._scoring = False
+        self._closed = False
+        # wid -> [n_obs, ewma seconds-per-model-unit]; completions feed
+        # it (observe_completion), the shadow score reads it.
+        self._spu: dict[str, list] = {}
+        self._spu_global = [0, _DEFAULT_SPU]
+        # wid -> set of strategy families completed there (compile-cache
+        # hit proxy: first sighting pays the cold wall).
+        self._fams: dict[str, set] = {}
+        # jid -> (wid, family, model units) parked at scoring time so a
+        # later completion can calibrate spu without re-deriving units.
+        self._units_pending: "collections.OrderedDict" = \
+            collections.OrderedDict()
+        # Scoring-budget token bucket (score_rate): scoring-thread-only
+        # state, no lock. Starts full (burst) so tests/short bursts are
+        # never sampled.
+        self._rate = score_rate()
+        self._burst = max(self._rate, 32.0)
+        self._tokens = self._burst
+        self._t_refill = clock()
+        # (family, bars, combos) -> model units memo: the op-model walk
+        # is ~1/3 of a record's scoring cost and fleets dispatch long
+        # runs of identically-shaped jobs. Scoring-thread-only, bounded.
+        self._units_memo: dict[tuple, float] = {}
+        self._n_scored = 0
+        self._regret_sum = 0.0
+        self._regret_ewma = 0.0
+        self._regret_buckets = [0] * (len(REGRET_BUCKETS_S) + 1)
+        self._hot_streak = 0
+        self._agree = 0
+        self._disagree = 0
+        self._h_regret = self._reg.histogram(
+            "dbx_dispatch_regret_seconds",
+            help="shadow placement regret per dispatch decision: "
+                 "cost(actual worker) - cost(best shadow candidate)",
+            buckets=REGRET_BUCKETS_S)
+        self._c_routes = {
+            r: self._reg.counter(
+                "dbx_decisions_total",
+                help="dispatch decisions recorded, by payload route",
+                route=r)
+            for r in ROUTES + ("other",)}
+        self._c_shadow = {
+            o: self._reg.counter(
+                "dbx_decisions_shadow_total",
+                help="shadow scorer outcomes: did the actual placement "
+                     "match the scorer's pick?",
+                outcome=o)
+            for o in ("agree", "disagree", "no_candidates")}
+        self._c_dropped = {
+            r: self._reg.counter(
+                "dbx_decisions_dropped_total",
+                help="decision batches/records not scored, by reason",
+                reason=r)
+            for r in ("queue_full", "closed", "error", "throttled")}
+
+    # -- hot-path surface (dispatcher's RequestJobs) -------------------
+
+    def want(self) -> bool:
+        """Should the dispatcher bother recording the NEXT take()?
+        True while the scoring budget (:func:`score_rate`) plausibly
+        has a token. Read-only and lock-free — tokens are spent by
+        :meth:`submit` on this same serving thread, so the estimate is
+        exact between submits and a racy read is at worst one poll
+        stale. This is the source-level throttle: an unarmed poll
+        skips explain assembly, record tuples, and the submit
+        entirely, so past the budget the hot path is byte-identical
+        to the kill-switch path."""
+        return (self._rate <= 0.0
+                or self._tokens + (self._clock() - self._t_refill)
+                * self._rate >= 1.0)
+
+    def submit(self, batch: list, *, worker: str = "",
+               t_take: float = 0.0) -> None:
+        """Queue one take()'s decision records for async scoring.
+        Items are either full raw dicts (tests, synthetic streams) or
+        the dispatcher's deferred 5-tuples ``(rec, route, digest,
+        panel_b, wfq)`` — the record object plus the four values only
+        the dispatch loop knows, with ``worker``/``t_take`` shared
+        batch-wide. Tuple items cost the hot path one small allocation;
+        the dict view is assembled on the scoring thread
+        (:meth:`_raw_of`). The scoring budget is spent HERE, under the
+        same lock the append needs anyway: records past the budget are
+        dropped as ``throttled`` before they cost a queue slot, and
+        the bucket state stays exact for :meth:`want`. Never raises,
+        never blocks beyond that one small-lock crossing — the
+        no-coordinator-on-the-hot-path bar applies verbatim."""
+        if not batch:
+            return
+        if self._rate > 0.0:
+            with self._lock:
+                now = self._clock()
+                self._tokens = min(
+                    self._burst,
+                    self._tokens + (now - self._t_refill) * self._rate)
+                self._t_refill = now
+                keep = min(len(batch), int(self._tokens))
+                self._tokens -= keep
+            if keep < len(batch):
+                self._c_dropped["throttled"].inc(len(batch) - keep)
+                if keep == 0:
+                    return
+                batch = batch[:keep]
+        self._enqueue(("score", (list(batch), str(worker),
+                                 float(t_take))), len(batch))
+
+    def observe_completion(self, worker_id: str, jid: str,
+                           elapsed_s: float) -> None:
+        """Calibrate the per-worker seconds-per-unit EWMA from a real
+        completion (measured end-to-end worker wall over the units the
+        scorer parked for this jid) and mark the job's strategy family
+        compile-warm on that worker. Completions ride a no-wake side
+        lane the thread drains only once the score queue is idle — so a
+        completion can never outrun its own decision's scoring, and the
+        (per-job!) completion path never thrashes the scoring thread
+        awake on a small-core box."""
+        if elapsed_s <= 0.0:
+            return
+        self.observe_completions([(worker_id, jid, elapsed_s)])
+
+    def observe_completions(self, batch: list[tuple]) -> None:
+        """Batch form of :meth:`observe_completion` — one lock crossing
+        for a whole CompleteJobs RPC's worth of ``(worker_id, jid,
+        elapsed_s)`` tuples."""
+        items = [(str(w), str(j), float(e)) for w, j, e in batch
+                 if e > 0.0]
+        if not items:
+            return
+        dropped = 0
+        with self._lock:
+            if self._closed:
+                dropped = len(items)
+            else:
+                room = self._COMPLETIONS_MAX - len(self._completions)
+                if room < len(items):
+                    dropped = len(items) - max(room, 0)
+                    items = items[:max(room, 0)]
+                if items:
+                    self._completions.extend(items)
+                    self._ensure_thread()
+        if dropped:
+            self._c_dropped["queue_full"].inc(dropped)
+
+    def _enqueue(self, item: tuple, weight: int) -> None:
+        # No wake: the thread's own _TICK_S poll picks the batch up.
+        # Event.set from the serving thread makes the scorer runnable
+        # mid-RPC, and on a small-core box the forced context switch
+        # costs the poll more than the whole record did; 50ms of
+        # scoring latency costs telemetry nothing.
+        drop = None
+        with self._lock:
+            if self._closed:
+                drop = "closed"
+            elif len(self._pending) >= self.QUEUE_MAX:
+                drop = "queue_full"
+            else:
+                self._pending.append(item)
+                self._ensure_thread()
+        if drop is not None:
+            self._c_dropped[drop].inc(weight)
+
+    def _calibrate(self, worker_id: str, jid: str,
+                   elapsed_s: float) -> None:
+        with self._lock:
+            hit = self._units_pending.pop(jid, None)
+            if hit is None:
+                return
+            _, family, units = hit
+            if units <= 0.0:
+                return
+            spu = elapsed_s / units
+            per_worker = self._spu.get(worker_id)
+            if per_worker is None:
+                if len(self._spu) < self._SPU_MAX:
+                    per_worker = self._spu[worker_id] = [
+                        0, self._spu_global[1]]
+                else:
+                    per_worker = self._spu_global  # hostile-id cap
+            cals = [per_worker]
+            if per_worker is not self._spu_global:
+                cals.append(self._spu_global)
+            for cal in cals:
+                n, ewma = cal
+                cal[0] = n + 1
+                cal[1] = spu if n == 0 else (
+                    _SPU_ALPHA * spu + (1.0 - _SPU_ALPHA) * ewma)
+            fams = self._fams.setdefault(worker_id, set())
+            if len(fams) < self._FAM_MAX:
+                fams.add(family)
+
+    # -- scoring thread ------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        # Called under self._lock.
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name="dbx-decisions", daemon=True)
+            self._thread.start()
+
+    _TICK_S = 0.05   # scoring-thread poll cadence (no hot-path wakes)
+
+    def _run(self) -> None:
+        while True:
+            self._wake.wait(timeout=self._TICK_S)
+            self._wake.clear()
+            while True:
+                completions = None
+                payload = None
+                with self._lock:
+                    if self._closed:
+                        return
+                    if self._pending:
+                        _op, payload = self._pending.popleft()
+                        self._scoring = True
+                    elif self._completions:
+                        # Score queue idle: every decision enqueued
+                        # before these completions has been scored (or
+                        # dropped), so calibration can't outrun it.
+                        completions = tuple(self._completions)
+                        self._completions.clear()
+                        self._scoring = True
+                    else:
+                        break
+                try:
+                    if payload is not None:
+                        self._score_batch(payload)
+                    else:
+                        # One lock to discard completions the scorer
+                        # never parked units for (throttled/unscored
+                        # jobs — most of them under load).
+                        with self._lock:
+                            completions = [
+                                c for c in completions
+                                if c[1] in self._units_pending]
+                        for comp in completions:
+                            self._calibrate(*comp)
+                except Exception:
+                    self._c_dropped["error"].inc()
+                finally:
+                    with self._lock:
+                        self._scoring = False
+
+    @staticmethod
+    def _raw_of(item, worker: str, t_take: float) -> dict:
+        """Dict view of one submitted item — a raw dict verbatim, or
+        the dispatcher's deferred ``(rec, route, digest, panel_b,
+        wfq)`` tuple expanded from the job record's own fields HERE,
+        on the scoring thread, so the take path never builds it."""
+        if isinstance(item, dict):
+            return dict(item)
+        rec, route, digest, panel_b, wfq = item
+        return {
+            "jid": rec.id, "trace_id": rec.trace_id,
+            "worker": worker, "tenant": rec.tenant,
+            "strategy": rec.strategy, "combos": float(rec.combos),
+            "affinity_skips": int(rec.affinity_skips),
+            "wfq": wfq, "digest": digest, "panel_b": int(panel_b),
+            "append_parent": rec.append_parent,
+            "base_len": int(rec.append_base_len),
+            "bars": int((rec.scenario or {}).get("n_bars", 0)),
+            "route": route, "t_take": t_take,
+        }
+
+    def _score_batch(self, payload) -> None:
+        # Throttling happened at submit(); everything queued is scored.
+        batch, worker, t_take = payload
+        snap = None   # (workers, spu_of, spu_default, fams) per batch
+        for item in batch:
+            if snap is None:
+                workers = {}
+                if self._fleet is not None:
+                    try:
+                        workers = self._fleet.snapshot().get("workers",
+                                                             {})
+                    except Exception:
+                        workers = {}
+                with self._lock:
+                    spu_of = {w: cal[1] for w, cal in self._spu.items()}
+                    spu_default = self._spu_global[1]
+                    fams = {w: set(f) for w, f in self._fams.items()}
+                snap = (workers, spu_of, spu_default, fams)
+            try:
+                rec = self._score_one(self._raw_of(item, worker, t_take),
+                                      *snap)
+            except Exception:
+                self._c_dropped["error"].inc()
+                continue
+            with self._lock:
+                self._ring.append(rec)
+            events.emit_record({"ev": "decision", **rec})
+
+    @staticmethod
+    def _resident(wentry: dict, digest: str) -> bool:
+        """Panel residency by the worker's top-K digest sketch (the
+        telemetry frame's ``caches.panel_topk`` 12-hex prefixes)."""
+        if not digest:
+            return False
+        topk = (wentry.get("caches") or {}).get("panel_topk") or ()
+        prefix = digest[:12]
+        return any(str(e.get("d", "")) == prefix for e in topk
+                   if isinstance(e, dict))
+
+    def _units_for(self, raw: dict) -> tuple[float, str]:
+        """Model units for this job via the shared op model; falls back
+        to raw cell-bars when the family is unmodelable. Bars not known
+        at dispatch are estimated from the full panel byte size (DBX1 ~
+        5 float64 columns => ~40 B/bar)."""
+        family = str(raw.get("strategy", ""))
+        combos = max(int(raw.get("combos", 0) or 0), 1)
+        bars = int(raw.get("bars", 0) or 0)
+        if bars <= 0:
+            bars = max(int(int(raw.get("panel_b", 0) or 0) / 40), 1)
+        key = (family, bars, combos)
+        units = self._units_memo.get(key)
+        if units is not None:
+            return units, family
+        try:
+            units = costmodel._model_units(family, bars, combos)
+        except Exception:
+            units = 0.0
+        if units <= 0.0 or not math.isfinite(units):
+            units = float(bars) * float(combos)
+        if len(self._units_memo) >= 512:    # shapes are wire-controlled
+            self._units_memo.clear()
+        self._units_memo[key] = units
+        return units, family
+
+    def _score_one(self, raw: dict, workers: dict, spu_of: dict,
+                   spu_default: float, fams: dict) -> dict:
+        actual = str(raw.get("worker", ""))
+        route = route_bucket(str(raw.get("route", "")))
+        self._c_routes[route].inc()
+        units, family = self._units_for(raw)
+        digest = str(raw.get("digest", ""))
+        base_digest = str(raw.get("append_parent", ""))
+        panel_b = int(raw.get("panel_b", 0) or 0)
+        # Delta fraction: the share of the sweep an append carry-hit
+        # still has to price (new bars over total). Unknown => 0.25.
+        frac = 1.0
+        if base_digest:
+            bars = int(raw.get("bars", 0) or 0)
+            base_len = int(raw.get("base_len", 0) or 0)
+            frac = ((bars - base_len) / bars
+                    if bars > base_len > 0 else 0.25)
+            frac = min(max(frac, 1e-3), 1.0)
+        rate = h2d_rate_bps()
+        cold = compile_wall_s()
+
+        def score(wid: str, wentry: dict) -> dict:
+            spu = spu_of.get(wid, spu_default)
+            exec_s = units * spu
+            carry_hit = False
+            if base_digest:
+                # Carry-hit vs reprice: ground truth for the actual
+                # worker (a delta route means the dispatcher verified
+                # the base is held); the digest sketch for shadows.
+                carry_hit = (wid == actual and route == "delta") or \
+                    self._resident(wentry, base_digest)
+                if carry_hit:
+                    exec_s *= frac
+            resident = (wid == actual and route in
+                        ("digest_only", "delta", "scenario")) or \
+                self._resident(wentry, digest) or carry_hit
+            transfer_s = 0.0 if resident else panel_b / rate
+            compile_s = 0.0 if family in fams.get(wid, ()) else cold
+            return {"cost_s": exec_s + transfer_s + compile_s,
+                    "exec_s": exec_s, "transfer_s": transfer_s,
+                    "compile_s": compile_s, "carry_hit": carry_hit,
+                    "resident": resident}
+
+        candidates = {wid: e for wid, e in workers.items()
+                      if not e.get("stale")}
+        if actual and actual not in candidates:
+            candidates[actual] = workers.get(actual, {})
+        scored = {wid: score(wid, e) for wid, e in
+                  sorted(candidates.items())}
+        shadow: dict = {"candidates": len(scored)}
+        regret = None
+        if scored:
+            actual_cost = scored.get(actual, {}).get("cost_s")
+            best = min(scored, key=lambda w: (scored[w]["cost_s"], w))
+            if actual_cost is not None and \
+                    actual_cost <= scored[best]["cost_s"]:
+                best = actual   # ties go to the placement that happened
+            shadow["best"] = best
+            shadow["best_cost_s"] = round(scored[best]["cost_s"], 9)
+            if actual_cost is not None:
+                regret = max(actual_cost - scored[best]["cost_s"], 0.0)
+                shadow["actual_cost_s"] = round(actual_cost, 9)
+                shadow["regret_s"] = round(regret, 9)
+                shadow["agree"] = best == actual
+            # Bounded per-candidate breakdown: cheapest 8, always
+            # including the actual worker.
+            keep = sorted(scored, key=lambda w: (scored[w]["cost_s"], w))
+            keep = list(dict.fromkeys(keep[:8] + [actual]))
+            shadow["costs"] = {
+                w: {k: (round(v, 9) if isinstance(v, float) else v)
+                    for k, v in scored[w].items()}
+                for w in keep if w in scored}
+        age = workers.get(actual, {}).get("age_s")
+        rec = {
+            "jid": str(raw.get("jid", "")),
+            "trace_id": str(raw.get("trace_id", "")),
+            "worker": actual,
+            "tenant": str(raw.get("tenant", "")),
+            "route": route,
+            "strategy": family,
+            "combos": int(raw.get("combos", 0) or 0),
+            "affinity_skips": int(raw.get("affinity_skips", 0) or 0),
+            "fleet_age_s": age,
+            "units": round(units, 3),
+            "shadow": shadow,
+            "t_take": float(raw.get("t_take", 0.0)),
+        }
+        wfq = raw.get("wfq")
+        if wfq is not None:
+            # take() hands back live PickExplain objects; serializing
+            # them (sort + round per pick) happens HERE, off the take
+            # path. held_explain entries are already plain dicts.
+            rec["wfq"] = (wfq.as_dict()
+                          if hasattr(wfq, "as_dict") else wfq)
+        self._account(rec, regret, family, units)
+        return rec
+
+    def _account(self, rec: dict, regret, family: str,
+                 units: float) -> None:
+        fire = None
+        with self._lock:
+            self._n_scored += 1
+            jid = rec["jid"]
+            if jid and units > 0.0:
+                while len(self._units_pending) >= self._PENDING_UNITS_MAX:
+                    self._units_pending.popitem(last=False)
+                self._units_pending[jid] = (rec["worker"], family, units)
+            if regret is None:
+                self._c_shadow["no_candidates"].inc()
+                return
+            if rec["shadow"].get("agree"):
+                self._agree += 1
+            else:
+                self._disagree += 1
+            self._regret_sum += regret
+            self._regret_ewma = (
+                regret if self._n_scored == 1 else
+                _REGRET_ALPHA * regret
+                + (1.0 - _REGRET_ALPHA) * self._regret_ewma)
+            i = 0
+            while (i < len(REGRET_BUCKETS_S)
+                   and regret > REGRET_BUCKETS_S[i]):
+                i += 1
+            self._regret_buckets[i] += 1
+            if self._regret_ewma > regret_bar_s():
+                self._hot_streak += 1
+                if self._hot_streak >= regret_window():
+                    fire = (rec["worker"], self._regret_ewma)
+                    self._hot_streak = 0
+            else:
+                self._hot_streak = 0
+        self._h_regret.observe(regret)
+        self._c_shadow["agree" if rec["shadow"].get("agree")
+                       else "disagree"].inc()
+        if fire is not None:
+            from . import flight
+
+            flight.trigger(
+                "regret", subject=fire[0],
+                regret_ewma_s=round(fire[1], 4),
+                window=regret_window(), bar_s=regret_bar_s())
+
+    # -- read surface --------------------------------------------------
+
+    def recent(self, n: int | None = None) -> list[dict]:
+        """Newest-last tail of the decision ring."""
+        with self._lock:
+            if n is None or n >= len(self._ring):
+                return list(self._ring)
+            return list(self._ring)[len(self._ring) - n:]
+
+    def snapshot(self, tail: int = 32) -> dict:
+        """The ``/decisions.json`` document (and the flight recorder's
+        ``decisions`` source): aggregate regret/agreement plus the
+        record tail."""
+        with self._lock:
+            n = self._n_scored
+            agree, disagree = self._agree, self._disagree
+            buckets = list(self._regret_buckets)
+            scored = sum(buckets)
+            doc = {
+                "enabled": enabled(),
+                "n_scored": n,
+                "ring": len(self._ring),
+                "regret": {
+                    "n": scored,
+                    "sum_s": round(self._regret_sum, 9),
+                    "ewma_s": round(self._regret_ewma, 9),
+                    "p50_s": round(histogram_quantile(
+                        buckets, REGRET_BUCKETS_S, 0.5), 9),
+                    "p95_s": round(histogram_quantile(
+                        buckets, REGRET_BUCKETS_S, 0.95), 9),
+                },
+                "calibrated_workers": len(self._spu),
+                "recent": list(self._ring)[-max(tail, 0):],
+            }
+        judged = agree + disagree
+        doc["agreement"] = {
+            "agree": agree, "disagree": disagree,
+            "pct": round(100.0 * agree / judged, 2) if judged else 0.0}
+        return doc
+
+    # -- lifecycle -----------------------------------------------------
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Wait for queued batches to score (tests / bench)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if (not self._pending and not self._completions
+                        and not self._scoring):
+                    return True
+            self._wake.set()   # completions don't wake the thread
+            time.sleep(0.005)
+        return False
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._pending.clear()
+            self._completions.clear()
+        self._wake.set()

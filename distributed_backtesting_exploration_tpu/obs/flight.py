@@ -18,7 +18,7 @@ one content-addressed JSON bundle:
 Trigger catalogue (the ``_KINDS`` tuple): job failure, SLO queue-wait
 breach, straggler flag, requeue-expiry, lockdep violation, cost-model
 residual blowout, worker collect failure, explicit ``TriggerDump``
-admin RPC, SIGUSR2.
+admin RPC, SIGUSR2, sustained placement regret (obs/decisions.py).
 
 Operational posture, in order of importance:
 
@@ -68,7 +68,8 @@ log = logging.getLogger("dbx.flight")
 #: bounded — the obs-cardinality lint sanctions this call the same way
 #: it sanctions ``tenant_bucket``.
 _KINDS = ("job_fail", "slo_breach", "straggler", "requeue_expired",
-          "lockdep", "residual", "collect_fail", "admin", "signal")
+          "lockdep", "residual", "collect_fail", "admin", "signal",
+          "regret")
 
 #: Lock-free trigger inbox for hostile acquire-site contexts. The
 #: lockdep violation hook fires while the offending locks are still
@@ -86,6 +87,15 @@ def trigger_bucket(kind: str) -> str:
     """Bounded bucket for a trigger kind: one of ``_KINDS`` or
     ``"other"``. Used for metric labels and bundle filenames."""
     return kind if kind in _KINDS else "other"
+
+
+def known_kinds() -> frozenset:
+    """The bundle-kind vocabulary THIS binary understands — the
+    ``dbxflight`` CLI's forward-compat gate (the PR-16 skip-and-count
+    seam extended to kinds): a bundle written by a newer binary with a
+    kind outside this set is skipped-and-counted by ``list`` and
+    rendered generically by ``show``, never a crash."""
+    return frozenset(_KINDS + ("other",))
 
 
 def flight_dir() -> str:
@@ -493,16 +503,29 @@ def _cmd_list(d: str) -> int:
               file=sys.stderr)
         return 2
     rows = []
+    unknown = 0
     for p in paths:
         try:
             doc = _load_bundle(p)
         except (OSError, ValueError):
             rows.append((os.path.basename(p), "?", "?", "?", "?"))
             continue
+        if doc.get("kind", "?") not in known_kinds():
+            # Forward-compat: a newer binary's bundle kind. Skip and
+            # count — an old CLI must not crash on (or misrender) a
+            # schema it predates.
+            unknown += 1
+            continue
         rows.append((os.path.basename(p), doc.get("kind", "?"),
                      doc.get("subject", "") or "-",
                      len(doc.get("spans", ())),
                      len(doc.get("jobs", ()))))
+    if unknown:
+        print(f"dbxflight: skipped {unknown} bundle(s) with unknown "
+              "kind (written by a newer binary?)", file=sys.stderr)
+    if not rows:
+        print(f"dbxflight: no listable bundles in {d}", file=sys.stderr)
+        return 2
     header = ("bundle", "kind", "subject", "spans", "jobs")
     widths = [max(len(str(r[i])) for r in rows + [header])
               for i in range(len(header))]
@@ -535,6 +558,13 @@ def _cmd_show(d: str, ref: str, as_json: bool) -> int:
         return 2
     if as_json:
         print(json.dumps(doc, indent=2, sort_keys=True))
+        return 0
+    if doc.get("kind", "?") not in known_kinds():
+        # The kind seam, show-side: render only the generic envelope —
+        # the kind-specific body belongs to a newer schema.
+        print(f"bundle   {os.path.basename(path)}")
+        print(f"kind     {doc.get('kind', '?')} (unknown to this "
+              "binary; use --json for the raw bundle)")
         return 0
     print(f"bundle   {os.path.basename(path)}")
     print(f"kind     {doc.get('kind', '?')}  subject "

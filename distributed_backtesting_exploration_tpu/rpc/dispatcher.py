@@ -46,10 +46,11 @@ from . import panel_store as panel_store_mod
 from . import service, wire
 from .journal import Journal
 from .. import obs
+from ..obs import decisions as obs_decisions
 from ..obs import fleet as obs_fleet
 from ..obs import flight as obs_flight
 from ..runtime import _core as native_core
-from ..sched import DEFAULT_TENANT, WfqScheduler, tenant_bucket
+from ..sched import DEFAULT_TENANT, WfqScheduler, held_explain, tenant_bucket
 from ..utils import data as data_mod
 
 log = logging.getLogger("dbx.dispatcher")
@@ -644,7 +645,8 @@ class JobQueue:
     # -- dispatch ----------------------------------------------------------
 
     def take(self, n: int, worker_id: str, admit=None,
-             scenario_spec: dict | None = None
+             scenario_spec: dict | None = None,
+             explain: dict | None = None
              ) -> list[tuple[JobRecord, bytes]]:
         """Pop up to ``n`` jobs, lease them to ``worker_id``, return payloads.
 
@@ -677,12 +679,23 @@ class JobQueue:
         materialized path verbatim, and so does any record that fails
         the eligibility gate — the fallback ladder is "don't coalesce",
         nothing else changes.
+
+        ``explain`` (a dict, or None) opts into the round-19 decision
+        plane: the WFQ pick-time explain record of every popped job
+        lands under its id (a ``sched.explain.PickExplain``; jobs
+        served from the affinity-held list get the minimal
+        ``held_explain`` dict). Captured under the same lock as the
+        pick itself, from the pick's own values — the record cannot
+        drift from the decision, and ``None`` (every legacy caller)
+        pays nothing. Serialization (``as_dict()``) is the consumer's
+        job, off this path — the decision plane does it on its scoring
+        thread.
         """
         out: list[tuple[JobRecord, bytes]] = []
         deferred: list[str] = []
         try:
             return self._take_inner(n, worker_id, admit, out, deferred,
-                                    scenario_spec)
+                                    scenario_spec, explain)
         finally:
             if deferred:
                 with self._lock:
@@ -693,7 +706,7 @@ class JobQueue:
                     self._affinity_held.extend(deferred)
 
     def _take_inner(self, n, worker_id, admit, out, deferred,
-                    scenario_spec=None):
+                    scenario_spec=None, explain=None):
         first = True
         while len(out) < n:
             with self._lock:
@@ -710,10 +723,18 @@ class JobQueue:
                         # per-iteration accounting below re-counts every
                         # id in `jids`, so release the held count here.
                         self._in_take -= k
+                        if explain is not None:
+                            for j in jids:
+                                explain[j] = held_explain(j)
                 # The WFQ pick replaces the FIFO pop: lowest virtual
                 # start tag across tenant lanes, quota-demoted tenants
                 # behind everyone else (sched.wfq).
-                jids += self._sched.pick(n - len(out) - len(jids))
+                exp_list = [] if explain is not None else None
+                jids += self._sched.pick(n - len(out) - len(jids),
+                                         explain=exp_list)
+                if exp_list:
+                    for e in exp_list:
+                        explain[e.jid] = e
                 if not jids:
                     break
                 # A popped id with no record is a state/record desync
@@ -1689,6 +1710,14 @@ class Dispatcher(service.DispatcherServicer):
         # obs_json (dbx_fleet) and the `dbxtop` CLI — and is the
         # worker-state view ROADMAP item 3's placement scorer ranks.
         self.fleet = obs_fleet.FleetView(registry=self.obs)
+        # Dispatch decision plane (obs/decisions.py, round 19): every
+        # take() resolution becomes one bounded decision record — WFQ
+        # pick context, payload route, fleet-view age — scored off the
+        # hot path by the shadow placement ranker against THIS fleet
+        # view. Records never influence dispatch (ROADMAP item 2 in
+        # shadow mode); DBX_DECISIONS=0 kills record assembly entirely.
+        self.decisions = obs_decisions.DecisionPlane(
+            fleet=self.fleet, registry=self.obs)
         # Thread-local: concurrent GetStats calls on the gRPC pool must
         # each lend their OWN snapshot to the collector, not race on one
         # shared slot.
@@ -1712,6 +1741,7 @@ class Dispatcher(service.DispatcherServicer):
             ("queue", self.queue.stats),
             ("schedule", self.fleet_schedule.to_json),
             ("lockdep", _lockdep_report),
+            ("decisions", self.decisions.snapshot),
         )
         for name, fn in self._flight_sources:
             obs_flight.add_source(name, fn)
@@ -1730,6 +1760,7 @@ class Dispatcher(service.DispatcherServicer):
         self.obs.remove_collector(self._collector_key)
         for name, _ in self._flight_sources:
             obs_flight.remove_source(name)
+        self.decisions.close()
 
     def _collect_gauges(self, reg: "obs.Registry") -> None:
         """Scrape-time refresh of queue-depth / liveness gauges (one
@@ -1953,10 +1984,20 @@ class Dispatcher(service.DispatcherServicer):
         spec_jids: dict[str, str] | None = (
             {} if (request.accepts_scenario_batch
                    and _scenario_fused_enabled()) else None)
+        # Decision plane (round 19): collect the pick-time WFQ context
+        # only while recording is armed AND the scoring budget has
+        # tokens (decisions.want) — with DBX_DECISIONS=0 or the budget
+        # spent, neither the explain hook nor the record tuples below
+        # are ever built and this path is the kill-switch path.
+        explain: dict | None = (
+            {} if obs_decisions.enabled() and self.decisions.want()
+            else None)
+        dec_batch: list[dict] = []
         taken = self.queue.take(n, request.worker_id,
                                 admit=self._affinity_admit(
                                     request.worker_id, delivered),
-                                scenario_spec=spec_jids)
+                                scenario_spec=spec_jids,
+                                explain=explain)
         if taken:
             self._c_dispatched.inc(len(taken))
         reply = pb.JobsReply()
@@ -2013,6 +2054,13 @@ class Dispatcher(service.DispatcherServicer):
                         wait_s=round(wait_s, 3),
                         slo_s=self.tenant_slo_s)
             if spec_jids and rec.id in spec_jids:
+                if explain is not None:
+                    # Deferred decision record (5-tuple; see
+                    # DecisionPlane.submit): the dict view assembles on
+                    # the plane's thread, never on this path.
+                    dec_batch.append((rec, "scenario",
+                                      spec_jids[rec.id], len(payload),
+                                      explain.get(rec.id)))
                 scn_batches.setdefault(
                     (spec_jids[rec.id], rec.strategy,
                      tuple(sorted(
@@ -2030,6 +2078,16 @@ class Dispatcher(service.DispatcherServicer):
                     if rec.append_parent else
                     self._payload_leg(delivered, rec.panel_digest,
                                       payload))
+            if explain is not None:
+                # The route the payload leg ACTUALLY took, derived from
+                # the leg bytes the counters above just classified.
+                if rec.append_parent:
+                    route = "delta" if not leg1 else "full"
+                else:
+                    route = ("digest_only" if payload and not leg1
+                             else "full")
+                dec_batch.append((rec, route, rec.panel_digest,
+                                  len(payload), explain.get(rec.id)))
             reply.jobs.append(pb.JobSpec(
                 id=rec.id, strategy=rec.strategy,
                 ohlcv=leg1,
@@ -2097,6 +2155,11 @@ class Dispatcher(service.DispatcherServicer):
                         id=rec.id, trace_id=rec.trace_id))
                 self._c_scn_coalesced.inc(len(members))
                 reply.jobs.append(spec)
+        if dec_batch:
+            # One small-lock append for the whole poll; scoring (fleet
+            # snapshot, shadow ranking) happens on the plane's thread.
+            self.decisions.submit(dec_batch, worker=request.worker_id,
+                                  t_take=t_disp0)
         if taken:
             log.info("dispatched %d jobs to %s", len(taken), request.worker_id)
         return reply
@@ -2145,6 +2208,10 @@ class Dispatcher(service.DispatcherServicer):
                                             journal=False)[0]
         if outcome == "unknown":
             return outcome
+        if outcome == "new" and obs_decisions.enabled():
+            # Decision-plane spu calibration: the measured end-to-end
+            # worker wall against the units the shadow scorer parked.
+            self.decisions.observe_completion(worker_id, jid, elapsed_s)
         if metrics:
             self._record_result(jid, metrics)
         if outcome == "new":
@@ -2193,11 +2260,16 @@ class Dispatcher(service.DispatcherServicer):
             [item.id for item in items], request.worker_id, journal=False)
         journal_ids: list[str] = []
         record_errors: list[tuple[str, Exception]] = []
+        dec_comps: list[tuple] | None = (
+            [] if obs_decisions.enabled() else None)
         for item, outcome in zip(items, outcomes):
             if outcome == "unknown":
                 reply.unknown_ids.append(item.id)
                 continue
             if outcome == "new":
+                if dec_comps is not None:
+                    dec_comps.append(
+                        (request.worker_id, item.id, item.elapsed_s))
                 # Live fan-out first (see _complete_one): the pushed
                 # block is the completion payload, valid regardless of
                 # whether the persist below succeeds — a redelivered
@@ -2237,6 +2309,10 @@ class Dispatcher(service.DispatcherServicer):
             # "dup" (a retried delivery the dispatcher already recorded) is
             # deliberately neither accepted nor unknown: the worker already
             # counted it on the attempt the dispatcher processed.
+        if dec_comps:
+            # One decision-plane lock crossing for the whole batch (spu
+            # calibration input; see observe_completions).
+            self.decisions.observe_completions(dec_comps)
         for outcome, n in collections.Counter(outcomes).items():
             self._c_completions[outcome].inc(n)
         self.queue.journal_completions(journal_ids, request.worker_id)
@@ -2522,6 +2598,9 @@ class DispatcherServer:
                     # The merged fleet telemetry document (obs/fleet.py;
                     # `dbxtop --url` scrapes this).
                     "/fleet.json": self.dispatcher.fleet.snapshot,
+                    # The decision-plane tail + aggregate regret
+                    # (obs/decisions.py; `dbxwhy --url` scrapes this).
+                    "/decisions.json": self.dispatcher.decisions.snapshot,
                 }).start()
         self._maint = threading.Thread(
             target=self._maintenance_loop, name="dbx-maint", daemon=True)

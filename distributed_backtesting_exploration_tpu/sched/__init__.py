@@ -12,9 +12,13 @@ behind it. This package owns the two pieces the dispatcher composes:
 - :mod:`.tenancy` — the ``default`` tenant constant (proto3-default
   mapping for legacy clients) and the BOUNDED tenant-bucket label map
   that makes ``dbx_queue_jobs{tenant=...}`` safe under dbxlint's
-  obs-cardinality rule.
+  obs-cardinality rule;
+- :mod:`.explain` — the pick-time explain records (round 19) the
+  dispatch decision plane (obs/decisions.py) stitches into per-job
+  "why this worker" reports.
 """
 
+from .explain import PickExplain, held_explain  # noqa: F401
 from .tenancy import (  # noqa: F401
     DEFAULT_TENANT, OVERFLOW_BUCKET, reset_tenant_buckets,
     stream_bucket, tenant_bucket, worker_bucket)

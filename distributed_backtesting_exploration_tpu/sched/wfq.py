@@ -32,6 +32,7 @@ from __future__ import annotations
 import collections
 import os
 
+from .explain import PickExplain
 from .tenancy import DEFAULT_TENANT
 
 
@@ -155,11 +156,18 @@ class WfqScheduler:
             self._gone.discard(lane.popleft()[1])
         return lane[0] if lane else None
 
-    def pick(self, n: int) -> list[str]:
+    def pick(self, n: int,
+             explain: list[PickExplain] | None = None) -> list[str]:
         """Pop up to ``n`` jids in virtual-time order (module docstring).
         Picked jobs are immediately charged against their tenant's quota
         (see ``_charged``) — the caller releases any that fail to
-        lease."""
+        lease.
+
+        ``explain`` (a list, or None) is the decision plane's hook: one
+        :class:`~.explain.PickExplain` is appended per served job, built
+        from the very values this pop used — the record cannot disagree
+        with the decision, and assembly is fully gated on the argument
+        so unobserved picks pay nothing."""
         out: list[str] = []
         while len(out) < n:
             heads = []   # (tag, seq, tenant, jid, cost, over_quota)
@@ -196,15 +204,17 @@ class WfqScheduler:
             if not heads:
                 break
             in_quota = [h for h in heads if not h[5]]
+            demoted_now: list[str] = []
             if in_quota and any_over:
                 # The demotion event: an at-quota tenant's head was
                 # pushed behind every in-quota tenant this pop.
                 for h in heads:
                     if h[5]:
                         self._demoted[h[2]] += 1
+                        demoted_now.append(h[2])
             # Work-conserving: quota demotes behind OTHER tenants' work,
             # it never idles the fleet when only over-quota work remains.
-            tag, seq, t, jid, cost, _ = min(
+            tag, seq, t, jid, cost, over = min(
                 in_quota or heads, key=lambda h: (h[0], h[1]))
             self._lanes[t].popleft()
             # pop-with-default: a duplicate enqueue of one id (already a
@@ -214,8 +224,16 @@ class WfqScheduler:
                 self._npend[t] -= 1
             self._charged[jid] = (t, cost)
             self._inflight[t] += cost
+            vtime_before = self._vtime
             self._finish[t] = tag + cost / self.weight(t)
             self._vtime = tag
+            if explain is not None:
+                explain.append(PickExplain(
+                    jid=jid, tenant=t, tag=tag, vtime=vtime_before,
+                    vfinish=self._finish[t], cost=cost,
+                    weight=self.weight(t), over_quota=over,
+                    demoted=demoted_now,
+                    heads={h[2]: h[0] for h in heads}))
             out.append(jid)
         return out
 

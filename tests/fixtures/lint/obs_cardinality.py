@@ -94,6 +94,27 @@ def record_worker(reg, worker_id):
               worker=worker_bucket(worker_id)).set(1)
 
 
+def record_decision(reg, worker, candidate, regret_s):
+    from distributed_backtesting_exploration_tpu.sched import worker_bucket
+
+    # decision-plane vocabulary (round 19): the actual and candidate
+    # worker ids in a decision record are raw registration strings that
+    # churn per restart — flagged
+    reg.counter("fx_decisions_total", worker=worker).inc()
+    reg.gauge("fx_shadow_best", candidate=candidate).set(1)
+    # per-decision regret as a LABEL is a continuous measurement: one
+    # time series per distinct float, forever — flagged (it belongs in
+    # a histogram's observe(), not a label)
+    reg.counter("fx_regret_total", regret=regret_s).inc()
+    # bounded route/outcome literals from the decision record: NOT
+    # flagged
+    reg.counter("fx_decisions_ok_total", route="digest_only").inc()
+    reg.counter("fx_shadow_ok_total", outcome="agree").inc()
+    # sanctioned worker-bucket rails: NOT flagged
+    reg.counter("fx_decisions_bucketed_total",
+                worker=worker_bucket(worker)).inc()
+
+
 def suppressed(reg, job_id):
     # dbxlint: disable=obs-cardinality -- demo: suppression carries a why
     reg.counter("fx_sup_total", job=job_id).inc()

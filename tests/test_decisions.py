@@ -1,0 +1,494 @@
+"""Dispatch decision plane (round 19): WFQ explain determinism, the
+shadow placement scorer, and the ``dbxwhy`` CLI.
+
+Tentpole coverage: the pick-time explain record is a pure function of
+scheduler logical state (bit-identical across queue substrates, and a
+journal-replayed queue reproduces it with virtual time restarting at 0);
+the ``DecisionPlane`` scores every dispatch against the live fleet off
+the hot path (ring-bounded, kill-switched, calibrated by completions,
+firing the flight recorder on sustained regret); and ``dbxwhy`` stitches
+the decision chain with the span timeline for an e2e gRPC-dispatched
+job — including the second dispatch after a journal-replay restart.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_backtesting_exploration_tpu import obs as obs_mod
+from distributed_backtesting_exploration_tpu.obs import (
+    decisions as dec_mod, events, flight as flight_mod, why)
+from distributed_backtesting_exploration_tpu.rpc import compute
+from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+    Dispatcher, DispatcherServer, JobQueue, JobRecord, PeerRegistry,
+    parse_grid, synthetic_jobs)
+from distributed_backtesting_exploration_tpu.rpc.journal import Journal
+from distributed_backtesting_exploration_tpu.rpc.worker import Worker
+from distributed_backtesting_exploration_tpu.sched import (
+    WfqScheduler, reset_tenant_buckets)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_buckets():
+    reset_tenant_buckets()
+    yield
+    reset_tenant_buckets()
+
+
+def _grid(combos):
+    return {"fast": np.arange(float(combos), dtype=np.float32) + 5.0}
+
+
+def _mk(tenant, n, combos=2):
+    return [JobRecord(id=f"{tenant}-{i}", strategy="sma_crossover",
+                      grid=_grid(combos), ohlcv=b"payload", tenant=tenant)
+            for i in range(n)]
+
+
+def _whale_vs_smalls(q):
+    """The round-9 adversarial intake: a whale's big-combo sweep enqueued
+    ahead of two small tenants."""
+    for r in _mk("whale", 6, combos=32):
+        q.enqueue(r)
+    for r in _mk("small_a", 4, combos=4) + _mk("small_b", 4, combos=4):
+        q.enqueue(r)
+
+
+def _queue(use_native, *args, **kw):
+    if use_native:
+        from distributed_backtesting_exploration_tpu.runtime import _core
+        if not _core.available():
+            pytest.skip("native core not available")
+    q = JobQueue(*args, use_native=use_native, **kw)
+    assert q.substrate == ("native" if use_native else "python")
+    return q
+
+
+# ---------------------------------------------------------------------------
+# WFQ explain determinism (satellite: both substrates + journal replay)
+# ---------------------------------------------------------------------------
+
+def test_wfq_explain_bit_identical_across_substrates():
+    """The explain stream is a pure function of scheduler logical state:
+    the SAME pinned whale-vs-smalls intake produces byte-identical
+    explain dicts on the python and native queue substrates."""
+    streams = []
+    for use_native in (False, True):
+        q = _queue(use_native)
+        _whale_vs_smalls(q)
+        exp: dict = {}
+        order = [r.id for r, _ in q.take(14, "w1", explain=exp)]
+        # take() hands back live PickExplain objects (serialization is
+        # deliberately off the take path); compare their JSON forms.
+        streams.append((order, {j: exp[j].as_dict() for j in order}))
+    (order_py, exp_py), (order_nat, exp_nat) = streams
+    assert order_py == order_nat
+    assert exp_py == exp_nat
+    # And the stream means what the round-9 schedule says: first pick
+    # ties at virtual time 0 and falls to arrival order (the whale) —
+    # with both small lanes visible as competing heads.
+    first = exp_py[order_py[0]]
+    assert order_py[0] == "whale-0"
+    assert first["vtime"] == 0.0 and first["tag"] == 0.0
+    assert set(first["heads"]) == {"whale", "small_a", "small_b"}
+    assert first["cost"] == 32.0 and first["vfinish"] == 32.0
+    # Every record carries the full field contract.
+    for rec in exp_py.values():
+        assert {"jid", "tenant", "tag", "vtime", "vfinish", "cost",
+                "weight", "over_quota", "demoted", "heads"} <= set(rec)
+
+
+def test_wfq_explain_journal_replay_restarts_virtual_time_at_zero(
+        tmp_path):
+    """A journal-restored queue reproduces the original run's explain
+    stream exactly: same picks, same tags, virtual time restarting at 0
+    (the PR-8 replay semantics — nothing completed pre-crash, so the
+    replayed intake IS the original intake)."""
+    jpath = str(tmp_path / "journal.jsonl")
+    q = JobQueue(Journal(jpath))
+    _whale_vs_smalls(q)
+    exp1: dict = {}
+    order1 = [r.id for r, _ in q.take(14, "w1", explain=exp1)]
+
+    q2 = JobQueue()
+    assert q2.restore(jpath) == 14
+    exp2: dict = {}
+    order2 = [r.id for r, _ in q2.take(14, "w2", explain=exp2)]
+    assert order2 == order1
+    assert ({j: e.as_dict() for j, e in exp2.items()}
+            == {j: e.as_dict() for j, e in exp1.items()})
+    assert exp2[order2[0]].as_dict()["vtime"] == 0.0
+
+
+def test_wfq_explain_quota_demotion_and_work_conservation():
+    """The demotion event lands in the explain record of the pick that
+    demoted (not the demoted tenant's own later record), and the
+    work-conserving over-quota serve is marked ``over_quota``."""
+    s = WfqScheduler(weights={}, quotas={"whale": 32.0})
+    s.push("w0", "whale", 32.0)
+    s.push("w1", "whale", 32.0)
+    s.push("s0", "small", 4.0)
+    exp: list = []
+    assert s.pick(3, explain=exp) == ["w0", "s0", "w1"]
+    d0, d1, d2 = (e.as_dict() for e in exp)
+    # Pop 1: nobody over quota yet.
+    assert not d0["over_quota"] and d0["demoted"] == []
+    # Pop 2: the whale's head is at quota — demoted behind the small
+    # tenant, recorded on the small tenant's winning pick.
+    assert d1["jid"] == "s0" and d1["demoted"] == ["whale"]
+    assert not d1["over_quota"]
+    assert d1["heads"]["whale"] == 32.0 and d1["heads"]["small"] == 0.0
+    # Pop 3: only over-quota work remains — served anyway, marked.
+    assert d2["jid"] == "w1" and d2["over_quota"]
+
+
+def test_wfq_explain_heads_snapshot_is_bounded():
+    """Tenant ids are wire-controlled: the competing-heads snapshot in
+    the JSON form is clamped at MAX_HEADS with an explicit drop count."""
+    s = WfqScheduler(weights={}, quotas={})
+    for i in range(12):
+        s.push(f"j{i}", f"t{i:02d}", 1.0)
+    exp: list = []
+    s.pick(1, explain=exp)
+    d = exp[0].as_dict()
+    assert len(d["heads"]) == 8
+    assert d["heads_dropped"] == 4
+    assert list(d["heads"]) == sorted(d["heads"])
+
+
+# ---------------------------------------------------------------------------
+# DecisionPlane unit: shadow scoring, bounds, kill switch, regret trigger
+# ---------------------------------------------------------------------------
+
+class _FakeFleet:
+    def __init__(self, workers):
+        self.workers = workers
+
+    def snapshot(self):
+        return {"workers": self.workers}
+
+
+_DIGEST = "ab" * 32
+
+
+def _raw(jid="j1", worker="slow", route="full", panel_b=200_000_000,
+         **over):
+    raw = {"jid": jid, "trace_id": jid + "-tr", "worker": worker,
+           "tenant": "default", "strategy": "sma_crossover",
+           "combos": 4.0, "affinity_skips": 0, "wfq": None,
+           "digest": _DIGEST, "panel_b": panel_b, "append_parent": "",
+           "base_len": 0, "bars": 512, "t_take": 1.0, "route": route}
+    raw.update(over)
+    return raw
+
+
+def _two_worker_fleet():
+    """``fast`` holds the panel (top-K sketch hit); ``slow`` does not."""
+    return _FakeFleet({
+        "fast": {"stale": False, "age_s": 0.25,
+                 "caches": {"panel_topk": [{"d": _DIGEST[:12], "b": 1}]}},
+        "slow": {"stale": False, "age_s": 0.5, "caches": {}},
+    })
+
+
+def test_shadow_scorer_prices_residency_and_measures_regret():
+    plane = dec_mod.DecisionPlane(fleet=_two_worker_fleet(),
+                                  registry=obs_mod.Registry())
+    try:
+        plane.submit([_raw(worker="slow", route="full")])
+        assert plane.flush()
+        (rec,) = plane.recent()
+        shadow = rec["shadow"]
+        # Both candidates share the uncalibrated spu and the cold
+        # compile, so the ranking is pure residency: ``fast`` skips the
+        # 200 MB transfer the actual worker paid.
+        assert shadow["candidates"] == 2
+        assert shadow["best"] == "fast" and shadow["agree"] is False
+        want = 200_000_000 / dec_mod.h2d_rate_bps()
+        assert shadow["regret_s"] == pytest.approx(want, rel=1e-6)
+        assert shadow["costs"]["slow"]["transfer_s"] > 0.0
+        assert shadow["costs"]["fast"]["transfer_s"] == 0.0
+        assert shadow["costs"]["fast"]["resident"] is True
+        snap = plane.snapshot()
+        assert snap["n_scored"] == 1
+        assert snap["agreement"]["disagree"] == 1
+        assert snap["regret"]["sum_s"] == pytest.approx(want, rel=1e-6)
+    finally:
+        plane.close()
+
+
+def test_digest_only_route_trusts_the_dispatchers_residency_check():
+    """A digest-only dispatch IS the residency proof for the actual
+    worker (the dispatcher verified the cache hold) — no transfer is
+    charged even when the telemetry sketch hasn't caught up."""
+    plane = dec_mod.DecisionPlane(fleet=_two_worker_fleet(),
+                                  registry=obs_mod.Registry())
+    try:
+        plane.submit([_raw(worker="slow", route="digest_only")])
+        assert plane.flush()
+        (rec,) = plane.recent()
+        assert rec["shadow"]["costs"]["slow"]["resident"] is True
+        assert rec["shadow"]["regret_s"] == 0.0
+        assert rec["shadow"]["agree"] is True
+        assert rec["fleet_age_s"] == 0.5
+    finally:
+        plane.close()
+
+
+def test_completion_calibrates_per_worker_spu_and_compile_warmth():
+    plane = dec_mod.DecisionPlane(fleet=_two_worker_fleet(),
+                                  registry=obs_mod.Registry())
+    try:
+        plane.submit([_raw(jid="c1", worker="fast", route="digest_only")])
+        plane.observe_completion("fast", "c1", elapsed_s=2.0)
+        assert plane.flush()
+        assert plane.snapshot()["calibrated_workers"] == 1
+        # The next decision prices ``fast`` from the measured wall
+        # (spu = 2.0s / units) and skips its compile (family now warm).
+        plane.submit([_raw(jid="c2", worker="fast", route="digest_only")])
+        assert plane.flush()
+        rec = plane.recent()[-1]
+        costs = rec["shadow"]["costs"]
+        assert costs["fast"]["exec_s"] == pytest.approx(2.0, rel=1e-6)
+        assert costs["fast"]["compile_s"] == 0.0
+        assert costs["slow"]["compile_s"] > 0.0
+    finally:
+        plane.close()
+
+
+def test_decision_ring_and_queue_stay_bounded(monkeypatch):
+    monkeypatch.setenv("DBX_DECISIONS_RING", "4")
+    plane = dec_mod.DecisionPlane(fleet=_two_worker_fleet(),
+                                  registry=obs_mod.Registry())
+    try:
+        for i in range(12):
+            plane.submit([_raw(jid=f"r{i}")])
+        assert plane.flush()
+        tail = plane.recent()
+        assert [r["jid"] for r in tail] == ["r8", "r9", "r10", "r11"]
+        assert plane.snapshot()["n_scored"] == 12
+    finally:
+        plane.close()
+
+
+def test_kill_switch_and_knob_parsing(monkeypatch):
+    assert dec_mod.enabled()
+    monkeypatch.setenv("DBX_DECISIONS", "0")
+    assert not dec_mod.enabled()
+    monkeypatch.setenv("DBX_DECISIONS_RING", "not-a-number")
+    assert dec_mod.ring_capacity() == 256
+    monkeypatch.setenv("DBX_DECISIONS_REGRET_N", "0")
+    assert dec_mod.regret_window() == 1
+
+
+def test_sustained_regret_fires_the_flight_trigger(monkeypatch):
+    monkeypatch.setenv("DBX_DECISIONS_REGRET_S", "0.01")
+    monkeypatch.setenv("DBX_DECISIONS_REGRET_N", "2")
+    fired = []
+    monkeypatch.setattr(flight_mod, "trigger",
+                        lambda kind, **kw: fired.append((kind, kw)))
+    plane = dec_mod.DecisionPlane(fleet=_two_worker_fleet(),
+                                  registry=obs_mod.Registry())
+    try:
+        # Each decision pays ~0.1s of avoidable transfer: the regret
+        # EWMA sits past the 10ms bar for 2 consecutive scored
+        # decisions -> one trigger (streak resets after firing).
+        plane.submit([_raw(jid=f"h{i}", worker="slow") for i in range(2)])
+        assert plane.flush()
+        assert [k for k, _ in fired] == ["regret"]
+        assert fired[0][1]["subject"] == "slow"
+        assert fired[0][1]["regret_ewma_s"] > 0.01
+    finally:
+        plane.close()
+
+
+def test_scorer_never_fails_a_decision(monkeypatch):
+    """Flight-recorder posture: a broken fleet snapshot degrades to a
+    candidate-less record, never an exception on (or off) the take
+    path."""
+
+    class _Broken:
+        def snapshot(self):
+            raise RuntimeError("fleet down")
+
+    reg = obs_mod.Registry()
+    plane = dec_mod.DecisionPlane(fleet=_Broken(), registry=reg)
+    try:
+        plane.submit([_raw(worker="")])
+        assert plane.flush()
+        (rec,) = plane.recent()
+        assert rec["shadow"] == {"candidates": 0}
+        assert "regret_s" not in rec["shadow"]
+    finally:
+        plane.close()
+
+# ---------------------------------------------------------------------------
+# dbxwhy CLI (satellite: tier-1 smoke — exit codes, formats, merge)
+# ---------------------------------------------------------------------------
+
+def _decision_line(jid, worker="w1", t_take=1.0):
+    return json.dumps({
+        "ev": "decision", "jid": jid, "trace_id": jid + "-tr",
+        "worker": worker, "tenant": "default", "route": "full",
+        "strategy": "sma_crossover", "combos": 4, "affinity_skips": 0,
+        "fleet_age_s": 0.1, "units": 100.0, "t_take": t_take,
+        "shadow": {"candidates": 2, "best": "w2", "best_cost_s": 0.1,
+                   "actual_cost_s": 0.3, "regret_s": 0.2, "agree": False,
+                   "costs": {"w1": {"cost_s": 0.3, "exec_s": 0.1,
+                                    "transfer_s": 0.2, "compile_s": 0.0,
+                                    "carry_hit": False,
+                                    "resident": False},
+                             "w2": {"cost_s": 0.1, "exec_s": 0.1,
+                                    "transfer_s": 0.0, "compile_s": 0.0,
+                                    "carry_hit": False,
+                                    "resident": True}}},
+        "wfq": {"jid": jid, "tenant": "default", "tag": 0.0, "vtime": 0.0,
+                "vfinish": 4.0, "cost": 4.0, "weight": 1.0,
+                "over_quota": False, "demoted": [], "heads": {}}})
+
+
+def test_dbxwhy_exit_2_on_no_match_and_no_events(tmp_path, capsys):
+    log = tmp_path / "events.jsonl"
+    log.write_text("not json\n{\"no\": \"ev key\"}\n")
+    assert why.main(["j1", "--jsonl", str(log)]) == 2
+    assert "no parseable events" in capsys.readouterr().err
+    log.write_text(_decision_line("other-job") + "\n")
+    assert why.main(["j1", "--jsonl", str(log)]) == 2
+    assert "no decision record matches" in capsys.readouterr().err
+    # No inputs at all is an argparse error, not a silent empty report.
+    with pytest.raises(SystemExit):
+        why.main(["j1"])
+
+
+def test_dbxwhy_merges_logs_and_orders_the_decision_chain(
+        tmp_path, capsys):
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    # The SECOND dispatch (post-restart) lives in another file with an
+    # earlier t_take in file order — the chain must sort by take time.
+    a.write_text(_decision_line("j1", worker="w9", t_take=7.0) + "\n")
+    b.write_text(_decision_line("j1", worker="w1", t_take=1.0) + "\n"
+                 + _decision_line("jX", t_take=2.0) + "\n")
+    assert why.main(["j1", "--jsonl", str(a), str(b)]) == 0
+    out = capsys.readouterr().out
+    assert "decision 1/2" in out and "decision 2/2" in out
+    assert out.index("worker w1") < out.index("worker w9")
+    assert "shadow preferred w2" in out
+    assert "(no span timeline for this job in the inputs)" in out
+
+
+def test_dbxwhy_json_format_and_trace_prefix_match(tmp_path, capsys):
+    log = tmp_path / "events.jsonl"
+    log.write_text(_decision_line("abc123") + "\n")
+    assert why.main(["abc123-tr", "--jsonl", str(log),
+                     "--format", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["job"] == "abc123-tr"
+    assert [d["jid"] for d in doc["decisions"]] == ["abc123"]
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: gRPC dispatch -> decision chain across a journal-replay
+# restart (acceptance: dbxwhy reconstructs the full chain)
+# ---------------------------------------------------------------------------
+
+GRID = parse_grid("fast=3:5,slow=10:14:2")
+
+_LIVE: list = []
+
+
+@pytest.fixture(autouse=True)
+def _cleanup_e2e():
+    yield
+    while _LIVE:
+        stop = _LIVE.pop()
+        stop()
+    events.configure(None)
+
+
+def _server(queue):
+    disp = Dispatcher(queue, PeerRegistry(prune_window_s=10.0))
+    srv = DispatcherServer(disp, bind="localhost:0").start()
+    _LIVE.append(srv.stop)
+    return disp, srv
+
+
+def _drain_with_worker(port, queue, timeout=30.0):
+    w = Worker(f"localhost:{port}", compute.InstantBackend(),
+               poll_interval_s=0.02, status_interval_s=0.05)
+    t = threading.Thread(target=lambda: w.run(max_idle_polls=1000),
+                         daemon=True)
+    t.start()
+    _LIVE.append(lambda: (w.stop(), t.join(timeout=10)))
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if queue.drained:
+            # Stop NOW: a worker left polling would steal the jobs the
+            # test enqueues next (the leaked-worker flake the rpc
+            # integration suite documents).
+            w.stop()
+            t.join(timeout=10)
+            return w
+        time.sleep(0.02)
+    raise AssertionError("queue never drained")
+
+
+@pytest.mark.slow
+def test_e2e_decision_chain_survives_journal_replay_restart(
+        tmp_path, capsys):
+    import grpc
+
+    from distributed_backtesting_exploration_tpu.rpc import service
+
+    log = str(tmp_path / "events.jsonl")
+    jpath = str(tmp_path / "journal.jsonl")
+    events.configure(log)
+
+    # --- life 1: dispatch over real gRPC; one job leases to a worker
+    # that dies without completing. ------------------------------------
+    queue = JobQueue(Journal(jpath))
+    for rec in synthetic_jobs(2, 64, "sma_crossover", GRID, seed=3):
+        queue.enqueue(rec)
+    disp, srv = _server(queue)
+    _drain_with_worker(srv.port, queue)
+    jid = "replay-me"
+    queue.enqueue(JobRecord(id=jid, strategy="sma_crossover", grid=GRID,
+                            ohlcv=b"payload"))
+    with grpc.insecure_channel(f"localhost:{srv.port}") as ch:
+        reply = service.DispatcherStub(ch).RequestJobs(
+            __import__("distributed_backtesting_exploration_tpu.rpc."
+                       "backtesting_pb2", fromlist=["JobsRequest"])
+            .JobsRequest(worker_id="doomed", chips=1, jobs_per_chip=4,
+                         accepts_digest_only=True), timeout=10.0)
+    assert [j.id for j in reply.jobs] == [jid]
+    assert disp.decisions.flush()
+    srv.stop()
+
+    # --- life 2: journal replay re-pends the abandoned lease; a live
+    # worker completes it — the job's SECOND decision record. ----------
+    q2 = JobQueue(Journal(jpath))
+    assert q2.restore(jpath) == 1
+    assert q2.stats()["jobs_pending"] == 1
+    disp2, srv2 = _server(q2)
+    _drain_with_worker(srv2.port, q2)
+    assert disp2.decisions.flush()
+    live = disp2.decisions.snapshot()
+    assert live["n_scored"] == 1 and live["recent"][0]["jid"] == jid
+    srv2.stop()
+
+    # --- dbxwhy stitches the whole chain from the shared event log. ---
+    assert why.main([jid, "--jsonl", log]) == 0
+    out = capsys.readouterr().out
+    assert "decision 1/2" in out and "decision 2/2" in out
+    assert out.index("worker doomed") < out.index("decision 2/2")
+    assert "wfq: tag=" in out
+    assert "== what actually happened ==" in out
+
+    # The same chain through the json surface, jids intact.
+    assert why.main([jid, "--jsonl", log, "--format", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert [d["jid"] for d in doc["decisions"]] == [jid, jid]
+    assert doc["decisions"][0]["worker"] == "doomed"
+    assert doc["decisions"][0]["t_take"] <= doc["decisions"][1]["t_take"]

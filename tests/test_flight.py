@@ -497,3 +497,60 @@ def test_flight_capture_under_lockdep_is_violation_free(tmp_path,
         if not was_active:
             lockdep.uninstall()
         lockdep.reset()
+
+
+# ---------------------------------------------------------------------------
+# Bundle-kind forward compat (round 19)
+# ---------------------------------------------------------------------------
+
+def test_unknown_bundle_kind_skipped_and_counted(tmp_path, capsys):
+    """The PR-16 skip-and-count seam extended to bundle KINDS: a bundle
+    written by a newer binary (a kind outside this binary's catalogue)
+    must be skipped-and-counted by `dbxflight list` and rendered as a
+    generic envelope by `show` — never a crash, and never a misrender
+    against a schema this binary predates. `show --json` stays a raw
+    passthrough either way."""
+    d = tmp_path / "fl"
+    d.mkdir()
+    known = {"v": 1, "kind": "job_fail", "subject": "k1", "t_wall": 0.0,
+             "pid": 1, "spans": [], "jobs": [], "sources": {}}
+    novel = {"v": 9, "kind": "decision_replay", "subject": "n1",
+             "t_wall": 0.0, "novel_body": {"schema": "from-the-future"}}
+    (d / "20260101T000000-job_fail-aaaa.json").write_text(
+        json.dumps(known))
+    (d / "20260101T000001-other-bbbb.json").write_text(json.dumps(novel))
+
+    assert flight.main(["--dir", str(d), "list"]) == 0
+    cap = capsys.readouterr()
+    assert "job_fail" in cap.out
+    assert "decision_replay" not in cap.out
+    assert "skipped 1 bundle(s) with unknown kind" in cap.err
+
+    assert flight.main(["--dir", str(d), "show",
+                        "20260101T000001"]) == 0
+    cap = capsys.readouterr()
+    assert "unknown to this binary" in cap.out
+    assert "from-the-future" not in cap.out
+
+    assert flight.main(["--dir", str(d), "show", "20260101T000001",
+                        "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["novel_body"]["schema"] == "from-the-future"
+
+    # A dir holding ONLY unknown-kind bundles lists nothing: exit 2,
+    # with the skip count still reported.
+    only = tmp_path / "only-novel"
+    only.mkdir()
+    (only / "20260101T000002-other-cccc.json").write_text(
+        json.dumps(novel))
+    assert flight.main(["--dir", str(only), "list"]) == 2
+    cap = capsys.readouterr()
+    assert "skipped 1 bundle(s) with unknown kind" in cap.err
+
+
+def test_regret_is_a_first_class_trigger_kind():
+    """The decision plane's sustained-regret trigger must ride a
+    catalogued kind — folding it to "other" would strip the bounded
+    metric label and the filename tag an operator greps for."""
+    assert flight.trigger_bucket("regret") == "regret"
+    assert "regret" in flight.known_kinds()

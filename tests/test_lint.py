@@ -277,6 +277,12 @@ def test_obs_cardinality_flags_unbounded_label_values():
          _fixture_line("obs_cardinality.py", 'sub=subscriber_id')),
         ("obs-cardinality", "obs_cardinality.py",
          _fixture_line("obs_cardinality.py", 'worker=worker_id')),
+        ("obs-cardinality", "obs_cardinality.py",
+         _fixture_line("obs_cardinality.py", 'worker=worker)')),
+        ("obs-cardinality", "obs_cardinality.py",
+         _fixture_line("obs_cardinality.py", 'candidate=candidate')),
+        ("obs-cardinality", "obs_cardinality.py",
+         _fixture_line("obs_cardinality.py", 'regret=regret_s')),
     ]
     alias = findings[0]
     assert "wid = self.worker_id" in alias.message
@@ -312,6 +318,14 @@ def test_obs_cardinality_flags_unbounded_label_values():
     # worker-bucket map is a sanctioned label source.
     wb_ok = _fixture_line("obs_cardinality.py", "worker=worker_bucket")
     assert wb_ok not in [f.line for f in findings]
+    # Decision-plane vocabulary (round 19): actual/candidate worker ids
+    # and per-decision regret are unbounded runtime data; the bounded
+    # route/outcome literals and the worker-bucket rails are not.
+    assert not any("fx_decisions_ok_total" in f.message
+                   or "fx_shadow_ok_total" in f.message for f in findings)
+    dec_wb_ok = _fixture_line("obs_cardinality.py",
+                              "worker=worker_bucket(worker))")
+    assert dec_wb_ok not in [f.line for f in findings]
 
 
 def test_obs_cardinality_ignores_splats_and_bounded_loops(tmp_path):

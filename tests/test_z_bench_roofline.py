@@ -803,3 +803,69 @@ def test_autotune_keys_present(autotune_bench):
     assert at["second_worker_compile_wall_warm_s"] > 0.0
     assert at["compile_wall_reduction"] > 0.0
     assert autotune_bench["configs"]["autotune"] > 0.0
+
+
+_DECISION_ENV = {
+    "DBX_BENCH_CPU": "1", "DBX_BENCH_CACHE": "",
+    "DBX_BENCH_CONFIGS": "decision_plane",
+    # Tiny-but-real: five short paired killed/armed direct-dispatch
+    # rounds plus the deterministic synthetic shadow stream. The <=2%
+    # median-paired-delta bar is asserted on the real-size run (tiny
+    # samples are pure noise), but the shadow math is exact at any
+    # scale.
+    "DBX_BENCH_LOCAL_JOBS": "96",
+}
+
+
+@pytest.fixture(scope="module")
+def decision_bench():
+    """One tiny in-process decision_plane run (loopback gRPC A/B plus
+    the synthetic two-worker shadow stream), shared by the module."""
+    prior = {k: os.environ.get(k) for k in _DECISION_ENV}
+    for knob in ("DBX_DECISIONS", "DBX_DECISIONS_RATE",
+                 "DBX_DECISIONS_H2D_GBPS"):
+        prior[knob] = os.environ.pop(knob, None)
+    os.environ.update(_DECISION_ENV)
+    bench.ROOFLINE.clear()
+    buf = io.StringIO()
+    try:
+        with contextlib.redirect_stdout(buf):
+            bench.main()
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return json.loads(buf.getvalue().strip().splitlines()[-1])
+
+
+def test_decision_plane_keys_present(decision_bench):
+    """The decision plane's acceptance numbers (recorder-armed median
+    paired delta on the direct_dispatch floor, shadow-scorer agreement
+    and regret percentiles) ride these BENCH JSON keys — a renamed key
+    would silently invalidate the round-19 acceptance record. The
+    overhead/floor verdicts are asserted as present, not True: at 96
+    jobs the paired deltas are box noise, and the bar belongs to the
+    real-size run. The shadow stream IS exact at any scale: 16
+    deterministic decisions over a two-worker fleet, 12 placed on the
+    panel-resident worker — agreement is 75% by construction and every
+    mis-placement's regret is the panel's h2d wall."""
+    dp = decision_bench["roofline"]["decision_plane"]
+    for key in ("jobs", "batch", "jobs_per_s_off", "jobs_per_s_on",
+                "decision_overhead_delta_pct", "overhead_rounds_pct",
+                "overhead_ok", "floor_ok", "shadow_scored",
+                "shadow_agreement_pct", "regret_p50", "regret_p95",
+                "regret_expected_s"):
+        assert key in dp, key
+    assert dp["jobs_per_s_off"] > 0.0
+    assert dp["jobs_per_s_on"] > 0.0
+    assert len(dp["overhead_rounds_pct"]) == 5
+    assert isinstance(dp["overhead_ok"], bool)
+    assert isinstance(dp["floor_ok"], bool)
+    # Deterministic synthetic stream: all 16 scored, 12/16 agree.
+    assert dp["shadow_scored"] == 16
+    assert dp["shadow_agreement_pct"] == 75.0
+    assert dp["regret_expected_s"] > 0.0
+    assert dp["regret_p95"] >= dp["regret_p50"] >= 0.0
+    assert decision_bench["configs"]["decision_plane"] > 0.0
