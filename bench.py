@@ -1749,6 +1749,220 @@ def main():
               f"{dp_snap['regret']['p95_s']:.4f}s (expected "
               f"{want_regret:.4f}s per mis-placement)", file=sys.stderr)
 
+    # --- e2e_local_placement: locality-scored placement A/B (round 20) ----
+    # The live placement stage's acceptance instrument: the SAME mixed
+    # append-chain / paged-repeat / cold workload drained twice through
+    # the loopback control plane — locality-blind (DBX_PLACEMENT=0, the
+    # round-19 pure-WFQ path) vs placement-live — against a backend that
+    # charges the simulated stage ladder keyed on what each worker
+    # actually holds: a carry-store hit prices PL_CARRY_S, a full
+    # reprice PL_REPRICE_S, and a panel miss adds PL_TRANSFER_S on top.
+    # The dispatcher cannot cheat the sleeps — only routing jobs to the
+    # worker holding the parent/panel avoids the expensive legs.
+    # DBX_DECISIONS_H2D_GBPS is pinned so the op model's transfer term
+    # matches the simulated link; the defer cap is raised because the
+    # 2 ms poll loop burns a poll-scaled budget in milliseconds (the
+    # production default assumes polls a batch-duration apart).
+    # regret_seconds_{shadow,live} are the shadow scorer's measured
+    # regret sums per arm: the live policy must leave strictly less on
+    # the table than blind WFQ (regret_ok: live < shadow).
+    if enabled("e2e_local_placement"):
+        import tempfile
+        import threading
+
+        from distributed_backtesting_exploration_tpu import obs as obs_mod
+        from distributed_backtesting_exploration_tpu.rpc import (
+            panel_store as pl_store)
+        from distributed_backtesting_exploration_tpu.rpc.compute import (
+            Completion)
+        from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+            Dispatcher, DispatcherServer, JobQueue, JobRecord, PeerRegistry)
+        from distributed_backtesting_exploration_tpu.rpc.worker import Worker
+        from distributed_backtesting_exploration_tpu.utils import (
+            data as pl_data)
+
+        # Workload scale knobs: the tier-1 fixture shrinks the run to a
+        # few seconds (structure test — the 1.5x bar belongs to the
+        # real-size run, like the decision_plane bench discipline).
+        pl_scale = float(os.environ.get("DBX_BENCH_PL_SCALE", 1.0))
+        PL_REPRICE_S = 0.100 * pl_scale
+        PL_CARRY_S = 0.002 * pl_scale
+        PL_TRANSFER_S = 0.060 * pl_scale
+        pl_bars, pl_step = 1024, 64
+        pl_chains = int(os.environ.get("DBX_BENCH_PL_CHAINS", 10))
+        pl_links = int(os.environ.get("DBX_BENCH_PL_LINKS", 20))
+        pl_panels = 4
+        pl_repeats = min(4, pl_links)
+        pl_cold = min(8, pl_links)
+        pl_grid = {"fast": np.arange(5.0, 9.0, dtype=np.float32)}
+
+        class LocalityBackend:
+            """Charges the stage ladder against what THIS worker holds:
+            carry hit vs full reprice, resident panel vs h2d leg. Keys
+            on digests only — digest-only dispatch never ships bytes it
+            would not read anyway."""
+
+            chips = 1
+
+            def __init__(self):
+                self.held: set[str] = set()
+
+            def process(self, jobs):
+                out = []
+                for job in jobs:
+                    base = job.append_parent_digest
+                    if base and base in self.held:
+                        dt = PL_CARRY_S
+                    else:
+                        dt = PL_REPRICE_S
+                        if job.panel_digest not in self.held:
+                            dt += PL_TRANSFER_S
+                    time.sleep(dt)
+                    self.held.add(job.panel_digest)
+                    out.append(Completion(job.id, b"", dt,
+                                          trace_id=job.trace_id))
+                return out
+
+        def pl_blob(seed, n):
+            s = pl_data.synthetic_ohlcv(1, n, seed=seed)
+            return pl_data.to_wire_bytes(
+                type(s)(*(np.asarray(f[0][:n]) for f in s)))
+
+        def pl_records():
+            """The deterministic mixed workload, rebuilt per arm (fresh
+            JobRecord objects — deferral bookkeeping must start cold).
+            Chains are real append streams: every link extends the
+            PREVIOUS link, so carry state lives only where the previous
+            link ran."""
+            master = pl_data.synthetic_ohlcv(
+                1, pl_bars + pl_links * pl_step, seed=700)
+            chains = []
+            for c in range(pl_chains):
+                links, prev_d, prev_n = [], "", 0
+                for k in range(pl_links):
+                    n = pl_bars + k * pl_step
+                    blob = pl_data.to_wire_bytes(type(master)(
+                        *(np.asarray(f[0][:n]) + c for f in master)))
+                    links.append(JobRecord(
+                        id=f"pl-c{c}-l{k}", strategy="sma_crossover",
+                        grid=pl_grid, ohlcv=blob,
+                        append_parent=prev_d, append_base_len=prev_n))
+                    prev_d, prev_n = pl_store.panel_digest(blob), n
+                chains.append(links)
+            repeat_blobs = [pl_blob(710 + p, pl_bars)
+                            for p in range(pl_panels)]
+            cold_blobs = [pl_blob(730 + i, pl_bars) for i in range(pl_cold)]
+            recs = []
+            for r in range(pl_links):
+                for links in chains:
+                    recs.append(links[r])
+                for p, blob in enumerate(repeat_blobs):
+                    if r < pl_repeats:
+                        recs.append(JobRecord(
+                            id=f"pl-r{p}-{r}", strategy="sma_crossover",
+                            grid=pl_grid, ohlcv=blob))
+                if r < pl_cold:
+                    recs.append(JobRecord(
+                        id=f"pl-x{r}", strategy="sma_crossover",
+                        grid=pl_grid, ohlcv=cold_blobs[r]))
+            return recs
+
+        def run_placement_arm(tag, live):
+            env = {"DBX_PLACEMENT": "1" if live else "0",
+                   "DBX_PLACEMENT_DEFER_CAP": "64",
+                   "DBX_DECISIONS_H2D_GBPS": "0.0007",
+                   "DBX_DECISIONS_RATE": "100000"}
+            prior = {k: os.environ.get(k) for k in env}
+            os.environ.update(env)
+            reg = obs_mod.get_registry()
+            counts0 = {o: reg.counter("dbx_placement_total", outcome=o).value
+                       for o in ("served", "deferred", "cap")}
+            queue = JobQueue()
+            try:
+                with tempfile.TemporaryDirectory() as results_dir:
+                    disp = Dispatcher(queue, PeerRegistry(prune_window_s=30.0),
+                                      results_dir=results_dir,
+                                      panel_dedupe=True)
+                    srv = DispatcherServer(disp, bind="localhost:0",
+                                           prune_interval_s=0.5).start()
+                    workers = [Worker(f"localhost:{srv.port}",
+                                      LocalityBackend(),
+                                      worker_id=f"pl-{i}",
+                                      poll_interval_s=0.002,
+                                      status_interval_s=0.5,
+                                      jobs_per_chip=2)
+                               for i in range(2)]
+                    threads = [threading.Thread(target=w.run, daemon=True)
+                               for w in workers]
+                    try:
+                        for t in threads:
+                            t.start()
+                        recs = pl_records()
+                        for rec in recs:
+                            queue.enqueue(rec)
+                        t0 = time.perf_counter()
+                        deadline = time.monotonic() + 300.0
+                        while not queue.drained:
+                            if time.monotonic() > deadline:
+                                sys.exit(f"bench[e2e_local_placement/{tag}]: "
+                                         f"drain wedged for 300s — "
+                                         f"stats={queue.stats()}")
+                            time.sleep(0.002)
+                        elapsed = time.perf_counter() - t0
+                        disp.decisions.flush(timeout=30.0)
+                        snap = disp.decisions.snapshot()
+                    finally:
+                        for w in workers:
+                            w.stop()
+                        for t in threads:
+                            t.join(timeout=30)
+                        srv.stop()
+            finally:
+                for k, v in prior.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+            counts = {o: reg.counter("dbx_placement_total", outcome=o).value
+                      - counts0[o] for o in ("served", "deferred", "cap")}
+            rate = len(recs) / elapsed
+            print(f"bench[e2e_local_placement/{tag}]: {len(recs)} jobs, "
+                  f"2 workers -> {rate:.0f} jobs/s, regret sum "
+                  f"{snap['regret']['sum_s']:.3f}s over "
+                  f"{snap['n_scored']} scored, placement counts "
+                  f"{counts}", file=sys.stderr)
+            return rate, snap, counts, len(recs)
+
+        r_blind, snap_blind, _, _ = run_placement_arm("blind", live=False)
+        r_live, snap_live, pl_counts, pl_n = run_placement_arm(
+            "live", live=True)
+        pl_polls = sum(pl_counts.values())
+        pl_speedup = r_live / max(r_blind, 1e-9)
+        regret_shadow = snap_blind["regret"]["sum_s"]
+        regret_live = snap_live["regret"]["sum_s"]
+
+        rates["e2e_local_placement"] = r_live
+        ROOFLINE["e2e_local_placement"] = {
+            "jobs": pl_n, "workers": 2,
+            "jobs_per_s_blind": round(r_blind, 1),
+            "jobs_per_s_live": round(r_live, 1),
+            "placement_speedup": round(pl_speedup, 3),
+            "defer_rate": round(
+                pl_counts["deferred"] / max(pl_polls, 1), 4),
+            "admit_counts": {o: int(v) for o, v in pl_counts.items()},
+            "regret_seconds_shadow": round(regret_shadow, 4),
+            "regret_seconds_live": round(regret_live, 4),
+            "scored_shadow": snap_blind["n_scored"],
+            "scored_live": snap_live["n_scored"],
+            "speedup_ok": bool(pl_speedup >= 1.5),
+            "regret_ok": bool(regret_live < regret_shadow),
+        }
+        print(f"bench[e2e_local_placement]: blind {r_blind:.0f} -> live "
+              f"{r_live:.0f} jobs/s ({pl_speedup:.2f}x), regret "
+              f"{regret_shadow:.3f}s -> {regret_live:.3f}s, defer rate "
+              f"{pl_counts['deferred'] / max(pl_polls, 1):.3f}",
+              file=sys.stderr)
+
     # --- queue_machine: the state machine alone, both substrates ----------
     # (VERDICT r4 weak #5 / next #7: the native DbxJobQueue driven per job
     # over ctypes measured ~2x SLOWER than the dict fallback; the batched

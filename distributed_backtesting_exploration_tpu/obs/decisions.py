@@ -26,9 +26,25 @@ that answer, built to the flight-recorder posture (obs/flight.py):
   vs cold wall** (first sighting of a strategy family on a worker pays
   the cold-compile constant);
 - ``regret = cost(actual) − cost(best_shadow)`` is recorded per decision
-  (>= 0 — the actual worker is always a candidate) WITHOUT ever
-  influencing dispatch: this is ROADMAP item 2 run in shadow mode, the
-  measure-before-commit discipline the locality scorer will be held to.
+  (>= 0 — the actual worker is always a candidate); round 19 ran this
+  in pure shadow mode, the measure-before-commit discipline the
+  locality scorer was held to.
+
+Round 20 promotes the scorer to DUAL live/shadow mode. The same cost
+model (ONE implementation: :func:`placement_cost`) now also feeds the
+live dispatch path through a pre-computed :class:`PlacementTable` this
+plane's daemon rebuilds every tick — off the take lock — from the
+fleet's ``placement_view()`` export, the dispatcher's delivered-digest
+ground truth, and the spu/family calibration below. The dispatcher's
+take-path admit hook reads the table lock-free and defers a job (at
+most ``DBX_PLACEMENT_DEFER_CAP`` polls, policy in ``sched.placement``)
+when another worker's expected stage cost wins by the relative bar.
+Stale or straggler-flagged workers are scored DOWN (penalty
+multipliers), never excluded, so degraded telemetry degrades placement
+quality, not liveness. The shadow scorer keeps running over the same
+inputs, so measured regret now *validates* the live policy: live-mode
+regret on a workload should sit strictly below the shadow-mode regret
+the same workload records with ``DBX_PLACEMENT=0``.
 
 Storage follows the span-ring discipline (obs/trace.py): a bounded
 in-memory ring (``DBX_DECISIONS_RING``, default 256) serves
@@ -59,6 +75,7 @@ import threading
 import time
 
 from . import costmodel, events
+from ..sched import placement as sched_placement
 from .registry import get_registry, histogram_quantile
 
 #: Payload-route vocabulary (bounded — metric label + record field).
@@ -158,6 +175,188 @@ def regret_window() -> int:
         return 32
 
 
+#: Score-down multipliers for degraded-but-live workers. Stale frames
+#: mean the residency/warmth evidence is old; a straggler flag means the
+#: worker is measurably slow this window. Multiplicative on the total
+#: cost so a degraded worker loses ties and close calls but still wins
+#: when it is the only one holding the state — scored down, never
+#: excluded (the round-20 liveness rule).
+STALE_PENALTY = 4.0
+STRAGGLER_PENALTY = 2.0
+
+#: (family, bars, combos) -> model units, module-wide: the op-model walk
+#: is the expensive third of a score and shapes repeat across jobs,
+#: planes, and the take-path ctx builder. Plain dict on purpose — every
+#: operation is a single GIL-atomic get/set, a racy miss merely
+#: recomputes the same value, and the bound clears wholesale (shapes are
+#: wire-controlled input; nothing may grow per shape ever seen).
+_UNITS_MEMO_MAX = 512
+_units_memo: dict = {}
+
+
+def model_units(family: str, bars: int, combos: int) -> float:
+    """Model units for one job shape via the shared op model
+    (``obs/costmodel.py``), memoized module-wide; falls back to raw
+    cell-bars when the family is unmodelable. Pure Python/math (the op
+    model imports no accelerator code), so the take-path ctx builder
+    may call it under the queue lock."""
+    family = str(family)
+    bars = max(int(bars), 1)
+    combos = max(int(combos), 1)
+    key = (family, bars, combos)
+    units = _units_memo.get(key)
+    if units is not None:
+        return units
+    try:
+        units = costmodel._model_units(family, bars, combos)
+    except Exception:
+        units = 0.0
+    if units <= 0.0 or not math.isfinite(units):
+        units = float(bars) * float(combos)
+    if len(_units_memo) >= _UNITS_MEMO_MAX:
+        _units_memo.clear()
+    _units_memo[key] = units
+    return units
+
+
+def placement_cost(*, units: float, spu: float, panel_b: int = 0,
+                   frac: float = 1.0, carry_hit: bool = False,
+                   resident: bool = False, family_warm: bool = True,
+                   rate: float | None = None, cold: float | None = None,
+                   penalty: float = 1.0) -> dict:
+    """THE op-model stage-cost estimate for (job shape, worker state) —
+    the single implementation both the shadow scorer and the live
+    placement table price with (the round-20 single-source rule; no
+    second copy in ``sched/``):
+
+    - execute wall: model ``units`` x the worker's seconds-per-unit
+      ``spu``, times the delta fraction ``frac`` on a carry-store hit
+      (an append job on the base holder prices only the new bars);
+    - transfer: ``panel_b`` over the nominal h2d/wire ``rate`` unless
+      the panel is resident (a carry hit implies the base is);
+    - compile: the cold wall unless the strategy family is warm there;
+    - ``penalty``: the stale/straggler score-down multiplier.
+    """
+    if rate is None:
+        rate = h2d_rate_bps()
+    if cold is None:
+        cold = compile_wall_s()
+    exec_s = units * spu
+    if carry_hit:
+        exec_s *= frac
+    resident = bool(resident or carry_hit)
+    transfer_s = 0.0 if resident else panel_b / rate
+    compile_s = 0.0 if family_warm else cold
+    return {"cost_s": (exec_s + transfer_s + compile_s) * penalty,
+            "exec_s": exec_s, "transfer_s": transfer_s,
+            "compile_s": compile_s, "carry_hit": carry_hit,
+            "resident": resident, "penalty": penalty}
+
+
+def placement_ctx(rec) -> dict:
+    """Per-job scoring context for :meth:`PlacementTable.rank`, built
+    from a dispatcher ``JobRecord`` (duck-typed — only plain field
+    reads). Cheap enough for the take path: one memoized op-model
+    lookup plus arithmetic; bars unknown at dispatch are estimated from
+    the base length (appends) or panel bytes (~40 B/bar, the DBX1
+    float64 row) exactly like the shadow scorer's raw view."""
+    family = str(rec.strategy)
+    combos = max(int(rec.combos), 1)
+    base = str(rec.append_parent or "")
+    base_len = int(rec.append_base_len or 0)
+    panel_b = len(rec.ohlcv) if rec.ohlcv is not None else 0
+    bars = int((rec.scenario or {}).get("n_bars", 0) or 0)
+    if bars <= 0:
+        bars = base_len if base_len > 0 else max(panel_b // 40, 1)
+    if panel_b <= 0:
+        panel_b = bars * 40
+    frac = 1.0
+    if base:
+        frac = (bars - base_len) / bars if bars > base_len > 0 else 0.25
+        frac = min(max(frac, 1e-3), 1.0)
+    return {"units": model_units(family, bars, combos),
+            "family": family,
+            "digest": str(rec.panel_digest or ""),
+            "base_digest": base,
+            "panel_b": int(panel_b),
+            "frac": frac,
+            "rate": h2d_rate_bps(),
+            "cold": compile_wall_s()}
+
+
+class PlacementTable:
+    """One immutable locality score table: everything the live
+    placement stage needs to rank a job across the fleet, pre-computed
+    OFF the take lock on the plane's daemon tick
+    (:meth:`DecisionPlane.refresh_placement_table`). The dispatcher's
+    admit hook reads the latest table with a single attribute load and
+    calls :meth:`rank` under the queue lock — pure dict/math work over
+    this frozen state, no locks, no I/O, no fleet folds.
+
+    Per-worker state: calibrated seconds-per-unit, the stale/straggler
+    score-down ``penalty``, the telemetry residency sketch (12-hex
+    prefixes), the dispatcher's delivered-digest set (ground truth —
+    held by reference; membership reads are GIL-atomic and a racy read
+    is at worst one poll stale), and the compile-warm family set.
+
+    ``any_warmth``: before ANY completion has calibrated a family
+    anywhere, family warmth is unknown — charging everyone the cold
+    wall would only drown the residency terms a fresh fleet CAN know
+    (delivered digests), so an uncalibrated table treats every worker
+    as warm. Once any family is known, unknown workers pay cold."""
+
+    __slots__ = ("workers", "built_s", "default_spu", "any_warmth")
+
+    def __init__(self, workers: dict, *, built_s: float,
+                 default_spu: float):
+        self.workers = workers
+        self.built_s = built_s
+        self.default_spu = default_spu
+        self.any_warmth = any(w["fams"] for w in workers.values())
+
+    _DEFAULT_W = {"spu": None, "penalty": 1.0, "prefixes": frozenset(),
+                  "delivered": (), "fams": frozenset(),
+                  "stale": False, "stragglers": ()}
+
+    def score(self, ctx: dict, wid: str) -> dict:
+        """Expected stage cost of ``ctx``'s job on one worker, via the
+        shared :func:`placement_cost` (cross-pinned against the shadow
+        scorer by test)."""
+        w = self.workers.get(wid, self._DEFAULT_W)
+        spu = w["spu"] if w["spu"] is not None else self.default_spu
+        delivered = w["delivered"]
+        base = ctx["base_digest"]
+        digest = ctx["digest"]
+        carry_hit = bool(base) and (base in delivered
+                                    or base[:12] in w["prefixes"])
+        resident = bool(digest) and (digest in delivered
+                                     or digest[:12] in w["prefixes"])
+        warm = (ctx["family"] in w["fams"]) if self.any_warmth else True
+        return placement_cost(
+            units=ctx["units"], spu=spu, panel_b=ctx["panel_b"],
+            frac=ctx["frac"], carry_hit=carry_hit, resident=resident,
+            family_warm=warm, rate=ctx["rate"], cold=ctx["cold"],
+            penalty=w["penalty"])
+
+    def rank(self, ctx: dict, polling: str) -> tuple:
+        """Score ``ctx`` on every table worker plus the polling worker
+        (which may be absent from the table — a worker's very first
+        poll predates any frame or delivery); returns
+        ``(my_cost, best_wid, best_cost)`` with ties by sorted wid."""
+        mine = None
+        best_wid = None
+        best = None
+        wids = set(self.workers)
+        wids.add(polling)
+        for wid in sorted(wids):
+            c = self.score(ctx, wid)
+            if wid == polling:
+                mine = c
+            if best is None or c["cost_s"] < best["cost_s"]:
+                best_wid, best = wid, c
+        return mine, best_wid, best
+
+
 class DecisionPlane:
     """Per-dispatcher decision recorder + shadow placement scorer.
 
@@ -208,10 +407,14 @@ class DecisionPlane:
         self._burst = max(self._rate, 32.0)
         self._tokens = self._burst
         self._t_refill = clock()
-        # (family, bars, combos) -> model units memo: the op-model walk
-        # is ~1/3 of a record's scoring cost and fleets dispatch long
-        # runs of identically-shaped jobs. Scoring-thread-only, bounded.
-        self._units_memo: dict[tuple, float] = {}
+        # Live placement (round 20): armed by the owning dispatcher via
+        # attach_placement; the daemon tick republishes _table (one
+        # attribute swap — readers never lock) from the fleet view, the
+        # dispatcher's delivered-digest callback, and the calibration
+        # maps above.
+        self._placement_armed = False
+        self._delivered_fn = None
+        self._table: PlacementTable | None = None
         self._n_scored = 0
         self._regret_sum = 0.0
         self._regret_ewma = 0.0
@@ -264,10 +467,10 @@ class DecisionPlane:
                t_take: float = 0.0) -> None:
         """Queue one take()'s decision records for async scoring.
         Items are either full raw dicts (tests, synthetic streams) or
-        the dispatcher's deferred 5-tuples ``(rec, route, digest,
-        panel_b, wfq)`` — the record object plus the four values only
-        the dispatch loop knows, with ``worker``/``t_take`` shared
-        batch-wide. Tuple items cost the hot path one small allocation;
+        the dispatcher's deferred tuples ``(rec, route, digest,
+        panel_b, wfq[, placement])`` — the record object plus the
+        values only the dispatch loop knows, with ``worker``/``t_take``
+        shared batch-wide. Tuple items cost the hot path one small allocation;
         the dict view is assembled on the scoring thread
         (:meth:`_raw_of`). The scoring budget is spent HERE, under the
         same lock the append needs anyway: records past the budget are
@@ -378,6 +581,96 @@ class DecisionPlane:
             if len(fams) < self._FAM_MAX:
                 fams.add(family)
 
+    # -- live placement table (round 20) -------------------------------
+
+    #: A table older than this is not served to the take path: a wedged
+    #: scorer thread must degrade placement to pure WFQ, never freeze a
+    #: view of a fleet that has moved on.
+    TABLE_MAX_AGE_S = 2.0
+
+    def attach_placement(self, delivered_fn=None) -> None:
+        """Arm the live placement table: the daemon tick (the same 50 ms
+        cadence that scores shadow batches) starts rebuilding the score
+        table from the fleet's ``placement_view()`` export, the
+        dispatcher's delivered-digest ground truth (``delivered_fn`` ->
+        ``{wid: set-of-digests}``, sets held by REFERENCE — membership
+        reads are GIL-atomic and at worst one poll stale), and this
+        plane's spu/family calibration. Called once by the owning
+        dispatcher while ``DBX_PLACEMENT`` is live; idempotent."""
+        # Prime the op model's lazy tune.autotune import HERE, off every
+        # lock: the take-path ctx builder calls model_units under the
+        # queue lock, and a first-call import there would nest the
+        # interpreter's import machinery inside it.
+        model_units("sma_crossover", 2, 1)
+        with self._lock:
+            if self._closed:
+                return
+            self._delivered_fn = delivered_fn
+            self._placement_armed = True
+            self._ensure_thread()
+
+    def refresh_placement_table(self) -> "PlacementTable":
+        """Build and publish a fresh placement table NOW — the daemon
+        tick's body, also the deterministic hook tests and bench call
+        directly. Runs entirely off the take lock: one fleet fold, one
+        delivered-map read, one pass over the calibration maps. The
+        worker universe is fleet-view ∪ delivered-map: a worker with no
+        telemetry frame (raw pollers, fresh fleets) still places by the
+        dispatcher's own delivery ground truth."""
+        view: dict = {}
+        if self._fleet is not None:
+            try:
+                view = self._fleet.placement_view()
+            except Exception:
+                view = {}
+        delivered: dict = {}
+        fn = self._delivered_fn
+        if fn is not None:
+            try:
+                delivered = fn() or {}
+            except Exception:
+                delivered = {}
+        with self._lock:
+            spu_of = {w: cal[1] for w, cal in self._spu.items()}
+            default_spu = self._spu_global[1]
+            fams = {w: frozenset(f) for w, f in self._fams.items()}
+        workers = {}
+        for wid in sorted(set(view) | set(delivered)):
+            v = view.get(wid) or {}
+            stale = bool(v.get("stale"))
+            stragglers = tuple(v.get("stragglers") or ())
+            penalty = 1.0
+            if stale:
+                penalty *= STALE_PENALTY
+            if stragglers:
+                penalty *= STRAGGLER_PENALTY
+            workers[wid] = {
+                "spu": spu_of.get(wid, default_spu),
+                "penalty": penalty,
+                "prefixes": frozenset(v.get("resident") or ()),
+                "delivered": delivered.get(wid) or (),
+                "fams": fams.get(wid, frozenset()),
+                "stale": stale,
+                "stragglers": stragglers,
+            }
+        table = PlacementTable(workers, built_s=self._clock(),
+                               default_spu=default_spu)
+        self._table = table
+        return table
+
+    def placement_table(self, max_age_s: float | None = None):
+        """The latest placement table, or ``None`` when placement is
+        unarmed, nothing has been built yet, or the builder has not
+        ticked within ``max_age_s`` (degrade to pure WFQ). Lock-free:
+        one attribute load plus a clock read."""
+        t = self._table
+        if t is None:
+            return None
+        bound = self.TABLE_MAX_AGE_S if max_age_s is None else max_age_s
+        if self._clock() - t.built_s > bound:
+            return None
+        return t
+
     # -- scoring thread ------------------------------------------------
 
     def _ensure_thread(self) -> None:
@@ -393,6 +686,14 @@ class DecisionPlane:
         while True:
             self._wake.wait(timeout=self._TICK_S)
             self._wake.clear()
+            if self._placement_armed and not self._closed:
+                # Live placement table refresh rides the same tick the
+                # shadow scorer wakes on — "off the take lock" is this
+                # thread, one attribute swap publishes the result.
+                try:
+                    self.refresh_placement_table()
+                except Exception:
+                    self._c_dropped["error"].inc()
             while True:
                 completions = None
                 payload = None
@@ -434,12 +735,16 @@ class DecisionPlane:
     def _raw_of(item, worker: str, t_take: float) -> dict:
         """Dict view of one submitted item — a raw dict verbatim, or
         the dispatcher's deferred ``(rec, route, digest, panel_b,
-        wfq)`` tuple expanded from the job record's own fields HERE,
-        on the scoring thread, so the take path never builds it."""
+        wfq[, placement])`` tuple expanded from the job record's own
+        fields HERE, on the scoring thread, so the take path never
+        builds it. The optional 6th element is the live placement
+        verdict the round-20 admit hook stashed for this job."""
         if isinstance(item, dict):
             return dict(item)
-        rec, route, digest, panel_b, wfq = item
+        rec, route, digest, panel_b, wfq = item[:5]
+        placement = item[5] if len(item) > 5 else None
         return {
+            **({"placement": placement} if placement else {}),
             "jid": rec.id, "trace_id": rec.trace_id,
             "worker": worker, "tenant": rec.tenant,
             "strategy": rec.strategy, "combos": float(rec.combos),
@@ -464,11 +769,17 @@ class DecisionPlane:
                                                              {})
                     except Exception:
                         workers = {}
+                delivered = {}
+                if self._delivered_fn is not None:
+                    try:
+                        delivered = self._delivered_fn() or {}
+                    except Exception:
+                        delivered = {}
                 with self._lock:
                     spu_of = {w: cal[1] for w, cal in self._spu.items()}
                     spu_default = self._spu_global[1]
                     fams = {w: set(f) for w, f in self._fams.items()}
-                snap = (workers, spu_of, spu_default, fams)
+                snap = (workers, spu_of, spu_default, fams, delivered)
             try:
                 rec = self._score_one(self._raw_of(item, worker, t_take),
                                       *snap)
@@ -491,32 +802,20 @@ class DecisionPlane:
                    if isinstance(e, dict))
 
     def _units_for(self, raw: dict) -> tuple[float, str]:
-        """Model units for this job via the shared op model; falls back
-        to raw cell-bars when the family is unmodelable. Bars not known
-        at dispatch are estimated from the full panel byte size (DBX1 ~
-        5 float64 columns => ~40 B/bar)."""
+        """Model units for this job via the shared module-wide memo
+        (:func:`model_units`). Bars not known at dispatch are estimated
+        from the full panel byte size (DBX1 ~ 5 float64 columns =>
+        ~40 B/bar)."""
         family = str(raw.get("strategy", ""))
         combos = max(int(raw.get("combos", 0) or 0), 1)
         bars = int(raw.get("bars", 0) or 0)
         if bars <= 0:
             bars = max(int(int(raw.get("panel_b", 0) or 0) / 40), 1)
-        key = (family, bars, combos)
-        units = self._units_memo.get(key)
-        if units is not None:
-            return units, family
-        try:
-            units = costmodel._model_units(family, bars, combos)
-        except Exception:
-            units = 0.0
-        if units <= 0.0 or not math.isfinite(units):
-            units = float(bars) * float(combos)
-        if len(self._units_memo) >= 512:    # shapes are wire-controlled
-            self._units_memo.clear()
-        self._units_memo[key] = units
-        return units, family
+        return model_units(family, bars, combos), family
 
     def _score_one(self, raw: dict, workers: dict, spu_of: dict,
-                   spu_default: float, fams: dict) -> dict:
+                   spu_default: float, fams: dict,
+                   delivered: dict | None = None) -> dict:
         actual = str(raw.get("worker", ""))
         route = route_bucket(str(raw.get("route", "")))
         self._c_routes[route].inc()
@@ -535,33 +834,42 @@ class DecisionPlane:
             frac = min(max(frac, 1e-3), 1.0)
         rate = h2d_rate_bps()
         cold = compile_wall_s()
+        delivered = delivered or {}
 
         def score(wid: str, wentry: dict) -> dict:
-            spu = spu_of.get(wid, spu_default)
-            exec_s = units * spu
+            dlv = delivered.get(wid) or ()
             carry_hit = False
             if base_digest:
                 # Carry-hit vs reprice: ground truth for the actual
                 # worker (a delta route means the dispatcher verified
-                # the base is held); the digest sketch for shadows.
+                # the base is held) and for any delivered-set holder;
+                # the digest sketch for the rest of the shadows.
                 carry_hit = (wid == actual and route == "delta") or \
-                    self._resident(wentry, base_digest)
-                if carry_hit:
-                    exec_s *= frac
+                    self._resident(wentry, base_digest) or \
+                    base_digest in dlv
             resident = (wid == actual and route in
                         ("digest_only", "delta", "scenario")) or \
-                self._resident(wentry, digest) or carry_hit
-            transfer_s = 0.0 if resident else panel_b / rate
-            compile_s = 0.0 if family in fams.get(wid, ()) else cold
-            return {"cost_s": exec_s + transfer_s + compile_s,
-                    "exec_s": exec_s, "transfer_s": transfer_s,
-                    "compile_s": compile_s, "carry_hit": carry_hit,
-                    "resident": resident}
+                self._resident(wentry, digest) or \
+                (bool(digest) and digest in dlv)
+            # Degraded-but-live workers are scored down, never dropped
+            # from the candidate set (the round-20 liveness rule).
+            penalty = 1.0
+            if wentry.get("stale"):
+                penalty *= STALE_PENALTY
+            if wentry.get("stragglers"):
+                penalty *= STRAGGLER_PENALTY
+            return placement_cost(
+                units=units, spu=spu_of.get(wid, spu_default),
+                panel_b=panel_b, frac=frac, carry_hit=carry_hit,
+                resident=resident,
+                family_warm=family in fams.get(wid, ()),
+                rate=rate, cold=cold, penalty=penalty)
 
-        candidates = {wid: e for wid, e in workers.items()
-                      if not e.get("stale")}
+        candidates = dict(workers)
+        for wid in delivered:
+            candidates.setdefault(wid, {})
         if actual and actual not in candidates:
-            candidates[actual] = workers.get(actual, {})
+            candidates[actual] = {}
         scored = {wid: score(wid, e) for wid, e in
                   sorted(candidates.items())}
         shadow: dict = {"candidates": len(scored)}
@@ -602,6 +910,13 @@ class DecisionPlane:
             "shadow": shadow,
             "t_take": float(raw.get("t_take", 0.0)),
         }
+        placement = raw.get("placement")
+        if placement:
+            # The live placement verdict the admit hook stashed at
+            # take time (round 20): chosen-vs-best worker, score gap,
+            # defers spent. Shadow ranking above stays independent —
+            # dual mode is the point (regret validates the policy).
+            rec["placement"] = dict(placement)
         wfq = raw.get("wfq")
         if wfq is not None:
             # take() hands back live PickExplain objects; serializing
@@ -690,6 +1005,16 @@ class DecisionPlane:
                 },
                 "calibrated_workers": len(self._spu),
                 "recent": list(self._ring)[-max(tail, 0):],
+            }
+            table = self._table
+            doc["placement"] = {
+                "live": sched_placement.enabled(),
+                "armed": self._placement_armed,
+                "defer_cap": sched_placement.defer_cap(),
+                "table": ({"workers": len(table.workers),
+                           "age_s": round(max(
+                               self._clock() - table.built_s, 0.0), 3)}
+                          if table is not None else None),
             }
         judged = agree + disagree
         doc["agreement"] = {

@@ -902,6 +902,28 @@ class FleetView:
             },
         }
 
+    def placement_view(self, now: float | None = None) -> dict:
+        """Score-table export (round 20): the per-worker scoring inputs
+        the decision plane's placement table is built from, and nothing
+        else — staleness/straggler verdicts (score-down signals, never
+        exclusion), frame age, and the cache-residency digest sketch as
+        a flat prefix tuple. Derived from :meth:`snapshot` so the
+        straggler rule (fleet p95 with a real population behind it)
+        stays single-sourced; runs on the plane's daemon tick, never
+        under the take lock."""
+        out: dict = {}
+        for wid, w in self.snapshot(now)["workers"].items():
+            topk = (w.get("caches") or {}).get("panel_topk") or ()
+            out[wid] = {
+                "stale": bool(w.get("stale")),
+                "age_s": float(w.get("age_s", 0.0)),
+                "stragglers": tuple(w.get("stragglers") or ()),
+                "resident": tuple(
+                    str(e.get("d", "")) for e in topk
+                    if isinstance(e, dict) and e.get("d")),
+            }
+        return out
+
     def collected_snapshot(self, max_age_s: float = 1.0):
         """The snapshot the last :meth:`collect` built, when fresh —
         ``None`` otherwise. GetStats' ``obs_json`` path runs the

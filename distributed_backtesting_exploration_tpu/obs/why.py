@@ -2,7 +2,9 @@
 
 The decision plane (obs/decisions.py) records one explain document per
 dispatched job — the WFQ pick context (sched/explain.py), the payload
-route, the polling worker's fleet-view age, and the shadow placement
+route, the polling worker's fleet-view age, the LIVE placement stage's
+rank (round 20: chosen vs best-placed worker, score gap, deferrals
+spent against ``DBX_PLACEMENT_DEFER_CAP``), and the shadow placement
 scorer's per-candidate cost ranking with its measured regret. The
 PR-4 timeline (obs/timeline.py) records what then actually happened —
 queue-wait, dispatch, transport, compile/execute, d2h, report. This CLI
@@ -105,7 +107,7 @@ def render_decision(d: dict, idx: int, total: int) -> str:
                   else "(no telemetry)"))
     wfq = d.get("wfq")
     if isinstance(wfq, dict) and wfq.get("affinity_held"):
-        out.append("wfq: served from the affinity-held list (one-shot "
+        out.append("wfq: served from the placement-held list (locality "
                    "deferral; no pick-time scheduler state)")
     elif isinstance(wfq, dict):
         out.append(
@@ -120,6 +122,20 @@ def render_decision(d: dict, idx: int, total: int) -> str:
         if wfq.get("demoted"):
             out.append("  quota-demoted this pick: "
                        + ", ".join(wfq["demoted"]))
+    placement = d.get("placement")
+    if isinstance(placement, dict):
+        best = str(placement.get("best", "?"))
+        actual = str(d.get("worker", ""))
+        verdict = ("best-placed worker" if best == actual else
+                   f"best-placed was {best}, "
+                   f"gap {timeline._fmt_s(placement.get('gap_s', 0.0))}")
+        out.append(
+            f"placement: outcome={placement.get('outcome', '?')}  "
+            f"cost={timeline._fmt_s(placement.get('cost_s', 0.0))} "
+            f"vs best={timeline._fmt_s(placement.get('best_cost_s', 0.0))}  "
+            f"defers={placement.get('defers', 0)}/{placement.get('cap', 0)}  "
+            f"({verdict}; table: "
+            f"{placement.get('table_workers', 0)} worker(s))")
     shadow = d.get("shadow") or {}
     costs = shadow.get("costs") or {}
     if costs:
@@ -168,8 +184,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="dbxwhy",
         description="stitch a job's dispatch decision records (WFQ pick "
-                    "context, payload route, shadow placement ranking, "
-                    "regret) with its span timeline")
+                    "context, payload route, live placement rank, shadow "
+                    "placement ranking, regret) with its span timeline")
     ap.add_argument("job", help="job id (or trace-id prefix)")
     ap.add_argument("--jsonl", nargs="+", action="extend", default=[],
                     metavar="PATH",

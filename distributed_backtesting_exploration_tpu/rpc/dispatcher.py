@@ -50,7 +50,8 @@ from ..obs import decisions as obs_decisions
 from ..obs import fleet as obs_fleet
 from ..obs import flight as obs_flight
 from ..runtime import _core as native_core
-from ..sched import DEFAULT_TENANT, WfqScheduler, held_explain, tenant_bucket
+from ..sched import (DEFAULT_TENANT, WfqScheduler, held_explain,
+                     placement as sched_placement, tenant_bucket)
 from ..utils import data as data_mod
 
 log = logging.getLogger("dbx.dispatcher")
@@ -123,9 +124,13 @@ class JobRecord:
     append_parent: str = ""
     append_base_len: int = 0
     delta: bytes | None = None
-    # Routing-affinity bookkeeping (NOT journaled): how many times take()
-    # deferred this job hoping the base-holding worker polls next. One
-    # deferral max — then any worker serves it (full reprice fallback).
+    # Placement-deferral bookkeeping (NOT journaled — locality evidence
+    # dies with the process, so restarts restart locality cold): how many
+    # times take() deferred this job for a better-scored worker (round
+    # 20, sched.placement). At DBX_PLACEMENT_DEFER_CAP any worker serves
+    # it. The field name survives from the round-6 one-shot append
+    # affinity this budget generalized (record/decision-schema
+    # stability).
     affinity_skips: int = 0
     # Multi-tenant serving (proto JobSpec.tenant_id): the weighted-fair-
     # queueing identity. proto3's default empty string — and a journal
@@ -464,15 +469,29 @@ class JobQueue:
         # through that window or an observer could tear the dispatcher down
         # with a job mid-dispatch.
         self._in_take = 0
-        # Affinity-deferred append jobs, held OUT of the FIFO so the next
+        # Placement-deferred jobs, held OUT of the FIFO so the next
         # take() serves them FIRST (front of line — a tail re-push would
         # park a latency-critical live update behind the whole batch
         # backlog). Journaled-pending either way, so a crash loses
-        # nothing.
-        self._affinity_held: list[str] = []
+        # nothing; held ids re-enter through the admit filter each
+        # round, which is what lets a job wait up to the deferral cap.
+        self._placement_held: list[str] = []
+        # Pending-digest refcounts for the placement stage's chain-
+        # settling rule: digest -> how many NOT-YET-DISPATCHED jobs
+        # carry it as their panel digest. An append link whose parent
+        # is still in here has no carry holder anywhere yet, so the
+        # score table cannot route it — the admit gate defers it
+        # (within the same affinity_skips budget) until the parent
+        # settles. Counts move under self._lock: incremented at
+        # intake, decremented at lease commit or intake-side failure.
+        # NOT journaled (restarts restart locality cold, like the rest
+        # of the placement state); rare refcount drift (requeue after
+        # lease expiry re-leases without re-incrementing) is bounded
+        # harm — the cap bounds any wait either way.
+        self._pending_digests: dict[str, int] = {}
         # Weighted-fair-queueing index (sched.wfq): EVERY pending job is
         # parked in a per-tenant lane, held OUT of the state machine's
-        # FIFO under the same discipline as _affinity_held — enqueue
+        # FIFO under the same discipline as _placement_held — enqueue
         # pushes through the state machine (register + FIFO) and
         # immediately drains the FIFO into the lanes under the same
         # lock, so the FIFO is empty between public calls and the WFQ
@@ -570,6 +589,8 @@ class JobQueue:
                 # take() re-materialize through this map.
                 if rec.panel_digest:
                     self._digest_jobs[rec.panel_digest] = rec.id
+                    self._pending_digests[rec.panel_digest] = \
+                        self._pending_digests.get(rec.panel_digest, 0) + 1
                 if rec.panel_digest2:
                     self._digest_jobs[rec.panel_digest2] = rec.id
             self._state.enqueue_n([rec.id for rec in recs],
@@ -659,15 +680,21 @@ class JobQueue:
         than the dict fallback (DESIGN.md's 42k-vs-85k row); one crossing
         per RPC is the fix.
 
-        ``admit`` is the streaming-affinity hook (``rec -> bool``,
-        consulted only for append jobs): a False verdict defers the job —
+        ``admit`` is the placement hook (``rec -> bool``, consulted for
+        EVERY popped record — round 20 generalized the append-only
+        affinity special case away): a False verdict defers the job —
         held OUT of the FIFO (front of line: the NEXT take() call, from
-        any worker, sees held jobs before the FIFO) — so a worker
-        holding the job's base panel gets first claim at the O(ΔT) path
-        without the job losing its place behind a batch backlog. The
-        callback bounds its own deferrals (``JobRecord.affinity_skips``);
-        a held job is served to ANYONE on the next attempt, so affinity
-        can delay a job by at most one poll round, never starve it.
+        any worker, sees held jobs before the FIFO and runs them
+        through its own admit again) — so a better-scored worker gets
+        first claim without the job losing its place behind a batch
+        backlog. The callback MUST bound its own deferrals
+        (``JobRecord.affinity_skips`` is the budget the round-20
+        placement gate spends, capped at ``DBX_PLACEMENT_DEFER_CAP``);
+        a held job whose budget is spent is served to ANYONE, so
+        placement can delay a job by a bounded number of poll rounds,
+        never starve it. WFQ fairness is untouched: the pick (and its
+        quota charge) happened before the hook runs, and a deferred job
+        keeps its place at the front.
 
         ``scenario_spec`` (a dict, or None) opts the caller into the
         scenario-megakernel spec dispatch: an eligible scenario record
@@ -703,7 +730,22 @@ class JobQueue:
                     # must not flicker True with a live job in neither
                     # pending nor leased, and the next take() drains the
                     # held list before popping the FIFO.
-                    self._affinity_held.extend(deferred)
+                    self._placement_held.extend(deferred)
+
+    def _digest_settled(self, digest: str) -> None:
+        """Release one pending-digest refcount (caller holds ``_lock``):
+        a job carrying this panel digest just left the pending pool —
+        leased (the digest now HAS a holder the score table can route
+        on) or failed at intake (it never will). ``get``-guarded: file-
+        backed payloads stamp their digest at first materialization,
+        AFTER intake counted nothing for them."""
+        if not digest:
+            return
+        left = self._pending_digests.get(digest, 0) - 1
+        if left > 0:
+            self._pending_digests[digest] = left
+        else:
+            self._pending_digests.pop(digest, None)
 
     def _take_inner(self, n, worker_id, admit, out, deferred,
                     scenario_spec=None, explain=None):
@@ -712,13 +754,15 @@ class JobQueue:
             with self._lock:
                 jids = []
                 if first:
-                    # Previously deferred append jobs go first — they
+                    # Previously placement-deferred jobs go first — they
                     # were at (or near) the FIFO head when deferred.
+                    # They re-enter the admit loop below, so a job keeps
+                    # deferring until its budget caps out.
                     first = False
-                    k = min(len(self._affinity_held), n - len(out))
+                    k = min(len(self._placement_held), n - len(out))
                     if k:
-                        jids = self._affinity_held[:k]
-                        self._affinity_held = self._affinity_held[k:]
+                        jids = self._placement_held[:k]
+                        self._placement_held = self._placement_held[k:]
                         # Already counted in _in_take while held; the
                         # per-iteration accounting below re-counts every
                         # id in `jids`, so release the held count here.
@@ -753,14 +797,14 @@ class JobQueue:
                     for j, r in zip(jids, recs):
                         # ONE admit call per rec: the callback counts its
                         # own deferrals on the record.
-                        if r.append_parent and not admit(r):
+                        if not admit(r):
                             deferred.append(j)
                         else:
                             kept_j.append(j)
                             kept_r.append(r)
                     jids, recs = kept_j, kept_r
                 # Deferred ids count as in-take for as long as they sit
-                # in _affinity_held (neither pending nor leased); the
+                # in _placement_held (neither pending nor leased); the
                 # count releases when a later take() re-serves them.
                 self._in_take += len(jids) + len(deferred) - n_deferred0
             good: list[tuple[str, JobRecord, bytes]] = []
@@ -854,6 +898,10 @@ class JobQueue:
                         if ok:
                             self._sched.on_lease(jid, r.tenant,
                                                  float(r.combos))
+                            # The digest has a holder now: any chain
+                            # child waiting on it can route on the next
+                            # table refresh instead of burning polls.
+                            self._digest_settled(r.panel_digest)
                         else:
                             self._sched.release(jid)
                     # Every triaged id is resolved — including a failed-
@@ -870,6 +918,11 @@ class JobQueue:
                     failed = [(jid, path, e, r)
                               for jid, path, e, r in failed
                               if self._state.fail(jid)]
+                    for _, _, _, r in failed:
+                        # A failed job's digest will never be held —
+                        # release the refcount so chain children stop
+                        # waiting on it before their cap runs out.
+                        self._digest_settled(r.panel_digest)
                 for jid, path, e, r in failed:
                     log.error("job %s: unreadable %s (%s) -> failed",
                               jid, path, e)
@@ -1534,6 +1587,25 @@ def _scenario_fused_enabled() -> bool:
     return os.environ.get("DBX_SCENARIO_FUSED", "1") != "0"
 
 
+class _PlacementGate:
+    """One poll's live placement verdicts (``Dispatcher._placement_gate``):
+    the admit closure plus the state it accumulates under the queue lock
+    — per-job placement info for the decision records and outcome counts
+    for the metrics — both drained by RequestJobs after take() returns."""
+
+    __slots__ = ("admit", "info", "counts", "served_digests")
+
+    def __init__(self):
+        self.admit = None
+        self.info: dict = {}
+        self.counts = {"served": 0, "deferred": 0, "cap": 0}
+        # Panel digests served THIS poll: the pending-digest refcount
+        # only drops at lease commit (a later lock block), so without
+        # this a chain child popped in the same batch as its parent
+        # would still see the parent "pending" and burn a deferral.
+        self.served_digests: set = set()
+
+
 def _timed_rpc(method: str):
     """Record the handler's wall into ``dbx_rpc_seconds{method=...}``.
 
@@ -1713,11 +1785,32 @@ class Dispatcher(service.DispatcherServicer):
         # Dispatch decision plane (obs/decisions.py, round 19): every
         # take() resolution becomes one bounded decision record — WFQ
         # pick context, payload route, fleet-view age — scored off the
-        # hot path by the shadow placement ranker against THIS fleet
-        # view. Records never influence dispatch (ROADMAP item 2 in
-        # shadow mode); DBX_DECISIONS=0 kills record assembly entirely.
+        # hot path by the placement ranker against THIS fleet view.
+        # DBX_DECISIONS=0 kills record assembly entirely.
         self.decisions = obs_decisions.DecisionPlane(
             fleet=self.fleet, registry=self.obs)
+        # Live locality placement (round 20): arm the plane's score
+        # table — rebuilt on its daemon tick from the fleet view, the
+        # delivered-digest ground truth, and completion calibration —
+        # and the take-path gate reads it lock-free per poll
+        # (_placement_gate). DBX_PLACEMENT=0 at construction keeps the
+        # plane in round-19 pure-shadow mode; the per-poll gate checks
+        # the knob again, so flipping it later also works (table
+        # refreshes are cheap and verdict-free). Placement state is
+        # deliberately NOT journaled: locality evidence (delivered
+        # sets, calibration) dies with the process, so restarts restart
+        # locality cold and replay stays byte-identical.
+        if sched_placement.enabled():
+            self.decisions.attach_placement(self._delivered_snapshot)
+        self._c_placement = {
+            o: self.obs.counter(
+                "dbx_placement_total",
+                help="live placement verdicts at take time: served "
+                     "(best here or no better worker), deferred (held "
+                     "for a better-scored worker), cap (better worker "
+                     "exists but the deferral budget is spent)",
+                outcome=o)
+            for o in ("served", "deferred", "cap")}
         # Thread-local: concurrent GetStats calls on the gRPC pool must
         # each lend their OWN snapshot to the collector, not race on one
         # shared slot.
@@ -1916,30 +2009,89 @@ class Dispatcher(service.DispatcherServicer):
         self._c_payloads["full"].inc()
         return payload
 
-    def _affinity_admit(self, worker_id: str, delivered: set | None):
-        """The take() affinity hook for this poll: defer an append job
-        (once) when ANOTHER live worker holds its base panel and this one
-        does not — the holder advances the carry in O(ΔT); everyone else
-        would full-reprice. Never starves: a job is deferred at most once
-        (affinity_skips), and only while some other worker actually holds
-        the base."""
+    def _delivered_snapshot(self) -> dict:
+        """Per-worker delivered-digest sets for the placement table
+        builder (``DecisionPlane.attach_placement``). A shallow copy:
+        the SETS ride by reference — membership reads are GIL-atomic,
+        and a racy read is at worst one poll stale, which is exactly
+        the staleness the table itself has."""
+        with self._delivered_lock:
+            return dict(self._delivered)
+
+    def _placement_gate(self, worker_id: str):
+        """The take() placement stage for ONE poll (round 20, replacing
+        the round-6 append-affinity special case): rank every popped
+        candidate across the pre-computed score table and defer a job —
+        up to ``DBX_PLACEMENT_DEFER_CAP`` polls — when a better-scored
+        worker should serve it instead. Returns ``None`` (no admit hook
+        at all, pure WFQ order) when the stage is killed
+        (``DBX_PLACEMENT=0``) or no fresh table exists (empty fleet,
+        cold start, wedged scorer — the degradation ladder's floor).
+
+        The returned gate's ``admit`` runs under the queue lock: pure
+        dict/math over the frozen table (the table build did every
+        fleet fold off this path). Verdicts accumulate on the gate —
+        ``info`` (per-job, for the decision records) and ``counts``
+        (for the ``dbx_placement_total`` counters) — and are flushed
+        by RequestJobs AFTER take() returns, so no metric locks are
+        ever taken under the queue lock."""
+        if not sched_placement.enabled():
+            return None
+        table = self.decisions.placement_table()
+        if table is None or not table.workers:
+            return None
+        cap = sched_placement.defer_cap()
+        gate = _PlacementGate()
+        # Chain-settling input, captured by reference: admit runs under
+        # the queue lock, where these counts are mutated — a membership
+        # read here can never tear.
+        pending = self.queue._pending_digests
+
         def admit(rec: JobRecord) -> bool:
-            if rec.affinity_skips >= 1:
+            try:
+                ctx = obs_decisions.placement_ctx(rec)
+                mine, best_wid, best = table.rank(ctx, worker_id)
+            except Exception:
+                # A scoring failure must never defer (or fail) a job.
+                gate.counts["served"] += 1
                 return True
-            if delivered is not None and (
-                    rec.append_parent in delivered
-                    or rec.panel_digest in delivered):
-                return True
-            with self._delivered_lock:
-                holder = any(
-                    rec.append_parent in digests
-                    for wid, digests in self._delivered.items()
-                    if wid != worker_id)
-            if not holder:
-                return True
-            rec.affinity_skips += 1
-            return False
-        return admit
+            better = (best_wid != worker_id
+                      and sched_placement.should_defer(
+                          mine["cost_s"], best["cost_s"], 0, 1))
+            # Chain settling: an append link whose parent job has not
+            # dispatched yet scores holderless (equal costs everywhere,
+            # `better` never fires) — wait for the parent to settle so
+            # the table can route the whole chain, within the same
+            # deferral budget. A parent served earlier in THIS poll
+            # counts as settled (it is going to this very worker).
+            base = str(ctx.get("base_digest") or "")
+            wait_parent = (not better and bool(base)
+                           and base not in gate.served_digests
+                           and pending.get(base, 0) > 0
+                           and sched_placement.should_wait_for_parent(
+                               rec.affinity_skips, cap))
+            if (better and rec.affinity_skips < cap) or wait_parent:
+                rec.affinity_skips += 1
+                gate.counts["deferred"] += 1
+                return False
+            gate.counts["cap" if better else "served"] += 1
+            if rec.panel_digest:
+                gate.served_digests.add(rec.panel_digest)
+            gate.info[rec.id] = {
+                "live": True,
+                "best": best_wid,
+                "cost_s": round(mine["cost_s"], 9),
+                "best_cost_s": round(best["cost_s"], 9),
+                "gap_s": round(mine["cost_s"] - best["cost_s"], 9),
+                "defers": int(rec.affinity_skips),
+                "cap": cap,
+                "outcome": "cap" if better else "served",
+                "table_workers": len(table.workers),
+            }
+            return True
+
+        gate.admit = admit
+        return gate
 
     # -- RPC handlers ------------------------------------------------------
 
@@ -1993,11 +2145,19 @@ class Dispatcher(service.DispatcherServicer):
             {} if obs_decisions.enabled() and self.decisions.want()
             else None)
         dec_batch: list[dict] = []
+        # Live placement stage (round 20): gate verdicts accumulate on
+        # the gate object under the queue lock; counters flush AFTER
+        # take() returns (no metric locks under the queue lock).
+        gate = self._placement_gate(request.worker_id)
         taken = self.queue.take(n, request.worker_id,
-                                admit=self._affinity_admit(
-                                    request.worker_id, delivered),
+                                admit=(gate.admit if gate is not None
+                                       else None),
                                 scenario_spec=spec_jids,
                                 explain=explain)
+        if gate is not None:
+            for o, v in gate.counts.items():
+                if v:
+                    self._c_placement[o].inc(v)
         if taken:
             self._c_dispatched.inc(len(taken))
         reply = pb.JobsReply()
@@ -2055,12 +2215,14 @@ class Dispatcher(service.DispatcherServicer):
                         slo_s=self.tenant_slo_s)
             if spec_jids and rec.id in spec_jids:
                 if explain is not None:
-                    # Deferred decision record (5-tuple; see
+                    # Deferred decision record (tuple; see
                     # DecisionPlane.submit): the dict view assembles on
                     # the plane's thread, never on this path.
                     dec_batch.append((rec, "scenario",
                                       spec_jids[rec.id], len(payload),
-                                      explain.get(rec.id)))
+                                      explain.get(rec.id),
+                                      gate.info.get(rec.id)
+                                      if gate is not None else None))
                 scn_batches.setdefault(
                     (spec_jids[rec.id], rec.strategy,
                      tuple(sorted(
@@ -2087,7 +2249,9 @@ class Dispatcher(service.DispatcherServicer):
                     route = ("digest_only" if payload and not leg1
                              else "full")
                 dec_batch.append((rec, route, rec.panel_digest,
-                                  len(payload), explain.get(rec.id)))
+                                  len(payload), explain.get(rec.id),
+                                  gate.info.get(rec.id)
+                                  if gate is not None else None))
             reply.jobs.append(pb.JobSpec(
                 id=rec.id, strategy=rec.strategy,
                 ohlcv=leg1,

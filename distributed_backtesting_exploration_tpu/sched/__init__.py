@@ -15,9 +15,14 @@ behind it. This package owns the two pieces the dispatcher composes:
   obs-cardinality rule;
 - :mod:`.explain` — the pick-time explain records (round 19) the
   dispatch decision plane (obs/decisions.py) stitches into per-job
-  "why this worker" reports.
+  "why this worker" reports;
+- :mod:`.placement` — the locality-placement deferral budget (round
+  20): pure policy (``should_defer`` + the ``DBX_PLACEMENT`` /
+  ``DBX_PLACEMENT_DEFER_CAP`` knobs) over the stage costs the decision
+  plane's score table computes off the take lock.
 """
 
+from . import placement  # noqa: F401
 from .explain import PickExplain, held_explain  # noqa: F401
 from .tenancy import (  # noqa: F401
     DEFAULT_TENANT, OVERFLOW_BUCKET, reset_tenant_buckets,

@@ -84,8 +84,10 @@ class PickExplain:
 
 
 def held_explain(jid: str) -> dict:
-    """The explain record of a job served from the affinity-held list:
+    """The explain record of a job served from the placement-held list:
     it skipped the WFQ pop entirely this round (front-of-line service
-    after a one-shot deferral), so there is no pick-time scheduler state
-    to report — only the fact of the hold."""
+    after a locality deferral — round 20's generalization of the old
+    one-shot append-affinity hold), so there is no pick-time scheduler
+    state to report — only the fact of the hold. The ``affinity_held``
+    key name survives from round 6 for record-schema stability."""
     return {"jid": jid, "affinity_held": True}

@@ -347,7 +347,10 @@ def _decision_line(jid, worker="w1", t_take=1.0):
                                     "resident": True}}},
         "wfq": {"jid": jid, "tenant": "default", "tag": 0.0, "vtime": 0.0,
                 "vfinish": 4.0, "cost": 4.0, "weight": 1.0,
-                "over_quota": False, "demoted": [], "heads": {}}})
+                "over_quota": False, "demoted": [], "heads": {}},
+        "placement": {"live": True, "best": "w2", "cost_s": 0.3,
+                      "best_cost_s": 0.1, "gap_s": 0.2, "defers": 2,
+                      "cap": 2, "outcome": "cap", "table_workers": 2}})
 
 
 def test_dbxwhy_exit_2_on_no_match_and_no_events(tmp_path, capsys):
@@ -376,6 +379,11 @@ def test_dbxwhy_merges_logs_and_orders_the_decision_chain(
     assert "decision 1/2" in out and "decision 2/2" in out
     assert out.index("worker w1") < out.index("worker w9")
     assert "shadow preferred w2" in out
+    # Round 20: the LIVE placement rank is stitched into the chain —
+    # outcome, chosen-vs-best cost gap, deferral budget spent.
+    assert "placement: outcome=cap" in out
+    assert "best-placed was w2" in out
+    assert "defers=2/2" in out
     assert "(no span timeline for this job in the inputs)" in out
 
 

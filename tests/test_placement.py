@@ -1,0 +1,514 @@
+"""Locality-scored placement (round 20): the live dispatch stage.
+
+Tentpole coverage: the take()-time placement gate defers a job — within
+the ``DBX_PLACEMENT_DEFER_CAP`` budget — toward the worker the shared op
+model scores cheapest (carry-store hit vs full reprice, panel residency
+vs h2d, compile warmth), the chain-settling rule holds an append link
+while its parent job is still undispatched, and the degradation ladder
+bottoms out at pure WFQ order bit-identically (kill switch, empty fleet
+view). The live table and the round-19 shadow scorer price through ONE
+``placement_cost`` implementation — cross-pinned here. Fairness stays
+WFQ's: a whale workload under live placement inflates small tenants'
+service by bounded deferrals only, never starvation.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_backtesting_exploration_tpu import obs as obs_mod
+from distributed_backtesting_exploration_tpu.obs import (
+    decisions as dec_mod, why)
+from distributed_backtesting_exploration_tpu.rpc import (
+    backtesting_pb2 as pb, panel_store)
+from distributed_backtesting_exploration_tpu.rpc.dispatcher import (
+    Dispatcher, JobQueue, JobRecord, PeerRegistry, parse_grid)
+from distributed_backtesting_exploration_tpu.rpc.journal import Journal
+from distributed_backtesting_exploration_tpu.sched import (
+    placement, reset_tenant_buckets)
+from distributed_backtesting_exploration_tpu.utils import data
+
+
+@pytest.fixture(autouse=True)
+def _fresh_buckets():
+    reset_tenant_buckets()
+    yield
+    reset_tenant_buckets()
+
+
+GRID = parse_grid("fast=3:5,slow=10:14:2")
+
+
+# ---------------------------------------------------------------------------
+# Policy core (sched/placement.py): pure functions, env knobs
+# ---------------------------------------------------------------------------
+
+def test_should_defer_budget_semantics():
+    """The entire deferral budget in one function: relative ratio bar,
+    cap exhaustion, NaN-safety — ties and garbage always serve."""
+    # Best worker wins by > PLACEMENT_RATIO with budget left: defer.
+    assert placement.should_defer(1.0, 0.1, 0, 2)
+    assert placement.should_defer(1.0, 0.1, 1, 2)
+    # Budget spent: serve no matter the gap.
+    assert not placement.should_defer(1.0, 0.001, 2, 2)
+    # cap=0 keeps scoring live but never defers.
+    assert not placement.should_defer(1.0, 0.001, 0, 0)
+    # Inside the ratio bar (including exact ties): serve.
+    assert not placement.should_defer(1.0, 1.0, 0, 2)
+    assert not placement.should_defer(1.0, 0.7, 0, 2)
+    # Non-finite garbage from a poisoned model: serve.
+    assert not placement.should_defer(float("nan"), 0.1, 0, 2)
+    assert not placement.should_defer(1.0, float("nan"), 0, 2)
+    # Chain settling draws on the SAME budget.
+    assert placement.should_wait_for_parent(0, 2)
+    assert placement.should_wait_for_parent(1, 2)
+    assert not placement.should_wait_for_parent(2, 2)
+    assert not placement.should_wait_for_parent(0, 0)
+
+
+def test_knob_parsing(monkeypatch):
+    monkeypatch.delenv("DBX_PLACEMENT", raising=False)
+    assert placement.enabled()                        # default on
+    for off in ("0", "off", "FALSE"):
+        monkeypatch.setenv("DBX_PLACEMENT", off)
+        assert not placement.enabled()
+    monkeypatch.setenv("DBX_PLACEMENT", "1")
+    assert placement.enabled()
+    monkeypatch.delenv("DBX_PLACEMENT_DEFER_CAP", raising=False)
+    assert placement.defer_cap() == 2                 # default
+    monkeypatch.setenv("DBX_PLACEMENT_DEFER_CAP", "7")
+    assert placement.defer_cap() == 7
+    monkeypatch.setenv("DBX_PLACEMENT_DEFER_CAP", "-3")
+    assert placement.defer_cap() == 0                 # floored
+    monkeypatch.setenv("DBX_PLACEMENT_DEFER_CAP", "garbage")
+    assert placement.defer_cap() == 2                 # parse -> default
+
+
+# ---------------------------------------------------------------------------
+# Score table: stale/straggler score-down, cross-pin vs the shadow scorer
+# ---------------------------------------------------------------------------
+
+_D = "ab" * 32
+
+
+class _ViewFleet:
+    """Fleet stub exposing only the table builder's placement_view."""
+
+    def __init__(self, view):
+        self._view = view
+
+    def placement_view(self):
+        return self._view
+
+
+def test_table_scores_down_degraded_workers_never_excludes(monkeypatch):
+    """A stale+straggling worker is penalized multiplicatively (loses
+    ties and close calls) but stays in the candidate set — it still wins
+    when it is the ONLY holder of the state (the liveness rule)."""
+    monkeypatch.setenv("DBX_DECISIONS_H2D_GBPS", "0.000001")  # 1 KB/s
+    plane = dec_mod.DecisionPlane(
+        fleet=_ViewFleet({
+            "degraded": {"stale": True, "stragglers": ("execute",),
+                         "resident": [_D[:12]]},
+            "clean": {},
+        }),
+        registry=obs_mod.Registry())
+    try:
+        table = plane.refresh_placement_table()
+        assert set(table.workers) == {"clean", "degraded"}
+        pen = table.workers["degraded"]["penalty"]
+        assert pen == dec_mod.STALE_PENALTY * dec_mod.STRAGGLER_PENALTY
+        # An append job whose base only the degraded worker holds: the
+        # carry-hit + residency terms dwarf the 8x penalty — degraded
+        # wins anyway (scored down, never excluded).
+        ctx = {"units": 1000.0, "family": "sma_crossover", "digest": "",
+               "base_digest": _D, "panel_b": 100_000, "frac": 0.01,
+               "rate": dec_mod.h2d_rate_bps(),
+               "cold": dec_mod.compile_wall_s()}
+        mine, best_wid, best = table.rank(ctx, "clean")
+        assert best_wid == "degraded"
+        assert best["carry_hit"] and best["penalty"] == pen
+        assert mine["transfer_s"] > 0.0 and best["transfer_s"] == 0.0
+        # A plain job held nowhere: the penalty makes degraded LOSE the
+        # otherwise-tied rank.
+        plain = dict(ctx, base_digest="", frac=1.0)
+        _, best_wid2, _ = table.rank(plain, "clean")
+        assert best_wid2 == "clean"
+    finally:
+        plane.close()
+
+
+def test_cross_pin_live_table_and_shadow_score_identically():
+    """THE single-op-model rule: for the same (job, worker-state) pins
+    the live table's score and the shadow scorer's per-candidate cost
+    are the same numbers — one ``placement_cost`` implementation, no
+    drift between the policy that routes and the regret that audits."""
+    blob = b"\0" * 40 * 512                    # 512 "bars" at ~40 B/bar
+    delivered = {"fast": {_D}}
+    plane = dec_mod.DecisionPlane(fleet=None, registry=obs_mod.Registry())
+    try:
+        plane.attach_placement(lambda: delivered)
+        # Calibrate one completion on ``fast`` so both sides price with
+        # measured spu and real family warmth (any_warmth semantics).
+        plane.submit([{
+            "jid": "cal", "trace_id": "cal", "worker": "fast",
+            "tenant": "default", "strategy": "sma_crossover",
+            "combos": 4.0, "affinity_skips": 0, "wfq": None,
+            "digest": _D, "panel_b": len(blob), "append_parent": "",
+            "base_len": 0, "bars": len(blob) // 40, "t_take": 1.0,
+            "route": "digest_only"}])
+        plane.observe_completion("fast", "cal", elapsed_s=0.5)
+        assert plane.flush()
+
+        rec = JobRecord(id="x1", strategy="sma_crossover", grid=GRID,
+                        ohlcv=blob, panel_digest=_D)
+        ctx = dec_mod.placement_ctx(rec)
+        table = plane.refresh_placement_table()
+
+        plane.submit([{
+            "jid": "x1", "trace_id": "x1", "worker": "slow",
+            "tenant": "default", "strategy": "sma_crossover",
+            "combos": float(rec.combos), "affinity_skips": 0,
+            "wfq": None, "digest": _D, "panel_b": len(blob),
+            "append_parent": "", "base_len": 0,
+            "bars": len(blob) // 40, "t_take": 2.0, "route": "full"}])
+        assert plane.flush()
+        shadow = plane.recent()[-1]["shadow"]
+        assert shadow["candidates"] == 2
+        for wid in ("fast", "slow"):
+            live = table.score(ctx, wid)
+            for k in ("cost_s", "exec_s", "transfer_s", "compile_s"):
+                assert shadow["costs"][wid][k] == pytest.approx(
+                    live[k], rel=1e-9, abs=1e-12), (wid, k)
+        # And the pins mean what they should: the delivered-set holder
+        # skips the transfer, the uncalibrated worker pays the cold wall.
+        assert table.score(ctx, "fast")["transfer_s"] == 0.0
+        assert table.score(ctx, "slow")["transfer_s"] > 0.0
+        assert table.score(ctx, "slow")["compile_s"] > 0.0
+    finally:
+        plane.close()
+
+
+# ---------------------------------------------------------------------------
+# Dispatcher-level: deferral to the holder, cap exhaustion, chain settling
+# ---------------------------------------------------------------------------
+
+def _chain_blobs(n0=128, dt=8, seed=50):
+    full = data.synthetic_ohlcv(1, n0 + dt, seed=seed)
+
+    def cut(lo, hi):
+        return data.to_wire_bytes(
+            type(full)(*(np.asarray(f[0, lo:hi]) for f in full)))
+
+    return cut(0, n0), cut(n0, n0 + dt), cut(0, n0 + dt)
+
+
+def _poll(disp, wid, n=4):
+    """One direct RequestJobs poll with a deterministic table refresh
+    (tests never race the decision plane's 50 ms daemon tick)."""
+    disp.decisions.refresh_placement_table()
+    return list(disp.RequestJobs(pb.JobsRequest(
+        worker_id=wid, chips=1, jobs_per_chip=n,
+        accepts_digest_only=True), None).jobs)
+
+
+def _complete(disp, wid, jids):
+    disp.CompleteJobs(pb.CompleteBatch(
+        worker_id=wid, items=[pb.CompleteItem(id=j) for j in jids]), None)
+
+
+def test_defers_to_carry_holder_then_caps_work_conserving():
+    """A single live non-holder is deferred exactly defer_cap() polls
+    for the (silent) carry holder, then served in full — work conserving
+    with `drained` never flickering while the job is held. The decision
+    record carries the placement verdict (outcome=cap, defers==cap) and
+    dbxwhy renders it."""
+    base_blob, delta_blob, _ = _chain_blobs(seed=51)
+    q = JobQueue()
+    q.enqueue(JobRecord(id="base", strategy="sma_crossover", grid=GRID,
+                        ohlcv=base_blob))
+    disp = Dispatcher(q, PeerRegistry(prune_window_s=60.0))
+    reg = obs_mod.get_registry()
+    c0 = {o: reg.counter("dbx_placement_total", outcome=o).value
+          for o in ("served", "deferred", "cap")}
+    try:
+        (bjob,) = _poll(disp, "holder")
+        assert bjob.id == "base" and bjob.ohlcv
+        _complete(disp, "holder", ["base"])
+        arec, outcome, _, _ = q.append_bars(
+            q._records["base"].panel_digest, 128, delta_blob,
+            strategy="sma_crossover", grid=GRID)
+        assert outcome == "extended"
+
+        cap = placement.defer_cap()
+        for i in range(cap):
+            assert _poll(disp, "other") == []   # held for the holder
+            assert not q.drained                # never flickers
+            assert q._records[arec.id].affinity_skips == i + 1
+        got = _poll(disp, "other")
+        assert [j.id for j in got] == [arec.id]
+        assert got[0].ohlcv                     # non-holder: full bytes
+        _complete(disp, "other", [arec.id])
+        assert q.drained
+
+        c1 = {o: reg.counter("dbx_placement_total", outcome=o).value
+              for o in ("served", "deferred", "cap")}
+        assert c1["deferred"] - c0["deferred"] == cap
+        assert c1["cap"] - c0["cap"] == 1
+
+        disp.decisions.flush(timeout=10.0)
+        rec = next(r for r in disp.decisions.recent()
+                   if r["jid"] == arec.id)
+        pl = rec["placement"]
+        assert pl["outcome"] == "cap" and pl["defers"] == cap
+        assert pl["best"] == "holder" and pl["live"] is True
+        text = why.render_decision(rec, 0, 1)
+        assert "placement: outcome=cap" in text
+        assert "best-placed was holder" in text
+        assert f"defers={cap}/{cap}" in text
+    finally:
+        disp.close()
+
+
+def test_chain_settling_defers_until_parent_dispatches():
+    """An append link popped BEFORE its parent job has dispatched has no
+    carry holder anywhere (equal scores — the ratio bar can never fire):
+    the chain-settling rule holds it, the parent dispatches first, and
+    the next poll routes the link delta-only to the parent's worker."""
+    base_blob, delta_blob, ext_blob = _chain_blobs(seed=52)
+    base_d = panel_store.panel_digest(base_blob)
+    q = JobQueue()
+    # Adversarial intake order: the child lands AHEAD of its parent.
+    q.enqueue(JobRecord(id="child", strategy="sma_crossover", grid=GRID,
+                        ohlcv=ext_blob, append_parent=base_d,
+                        append_base_len=128, delta=delta_blob))
+    q.enqueue(JobRecord(id="parent", strategy="sma_crossover", grid=GRID,
+                        ohlcv=base_blob))
+    disp = Dispatcher(q, PeerRegistry(prune_window_s=60.0))
+    try:
+        # Arm the table with the poller (no deliveries yet): the gate
+        # only runs with a live table.
+        with disp._delivered_lock:
+            disp._delivered.setdefault("w1", set())
+        got = _poll(disp, "w1", n=1)
+        # The child was popped first (FIFO), held for its parent; the
+        # SAME take then served the parent — no wasted poll.
+        assert [j.id for j in got] == ["parent"]
+        assert q._records["child"].affinity_skips == 1
+        # Parent settled and held by w1 now: the child follows it,
+        # delta-only (w1 holds the base).
+        got2 = _poll(disp, "w1", n=1)
+        assert [j.id for j in got2] == ["child"]
+        assert got2[0].ohlcv == b"" and got2[0].append_delta
+        _complete(disp, "w1", ["parent", "child"])
+        assert q.drained
+    finally:
+        disp.close()
+
+
+def test_same_poll_parent_then_child_needs_no_deferral():
+    """A parent served earlier in the SAME poll counts as settled: a
+    chain enqueued in order rides one batch with zero deferrals (the
+    gate's served-digest grace, not the pending-refcount — that only
+    drops at commit, after the admit loop)."""
+    base_blob, delta_blob, ext_blob = _chain_blobs(seed=53)
+    base_d = panel_store.panel_digest(base_blob)
+    q = JobQueue()
+    q.enqueue(JobRecord(id="parent", strategy="sma_crossover", grid=GRID,
+                        ohlcv=base_blob))
+    q.enqueue(JobRecord(id="child", strategy="sma_crossover", grid=GRID,
+                        ohlcv=ext_blob, append_parent=base_d,
+                        append_base_len=128, delta=delta_blob))
+    disp = Dispatcher(q, PeerRegistry(prune_window_s=60.0))
+    try:
+        with disp._delivered_lock:
+            disp._delivered.setdefault("w1", set())
+        got = _poll(disp, "w1", n=4)
+        assert [j.id for j in got] == ["parent", "child"]
+        assert q._records["child"].affinity_skips == 0
+        _complete(disp, "w1", ["parent", "child"])
+        assert q.drained
+    finally:
+        disp.close()
+
+
+# ---------------------------------------------------------------------------
+# Degradation ladder: kill switch and empty fleet view are pure WFQ
+# ---------------------------------------------------------------------------
+
+def _tenant_recs(prefix=""):
+    """The round-9 whale-vs-smalls adversarial intake, fresh records."""
+    recs = []
+    for i in range(6):
+        recs.append(JobRecord(
+            id=f"{prefix}whale-{i}", strategy="sma_crossover",
+            grid={"fast": np.arange(32.0, dtype=np.float32) + 5.0},
+            ohlcv=b"W-payload-%02d" % i, tenant="whale"))
+    for t in ("small_a", "small_b"):
+        for i in range(4):
+            recs.append(JobRecord(
+                id=f"{prefix}{t}-{i}", strategy="sma_crossover",
+                grid={"fast": np.arange(4.0, dtype=np.float32) + 5.0},
+                ohlcv=f"{t}-payload-{i}".encode(), tenant=t))
+    return recs
+
+
+def _drain_order(disp, q, wid, n=4, max_polls=200):
+    order = []
+    for _ in range(max_polls):
+        order.extend(j.id for j in _poll(disp, wid, n=n))
+        if len(order) == len(q._records):
+            return order
+    raise AssertionError(f"drain wedged after {max_polls} polls: {order}")
+
+
+def test_kill_switch_and_empty_view_are_pure_wfq_bit_identical(
+        monkeypatch):
+    """The degradation ladder's floor, pinned: DBX_PLACEMENT=0 (with a
+    live, biased table!) and placement-on-but-empty-fleet both serve the
+    EXACT round-19 WFQ order — and a raw queue with no dispatcher at all
+    agrees. affinity_skips stays untouched on the kill-switch path."""
+    # Rung 0: the raw queue's WFQ order (round-19 behavior).
+    q0 = JobQueue()
+    for r in _tenant_recs():
+        q0.enqueue(r)
+    want = [r.id for r, _ in q0.take(14, "w2")]
+
+    # Rung 1: kill switch down, despite a table biased toward w1.
+    monkeypatch.setenv("DBX_PLACEMENT", "0")
+    monkeypatch.setenv("DBX_DECISIONS_H2D_GBPS", "0.000001")
+    q1 = JobQueue()
+    recs = _tenant_recs()
+    for r in recs:
+        q1.enqueue(r)
+    disp1 = Dispatcher(q1, PeerRegistry(prune_window_s=60.0))
+    try:
+        with disp1._delivered_lock:
+            disp1._delivered["w1"] = {r.panel_digest for r in recs}
+        assert _drain_order(disp1, q1, "w2", n=14) == want
+        assert all(r.affinity_skips == 0 for r in q1._records.values())
+    finally:
+        disp1.close()
+
+    # Rung 2: placement on, but nothing to score with (no frames, no
+    # deliveries -> no table): same order again.
+    monkeypatch.setenv("DBX_PLACEMENT", "1")
+    q2 = JobQueue()
+    for r in _tenant_recs():
+        q2.enqueue(r)
+    disp2 = Dispatcher(q2, PeerRegistry(prune_window_s=60.0))
+    try:
+        assert _drain_order(disp2, q2, "w2", n=14) == want
+    finally:
+        disp2.close()
+
+    # Rung 3: a biased table EXISTS but has aged past TABLE_MAX_AGE_S
+    # (wedged scorer thread): the take path refuses it — same order,
+    # no deferrals. Polls go direct (no refresh), unlike _poll().
+    q3 = JobQueue()
+    recs3 = _tenant_recs()
+    for r in recs3:
+        q3.enqueue(r)
+    disp3 = Dispatcher(q3, PeerRegistry(prune_window_s=60.0))
+    try:
+        with disp3._delivered_lock:
+            disp3._delivered["w1"] = {r.panel_digest for r in recs3}
+        table = disp3.decisions.refresh_placement_table()
+        table.built_s -= 10.0 * dec_mod.DecisionPlane.TABLE_MAX_AGE_S
+        got = [j.id for j in disp3.RequestJobs(pb.JobsRequest(
+            worker_id="w2", chips=1, jobs_per_chip=14,
+            accepts_digest_only=True), None).jobs]
+        assert got == want
+        assert all(r.affinity_skips == 0 for r in q3._records.values())
+    finally:
+        disp3.close()
+
+
+def test_whale_fairness_survives_live_placement(monkeypatch):
+    """PR-8's fairness bar under the round-20 stage: with every whale
+    panel resident on a worker that never polls, the whale's jobs burn
+    their full deferral budget — yet the polling worker still drains
+    everything (work conservation) and the small tenants' mean serve
+    position inflates by well under 2x vs the locality-blind order."""
+    monkeypatch.setenv("DBX_DECISIONS_H2D_GBPS", "0.000001")  # 1 KB/s
+
+    def positions(order):
+        out = {}
+        for t in ("whale", "small_a", "small_b"):
+            idx = [i for i, j in enumerate(order) if j.startswith(t)]
+            out[t] = sum(idx) / len(idx)
+        return out
+
+    # Blind arm.
+    monkeypatch.setenv("DBX_PLACEMENT", "0")
+    qa = JobQueue()
+    for r in _tenant_recs():
+        qa.enqueue(r)
+    da = Dispatcher(qa, PeerRegistry(prune_window_s=60.0))
+    try:
+        pos_blind = positions(_drain_order(da, qa, "w2"))
+    finally:
+        da.close()
+
+    # Live arm: w1 holds every whale panel but never polls.
+    monkeypatch.setenv("DBX_PLACEMENT", "1")
+    qb = JobQueue()
+    recs = _tenant_recs()
+    for r in recs:
+        qb.enqueue(r)
+    db = Dispatcher(qb, PeerRegistry(prune_window_s=60.0))
+    try:
+        with db._delivered_lock:
+            db._delivered["w1"] = {
+                r.panel_digest for r in recs if r.tenant == "whale"}
+        order = _drain_order(db, qb, "w2")
+        assert len(order) == len(recs)          # work conserving
+        cap = placement.defer_cap()
+        assert all(r.affinity_skips <= cap for r in qb._records.values())
+        pos_live = positions(order)
+        for t in ("small_a", "small_b"):
+            assert pos_live[t] <= 2.0 * max(pos_blind[t], 1.0), (
+                t, pos_live, pos_blind)
+    finally:
+        db.close()
+
+
+# ---------------------------------------------------------------------------
+# Restart: placement state is NOT journaled
+# ---------------------------------------------------------------------------
+
+def test_restart_restarts_placement_state_cold(tmp_path):
+    """affinity_skips and the pending-digest refcounts die with the
+    process: a journal-replayed queue restores every pending job with a
+    zero deferral budget spent and refcounts rebuilt purely from the
+    replayed intake — locality evidence is never trusted across a
+    restart."""
+    base_blob, delta_blob, ext_blob = _chain_blobs(seed=54)
+    base_d = panel_store.panel_digest(base_blob)
+    jp = str(tmp_path / "j.jsonl")
+    q = JobQueue(Journal(jp))
+    q.enqueue(JobRecord(id="parent", strategy="sma_crossover", grid=GRID,
+                        ohlcv=base_blob))
+    q.enqueue(JobRecord(id="child", strategy="sma_crossover", grid=GRID,
+                        ohlcv=ext_blob, append_parent=base_d,
+                        append_base_len=128, delta=delta_blob))
+    ext_d = q._records["child"].panel_digest
+    assert q._pending_digests == {base_d: 1, ext_d: 1}
+
+    # Burn deferral budget (a deny-all admit is the placement hook's
+    # worst case), then serve the parent so the refcounts diverge.
+    def deny(r):
+        r.affinity_skips += 1
+        return False
+
+    assert q.take(2, "w1", admit=deny) == []
+    got = q.take(1, "w1", admit=lambda r: r.id == "parent")
+    assert [r.id for r, _ in got] == ["parent"]
+    assert q._pending_digests == {ext_d: 1}
+    assert q._records["child"].affinity_skips >= 1
+
+    q2 = JobQueue()
+    assert q2.restore(jp) == 2     # parent never completed: replayed too
+    assert all(r.affinity_skips == 0 for r in q2._records.values())
+    assert q2._pending_digests == {base_d: 1, ext_d: 1}
+    # And the restored queue serves everything.
+    assert {r.id for r, _ in q2.take(4, "w2")} == {"parent", "child"}

@@ -1741,14 +1741,18 @@ def test_append_bars_reject_outcomes():
 
 
 def test_append_affinity_routes_to_base_holder(tmp_path):
-    """RequestJobs affinity: an append job is deferred (once) from a
-    worker that does NOT hold the base while another live worker does;
-    the holder then receives it delta-only (empty ohlcv + append_delta).
-    The deferral is bounded — a second poll from the non-holder would be
-    served the job in full."""
+    """RequestJobs placement (round 20, generalizing the round-6
+    append-affinity hook): an append job is deferred from a worker that
+    does NOT hold the base while the score table ranks the base holder
+    better; the holder then receives it delta-only (empty ohlcv +
+    append_delta). The deferral is bounded — with the holder gone
+    silent the non-holder is served the job in full once the
+    DBX_PLACEMENT_DEFER_CAP budget is spent."""
     import grpc
 
     from distributed_backtesting_exploration_tpu.rpc import service
+    from distributed_backtesting_exploration_tpu.sched import (
+        placement as sched_placement)
 
     _, rec, cut = _stream_setup(seed=45)
     queue = JobQueue()
@@ -1760,12 +1764,16 @@ def test_append_affinity_routes_to_base_holder(tmp_path):
     stub = service.DispatcherStub(channel)
     try:
         def poll(worker):
+            # The live table normally refreshes on the decision plane's
+            # 50ms daemon tick; rebuild it synchronously here so the
+            # test never races the daemon.
+            disp.decisions.refresh_placement_table()
             return list(stub.RequestJobs(pb.JobsRequest(
                 worker_id=worker, chips=1, jobs_per_chip=4,
                 accepts_digest_only=True)).jobs)
 
         # holder takes (and completes) the base job: its delivered set
-        # now contains the base digest.
+        # now contains the base digest — the table's ground truth.
         base_jobs = poll("holder")
         assert len(base_jobs) == 1 and base_jobs[0].ohlcv
         disp.CompleteJobs(pb.CompleteBatch(
@@ -1775,7 +1783,7 @@ def test_append_affinity_routes_to_base_holder(tmp_path):
         r = _append(stub, rec.panel_digest, 128, cut(128, 144))
         assert r.ok
         # The non-holder polls first: the append job is deferred to give
-        # the base holder first claim.
+        # the base holder (carry-store hit, no h2d) first claim.
         assert poll("other") == []
         got = poll("holder")
         assert len(got) == 1
@@ -1788,10 +1796,12 @@ def test_append_affinity_routes_to_base_holder(tmp_path):
             items=[pb.CompleteItem(id=job.id)]), None)
 
         # Bounded deferral: with the holder gone silent, a SECOND append
-        # reaches the non-holder on its second poll, full bytes.
+        # reaches the non-holder in full bytes after exactly
+        # defer_cap() deferred polls — work-conserving by construction.
         r2 = _append(stub, r.panel_digest, 144, cut(144, 160))
         assert r2.ok
-        assert poll("other") == []            # deferred once
+        for _ in range(sched_placement.defer_cap()):
+            assert poll("other") == []        # budget burning down
         job2 = poll("other")
         assert len(job2) == 1 and job2[0].ohlcv   # then served, in full
     finally:

@@ -1364,14 +1364,16 @@ def test_append_bars_base_gone_is_explicit_reject(qfactory):
 
 
 def test_take_admit_defers_then_serves(qfactory):
-    """The affinity hook's contract: a rejected append job is held OUT of
-    the batch (and the FIFO) for that call, re-queued afterwards, and an
-    admit that keeps rejecting cannot lose the job — while ordinary jobs
-    are never consulted."""
+    """The placement hook's contract (round 20, generalizing the old
+    append-only affinity hook): EVERY popped record is consulted — the
+    placement stage ranks ordinary jobs too — a rejected job is held OUT
+    of the batch (and the FIFO) for that call, re-queued front-of-line
+    afterwards, and an admit that keeps rejecting cannot lose a job.
+    ``drained`` must stay False while anything is held."""
     rec, cut = _stream_base(seed=23)
     q = qfactory(None)
     q.enqueue(rec)
-    _, outcome, ndig, _ = q.append_bars(
+    arec, outcome, ndig, _ = q.append_bars(
         rec.panel_digest, 64, cut(64, 72), strategy="sma_crossover",
         grid=rec.grid)
     assert outcome == "extended"
@@ -1384,14 +1386,18 @@ def test_take_admit_defers_then_serves(qfactory):
         return False
 
     got = q.take(4, "w", admit=deny)
-    # The ordinary base job is served without consulting admit; the
-    # append job was deferred.
-    assert [r.id for r, _ in got] == [rec.id]
-    assert len(consulted) == 1
-    # Deferred, not lost: a later take (any admit verdict) serves it.
+    # Both jobs consulted, both deferred — nothing served this call.
+    assert got == []
+    assert sorted(consulted) == sorted([rec.id, arec.id])
+    # Held jobs still count as in-take: an observer must not tear the
+    # dispatcher down while placement holds the whole queue.
+    assert not q.drained
+    # Deferred, not lost: a later take (any admit verdict) serves both,
+    # the held pair first in line.
     got2 = q.take(4, "w", admit=lambda r: True)
-    assert len(got2) == 1 and got2[0][0].panel_digest == ndig
-    q.complete_batch([rec.id, got2[0][0].id], "w")
+    assert {r.id for r, _ in got2} == {rec.id, arec.id}
+    assert ndig in {r.panel_digest for r, _ in got2}
+    q.complete_batch([r.id for r, _ in got2], "w")
     assert q.drained
 
 
